@@ -1,0 +1,72 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's capability
+surface, built from scratch on JAX/XLA/Pallas/pjit.
+
+Top-level namespace parity target: ``python/paddle/__init__.py`` in the reference.
+Heavy submodules (nn, optimizer, distributed, vision, ...) load lazily via PEP 562.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod
+from .flags import get_flags, set_flags
+from .core.dtype import (bfloat16, bool_ as bool8, canonical_dtype, complex64,
+                         complex128, dtype, finfo, float16, float32, float64,
+                         get_default_dtype, iinfo, int8, int16, int32, int64,
+                         promote_types, set_default_dtype, uint8)
+from .core.place import (CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+                         get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+                         is_compiled_with_xpu, set_device)
+from .core.tensor import Parameter, Tensor, to_tensor
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.dispatch import OP_REGISTRY
+from .ops import *  # noqa: F401,F403 — the tensor op surface
+from .ops import __all__ as _ops_all
+from .ops import seed  # override any collision: paddle.seed is the RNG seed
+
+_LAZY_SUBMODULES = (
+    "nn", "optimizer", "io", "jit", "distributed", "amp", "vision", "metric",
+    "hapi", "device", "profiler", "static", "autograd", "framework", "linalg",
+    "fft", "sparse", "distribution", "incubate", "text", "audio", "callbacks",
+    "kernels", "regularizer", "utils", "version",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    # paddle.save/load live in framework.io
+    if name in ("save", "load"):
+        mod = importlib.import_module(".framework.io", __name__)
+        fn = getattr(mod, name)
+        globals()[name] = fn
+        return fn
+    if name == "summary":
+        from .hapi import summary as fn
+        globals()[name] = fn
+        return fn
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel as cls
+        globals()[name] = cls
+        return cls
+    if name == "disable_static":
+        return lambda *a, **k: None
+    if name == "enable_static":
+        from .static import enable_static as fn
+        return fn
+    if name == "in_dynamic_mode":
+        return lambda: True
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+# numpy-style dtype aliases used throughout reference scripts
+bool = bool8  # noqa: A001

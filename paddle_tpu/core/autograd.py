@@ -1,0 +1,358 @@
+"""Tape autograd for the imperative API.
+
+Parity target: Paddle's eager autograd engine (reference: ``paddle/fluid/eager/
+backward.cc`` ``egr::Backward``, ``grad_node_info.h`` ``GradNodeBase``,
+``autograd_meta.h``, ``tensor_wrapper.h``) — dependency-counted reverse traversal over a
+grad-node graph recorded during eager forward, with leaf accumulation and hooks.
+
+TPU-native redesign: every grad node's backward function is the ``jax.vjp`` closure of
+the op's pure-jax forward, captured at record time. Because ``jax.Array`` is immutable,
+Paddle's ``TensorWrapper`` inplace-version checks are unnecessary — a vjp closure can
+never observe a later in-place mutation (our in-place ops rebind ``Tensor._value`` to a
+new array). Double grad (``create_graph=True``) re-executes a node's forward under
+``jax.vjp`` *through the dispatcher*, so the grad-of-grad graph is recorded on the same
+tape. The same code path runs under a ``jax.jit`` trace (values become tracers), which is
+how ``jit.to_static`` compiles whole training steps containing ``loss.backward()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradNode", "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+           "backward", "grad"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _state.enabled = bool(mode)
+
+
+class _GradModeCtx:
+    """Context manager *and* decorator, usable bare (``@no_grad``) or called
+    (``with no_grad():``) — matching paddle.no_grad's dual use."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._saved: List[bool] = []
+
+    def __enter__(self):
+        self._saved.append(_state.enabled)
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._saved.pop()
+        return False
+
+    def __call__(self, fn=None):
+        if fn is None:
+            return _GradModeCtx(self._mode)
+        import functools
+
+        mode = self._mode
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeCtx(mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class _NoGrad(_GradModeCtx):
+    def __init__(self):
+        super().__init__(False)
+
+
+class _EnableGrad(_GradModeCtx):
+    def __init__(self):
+        super().__init__(True)
+
+
+no_grad = _NoGrad()
+enable_grad = _EnableGrad()
+
+
+class Edge:
+    """A snapshotted producer edge for one differentiable op input.
+
+    Captured at record time (not resolved lazily) so that later in-place rebinding of
+    the input Tensor's ``_grad_node`` cannot corrupt the recorded graph — this replaces
+    Paddle's ``TensorWrapper`` inplace-version check.
+    """
+
+    __slots__ = ("node", "index", "tensor")
+
+    def __init__(self, node, index, tensor):
+        self.node = node      # producer GradNode, or None for a leaf
+        self.index = index    # output index on the producer
+        self.tensor = tensor  # live Tensor (for hooks / leaf .grad accumulation)
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents for the differentiable
+    inputs. ``inputs`` is the list of :class:`Edge` for those inputs.
+    ``replay`` holds (pure_fn, input_edges, diff_indices, const_vals) for create_graph.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs", "hooks",
+                 "replay", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence["Edge"],
+                 out_avals: Sequence[Tuple[tuple, Any]], replay=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_avals = list(out_avals)  # [(shape, dtype), ...]
+        self.n_outputs = len(out_avals)
+        self.hooks: Dict[int, List[Callable]] = {}
+        self.replay = replay
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={self.n_outputs}>"
+
+
+def _topo_order(root: GradNode) -> List[GradNode]:
+    """Post-order DFS (iterative) over the node graph from root."""
+    order: List[GradNode] = []
+    seen = set()
+    stack: List[Tuple[GradNode, bool]] = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for e in node.inputs:
+            if e.node is not None and id(e.node) not in seen:
+                stack.append((e.node, False))
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False, _leaf_filter=None) -> None:
+    """Run reverse accumulation from ``tensors`` into leaf ``.grad`` slots.
+
+    Mirrors ``egr::Backward``: seeds default to ones for scalar outputs, dependency
+    counting is implicit in the topological order, multi-consumer grads are summed,
+    tensor hooks fire as the cotangent passes the tensor.
+
+    With ``create_graph=True`` the cotangents are carried as tape-tracked Tensors and
+    every vjp application is re-recorded through the dispatcher, so the grad-of-grad
+    graph is differentiable (Paddle double-grad parity).
+    """
+    from .tensor import Tensor, _wrap_value  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    def lift(raw):
+        # cotangent representation: Tensor when create_graph, raw jax value otherwise
+        if create_graph:
+            return raw if isinstance(raw, Tensor) else _wrap_value(raw, stop_gradient=False)
+        return raw._value if isinstance(raw, Tensor) else raw
+
+    def unlift(c):
+        return c._value if isinstance(c, Tensor) else c
+
+    def acc(slot, value):
+        if slot is None:
+            return value
+        return slot + value  # Tensor + Tensor records an add op under create_graph
+
+    # cotangent store: id(node) -> [cot or None per output]
+    cots: Dict[int, List[Any]] = {}
+    roots: List[GradNode] = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                f"backward() called on a tensor with stop_gradient=True: {t!r}")
+        seed = g if g is not None else None
+        if seed is None:
+            if t._value.size != 1:
+                raise RuntimeError("grad must be provided for non-scalar backward()")
+            seed = jnp.ones_like(t._value)
+        seed = lift(seed)
+        node = t._grad_node
+        if node is None:
+            # loss is itself a leaf
+            t._accumulate_grad(seed)
+            continue
+        slot = cots.setdefault(id(node), [None] * node.n_outputs)
+        slot[t._node_index] = acc(slot[t._node_index], seed)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    # Build one merged topological order over all roots.
+    merged_order: List[GradNode] = []
+    seen = set()
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                merged_order.append(n)
+    # merged_order is post-order (inputs before outputs); process in reverse.
+    from .. import flags as _flags
+
+    for node in reversed(merged_order):
+        slot = cots.get(id(node))
+        if slot is None:
+            continue  # no cotangent reached this node
+        out_cots = []
+        for i, c in enumerate(slot):
+            if c is None:
+                shape, dt = node.out_avals[i]
+                c = lift(jnp.zeros(shape, dt))
+            for h in node.hooks.get(i, ()):
+                r = h(c if isinstance(c, Tensor) else _wrap_value(c))
+                if r is not None:
+                    c = lift(r)
+            out_cots.append(c)
+
+        if create_graph and node.replay is not None:
+            in_cots = _replay_vjp(node, out_cots)
+        else:
+            raw = tuple(unlift(c) for c in out_cots)
+            in_cots = node.vjp_fn(raw if node.n_outputs > 1 else raw[0])
+            if _flags.flag("FLAGS_check_nan_inf"):
+                _check_nan_inf(node.name + "_grad", in_cots)
+
+        for e, c in zip(node.inputs, in_cots):
+            if c is None:
+                continue
+            t = e.tensor
+            c = lift(c) if create_graph else c
+            for h in t._hooks:
+                r = h(c if isinstance(c, Tensor) else _wrap_value(c))
+                if r is not None:
+                    c = lift(r)
+            if e.node is None:
+                if not t.stop_gradient and (_leaf_filter is None or id(t) in _leaf_filter):
+                    t._accumulate_grad(c)
+            else:
+                pslot = cots.setdefault(id(e.node), [None] * e.node.n_outputs)
+                pslot[e.index] = acc(pslot[e.index], c)
+                if not t.stop_gradient and (t._retain_grads or
+                                            _flags.flag("FLAGS_retain_grad_for_all_tensor")):
+                    t._accumulate_grad(c)
+
+    if not retain_graph and not create_graph:
+        for n in merged_order:
+            n.vjp_fn = _freed_vjp
+            n.replay = None
+
+
+def _freed_vjp(*_a, **_k):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time: the saved intermediate "
+        "results have been freed. Pass retain_graph=True to backward().")
+
+
+def _replay_vjp(node: GradNode, out_cot_tensors):
+    """Re-execute the node's vjp *through the dispatcher* so that grad-of-grad is
+    itself recorded on the tape (supports double grad). Returns Tensors."""
+    from .dispatch import forward_op
+
+    pure_fn, in_edges, diff_idx, const_vals = node.replay
+    in_tensors = [e.tensor for e in in_edges]
+    n_in = len(in_tensors)
+
+    def grad_fn(*vals):
+        ins, cot_vals = vals[:n_in], vals[n_in:]
+        full = list(const_vals)
+        for i, v in zip(diff_idx, ins):
+            full[i] = v
+
+        def diff_only(*dv):
+            f2 = list(full)
+            for i, v in zip(diff_idx, dv):
+                f2[i] = v
+            return pure_fn(*f2)
+
+        _, vjp_fn = jax.vjp(diff_only, *ins)
+        return vjp_fn(tuple(cot_vals) if len(cot_vals) > 1 else cot_vals[0])
+
+    outs = forward_op(node.name + "_grad", grad_fn,
+                      list(in_tensors) + list(out_cot_tensors), {})
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+def _check_nan_inf(name: str, values):
+    """Eager NaN/Inf scan (ref: FLAGS_check_nan_inf, nan_inf_utils_detail)."""
+    for v in values if isinstance(values, (tuple, list)) else (values,):
+        if v is None or not hasattr(v, "dtype"):
+            continue
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            try:
+                bad = bool(jnp.any(~jnp.isfinite(v)))
+            except jax.errors.TracerBoolConversionError:
+                return  # under trace: skip (jit path uses jax.debug_nans instead)
+            if bad:
+                raise FloatingPointError(f"NaN/Inf detected in output of op {name!r}")
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """Functional gradient API (``paddle.grad`` parity).
+
+    Computes grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad`` slots.
+    """
+    from .tensor import Tensor, _wrap_value
+
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+
+    # Temporarily swap in fresh grad accumulators on the inputs.
+    saved = [(t.grad, t._retain_grads, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+        t.stop_gradient = False
+    try:
+        backward(outputs, grad_outputs, retain_graph=bool(retain_graph) or create_graph,
+                 create_graph=create_graph,
+                 _leaf_filter={id(t) for t in inputs} if only_inputs else None)
+        results = []
+        for t, (old, _, _) in zip(inputs, saved):
+            g = t.grad
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    f"One of the differentiated tensors ({t.name}) appears unused in "
+                    "the graph; pass allow_unused=True to return None for it.")
+            results.append(g)
+    finally:
+        for t, (old, retain, stop) in zip(inputs, saved):
+            t.grad = old
+            t._retain_grads = retain
+            t.stop_gradient = stop
+    return results[0] if single_in else results

@@ -1,0 +1,119 @@
+"""Eager op dispatch.
+
+Parity target: the generated eager hot path in Paddle (reference call chain:
+pybind eager op function -> ``*_ad_func`` (``paddle/fluid/eager/api/generated/``) ->
+``paddle::experimental::*`` (``paddle/phi/api/lib/``) -> ``KernelFactory::SelectKernel``
+-> phi kernel). Here the whole chain collapses to: read ``Tensor._value`` -> run the
+op's pure-jax function (XLA dispatch) -> wrap outputs -> record one ``GradNode`` whose
+backward is the ``jax.vjp`` closure. There is no kernel registry keyed by
+(backend, layout, dtype) because XLA owns kernel selection; the op *schema* registry
+(`OP_REGISTRY`) is the single source of truth in the spirit of Paddle's
+``paddle/phi/api/yaml/ops.yaml``.
+
+The same dispatcher runs unmodified under a ``jax.jit`` trace: values become tracers,
+the tape records tracer-valued vjp closures, and ``backward()`` inside the trace emits
+the grad computation into the compiled program (this is how ``jit.to_static`` compiles
+imperative training steps).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd import Edge, GradNode
+
+__all__ = ["forward_op", "register_op", "OP_REGISTRY", "OpDef"]
+
+
+@dataclass
+class OpDef:
+    """Schema entry for one op (the ops.yaml-equivalent single source of truth)."""
+    name: str
+    fn: Callable
+    doc: str = ""
+    n_outputs: int = 1
+    differentiable: bool = True
+
+
+OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable, doc: str = "", n_outputs: int = 1,
+                differentiable: bool = True) -> OpDef:
+    d = OpDef(name, fn, doc, n_outputs, differentiable)
+    OP_REGISTRY[name] = d
+    return d
+
+
+def _is_diff_dtype(v) -> bool:
+    return hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact)
+
+
+def forward_op(name: str, fn: Callable, args: Sequence[Any],
+               kwargs: Optional[dict] = None, differentiable: bool = True):
+    """Run pure-jax ``fn`` on mixed Tensor/raw ``args`` (``kwargs`` are static).
+
+    Returns Tensor (or tuple of Tensors, mirroring fn's output structure). Records a
+    GradNode iff grad mode is on and some floating Tensor input has
+    ``stop_gradient=False``.
+    """
+    from .tensor import Tensor, _wrap_value
+
+    kwargs = kwargs or {}
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    diff_idx = []
+    if differentiable and autograd.is_grad_enabled():
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor) and not a.stop_gradient and _is_diff_dtype(a._value):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out_vals = fn(*vals, **kwargs)
+        _maybe_check_nan(name, out_vals)
+        return _wrap_outputs(out_vals, None)
+
+    def diff_fn(*dvals):
+        full = list(vals)
+        for i, v in zip(diff_idx, dvals):
+            full[i] = v
+        return fn(*full, **kwargs)
+
+    out_vals, vjp_fn = jax.vjp(diff_fn, *(vals[i] for i in diff_idx))
+    _maybe_check_nan(name, out_vals)
+
+    multi = isinstance(out_vals, (tuple, list))
+    outs_seq = tuple(out_vals) if multi else (out_vals,)
+    avals = [(v.shape, v.dtype) for v in outs_seq]
+    edges = [Edge(args[i]._grad_node, args[i]._node_index, args[i]) for i in diff_idx]
+
+    def pure_fn(*full_vals):
+        return fn(*full_vals, **kwargs)
+
+    node = GradNode(name, vjp_fn, edges, avals,
+                    replay=(pure_fn, edges, diff_idx, vals))
+    return _wrap_outputs(out_vals, node)
+
+
+def _wrap_outputs(out_vals, node):
+    from .tensor import _wrap_value
+
+    stop = node is None
+    if isinstance(out_vals, (tuple, list)):
+        wrapped = tuple(
+            _wrap_value(v, stop_gradient=stop, node=node, index=i)
+            for i, v in enumerate(out_vals))
+        return wrapped
+    return _wrap_value(out_vals, stop_gradient=stop, node=node, index=0)
+
+
+def _maybe_check_nan(name, out_vals):
+    from .. import flags as _flags
+
+    if _flags.flag("FLAGS_check_nan_inf"):
+        autograd._check_nan_inf(name, out_vals)

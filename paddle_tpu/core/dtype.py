@@ -1,0 +1,102 @@
+"""Dtype system.
+
+Parity target: Paddle's ``paddle.dtype`` / ``phi::DataType`` enum (reference:
+``paddle/phi/common/data_type.h``) and the string-or-dtype-accepting Python surface.
+On TPU the canonical set maps 1:1 onto jnp dtypes; bfloat16 is first-class (MXU native).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "dtype", "float16", "bfloat16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "complex64", "complex128",
+    "canonical_dtype", "get_default_dtype", "set_default_dtype", "is_floating_point_dtype",
+    "promote_types", "finfo", "iinfo",
+]
+
+# The public dtype objects are numpy dtype instances (hashable, comparable, printable);
+# jnp accepts them everywhere.
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(jnp.bfloat16)  # ml_dtypes bfloat16
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+dtype = np.dtype  # the "type of a dtype", for isinstance checks
+
+_ALIASES = {
+    "float": float32, "double": float64, "half": float16, "bfloat16": bfloat16,
+    "bf16": bfloat16, "fp16": float16, "fp32": float32, "fp64": float64,
+    "bool": bool_, "int": int32, "long": int64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+
+
+def canonical_dtype(d) -> np.dtype:
+    """Accept str / np.dtype / jnp dtype / python type and return a canonical dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        if key in _ALIASES:
+            return _ALIASES[key]
+        if key == "bfloat16":
+            return bfloat16
+        return np.dtype(key)
+    if d is float:
+        return get_default_dtype()
+    if d is int:
+        return int64
+    if d is bool:
+        return bool_
+    try:
+        nd = np.dtype(d)
+        return nd
+    except TypeError:
+        # jnp scalar types like jnp.bfloat16
+        return np.dtype(d().dtype) if callable(d) else np.dtype(d)
+
+
+_default_dtype = float32
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = canonical_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def is_floating_point_dtype(d) -> bool:
+    return canonical_dtype(d) in _FLOATING
+
+
+def promote_types(a, b):
+    return jnp.promote_types(canonical_dtype(a), canonical_dtype(b))
+
+
+def finfo(d):
+    return jnp.finfo(canonical_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(canonical_dtype(d))

@@ -1,0 +1,421 @@
+"""The eager Tensor.
+
+Parity target: Paddle's eager ``paddle.Tensor`` (reference: pybind surface in
+``paddle/fluid/pybind/eager.cc`` / ``eager_method.cc``; autograd meta in
+``paddle/fluid/eager/autograd_meta.h``; the underlying ``phi::DenseTensor`` in
+``paddle/phi/core/dense_tensor.h``). Redesign: the storage is an immutable
+``jax.Array``; "in-place" ops rebind ``_value`` (and bump ``_version``), which is safe
+for autograd because recorded vjp closures capture the old immutable arrays
+(see core/autograd.py). Methods are monkey-patched onto this class by the op modules at
+import time, mirroring how Paddle patches ``python/paddle/tensor/*`` onto the C++
+tensor.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import canonical_dtype, get_default_dtype
+from .place import CPUPlace, Place, TPUPlace, get_jax_device
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "_wrap_value"]
+
+_name_counter = itertools.count()
+
+
+def _auto_name(prefix="generated_tensor"):
+    return f"{prefix}_{next(_name_counter)}"
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
+                 "_grad_node", "_node_index", "_hooks", "_retain_grads", "_version",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name or _auto_name()
+        self.persistable = False
+        self._grad_node: Optional[autograd.GradNode] = None
+        self._node_index = 0
+        self._hooks: List[Callable] = []
+        self._retain_grads = False
+        self._version = 0
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = list(self._value.devices())[0]
+        except Exception:
+            return CPUPlace()
+        return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from ..ops import manipulation
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from ..ops import manipulation
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return manipulation.transpose(self, perm)
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    __array__ = numpy
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return self._value.dtype.itemsize
+
+    def is_floating_point(self) -> bool:
+        return bool(jnp.issubdtype(self.dtype, jnp.floating))
+
+    def is_integer(self) -> bool:
+        return bool(jnp.issubdtype(self.dtype, jnp.integer))
+
+    def is_complex(self) -> bool:
+        return bool(jnp.issubdtype(self.dtype, jnp.complexfloating))
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def register_hook(self, hook: Callable):
+        """Hook(grad)->grad|None fires when the cotangent passes this tensor."""
+        if self._grad_node is not None:
+            self._grad_node.hooks.setdefault(self._node_index, []).append(hook)
+            node, idx = self._grad_node, self._node_index
+
+            class _Handle:
+                def remove(_h):
+                    node.hooks[idx].remove(hook)
+        else:
+            self._hooks.append(hook)
+            hooks = self._hooks
+
+            class _Handle:
+                def remove(_h):
+                    hooks.remove(hook)
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def _accumulate_grad(self, value):
+        v = value if isinstance(value, Tensor) else _wrap_value(value)
+        if self.grad is None:
+            self.grad = v
+        else:
+            self.grad = _wrap_value(self.grad._value + v._value) \
+                if self.grad._grad_node is None and v._grad_node is None else self.grad + v
+
+    def detach(self) -> "Tensor":
+        t = _wrap_value(self._value, stop_gradient=True)
+        t.name = self.name + ".detach"
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self._node_index = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .dispatch import forward_op
+        return forward_op("clone", lambda x: x + 0, [self])
+
+    # -- mutation (in-place surface; storage itself is immutable) ----------
+    def _rebind(self, new: "Tensor") -> "Tensor":
+        """Adopt another tensor's value + tape position (the in-place protocol)."""
+        self._value = new._value
+        self._grad_node = new._grad_node
+        self._node_index = new._node_index
+        self._version += 1
+        return self
+
+    @property
+    def inplace_version(self) -> int:
+        return self._version
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        other = other if isinstance(other, Tensor) else to_tensor(other)
+        self._value = jnp.asarray(other._value, self._value.dtype)
+        self._version += 1
+        return self
+
+    def set_value(self, value) -> "Tensor":
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(f"set_value shape mismatch: {v.shape} vs {self._value.shape}")
+        self._value = v.astype(self._value.dtype)
+        self._version += 1
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._value = jnp.zeros_like(self._value)
+        self._version += 1
+        return self
+
+    def fill_(self, v) -> "Tensor":
+        self._value = jnp.full_like(self._value, v)
+        self._version += 1
+        return self
+
+    # -- placement ----------------------------------------------------------
+    def to(self, *args, **kwargs) -> "Tensor":
+        device = kwargs.pop("device", None)
+        dtype = kwargs.pop("dtype", None)
+        for a in args:
+            if isinstance(a, (str, Place)) and not _looks_like_dtype(a):
+                device = a
+            else:
+                dtype = a
+        t = self
+        if dtype is not None:
+            t = t.astype(dtype)
+        if device is not None:
+            from .place import set_device, _current_place
+            if isinstance(device, str):
+                saved = _current_place()
+                place = set_device(device)
+                set_device(saved)
+            else:
+                place = device
+            val = jax.device_put(t._value, get_jax_device(place))
+            nt = _wrap_value(val, stop_gradient=t.stop_gradient, node=t._grad_node,
+                             index=t._node_index)
+            return nt
+        return t
+
+    def cpu(self) -> "Tensor":
+        return self.to("cpu")
+
+    def cuda(self, device_id=0) -> "Tensor":
+        return self.to(f"tpu:{device_id}")
+
+    def tpu(self, device_id=0) -> "Tensor":
+        return self.to(f"tpu:{device_id}")
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    def is_contiguous(self) -> bool:
+        return True
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from .dispatch import forward_op
+        idx = _convert_index(idx)
+        return forward_op("slice", lambda x: x[idx], [self])
+
+    def __setitem__(self, idx, value):
+        from .dispatch import forward_op
+        idx = _convert_index(idx)
+        slot = jax.eval_shape(lambda a: a[idx], self._value)
+
+        def fit(v):
+            if v.shape == slot.shape:
+                return v
+            if int(np.prod(v.shape)) == int(np.prod(slot.shape)):
+                return v.reshape(slot.shape)
+            return jnp.broadcast_to(v, slot.shape)
+
+        if isinstance(value, Tensor):
+            new = forward_op("set_value_",
+                             lambda x, v: x.at[idx].set(fit(v.astype(x.dtype))),
+                             [self, value])
+        else:
+            new = forward_op("set_value_", lambda x: x.at[idx].set(value), [self])
+        self._rebind(new)
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    __hash__ = object.__hash__
+
+    def __repr__(self):
+        grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}{grad_part},\n       {self._value})")
+
+    def __dlpack__(self, *a, **k):
+        return self._value.__dlpack__(*a, **k)
+
+
+def _looks_like_dtype(a) -> bool:
+    if isinstance(a, str):
+        try:
+            canonical_dtype(a)
+            return True
+        except TypeError:
+            return False
+    return not isinstance(a, Place)
+
+
+def _convert_index(idx):
+    """Unwrap Tensors inside an index expression."""
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_convert_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx) if any(isinstance(i, (int, np.integer)) for i in idx) else idx
+    return idx
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (``paddle.base.framework.Parameter`` parity):
+    ``stop_gradient=False`` and ``persistable=True`` by default."""
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name or _auto_name("param"))
+        self.persistable = True
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _wrap_value(value, stop_gradient: bool = True, node=None, index: int = 0,
+                name: Optional[str] = None) -> Tensor:
+    t = Tensor.__new__(Tensor)
+    t._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+    t.stop_gradient = stop_gradient
+    t.grad = None
+    t.name = name or _auto_name()
+    t.persistable = False
+    t._grad_node = node
+    t._node_index = index
+    t._hooks = []
+    t._retain_grads = False
+    t._version = 0
+    return t
+
+
+def to_tensor(data, dtype=None, place: Optional[Place] = None,
+              stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` parity: copy ``data`` into a new Tensor."""
+    if isinstance(data, Tensor):
+        val = data._value
+    elif isinstance(data, (list, tuple)) and any(isinstance(x, Tensor) for x in _flatten(data)):
+        val = jnp.stack([to_tensor(x)._value for x in data]) if data else jnp.asarray(data)
+    else:
+        val = data
+    dt = canonical_dtype(dtype)
+    if dt is None and not hasattr(val, "dtype"):
+        arr = np.asarray(val)
+        if arr.dtype == np.float64:
+            dt = get_default_dtype()  # python floats land on default float dtype
+        val = arr
+    val = jnp.asarray(val, dt) if dt is not None else jnp.asarray(val)
+    if place is not None:
+        val = jax.device_put(val, get_jax_device(place))
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def _flatten(seq):
+    for x in seq:
+        if isinstance(x, (list, tuple)):
+            yield from _flatten(x)
+        else:
+            yield x
+
+
+# Register Tensor as a jax pytree node so jax.tree_util / optax-style utilities can
+# traverse containers of Tensors. Unflattening produces detached tensors (the tape
+# linkage is an eager-mode concept, not part of the value).
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient,)),
+    lambda aux, ch: _wrap_value(ch[0], stop_gradient=aux[0]),
+)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), (t.stop_gradient,)),
+    lambda aux, ch: _wrap_value(ch[0], stop_gradient=aux[0]),
+)

@@ -1,0 +1,112 @@
+"""Runtime flag registry.
+
+Capability parity with Paddle's FLAGS_* system (reference: ``paddle/utils/flags.h``,
+registry in ``paddle/phi/core/flags.cc``; Python surface ``paddle.set_flags`` /
+``paddle.get_flags``): typed flags, defined at import time, overridable from the
+environment (``FLAGS_name=value``) and at runtime. Redesigned as a plain typed Python
+registry — there is no C++ gflags clone to wrap because on TPU the runtime toggles that
+matter (XLA options, libtpu options) pass through ``XLA_FLAGS`` / ``LIBTPU_INIT_ARGS``,
+which :func:`set_flags` also accepts transparently.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_registry: Dict[str, _FlagDef] = {}
+_lock = threading.Lock()
+
+
+def _coerce(defn: _FlagDef, value: Any) -> Any:
+    if defn.type is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return defn.type(value)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: Optional[type] = None,
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the default."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    ftype = type if type is not None else default.__class__
+    defn = _FlagDef(name=name, default=default, type=ftype, help=help, on_change=on_change)
+    env = os.environ.get(name)
+    defn.value = _coerce(defn, env) if env is not None else default
+    with _lock:
+        _registry[name] = defn
+
+
+def flag(name: str) -> Any:
+    """Fast read of a single flag value."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _registry[name].value
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    if names is None:
+        return {k: d.value for k, d in _registry.items()}
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        out[n] = _registry[key].value
+    return out
+
+
+def set_flags(flags_dict: Dict[str, Any]) -> None:
+    """Set flags at runtime (``paddle.set_flags`` equivalent).
+
+    Unknown ``XLA_``/``LIBTPU_`` prefixed keys are exported to the environment so they
+    reach XLA/libtpu on next backend init.
+    """
+    for name, value in flags_dict.items():
+        if name.startswith(("XLA_", "LIBTPU_", "TPU_")):
+            os.environ[name] = str(value)
+            continue
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _registry:
+            raise ValueError(f"unknown flag {name!r}; known: {sorted(_registry)[:20]}...")
+        defn = _registry[key]
+        defn.value = _coerce(defn, value)
+        if defn.on_change is not None:
+            defn.on_change(defn.value)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (Paddle equivalents noted).
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False, "Scan every op output for NaN/Inf in eager "
+            "mode (ref: FLAGS_check_nan_inf / nan_inf_utils_detail).", bool)
+define_flag("FLAGS_retain_grad_for_all_tensor", False,
+            "Accumulate .grad for non-leaf tensors too.", bool)
+define_flag("FLAGS_eager_op_jit", True,
+            "Dispatch eager ops through a cached jax.jit per (op, shapes, dtypes).", bool)
+define_flag("FLAGS_use_stride_kernel", False, "Accepted for API parity; XLA manages "
+            "layout so strides are not user-visible.", bool)
+define_flag("FLAGS_cudnn_deterministic", True, "Accepted for API parity; XLA on TPU is "
+            "deterministic by default.", bool)
+define_flag("FLAGS_embedding_deterministic", 1, "API parity; deterministic on TPU.", int)
+define_flag("FLAGS_allocator_strategy", "auto_growth", "API parity; PJRT owns device "
+            "memory (ref: auto_growth_best_fit_allocator).", str)
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "API parity; unused on TPU.", float)
+define_flag("FLAGS_log_level", 0, "Framework VLOG level (ref: GLOG_v).", int)

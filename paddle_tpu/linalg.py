@@ -1,0 +1,18 @@
+"""paddle.linalg namespace (parity: python/paddle/tensor/linalg.py public exports +
+python/paddle/linalg.py in the reference)."""
+
+from .ops.linalg import (bmm, cholesky, cholesky_solve, cond, corrcoef, cov, det,
+                         dist, eig, eigh, eigvals, eigvalsh, einsum,
+                         householder_product, inv, lstsq, matmul, matrix_norm,
+                         matrix_power, matrix_rank, multi_dot, mv, norm, pinv, qr,
+                         slogdet, solve, svd, svdvals, t, triangular_solve,
+                         vector_norm)
+from .ops.math import cross, dot
+
+__all__ = [
+    "bmm", "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "dist",
+    "eig", "eigh", "eigvals", "eigvalsh", "einsum", "householder_product", "inv",
+    "lstsq", "matmul", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
+    "mv", "norm", "pinv", "qr", "slogdet", "solve", "svd", "svdvals", "t",
+    "triangular_solve", "vector_norm", "cross", "dot",
+]

@@ -1,0 +1,187 @@
+"""Activation functionals.
+
+Parity target: ``python/paddle/nn/functional/activation.py`` in the reference.
+All map to jax.nn / jnp primitives; XLA fuses them into adjacent matmuls on TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op, unary_factory
+
+relu = unary_factory("relu", jax.nn.relu)
+relu6 = unary_factory("relu6", jax.nn.relu6)
+sigmoid = unary_factory("sigmoid", jax.nn.sigmoid)
+tanh = unary_factory("tanh", jnp.tanh)
+silu = unary_factory("silu", jax.nn.silu)
+swish = silu
+mish = unary_factory("mish", jax.nn.mish)
+softsign = unary_factory("softsign", jax.nn.soft_sign)
+tanhshrink = unary_factory("tanhshrink", lambda x: x - jnp.tanh(x))
+hardswish = unary_factory("hardswish", jax.nn.hard_swish)
+hardsigmoid = unary_factory("hardsigmoid",
+                            lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return forward_op("gelu", lambda v: jax.nn.gelu(v, approximate=bool(approximate)),
+                      [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return forward_op("leaky_relu",
+                      lambda v: jax.nn.leaky_relu(v, negative_slope),
+                      [ensure_tensor(x)])
+
+
+def elu(x, alpha=1.0, name=None):
+    return forward_op("elu", lambda v: jax.nn.elu(v, alpha), [ensure_tensor(x)])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return forward_op("selu",
+                      lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                      [ensure_tensor(x)])
+
+
+def celu(x, alpha=1.0, name=None):
+    return forward_op("celu", lambda v: jax.nn.celu(v, alpha), [ensure_tensor(x)])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return forward_op("hardtanh", lambda v: jnp.clip(v, min, max), [ensure_tensor(x)])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return forward_op("hardshrink",
+                      lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                      [ensure_tensor(x)])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return forward_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        [ensure_tensor(x)])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return forward_op(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v,
+                            jnp.log1p(jnp.exp(beta * v)) / beta),
+        [ensure_tensor(x)])
+
+
+def logsigmoid(x, name=None):
+    return forward_op("logsigmoid", jax.nn.log_sigmoid, [ensure_tensor(x)])
+
+
+log_sigmoid = logsigmoid
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import canonical_dtype
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.softmax(v, axis=int(axis))
+
+    return forward_op("softmax", impl, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import canonical_dtype
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.log_softmax(v, axis=int(axis))
+
+    return forward_op("log_softmax", impl, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops.random import _next_key
+    x = ensure_tensor(x)
+    key = _next_key()
+
+    def impl(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=int(axis))
+        if hard:  # straight-through: hard value, soft gradient
+            idx = jnp.argmax(y, axis=int(axis), keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                        jnp.ones(idx.shape, y.dtype), int(axis),
+                                        inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return forward_op("gumbel_softmax", impl, [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def impl(v, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+
+    return forward_op("prelu", impl, [x, weight])
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ...ops.random import _next_key
+    x = ensure_tensor(x)
+    if training:
+        key = _next_key()
+        return forward_op(
+            "rrelu",
+            lambda v: jnp.where(v >= 0, v, v * jax.random.uniform(
+                key, v.shape, v.dtype, lower, upper)),
+            [x])
+    mid = (lower + upper) / 2.0
+    return forward_op("rrelu", lambda v: jnp.where(v >= 0, v, v * mid), [x])
+
+
+def glu(x, axis=-1, name=None):
+    return forward_op("glu", lambda v: jax.nn.glu(v, axis=int(axis)),
+                      [ensure_tensor(x)])
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def impl(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return forward_op("maxout", impl, [x])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return forward_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v),
+                      [ensure_tensor(x)])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return forward_op("thresholded_relu",
+                      lambda v: jnp.where(v > threshold, v, value),
+                      [ensure_tensor(x)])

@@ -1,0 +1,277 @@
+"""Common functionals: linear, dropout, padding, embedding, interpolation, similarity.
+
+Parity target: ``python/paddle/nn/functional/common.py`` + ``input.py`` in the
+reference. Dropout draws from the global splittable RNG (TPU-native replacement for
+Paddle's per-device generator + RNGStatesTracker; distributed variants fold in mesh
+axes — see distributed/random.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+from ...ops.random import _next_key
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in, out] (ref: nn.functional.linear)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        return forward_op("linear", lambda v, w, b: v @ w + b,
+                          [x, weight, ensure_tensor(bias)])
+    return forward_op("linear", lambda v, w: v @ w, [x, weight])
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return forward_op("dropout_scale", lambda v: v * (1.0 - p), [x])
+        return x
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    key = _next_key()
+    ax = (axis,) if isinstance(axis, int) else axis
+
+    def impl(v):
+        shape = v.shape if ax is None else tuple(
+            v.shape[i] if i in ax else 1 for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return forward_op("dropout", impl, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = _next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return forward_op("alpha_dropout", impl, [x])
+
+
+_PAD_MODE = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):  # noqa: A002
+    """paddle.nn.functional.pad: `pad` is per-dim [lo, hi] pairs; for 4-D/5-D inputs
+    with data_format, `pad` covers only the spatial dims (paddle semantics)."""
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._value).reshape(-1)]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial-only padding per data_format; paddle orders pad back-to-front
+        if data_format is None:
+            data_format = {3: "NCL", 4: "NCHW", 5: "NCDHW"}[nd]
+        n_spatial = len(pad) // 2
+        spatial_pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            for i, pr in enumerate(spatial_pairs):
+                pairs[2 + i] = pr
+        else:  # channels-last
+            for i, pr in enumerate(spatial_pairs):
+                pairs[1 + i] = pr
+
+    jmode = _PAD_MODE[mode]
+
+    def impl(v):
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode="constant", constant_values=value)
+        return jnp.pad(v, pairs, mode=jmode)
+
+    return forward_op("pad", impl, [x])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of `weight` (ref: nn.functional.embedding). `sparse` accepted for
+    API parity; XLA gathers are already efficient, there is no SelectedRows path."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def impl(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return forward_op("embedding", impl, [x, weight])
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def impl(a, b):
+        num = jnp.sum(a * b, axis=int(axis))
+        den = jnp.linalg.norm(a, axis=int(axis)) * jnp.linalg.norm(b, axis=int(axis))
+        return num / jnp.maximum(den, eps)
+
+    return forward_op("cosine_similarity", impl, [x1, x2])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def impl(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=int(axis), keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return forward_op("normalize", impl, [x])
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """Image resize (ref: nn.functional.interpolate → phi interpolate kernels);
+    lowered to jax.image.resize."""
+    x = ensure_tensor(x)
+    nd = x.ndim
+    channels_last = data_format in ("NHWC", "NDHWC", "NLC")
+    spatial_idx = list(range(1, nd - 1)) if channels_last else list(range(2, nd))
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size._value).reshape(-1)]
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in
+                       (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial_idx)
+        out_spatial = [int(x.shape[i] * s) for i, s in zip(spatial_idx, scale_factor)]
+
+    out_shape = list(x.shape)
+    for i, s in zip(spatial_idx, out_spatial):
+        out_shape[i] = s
+
+    jmode = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+             "trilinear": "trilinear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def impl(v):
+        return jax.image.resize(v, tuple(out_shape), method=jmode).astype(v.dtype)
+
+    return forward_op("interpolate", impl, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = int(upscale_factor)
+
+    def impl(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return forward_op("pixel_shuffle", impl, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = int(downscale_factor)
+
+    def impl(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return forward_op("pixel_unshuffle", impl, [x])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def impl(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return forward_op("channel_shuffle", impl, [x])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: nn.functional.unfold)."""
+    x = ensure_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    k, s, p, d = _pair(kernel_sizes), _pair(strides), _pair(paddings), _pair(dilations)
+
+    def impl(v):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n2, ckk, oh, ow = patches.shape
+        return patches.reshape(n2, ckk, oh * ow)
+
+    return forward_op("unfold", impl, [x])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+
+    def impl(v):
+        k = v.shape[-1]
+        if prior_dist is None:
+            return (1 - epsilon) * v + epsilon / k
+        return (1 - epsilon) * v + epsilon * prior_dist._value
+
+    return forward_op("label_smooth", impl, [label])

@@ -1,0 +1,155 @@
+"""Convolution functionals.
+
+Parity target: ``python/paddle/nn/functional/conv.py`` (backed there by cuDNN phi
+kernels). TPU redesign: a single ``jax.lax.conv_general_dilated`` entry per rank —
+XLA lowers convs onto the MXU directly, so there is no algo-search/cudnn-autotune tier.
+Paddle's default NCHW layout is preserved at the API; XLA repacks layouts internally.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+def _padding(padding, n, strides=None, in_spatial=None, k=None, dilation=None):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(int(x) for x in p) for p in padding]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:  # [before0, after0, before1, after1,...]
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dnums(nd, channels_last):
+    if nd == 3:
+        return ("NLC", "LIO" if channels_last else "OIL", "NLC") if channels_last \
+            else ("NCL", "OIL", "NCL")
+    if nd == 4:
+        return ("NHWC", "HWIO", "NHWC") if channels_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channels_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(rank: int):
+    def conv(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+             data_format=None, name=None):
+        x, weight = ensure_tensor(x), ensure_tensor(weight)
+        nd = rank + 2
+        channels_last = (data_format or "NC...").startswith("N") and \
+            (data_format in ("NLC", "NHWC", "NDHWC"))
+        s = _tuple(stride, rank)
+        d = _tuple(dilation, rank)
+        pad = _padding(padding, rank)
+        dn = _dnums(nd, channels_last)
+
+        args = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+
+        def impl(v, w, *b):
+            # weight layout is paddle's [out_c, in_c/groups, *k]; transpose for
+            # channels-last dimension numbers
+            if channels_last:
+                perm = tuple(range(2, nd)) + (1, 0)
+                w = jnp.transpose(w, perm)
+            out = jax.lax.conv_general_dilated(
+                v, w, window_strides=s, padding=pad, rhs_dilation=d,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=None)
+            if b:
+                bias_shape = [1] * nd
+                bias_shape[nd - 1 if channels_last else 1] = b[0].shape[0]
+                out = out + b[0].reshape(bias_shape)
+            return out
+
+        return forward_op(f"conv{rank}d", impl, args)
+
+    conv.__name__ = f"conv{rank}d"
+    return conv
+
+
+conv1d = _conv(1)
+conv2d = _conv(2)
+conv3d = _conv(3)
+
+
+def _conv_transpose(rank: int):
+    def convt(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+              groups=1, dilation=1, data_format=None, output_size=None, name=None):
+        x, weight = ensure_tensor(x), ensure_tensor(weight)
+        nd = rank + 2
+        channels_last = data_format in ("NLC", "NHWC", "NDHWC")
+        s = _tuple(stride, rank)
+        d = _tuple(dilation, rank)
+        op = _tuple(output_padding, rank)
+        pad = _padding(padding, rank)
+        dn = _dnums(nd, channels_last)
+
+        args = [x, weight] + ([ensure_tensor(bias)] if bias is not None else [])
+
+        def impl(v, w, *b):
+            # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+            if groups > 1:
+                icg = w.shape[0] // groups
+                w = w.reshape((groups, icg) + w.shape[1:])
+                outs = []
+                vs = jnp.split(v, groups, axis=nd - 1 if channels_last else 1)
+                for g in range(groups):
+                    outs.append(_one(vs[g], w[g]))
+                return _fin(jnp.concatenate(outs, axis=nd - 1 if channels_last else 1), b)
+            return _fin(_one(v, w), b)
+
+        def _one(v, w):
+            # grad-of-conv formulation: conv_transpose via lax.conv_transpose
+            if channels_last:
+                w2 = jnp.transpose(w, tuple(range(2, nd)) + (0, 1))  # spatial,I,O
+            else:
+                w2 = jnp.transpose(w, (1, 0) + tuple(range(2, nd)))  # OI spatial
+            if isinstance(pad, str):
+                padding_arg = pad
+            else:
+                padding_arg = [(d[i] * (w.shape[2 + i] - 1) - pad[i][0],
+                                d[i] * (w.shape[2 + i] - 1) - pad[i][1] + op[i])
+                               for i in range(rank)]
+            out = jax.lax.conv_general_dilated(
+                v, jnp.flip(w2, axis=tuple(range(2, nd)) if not channels_last
+                            else tuple(range(rank))),
+                window_strides=(1,) * rank, padding=padding_arg,
+                lhs_dilation=s, rhs_dilation=d, dimension_numbers=dn)
+            return out
+
+        def _fin(out, b):
+            if b:
+                bias_shape = [1] * nd
+                bias_shape[nd - 1 if channels_last else 1] = b[0].shape[0]
+                out = out + b[0].reshape(bias_shape)
+            return out
+
+        return forward_op(f"conv{rank}d_transpose", impl, args)
+
+    convt.__name__ = f"conv{rank}d_transpose"
+    return convt
+
+
+conv1d_transpose = _conv_transpose(1)
+conv2d_transpose = _conv_transpose(2)
+conv3d_transpose = _conv_transpose(3)

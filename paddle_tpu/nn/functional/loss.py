@@ -1,0 +1,343 @@
+"""Loss functionals.
+
+Parity target: ``python/paddle/nn/functional/loss.py`` in the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross entropy (ref: nn.functional.cross_entropy →
+    softmax_with_cross_entropy phi kernel)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    args = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def impl(logits, lab, *w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-30, None))
+        n_classes = logits.shape[ax]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+            valid = None
+        else:
+            lab_idx = lab
+            if lab_idx.ndim == logits.ndim:  # trailing 1 dim
+                lab_idx = jnp.squeeze(lab_idx, axis=ax)
+            lab_idx = lab_idx.astype(jnp.int32)
+            valid = lab_idx != ignore_index
+            safe = jnp.where(valid, lab_idx, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, ax), axis=ax).squeeze(ax)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=ax)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if w:
+                loss = loss * jnp.take(w[0], safe)
+            loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if valid is not None:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                if w:
+                    denom = jnp.maximum(jnp.sum(
+                        jnp.where(valid, jnp.take(w[0], jnp.where(valid, lab_idx, 0)),
+                                  0.0)), 1e-12)
+                return jnp.sum(loss) / denom
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return forward_op("cross_entropy", impl, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle keeps the reduced axis
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    args = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def impl(logp, lab, *w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1).squeeze(1)
+        loss = -picked
+        wt = jnp.take(w[0], safe) if w else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wt, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return forward_op("nll_loss", impl, args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return forward_op("mse_loss",
+                      lambda a, b: _reduce(jnp.square(a - b), reduction),
+                      [ensure_tensor(input), ensure_tensor(label)])
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return forward_op("l1_loss",
+                      lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                      [ensure_tensor(input), ensure_tensor(label)])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def impl(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        # paddle multiplies by delta (huber normalization)
+        return _reduce(loss * delta, reduction)
+
+    return forward_op("smooth_l1_loss", impl,
+                      [ensure_tensor(input), ensure_tensor(label)])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    args = [ensure_tensor(input), ensure_tensor(label)] + \
+        ([ensure_tensor(weight)] if weight is not None else [])
+
+    def impl(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return forward_op("binary_cross_entropy", impl, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        args.append(ensure_tensor(pos_weight))
+
+    def impl(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with optional pos_weight
+        log_sig_pos = -jax.nn.softplus(-z)
+        log_sig_neg = -z - jax.nn.softplus(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig_pos + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig_pos + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return forward_op("bce_with_logits", impl, args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def impl(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return forward_op("kl_div", impl, [ensure_tensor(input), ensure_tensor(label)])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    return forward_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        [ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return forward_op(
+        "hinge_embedding_loss",
+        lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)),
+                             reduction),
+        [ensure_tensor(input), ensure_tensor(label)])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) *
+                                    jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return forward_op("cosine_embedding_loss", impl,
+                      [ensure_tensor(input1), ensure_tensor(input2),
+                       ensure_tensor(label)])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    def impl(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return forward_op("triplet_margin_loss", impl,
+                      [ensure_tensor(input), ensure_tensor(positive),
+                       ensure_tensor(negative)])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return forward_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        [ensure_tensor(input), ensure_tensor(label)])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [ensure_tensor(logit), ensure_tensor(label)] + \
+        ([ensure_tensor(normalizer)] if normalizer is not None else [])
+
+    def impl(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    return forward_op("sigmoid_focal_loss", impl, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss via the standard alpha-recursion in log space (lax.scan over time).
+
+    Ref capability: paddle.nn.functional.ctc_loss (warpctc in the reference).
+    Expects log_probs [T, B, C] (paddle layout) already log-softmaxed or logits.
+    """
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def impl(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext_len = 2 * S + 1
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, ext_len), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        def get_probs(t_lp):  # [B, ext_len]
+            return jnp.take_along_axis(t_lp, ext, axis=1)
+
+        # init alpha at t=0
+        alpha0 = jnp.full((B, ext_len), neg_inf)
+        p0 = get_probs(lp[0])
+        alpha0 = alpha0.at[:, 0].set(p0[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, p0[:, 1], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t_lp):
+            p = get_probs(t_lp)
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            new = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2) + p
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], 0)  # [T, B, ext_len]
+
+        # pick alpha at t = in_len-1, positions 2*lab_len-1 and 2*lab_len
+        t_idx = jnp.clip(in_len - 1, 0, T - 1).astype(jnp.int32)
+        batch = jnp.arange(B)
+        final = alphas[t_idx, batch]  # [B, ext_len]
+        e1 = jnp.take_along_axis(final, jnp.clip(2 * lab_len - 1, 0, ext_len - 1)
+                                 [:, None].astype(jnp.int32), 1)[:, 0]
+        e2 = jnp.take_along_axis(final, jnp.clip(2 * lab_len, 0, ext_len - 1)
+                                 [:, None].astype(jnp.int32), 1)[:, 0]
+        ll = jnp.logaddexp(e1, e2)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(loss.dtype)
+        if reduction == "mean":
+            return jnp.mean(loss / lab_len.astype(loss.dtype))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return forward_op("ctc_loss", impl,
+                      [log_probs, labels, input_lengths, label_lengths])
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return forward_op("square_error_cost", lambda a, b: jnp.square(a - b),
+                      [ensure_tensor(input), ensure_tensor(label)])
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    def impl(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return forward_op("dice_loss", impl, [ensure_tensor(input), ensure_tensor(label)])

@@ -1,0 +1,204 @@
+"""Normalization functionals.
+
+Parity target: ``python/paddle/nn/functional/norm.py`` (batch_norm backed by phi
+batch_norm kernels with running-stat mutation). Running stats are updated in-place on
+the passed mean/variance tensors, mirroring Paddle's semantics; inside ``jit`` those
+become functionalized state (captured as inputs/outputs of the compiled step).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    x = ensure_tensor(x)
+    ch_axis = x.ndim - 1 if data_format[-1] == "C" and len(data_format) > 2 else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    stat_shape = [1] * x.ndim
+    stat_shape[ch_axis] = x.shape[ch_axis]
+
+    if use_batch_stats:
+        # compute batch stats eagerly (they're needed to mutate running stats)
+        args = [x] + [a for a in (weight, bias) if a is not None]
+
+        def impl(v, *wb):
+            mean = jnp.mean(v, axis=axes, keepdims=True)
+            var = jnp.var(v, axis=axes, keepdims=True)
+            out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(stat_shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(stat_shape)
+            return out, mean.reshape(-1), var.reshape(-1)
+
+        out, bmean, bvar = forward_op("batch_norm", impl, args)
+        if running_mean is not None:
+            running_mean.set_value(momentum * running_mean._value +
+                                   (1 - momentum) * bmean._value)
+        if running_var is not None:
+            n = int(np.prod([x.shape[a] for a in axes]))
+            unbiased = bvar._value * (n / max(n - 1, 1))
+            running_var.set_value(momentum * running_var._value +
+                                  (1 - momentum) * unbiased)
+        return out
+
+    args = [x, ensure_tensor(running_mean), ensure_tensor(running_var)] + \
+        [a for a in (weight, bias) if a is not None]
+
+    def impl_infer(v, m, var, *wb):
+        out = (v - m.reshape(stat_shape)) * jax.lax.rsqrt(var.reshape(stat_shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(stat_shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(stat_shape)
+        return out
+
+    return forward_op("batch_norm_infer", impl_infer, args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    args = [x] + [ensure_tensor(a) for a in (weight, bias) if a is not None]
+
+    def impl(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    return forward_op("layer_norm", impl, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (ref: paddle.incubate.nn.functional.fused_rms_norm). The Pallas kernel
+    path (kernels/rmsnorm.py) is used by models on TPU; this is the jnp fallback."""
+    x = ensure_tensor(x)
+    args = [x] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def impl(v, *w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    return forward_op("rms_norm", impl, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    stat_shape = [1] * x.ndim
+    stat_shape[ch_axis] = x.shape[ch_axis]
+    args = [x] + [ensure_tensor(a) for a in (weight, bias) if a is not None]
+
+    def impl(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(stat_shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(stat_shape)
+        return out
+
+    return forward_op("instance_norm", impl, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channels_last = data_format[-1] == "C" and len(data_format) > 2
+    args = [x] + [ensure_tensor(a) for a in (weight, bias) if a is not None]
+
+    def impl(v, *wb):
+        if channels_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        g = v.reshape(n, num_groups, c // num_groups, *v.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        stat_shape = [1] * out.ndim
+        stat_shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(stat_shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(stat_shape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return forward_op("group_norm", impl, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    x = ensure_tensor(x)
+
+    def impl(v):
+        if data_format != "NCHW":
+            v = jnp.moveaxis(v, -1, 1)
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2)
+        padded = jnp.pad(sq, pads)
+        window = [1, size] + [1] * (v.ndim - 2)
+        summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add, window,
+                                       (1,) * v.ndim, "VALID" if False else
+                                       [(0, 0)] * v.ndim)
+        out = v / (k + alpha * summed) ** beta
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return forward_op("local_response_norm", impl, [x])
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
+    weight, u, v = ensure_tensor(weight), ensure_tensor(u), ensure_tensor(v)
+
+    def impl(w, u_, v_):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v_ = wm.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = wm @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        sigma = u_ @ wm @ v_
+        return w / sigma
+
+    return forward_op("spectral_norm", impl, [weight, u, v])

@@ -1,0 +1,160 @@
+"""Pooling functionals.
+
+Parity target: ``python/paddle/nn/functional/pooling.py``. Lowered to
+``jax.lax.reduce_window`` (XLA pools natively on TPU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+from .conv import _padding, _tuple
+
+
+def _window(rank, kernel, stride, padding, channels_last, ceil_mode=False):
+    k = _tuple(kernel, rank)
+    s = _tuple(stride if stride is not None else kernel, rank)
+    pad = _padding(padding, rank)
+    nd = rank + 2
+    if channels_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else []) + [(0, 0)] \
+            if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+    return dims, strides, pads, k, s, pad
+
+
+def _pool(rank, reducer, init_val, avg=False):
+    def pool(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+             exclusive=True, divisor_override=None, data_format=None,
+             return_mask=False, name=None, count_include_pad=None):
+        x = ensure_tensor(x)
+        channels_last = data_format in ("NLC", "NHWC", "NDHWC")
+        dims, strides, pads, k, s, pad = _window(rank, kernel_size, stride, padding,
+                                                 channels_last, ceil_mode)
+        if count_include_pad is not None:
+            # paddle MaxPool uses `ceil_mode`; AvgPool's exclusive == not count_include_pad
+            exclusive = not count_include_pad
+
+        def impl(v):
+            if avg:
+                summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pads)
+                if divisor_override:
+                    return summed / divisor_override
+                if exclusive and not isinstance(pads, str):
+                    ones = jnp.ones_like(v)
+                    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                                   strides, pads)
+                    return summed / counts
+                return summed / float(np.prod(k))
+            return jax.lax.reduce_window(v, init_val, reducer, dims, strides, pads)
+
+        out = forward_op(f"{'avg' if avg else 'max'}_pool{rank}d", impl, [x])
+        if return_mask:
+            idx = _pool_mask(x, k, s, pads, rank, channels_last)
+            return out, idx
+        return out
+
+    pool.__name__ = f"{'avg' if avg else 'max'}_pool{rank}d"
+    return pool
+
+
+def _pool_mask(x, k, s, pads, rank, channels_last):
+    """Indices of max elements (flattened spatial index, paddle convention)."""
+    from ...core.tensor import to_tensor
+
+    v = np.asarray(x._value)
+    if rank != 2 or channels_last:
+        raise NotImplementedError("return_mask only for NCHW 2-D pooling")
+    n, c, h, w = v.shape
+    kh, kw = k
+    sh, sw = s
+    ph, pw = (pads[2][0], pads[3][0]) if not isinstance(pads, str) else (0, 0)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, c, oh, ow), np.int64)
+    vp = np.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)), constant_values=-np.inf)
+    for i in range(oh):
+        for j in range(ow):
+            win = vp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw].reshape(n, c, -1)
+            am = win.argmax(-1)
+            r = i * sh + am // kw - ph
+            cc = j * sw + am % kw - pw
+            out[:, :, i, j] = r * w + cc
+    return to_tensor(out)
+
+
+max_pool1d = _pool(1, jax.lax.max, -jnp.inf)
+max_pool2d = _pool(2, jax.lax.max, -jnp.inf)
+max_pool3d = _pool(3, jax.lax.max, -jnp.inf)
+avg_pool1d = _pool(1, jax.lax.add, 0.0, avg=True)
+avg_pool2d = _pool(2, jax.lax.add, 0.0, avg=True)
+avg_pool3d = _pool(3, jax.lax.add, 0.0, avg=True)
+
+
+def _adaptive(rank, avg):
+    def pool(x, output_size, data_format=None, return_mask=False, name=None):
+        x = ensure_tensor(x)
+        channels_last = data_format in ("NLC", "NHWC", "NDHWC")
+        out_sp = _tuple(output_size, rank)
+        nd = rank + 2
+        spatial = list(range(1, nd - 1)) if channels_last else list(range(2, nd))
+        in_sp = [x.shape[i] for i in spatial]
+        out_sp = tuple(in_sp[i] if out_sp[i] is None else out_sp[i]
+                       for i in range(rank))
+
+        def impl(v):
+            # decompose into per-axis adaptive pooling via mean/max over index bins
+            out = v
+            for ax_i, (ax, osz) in enumerate(zip(spatial, out_sp)):
+                isz = out.shape[ax]
+                starts = np.floor(np.arange(osz) * isz / osz).astype(int)
+                ends = np.ceil((np.arange(osz) + 1) * isz / osz).astype(int)
+                pieces = []
+                for st, en in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                    red = jnp.mean(sl, axis=ax, keepdims=True) if avg else \
+                        jnp.max(sl, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+            return out
+
+        res = forward_op(f"adaptive_{'avg' if avg else 'max'}_pool{rank}d", impl, [x])
+        if return_mask:
+            raise NotImplementedError("adaptive pooling return_mask")
+        return res
+
+    pool.__name__ = f"adaptive_{'avg' if avg else 'max'}_pool{rank}d"
+    return pool
+
+
+adaptive_avg_pool1d = _adaptive(1, True)
+adaptive_avg_pool2d = _adaptive(2, True)
+adaptive_avg_pool3d = _adaptive(3, True)
+adaptive_max_pool1d = _adaptive(1, False)
+adaptive_max_pool2d = _adaptive(2, False)
+adaptive_max_pool3d = _adaptive(3, False)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    channels_last = data_format == "NHWC"
+    dims, strides, pads, k, s, _ = _window(2, kernel_size, stride, padding,
+                                           channels_last, ceil_mode)
+
+    def impl(v):
+        powed = jnp.abs(v) ** p
+        summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, dims, strides, pads)
+        return summed ** (1.0 / p)
+
+    return forward_op("lp_pool2d", impl, [x])

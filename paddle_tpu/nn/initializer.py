@@ -1,0 +1,218 @@
+"""Weight initializers.
+
+Parity target: ``python/paddle/nn/initializer/`` in the reference (Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform, Assign,
+Orthogonal, calculate_gain). Initializers mutate the Parameter's value via the global
+splittable RNG (ops/random.py), so ``paddle.seed`` makes init deterministic.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..ops.random import _next_key
+
+__all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+           "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+           "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer"]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle layout [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        param._value = jnp.full_like(param._value, self.value)
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        z = jax.random.normal(_next_key(), param._value.shape, jnp.float32)
+        param._value = (self.mean + self.std * z).astype(param._value.dtype)
+        return param
+
+
+class TruncatedNormal(Initializer):
+    """Truncated at ±2σ (paddle default a=-2,b=2)."""
+
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param, block=None):
+        z = jax.random.truncated_normal(_next_key(), self.a, self.b,
+                                        param._value.shape, jnp.float32)
+        param._value = (self.mean + self.std * z).astype(param._value.dtype)
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        u = jax.random.uniform(_next_key(), param._value.shape, jnp.float32,
+                               self.low, self.high)
+        param._value = u.astype(param._value.dtype)
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._value.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        z = jax.random.normal(_next_key(), param._value.shape, jnp.float32) * std
+        param._value = z.astype(param._value.dtype)
+        return param
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._value.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        u = jax.random.uniform(_next_key(), param._value.shape, jnp.float32,
+                               -limit, limit)
+        param._value = u.astype(param._value.dtype)
+        return param
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._value.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        z = jax.random.normal(_next_key(), param._value.shape, jnp.float32) * std
+        param._value = z.astype(param._value.dtype)
+        return param
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._value.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        u = jax.random.uniform(_next_key(), param._value.shape, jnp.float32,
+                               -limit, limit)
+        param._value = u.astype(param._value.dtype)
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        v = self.value._value if isinstance(self.value, Tensor) else jnp.asarray(self.value)
+        param._value = v.astype(param._value.dtype).reshape(param._value.shape)
+        return param
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        flat = jax.random.normal(_next_key(), (max(rows, cols), min(rows, cols)),
+                                 jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        param._value = (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            param._value.dtype)
+        return param
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (ref: paddle.nn.initializer.Dirac)."""
+
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape  # [out_c, in_c, *spatial]
+        v = np.zeros(shape, np.float32)
+        out_c, in_c = shape[0], shape[1]
+        centers = tuple(s // 2 for s in shape[2:])
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                v[(g * per + i, i) + centers] = 1.0
+        param._value = jnp.asarray(v, param._value.dtype)
+        return param
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    recipes = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv_transpose1d": 1.0, "conv_transpose2d": 1.0, "conv_transpose3d": 1.0,
+        "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        slope = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + slope ** 2))
+    if nonlinearity in recipes:
+        return recipes[nonlinearity]
+    raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init

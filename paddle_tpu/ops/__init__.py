@@ -1,0 +1,32 @@
+"""The op surface.
+
+The ops.yaml-equivalent single source of truth lives in ``core.dispatch.OP_REGISTRY``;
+these modules populate it and patch methods onto Tensor (mirroring how the reference's
+``python/paddle/tensor/__init__.py`` assembles the tensor namespace).
+"""
+
+import types as _types
+
+from . import creation, linalg, logic, manipulation, math, random, search
+
+_EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
+            "register_op", "patch_methods", "unary_factory", "binary_factory",
+            "axes_arg", "canonical_dtype", "get_default_dtype", "get_jax_device",
+            "Generator", "default_generator"}
+
+
+def _export(module):
+    names = []
+    for k, v in vars(module).items():
+        if k.startswith("_") or isinstance(v, _types.ModuleType) or k in _EXCLUDE:
+            continue
+        globals()[k] = v
+        names.append(k)
+    return names
+
+
+__all__ = sorted(set(
+    _export(creation) + _export(math) + _export(manipulation) + _export(linalg)
+    + _export(logic) + _export(search) + _export(random)))
+from .random import Generator, default_generator  # noqa: E402
+from .creation import to_tensor  # noqa: E402

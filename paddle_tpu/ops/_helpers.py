@@ -1,0 +1,65 @@
+"""Shared plumbing for the op surface modules.
+
+Parity target: the argument-normalization layer of ``python/paddle/tensor/*.py`` in the
+reference — each public op is a thin wrapper that canonicalizes arguments and enters the
+dispatcher (see core/dispatch.py for the TPU redesign of the hot path below it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import forward_op, register_op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["ensure_tensor", "unary_factory", "binary_factory", "patch_methods",
+           "forward_op", "register_op", "Tensor", "axes_arg"]
+
+
+def ensure_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def axes_arg(axis):
+    """Canonicalize paddle-style axis arguments (int | list | tuple | None)."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in np.asarray(axis._value).reshape(-1))
+    return int(axis)
+
+
+def unary_factory(name: str, jfn: Callable, doc: str = ""):
+    register_op(name, jfn, doc)
+
+    def op(x, name=None):
+        return forward_op(op.__name__, jfn, [ensure_tensor(x)])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} (jnp-backed; Paddle API parity)."
+    return op
+
+
+def binary_factory(name: str, jfn: Callable, doc: str = ""):
+    register_op(name, jfn, doc)
+
+    def op(x, y, name=None):
+        return forward_op(op.__name__, jfn, [ensure_tensor(x), ensure_tensor(y)])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise broadcasting {name} (jnp-backed; Paddle API parity)."
+    return op
+
+
+def patch_methods(pairs: Sequence[tuple]):
+    """Attach (method_name, function) pairs to Tensor, mirroring Paddle's
+    monkey-patching of python/paddle/tensor/* onto the C++ tensor class."""
+    for mname, fn in pairs:
+        setattr(Tensor, mname, fn)

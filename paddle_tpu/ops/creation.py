@@ -1,0 +1,228 @@
+"""Tensor creation ops.
+
+Parity target: ``python/paddle/tensor/creation.py`` in the reference. Creation runs
+outside the tape (constants have no grad) except ``assign``/``clone``/``diag``-style
+ops over Tensor inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype, get_default_dtype
+from ..core.place import get_jax_device
+from ..core.tensor import Parameter, Tensor, _wrap_value, to_tensor
+from ._helpers import ensure_tensor, forward_op, patch_methods
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "diag",
+    "diagflat", "meshgrid", "tril", "triu", "tril_indices", "triu_indices", "assign",
+    "clone", "numel", "complex", "one_hot", "create_parameter",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, like_float=True):
+    d = canonical_dtype(dtype)
+    if d is None:
+        return get_default_dtype() if like_float else None
+    return d
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape_arg(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape_arg(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = canonical_dtype(dtype)
+    if d is None:
+        d = get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape_arg(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    # XLA has no uninitialized memory; zeros is the TPU-native "empty".
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, canonical_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, canonical_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=canonical_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, canonical_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=canonical_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=canonical_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def impl(v):
+        out = jnp.diag(v, k=offset)
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(out.shape[0], dtype=bool)
+            mask = jnp.roll(mask, offset, axis=1) if offset else mask
+            out = jnp.where(mask, out, padding_value)
+        return out
+
+    return forward_op("diag", impl, [x])
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return forward_op("diagflat", lambda v: jnp.diagflat(v, k=offset), [ensure_tensor(x)])
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def impl(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return forward_op("diag_embed", impl, [x])
+
+
+def meshgrid(*args, **kwargs):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and
+                                     isinstance(args[0], (list, tuple)) else args)]
+    outs = forward_op("meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), ts)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return forward_op("tril", lambda v: jnp.tril(v, k=diagonal), [ensure_tensor(x)])
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return forward_op("triu", lambda v: jnp.triu(v, k=diagonal), [ensure_tensor(x)])
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None) -> Tensor:
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), canonical_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), canonical_dtype(dtype)))
+
+
+def assign(x, output=None):
+    """paddle.assign: copy into `output` (or a fresh tensor); differentiable."""
+    x = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    out = forward_op("assign", lambda v: v + 0, [x])
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return ensure_tensor(x).clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x).size, jnp.int64))
+
+
+def complex(real, imag, name=None) -> Tensor:  # noqa: A001
+    return forward_op("complex", jax.lax.complex,
+                      [ensure_tensor(real), ensure_tensor(imag)])
+
+
+def polar(abs, angle, name=None) -> Tensor:  # noqa: A002
+    return forward_op("polar",
+                      lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                      [ensure_tensor(abs), ensure_tensor(angle)])
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return forward_op("one_hot",
+                      lambda v: jax.nn.one_hot(v, num_classes,
+                                               dtype=get_default_dtype()),
+                      [x], differentiable=False)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None) -> Parameter:
+    """paddle.create_parameter parity (static-graph helper, eager here)."""
+    from ..nn import initializer as init
+
+    d = _dt(dtype)
+    p = Parameter(jnp.zeros(_shape_arg(shape), d), name=name)
+    if default_initializer is not None:
+        default_initializer(p)
+    elif is_bias:
+        init.Constant(0.0)(p)
+    else:
+        init.XavierNormal()(p)
+    return p
+
+
+patch_methods([
+    ("tril", tril), ("triu", triu), ("diag", diag), ("diagflat", diagflat),
+])

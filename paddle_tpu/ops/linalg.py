@@ -1,0 +1,255 @@
+"""Linear algebra ops.
+
+Parity target: ``python/paddle/tensor/linalg.py`` (+ ``paddle.linalg`` namespace) in
+the reference, backed there by cuBLAS/cuSOLVER phi kernels. Matmuls here go straight
+to jnp → XLA dot_general, which is the MXU path on TPU; decompositions lower to XLA's
+linalg suite.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import axes_arg, ensure_tensor, forward_op, patch_methods
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        if transpose_x and a.ndim >= 2:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y and b.ndim >= 2:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    return forward_op("matmul", impl, [x, y])
+
+
+def mm(input, mat2, name=None) -> Tensor:  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None) -> Tensor:
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None) -> Tensor:
+    return matmul(x, vec)
+
+
+def t(input, name=None) -> Tensor:  # noqa: A002
+    input = ensure_tensor(input)
+    if input.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2")
+    return forward_op("t", lambda v: v.T, [input])
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    if p is None:
+        p = "fro" if (ax is None or isinstance(ax, tuple)) else 2
+
+    def impl(v):
+        if ax is None:
+            flat = v.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.linalg.norm(flat)
+            if p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(v, ord=None if p == "fro" else p, axis=ax,
+                               keepdims=keepdim)
+
+    return forward_op("norm", impl, [x])
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None) -> Tensor:
+    return forward_op("vector_norm",
+                      lambda v: jnp.linalg.vector_norm(v, ord=p, axis=axes_arg(axis),
+                                                       keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None) -> Tensor:
+    return forward_op("matrix_norm",
+                      lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def dist(x, y, p=2, name=None) -> Tensor:
+    return norm(ensure_tensor(x) - ensure_tensor(y), p=p)
+
+
+def cholesky(x, upper=False, name=None) -> Tensor:
+    return forward_op("cholesky",
+                      lambda v: jnp.linalg.cholesky(v).swapaxes(-1, -2) if upper
+                      else jnp.linalg.cholesky(v), [ensure_tensor(x)])
+
+
+def cholesky_solve(x, y, upper=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(b, L):
+        if upper:
+            L = jnp.swapaxes(L, -1, -2)
+        z = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z, lower=False)
+
+    return forward_op("cholesky_solve", impl, [x, y])
+
+
+def qr(x, mode="reduced", name=None):
+    outs = forward_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)),
+                      [ensure_tensor(x)])
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return forward_op("svd",
+                      lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+                      [ensure_tensor(x)])
+
+
+def svdvals(x, name=None) -> Tensor:
+    return forward_op("svdvals",
+                      lambda v: jnp.linalg.svd(v, compute_uv=False), [ensure_tensor(x)])
+
+
+def eig(x, name=None):
+    """General eig — XLA supports it on CPU only; eager-mode host fallback, matching
+    the reference's cuSOLVER-on-host behavior class."""
+    x = ensure_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._value))
+    from ..core.tensor import to_tensor
+    return to_tensor(w), to_tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return forward_op("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)),
+                      [ensure_tensor(x)])
+
+
+def eigvals(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    from ..core.tensor import to_tensor
+    return to_tensor(np.linalg.eigvals(np.asarray(x._value)))
+
+
+def eigvalsh(x, UPLO="L", name=None) -> Tensor:
+    return forward_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO),
+                      [ensure_tensor(x)])
+
+
+def inv(x, name=None) -> Tensor:
+    return forward_op("inv", jnp.linalg.inv, [ensure_tensor(x)])
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None) -> Tensor:
+    return forward_op("pinv",
+                      lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                      [ensure_tensor(x)])
+
+
+def solve(x, y, name=None) -> Tensor:
+    return forward_op("solve", jnp.linalg.solve, [ensure_tensor(x), ensure_tensor(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return forward_op("triangular_solve", impl, [x, y])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = (jnp.linalg.lstsq(x._value, y._value, rcond=rcond))
+    from ..core.tensor import to_tensor
+    return to_tensor(sol), to_tensor(res), to_tensor(rank), to_tensor(sv)
+
+
+def det(x, name=None) -> Tensor:
+    return forward_op("det", jnp.linalg.det, [ensure_tensor(x)])
+
+
+def slogdet(x, name=None):
+    return forward_op("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)),
+                      [ensure_tensor(x)])
+
+
+def matrix_power(x, n, name=None) -> Tensor:
+    return forward_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, int(n)),
+                      [ensure_tensor(x)])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None) -> Tensor:
+    return forward_op("matrix_rank",
+                      lambda v: jnp.linalg.matrix_rank(v, rtol=tol),
+                      [ensure_tensor(x)], differentiable=False)
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    return forward_op("cond_number", lambda v: jnp.linalg.cond(v, p=p),
+                      [ensure_tensor(x)])
+
+
+def multi_dot(tensors, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in tensors]
+    return forward_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), ts)
+
+
+def einsum(equation, *operands) -> Tensor:
+    ts = [ensure_tensor(o) for o in operands]
+    return forward_op("einsum", lambda *vs: jnp.einsum(equation, *vs), ts)
+
+
+def householder_product(x, tau, name=None) -> Tensor:
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def impl(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * \
+                (v[..., :, None] @ v[..., None, :])
+            return q @ h
+
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return forward_op("householder_product", impl, [x, tau])
+
+
+def corrcoef(x, rowvar=True, name=None) -> Tensor:
+    return forward_op("corrcoef",
+                      lambda v: jnp.corrcoef(v, rowvar=rowvar), [ensure_tensor(x)])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None) -> Tensor:
+    return forward_op("cov",
+                      lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0),
+                      [ensure_tensor(x)])
+
+
+patch_methods([
+    ("matmul", matmul), ("mm", mm), ("bmm", bmm), ("mv", mv), ("norm", norm),
+    ("cholesky", cholesky), ("inv", inv), ("pinv", pinv), ("det", det),
+    ("matrix_power", matrix_power),
+])

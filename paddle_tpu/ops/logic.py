@@ -1,0 +1,75 @@
+"""Comparison, logical and bitwise ops.
+
+Parity target: ``python/paddle/tensor/logic.py`` in the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import binary_factory, ensure_tensor, forward_op, patch_methods, unary_factory
+
+equal = binary_factory("equal", jnp.equal)
+not_equal = binary_factory("not_equal", jnp.not_equal)
+less_than = binary_factory("less_than", jnp.less)
+less_equal = binary_factory("less_equal", jnp.less_equal)
+greater_than = binary_factory("greater_than", jnp.greater)
+greater_equal = binary_factory("greater_equal", jnp.greater_equal)
+logical_and = binary_factory("logical_and", jnp.logical_and)
+logical_or = binary_factory("logical_or", jnp.logical_or)
+logical_xor = binary_factory("logical_xor", jnp.logical_xor)
+logical_not = unary_factory("logical_not", jnp.logical_not)
+bitwise_and = binary_factory("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_factory("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_factory("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary_factory("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = binary_factory("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary_factory("bitwise_right_shift", jnp.right_shift)
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    return forward_op("equal_all", lambda a, b: jnp.array_equal(a, b),
+                      [ensure_tensor(x), ensure_tensor(y)], differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return forward_op("allclose",
+                      lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                                equal_nan=equal_nan),
+                      [ensure_tensor(x), ensure_tensor(y)], differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return forward_op("isclose",
+                      lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                               equal_nan=equal_nan),
+                      [ensure_tensor(x), ensure_tensor(y)], differentiable=False)
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+patch_methods([
+    ("__eq__", lambda s, o: equal(s, o)), ("__ne__", lambda s, o: not_equal(s, o)),
+    ("__lt__", lambda s, o: less_than(s, o)), ("__le__", lambda s, o: less_equal(s, o)),
+    ("__gt__", lambda s, o: greater_than(s, o)),
+    ("__ge__", lambda s, o: greater_equal(s, o)),
+    ("__and__", lambda s, o: bitwise_and(s, o)),
+    ("__or__", lambda s, o: bitwise_or(s, o)),
+    ("__xor__", lambda s, o: bitwise_xor(s, o)),
+    ("__invert__", lambda s: bitwise_not(s)),
+    ("equal", equal), ("not_equal", not_equal), ("less_than", less_than),
+    ("less_equal", less_equal), ("greater_than", greater_than),
+    ("greater_equal", greater_equal), ("logical_and", logical_and),
+    ("logical_or", logical_or), ("logical_xor", logical_xor),
+    ("logical_not", logical_not), ("bitwise_and", bitwise_and),
+    ("bitwise_or", bitwise_or), ("bitwise_xor", bitwise_xor),
+    ("bitwise_not", bitwise_not), ("equal_all", equal_all), ("allclose", allclose),
+    ("isclose", isclose),
+])

@@ -1,0 +1,471 @@
+"""Shape / layout manipulation ops.
+
+Parity target: ``python/paddle/tensor/manipulation.py`` in the reference. All ops are
+functional on immutable arrays; Paddle's view semantics (reshape returning a view)
+degrade gracefully to copies under XLA, which is the TPU-correct behavior.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import axes_arg, ensure_tensor, forward_op, patch_methods
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value).reshape(-1))
+    out = []
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = _shape_arg(shape)
+    # paddle semantics: 0 means "copy this dim from input"
+    shp = tuple(x._value.shape[i] if s == 0 else s for i, s in enumerate(shp))
+    return forward_op("reshape", lambda v: v.reshape(shp), [x])
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    x._rebind(reshape(x, shape))
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None) -> Tensor:
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def transpose(x, perm, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    perm = tuple(int(p) for p in perm)
+    return forward_op("transpose", lambda v: jnp.transpose(v, perm), [x])
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    return forward_op("moveaxis",
+                      lambda v: jnp.moveaxis(v, axes_arg(source), axes_arg(destination)),
+                      [ensure_tensor(x)])
+
+
+def swapaxes(x, axis0, axis1, name=None) -> Tensor:
+    return forward_op("swapaxes", lambda v: jnp.swapaxes(v, int(axis0), int(axis1)),
+                      [ensure_tensor(x)])
+
+
+def concat(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return forward_op("concat", lambda *vs: jnp.concatenate(vs, axis=int(axis)), ts)
+
+
+def stack(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return forward_op("stack", lambda *vs: jnp.stack(vs, axis=int(axis)), ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x._value.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def impl(v):
+        return tuple(jax.lax.slice_in_dim(v, int(o), int(o + s), axis=ax)
+                     for o, s in zip(offsets, sizes))
+
+    outs = forward_op("split", impl, [x])
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0):
+    x = ensure_tensor(x)
+    n = x._value.shape[int(axis)]
+
+    def impl(v):
+        return tuple(jnp.take(v, i, axis=int(axis)) for i in range(n))
+
+    outs = forward_op("unbind", impl, [x])
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def impl(v):
+        if ax is None:
+            return jnp.squeeze(v)
+        keep = tuple(a for a in ax if v.shape[a] == 1)  # paddle ignores non-1 dims
+        return jnp.squeeze(v, axis=keep) if keep else v
+
+    return forward_op("squeeze", impl, [x])
+
+
+def squeeze_(x, axis=None, name=None) -> Tensor:
+    x._rebind(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return forward_op("unsqueeze", lambda v: jnp.expand_dims(v, ax), [x])
+
+
+def unsqueeze_(x, axis, name=None) -> Tensor:
+    x._rebind(unsqueeze(x, axis))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def impl(v):
+        shp = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return v.reshape(shp)
+
+    return forward_op("flatten", impl, [x])
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x._rebind(flatten(x, start_axis, stop_axis))
+    return x
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    reps = _shape_arg(repeat_times)
+    return forward_op("tile", lambda v: jnp.tile(v, reps), [x])
+
+
+def expand(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = _shape_arg(shape)
+    shp = tuple(x._value.shape[len(shp) - x.ndim + i] if s == -1 else s
+                for i, s in enumerate(shp))
+    return forward_op("expand", lambda v: jnp.broadcast_to(v, shp), [x])
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    return forward_op("broadcast_to",
+                      lambda v: jnp.broadcast_to(v, _shape_arg(shape)),
+                      [ensure_tensor(x)])
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    outs = forward_op("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), ts)
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    """paddle.gather: select rows of `axis` by a 1-D index."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return forward_op("gather", lambda v, i: jnp.take(v, i.reshape(-1), axis=ax),
+                      [x, index])
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def impl(v, idx):
+        depth = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))] if depth == v.ndim else \
+            v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return forward_op("gather_nd", impl, [x, index])
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    """paddle.scatter: write `updates` rows into `x` at `index` along axis 0."""
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def impl(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        zeroed = v.at[i].set(jnp.zeros_like(u, v.dtype))
+        return zeroed.at[i].add(u.astype(v.dtype))
+
+    return forward_op("scatter", impl, [x, index, updates])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None) -> Tensor:
+    x._rebind(scatter(x, index, updates, overwrite))
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def impl(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u.astype(v.dtype))
+
+    return forward_op("scatter_nd_add", impl, [x, index, updates])
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = _shape_arg(shape)
+
+    def impl(i, u):
+        base = jnp.zeros(shp, u.dtype)
+        return base.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return forward_op("scatter_nd", impl, [index, updates])
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return forward_op("index_select",
+                      lambda v, i: jnp.take(v, i.reshape(-1), axis=int(axis)),
+                      [x, index])
+
+
+def index_sample(x, index) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return forward_op("index_sample",
+                      lambda v, i: jnp.take_along_axis(v, i, axis=1), [x, index])
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+    ax = int(axis)
+
+    def impl(v, i, u):
+        vm = jnp.moveaxis(v, ax, 0)
+        um = jnp.moveaxis(u, ax, 0)
+        out = vm.at[i.reshape(-1)].add(um.astype(v.dtype))
+        return jnp.moveaxis(out, 0, ax)
+
+    return forward_op("index_add", impl, [x, index, value])
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    x, value = ensure_tensor(x), ensure_tensor(value)
+    idx = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
+
+    def impl(v, u):
+        return v.at[idx].add(u) if accumulate else v.at[idx].set(u.astype(v.dtype))
+
+    return forward_op("index_put", impl, [x, value])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return forward_op("take_along_axis",
+                      lambda v, i: jnp.take_along_axis(v, i, axis=int(axis)),
+                      [arr, indices])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def impl(v, i, u):
+        u = jnp.broadcast_to(u.astype(v.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=int(axis), inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max"}[reduce]
+        dim_idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(v.ndim)])
+                   for k, s in enumerate(v.shape)]
+        dim_idx[int(axis)] = i
+        at = v.at[tuple(dim_idx)]
+        return {"add": at.add, "multiply": at.multiply, "min": at.min,
+                "max": at.max}[mode](u)
+
+    return forward_op("put_along_axis", impl, [arr, indices, values])
+
+
+def take(x, index, mode="raise", name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return forward_op("take",
+                      lambda v, i: jnp.take(v.reshape(-1), i, mode=jmode), [x, index])
+
+
+def flip(x, axis, name=None) -> Tensor:
+    ax = axes_arg(axis)
+    return forward_op("flip", lambda v: jnp.flip(v, axis=ax), [ensure_tensor(x)])
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    sh = axes_arg(shifts)
+    ax = axes_arg(axis)
+    return forward_op("roll", lambda v: jnp.roll(v, sh, axis=ax), [ensure_tensor(x)])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    return forward_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)),
+                      [ensure_tensor(x)])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = repeats._value if isinstance(repeats, Tensor) else repeats
+    return forward_op("repeat_interleave",
+                      lambda v: jnp.repeat(v, r, axis=axes_arg(axis)), [x])
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    """Dynamic output shape: eager-only (not traceable under jit) — same caveat class
+    as Paddle's dynamic-shape ops under to_static."""
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    return forward_op("masked_select", lambda v, m: v[m.astype(bool)], [x, mask])
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return forward_op("masked_fill",
+                          lambda v, m, u: jnp.where(m.astype(bool), u.astype(v.dtype), v),
+                          [x, mask, value])
+    return forward_op("masked_fill",
+                      lambda v, m: jnp.where(m.astype(bool), value, v), [x, mask])
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+
+    def impl(v, m, u):
+        m = m.astype(bool)
+        flat_idx = jnp.cumsum(m.reshape(-1)) - 1
+        picked = u.reshape(-1)[jnp.clip(flat_idx, 0, u.size - 1)]
+        return jnp.where(m, picked.reshape(v.shape).astype(v.dtype), v)
+
+    return forward_op("masked_scatter", impl, [x, mask, value])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Eager-only (dynamic output shape)."""
+    x = ensure_tensor(x)
+    res = np.unique(np.asarray(x._value), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axes_arg(axis))
+    if not isinstance(res, tuple):
+        return to_tensor(res)
+    return tuple(to_tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = np.asarray(ensure_tensor(x)._value)
+    if axis is None:
+        x = x.reshape(-1)
+        keep = np.concatenate([[True], x[1:] != x[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = to_tensor(x[keep])
+    extras = []
+    if return_inverse:
+        extras.append(to_tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        extras.append(to_tensor(np.diff(np.append(idx, x.size))))
+    return (out, *extras) if extras else out
+
+
+def as_complex(x, name=None) -> Tensor:
+    return forward_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+                      [ensure_tensor(x)])
+
+
+def as_real(x, name=None) -> Tensor:
+    return forward_op("as_real",
+                      lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                      [ensure_tensor(x)])
+
+
+def tensordot(x, y, axes=2, name=None) -> Tensor:
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax._value).tolist()
+    return forward_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax),
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    input = ensure_tensor(input)
+    size = index_num // nshards
+
+    def impl(v):
+        shard = v // size
+        local = v % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return forward_op("shard_index", impl, [input], differentiable=False)
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = _shape_arg(shape)
+    offs = _shape_arg(offsets) if offsets is not None else (0,) * len(shp)
+    shp = tuple(x._value.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp))
+
+    def impl(v):
+        return jax.lax.dynamic_slice(v, offs, shp)
+
+    return forward_op("crop", impl, [x])
+
+
+patch_methods([
+    ("reshape", reshape), ("reshape_", reshape_), ("view", view), ("view_as", view_as),
+    ("transpose", transpose), ("moveaxis", moveaxis), ("swapaxes", swapaxes),
+    ("split", split), ("chunk", chunk), ("squeeze", squeeze), ("squeeze_", squeeze_),
+    ("unsqueeze", unsqueeze), ("unsqueeze_", unsqueeze_), ("flatten", flatten),
+    ("flatten_", flatten_), ("tile", tile), ("expand", expand), ("expand_as", expand_as),
+    ("broadcast_to", broadcast_to), ("gather", gather), ("gather_nd", gather_nd),
+    ("scatter", scatter), ("scatter_", scatter_), ("scatter_nd_add", scatter_nd_add),
+    ("index_select", index_select), ("index_sample", index_sample),
+    ("index_add", index_add), ("index_put", index_put),
+    ("take_along_axis", take_along_axis), ("put_along_axis", put_along_axis),
+    ("take", take), ("flip", flip), ("roll", roll), ("rot90", rot90),
+    ("repeat_interleave", repeat_interleave), ("masked_select", masked_select),
+    ("masked_fill", masked_fill), ("unique", unique), ("unbind", unbind),
+    ("tensordot", tensordot), ("as_complex", as_complex), ("as_real", as_real),
+])

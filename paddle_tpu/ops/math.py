@@ -1,0 +1,498 @@
+"""Elementwise and reduction math ops.
+
+Parity target: ``python/paddle/tensor/math.py`` + ``python/paddle/tensor/stat.py`` in
+the reference (backed there by phi kernels, ``paddle/phi/kernels/``). Here every op is
+one pure-jnp function entering the dispatcher; XLA fuses elementwise chains into
+surrounding matmuls on TPU, so there is no hand-written fusion tier
+(``paddle/phi/kernels/fusion/``) for these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import (axes_arg, binary_factory, ensure_tensor, forward_op,
+                       patch_methods, unary_factory)
+
+# -- elementwise binary -----------------------------------------------------
+add = binary_factory("add", jnp.add)
+subtract = binary_factory("subtract", jnp.subtract)
+multiply = binary_factory("multiply", jnp.multiply)
+divide = binary_factory("divide", jnp.true_divide)
+floor_divide = binary_factory("floor_divide", jnp.floor_divide)
+remainder = binary_factory("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow_ = binary_factory("elementwise_pow", jnp.power)
+maximum = binary_factory("maximum", jnp.maximum)
+minimum = binary_factory("minimum", jnp.minimum)
+fmax = binary_factory("fmax", jnp.fmax)
+fmin = binary_factory("fmin", jnp.fmin)
+atan2 = binary_factory("atan2", jnp.arctan2)
+hypot = binary_factory("hypot", jnp.hypot)
+logaddexp = binary_factory("logaddexp", jnp.logaddexp)
+nextafter = binary_factory("nextafter", jnp.nextafter)
+copysign = binary_factory("copysign", jnp.copysign)
+heaviside = binary_factory("heaviside", lambda x, y: jnp.heaviside(x, y))
+gcd = binary_factory("gcd", jnp.gcd)
+lcm = binary_factory("lcm", jnp.lcm)
+ldexp = binary_factory("ldexp", jnp.ldexp)
+inner = binary_factory("inner", jnp.inner)
+outer = binary_factory("outer", lambda x, y: jnp.outer(x, y))
+
+
+def pow(x, y, name=None):  # noqa: A001 — Paddle public name
+    return pow_(x, y)
+
+
+# -- elementwise unary ------------------------------------------------------
+exp = unary_factory("exp", jnp.exp)
+expm1 = unary_factory("expm1", jnp.expm1)
+log = unary_factory("log", jnp.log)
+log2 = unary_factory("log2", jnp.log2)
+log10 = unary_factory("log10", jnp.log10)
+log1p = unary_factory("log1p", jnp.log1p)
+sqrt = unary_factory("sqrt", jnp.sqrt)
+rsqrt = unary_factory("rsqrt", jax.lax.rsqrt)
+square = unary_factory("square", jnp.square)
+abs = unary_factory("abs", jnp.abs)  # noqa: A001
+sign = unary_factory("sign", jnp.sign)
+neg = unary_factory("neg", jnp.negative)
+negative = neg
+reciprocal = unary_factory("reciprocal", jnp.reciprocal)
+sin = unary_factory("sin", jnp.sin)
+cos = unary_factory("cos", jnp.cos)
+tan = unary_factory("tan", jnp.tan)
+asin = unary_factory("asin", jnp.arcsin)
+acos = unary_factory("acos", jnp.arccos)
+atan = unary_factory("atan", jnp.arctan)
+sinh = unary_factory("sinh", jnp.sinh)
+cosh = unary_factory("cosh", jnp.cosh)
+tanh = unary_factory("tanh", jnp.tanh)
+asinh = unary_factory("asinh", jnp.arcsinh)
+acosh = unary_factory("acosh", jnp.arccosh)
+atanh = unary_factory("atanh", jnp.arctanh)
+erf = unary_factory("erf", jax.scipy.special.erf)
+erfinv = unary_factory("erfinv", jax.scipy.special.erfinv)
+floor = unary_factory("floor", jnp.floor)
+ceil = unary_factory("ceil", jnp.ceil)
+round = unary_factory("round", jnp.round)  # noqa: A001
+trunc = unary_factory("trunc", jnp.trunc)
+frac = unary_factory("frac", lambda x: x - jnp.trunc(x))
+sigmoid = unary_factory("sigmoid", jax.nn.sigmoid)
+digamma = unary_factory("digamma", jax.scipy.special.digamma)
+lgamma = unary_factory("lgamma", jax.scipy.special.gammaln)
+gammaln = lgamma
+i0 = unary_factory("i0", jax.scipy.special.i0)
+i0e = unary_factory("i0e", jax.scipy.special.i0e)
+i1 = unary_factory("i1", jax.scipy.special.i1)
+i1e = unary_factory("i1e", jax.scipy.special.i1e)
+deg2rad = unary_factory("deg2rad", jnp.deg2rad)
+rad2deg = unary_factory("rad2deg", jnp.rad2deg)
+conj = unary_factory("conj", jnp.conj)
+real = unary_factory("real", jnp.real)
+imag = unary_factory("imag", jnp.imag)
+angle = unary_factory("angle", jnp.angle)
+isfinite = unary_factory("isfinite", jnp.isfinite)
+isinf = unary_factory("isinf", jnp.isinf)
+isnan = unary_factory("isnan", jnp.isnan)
+isneginf = unary_factory("isneginf", jnp.isneginf)
+isposinf = unary_factory("isposinf", jnp.isposinf)
+isreal = unary_factory("isreal", jnp.isreal)
+
+
+def logit(x, eps=None, name=None):
+    x = ensure_tensor(x)
+    return forward_op("logit", _logit_impl, [x], {"eps": eps})
+
+
+def _logit_impl(x, eps):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return forward_op("nan_to_num", jnp.nan_to_num, [x],
+                      {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale: out = scale*x + bias (or scale*(x+bias))."""
+    x = ensure_tensor(x)
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    def impl(x):
+        out = x * s + bias if bias_after_scale else (x + bias) * s
+        return out.astype(x.dtype)
+
+    out = forward_op("scale", impl, [x])
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return forward_op("clip", lambda v: jnp.clip(v, lo, hi), [x])
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return forward_op("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+    return forward_op("lerp", lambda a, b: a + weight * (b - a), [x, y])
+
+
+def cast(x, dtype):
+    """Differentiable dtype cast (ref: paddle.cast / phi cast kernel)."""
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+    return forward_op("cast", lambda v: v.astype(dt), [x])
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    new = forward_op("increment", lambda v: v + value, [x])
+    x._rebind(new)
+    return x
+
+
+def multiply_(x, y):
+    return _inplace(x, multiply, y)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (ref: paddle.add_n / sum_op)."""
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def impl(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return forward_op("add_n", impl, ts)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return forward_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                      [ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)])
+
+
+def cross(x, y, axis=None, name=None):
+    ax = -1 if axis is None else int(axis)
+    return forward_op("cross", lambda a, b: jnp.cross(a, b, axis=ax),
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def dot(x, y, name=None):
+    # paddle.dot: 1-D/2-D batched inner product over last dim
+    return forward_op("dot", lambda a, b: jnp.sum(a * b, axis=-1),
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return forward_op("trace",
+                      lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                      [ensure_tensor(x)])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return forward_op("diagonal",
+                      lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                      [ensure_tensor(x)])
+
+
+def kron(x, y, name=None):
+    return forward_op("kron", jnp.kron, [ensure_tensor(x), ensure_tensor(y)])
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [ensure_tensor(x)]
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return forward_op("diff",
+                      lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app),
+                      args)
+
+
+# -- reductions -------------------------------------------------------------
+def _reduction(name: str, jfn, allow_dtype=False):
+    def op(x, axis=None, keepdim=False, dtype=None, name=None):
+        x = ensure_tensor(x)
+        ax = axes_arg(axis)
+        kw = {"axis": ax, "keepdims": keepdim}
+        if allow_dtype and dtype is not None:
+            kw["dtype"] = canonical_dtype(dtype)
+        return forward_op(name, lambda v: jfn(v, **kw), [x])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Reduction {name} over `axis` (Paddle API parity)."
+    return op
+
+
+sum = _reduction("sum", jnp.sum, allow_dtype=True)  # noqa: A001
+mean = _reduction("mean", jnp.mean)
+prod = _reduction("prod", jnp.prod, allow_dtype=True)
+max = _reduction("max", jnp.max)  # noqa: A001
+min = _reduction("min", jnp.min)  # noqa: A001
+amax = _reduction("amax", jnp.max)
+amin = _reduction("amin", jnp.min)
+nansum = _reduction("nansum", jnp.nansum, allow_dtype=True)
+nanmean = _reduction("nanmean", jnp.nanmean)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return forward_op("all", lambda v: jnp.all(v, axis=axes_arg(axis), keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return forward_op("any", lambda v: jnp.any(v, axis=axes_arg(axis), keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return forward_op("count_nonzero",
+                      lambda v: jnp.count_nonzero(v, axis=axes_arg(axis), keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return forward_op("logsumexp",
+                      lambda v: jax.scipy.special.logsumexp(v, axis=axes_arg(axis),
+                                                            keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return forward_op("std",
+                      lambda v: jnp.std(v, axis=axes_arg(axis), ddof=ddof, keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return forward_op("var",
+                      lambda v: jnp.var(v, axis=axes_arg(axis), ddof=ddof, keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return forward_op("median",
+                      lambda v: jnp.median(v, axis=axes_arg(axis), keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return forward_op("nanmedian",
+                      lambda v: jnp.nanmedian(v, axis=axes_arg(axis), keepdims=keepdim),
+                      [ensure_tensor(x)])
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return forward_op("quantile",
+                      lambda v: jnp.quantile(v, qv, axis=axes_arg(axis), keepdims=keepdim,
+                                             method=interpolation),
+                      [ensure_tensor(x)])
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+
+    return forward_op("cumsum", impl, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        if dim is None:
+            v = v.reshape(-1)
+            return jnp.cumprod(v, dtype=dt)
+        return jnp.cumprod(v, axis=int(dim), dtype=dt)
+
+    return forward_op("cumprod", impl, [x])
+
+
+def _cum_extreme(name, cmp):
+    def op(x, axis=None, dtype="int64", name_=None):
+        x = ensure_tensor(x)
+        ax = 0 if axis is None else int(axis)
+        idx_dt = canonical_dtype(dtype)
+
+        def impl(v):
+            if axis is None:
+                v = v.reshape(-1)
+            iota = jax.lax.broadcasted_iota(idx_dt, v.shape, ax)
+
+            def comb(a, b):
+                av, ai = a
+                bv, bi = b
+                take_b = cmp(bv, av)  # strict: earliest index wins ties (Paddle)
+                return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+            return jax.lax.associative_scan(comb, (v, iota), axis=ax)
+
+        return forward_op(name, impl, [x])
+
+    op.__name__ = name
+    return op
+
+
+cummax = _cum_extreme("cummax", lambda b, a: b > a)
+cummin = _cum_extreme("cummin", lambda b, a: b < a)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def impl(v):
+        if axis is None:
+            return jax.lax.cumlogsumexp(v.reshape(-1), axis=0)
+        return jax.lax.cumlogsumexp(v, axis=int(axis))
+
+    return forward_op("logcumsumexp", impl, [x])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return forward_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v),
+                      [ensure_tensor(x)])
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def impl(i, *vs):
+        stacked = jnp.stack(vs)  # [n, batch, ...]
+        rows = jnp.arange(vs[0].shape[0])
+        return stacked[i.reshape(-1), rows]
+
+    return forward_op("multiplex", impl, [idx] + ts)
+
+
+# -- in-place variants ------------------------------------------------------
+def _inplace(x: Tensor, fn, *args, **kwargs):
+    new = fn(x, *args, **kwargs)
+    x._rebind(new)
+    return x
+
+
+def _make_inplace(fn):
+    def op(x, *args, **kwargs):
+        return _inplace(x, fn, *args, **kwargs)
+    op.__name__ = fn.__name__ + "_"
+    return op
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+clip_ = _make_inplace(clip)
+scale_ = _make_inplace(scale)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+tanh_ = _make_inplace(tanh)
+abs_ = _make_inplace(abs)
+sin_ = _make_inplace(sin)
+cos_ = _make_inplace(cos)
+neg_ = _make_inplace(neg)
+
+
+# -- dunders + method patching ---------------------------------------------
+def _rsub(x, y):
+    return subtract(y, x)
+
+
+def _rdiv(x, y):
+    return divide(y, x)
+
+
+def _rpow(x, y):
+    return pow_(y, x)
+
+
+def _rfloordiv(x, y):
+    return floor_divide(y, x)
+
+
+def _rmod(x, y):
+    return remainder(y, x)
+
+
+def _matmul_method(x, y):
+    from . import linalg
+    return linalg.matmul(x, y)
+
+
+def _rmatmul_method(x, y):
+    from . import linalg
+    return linalg.matmul(y, x)
+
+
+patch_methods([
+    ("__add__", lambda s, o: add(s, o)), ("__radd__", lambda s, o: add(s, o)),
+    ("__sub__", lambda s, o: subtract(s, o)), ("__rsub__", _rsub),
+    ("__mul__", lambda s, o: multiply(s, o)), ("__rmul__", lambda s, o: multiply(s, o)),
+    ("__truediv__", lambda s, o: divide(s, o)), ("__rtruediv__", _rdiv),
+    ("__floordiv__", lambda s, o: floor_divide(s, o)), ("__rfloordiv__", _rfloordiv),
+    ("__mod__", lambda s, o: remainder(s, o)), ("__rmod__", _rmod),
+    ("__pow__", lambda s, o: pow_(s, o)), ("__rpow__", _rpow),
+    ("__neg__", lambda s: neg(s)), ("__abs__", lambda s: abs(s)),
+    ("__matmul__", _matmul_method), ("__rmatmul__", _rmatmul_method),
+    ("__pos__", lambda s: s),
+    ("add", add), ("subtract", subtract), ("multiply", multiply), ("divide", divide),
+    ("floor_divide", floor_divide), ("remainder", remainder), ("mod", remainder),
+    ("pow", pow), ("maximum", maximum), ("minimum", minimum), ("fmax", fmax),
+    ("fmin", fmin), ("atan2", atan2),
+    ("exp", exp), ("log", log), ("log2", log2), ("log10", log10), ("log1p", log1p),
+    ("sqrt", sqrt), ("rsqrt", rsqrt), ("square", square), ("abs", abs), ("sign", sign),
+    ("reciprocal", reciprocal), ("sin", sin), ("cos", cos), ("tan", tan),
+    ("tanh", tanh), ("sigmoid", sigmoid), ("erf", erf), ("erfinv", erfinv),
+    ("floor", floor), ("ceil", ceil), ("round", round), ("trunc", trunc),
+    ("frac", frac), ("digamma", digamma), ("lgamma", lgamma),
+    ("isfinite", isfinite), ("isinf", isinf), ("isnan", isnan),
+    ("scale", scale), ("clip", clip), ("lerp", lerp), ("cast", cast),
+    ("astype", cast), ("nan_to_num", nan_to_num), ("logit", logit),
+    ("sum", sum), ("mean", mean), ("prod", prod), ("max", max), ("min", min),
+    ("amax", amax), ("amin", amin), ("all", all), ("any", any),
+    ("logsumexp", logsumexp), ("std", std), ("var", var), ("median", median),
+    ("quantile", quantile), ("cumsum", cumsum), ("cumprod", cumprod),
+    ("logcumsumexp", logcumsumexp), ("count_nonzero", count_nonzero),
+    ("nansum", nansum), ("nanmean", nanmean),
+    ("dot", dot), ("cross", cross), ("trace", trace), ("diagonal", diagonal),
+    ("kron", kron), ("inner", inner), ("outer", outer), ("addmm", addmm),
+    ("diff", diff), ("neg", neg),
+    ("add_", add_), ("subtract_", subtract_), ("multiply_", multiply_),
+    ("divide_", divide_), ("clip_", clip_), ("scale_", scale_), ("exp_", exp_),
+    ("sqrt_", sqrt_), ("rsqrt_", rsqrt_), ("reciprocal_", reciprocal_),
+    ("round_", round_), ("floor_", floor_), ("ceil_", ceil_), ("tanh_", tanh_),
+    ("abs_", abs_), ("sin_", sin_), ("cos_", cos_), ("neg_", neg_),
+])

@@ -1,0 +1,202 @@
+"""Random ops and global RNG state.
+
+Parity target: ``python/paddle/tensor/random.py`` + ``paddle.seed`` / generator state
+(reference: ``paddle/phi/core/generator.h``). TPU redesign: the global generator is a
+splittable ``jax.random`` key held in a module-level state object. Each op splits the
+key functionally; ``jit.to_static`` captures the state as an implicit input/output of
+the compiled program, so compiled steps draw fresh randomness per call (unlike naive
+tracing which would bake the key in as a constant).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import ensure_tensor, forward_op, patch_methods
+
+
+class Generator:
+    """Splittable-key RNG generator (``paddle.Generator`` parity)."""
+
+    def __init__(self, seed: int = 0):
+        self.key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self.key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def get_state(self):
+        return to_tensor(self.key)
+
+    def set_state(self, state):
+        self.key = state._value if isinstance(state, Tensor) else jnp.asarray(state)
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity: reseed the global generator."""
+    _default_generator.manual_seed(int(s))
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state_list):
+    _default_generator.set_state(state_list[0])
+
+
+def _next_key():
+    return _default_generator.next_key()
+
+
+def _float_dt(dtype):
+    d = canonical_dtype(dtype)
+    return d if d is not None else get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    from .creation import _shape_arg
+    return Tensor(jax.random.normal(_next_key(), _shape_arg(shape), _float_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    from .creation import _shape_arg
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else jnp.asarray(mean)
+        s = std._value if isinstance(std, Tensor) else jnp.asarray(std)
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        z = jax.random.normal(_next_key(), shp, get_default_dtype())
+        return Tensor(m + s * z)
+    shp = _shape_arg(shape) if shape is not None else ()
+    z = jax.random.normal(_next_key(), shp, get_default_dtype())
+    return Tensor(mean + std * z)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    from .creation import _shape_arg
+    key = jax.random.PRNGKey(seed) if seed else _next_key()
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape_arg(shape), _float_dt(dtype),
+                                     minval=lo, maxval=hi))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    from .creation import _shape_arg
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_next_key(), _shape_arg(shape), int(low), int(high),
+                                     canonical_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_next_key(), tuple(x.shape), int(low), int(high),
+                                     dt if jnp.issubdtype(dt, jnp.integer) else jnp.int64
+                                     ).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(_next_key(), int(n)).astype(canonical_dtype(dtype)))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return forward_op(
+        "bernoulli",
+        lambda v, key: jax.random.bernoulli(key, v).astype(v.dtype),
+        [x, Tensor(_next_key())], differentiable=False)
+
+
+def bernoulli_(x, p=0.5, name=None) -> Tensor:
+    x.set_value(jax.random.bernoulli(_next_key(), p, tuple(x.shape)).astype(x.dtype))
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(_next_key(), x._value).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    logits = jnp.log(jnp.clip(x._value, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(_next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + logits.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick: without-replacement sampling
+        g = jax.random.gumbel(_next_key(), logits.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x.set_value(jax.random.exponential(_next_key(), tuple(x.shape)).astype(x.dtype) / lam)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    x.set_value(jax.random.uniform(_next_key(), tuple(x.shape),
+                                   x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                                   else jnp.float32, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x.set_value(mean + std * jax.random.normal(_next_key(), tuple(x.shape), x.dtype))
+    return x
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(_next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(_next_key(), tuple(x.shape), dt))
+
+
+patch_methods([
+    ("bernoulli_", bernoulli_), ("exponential_", exponential_),
+    ("uniform_", uniform_), ("normal_", normal_), ("multinomial", multinomial),
+])

@@ -1,0 +1,186 @@
+"""Search / sort / selection ops.
+
+Parity target: ``python/paddle/tensor/search.py`` in the reference. Ops with
+data-dependent output shapes (``nonzero``, ``masked_select``) are eager-only, the same
+restriction class Paddle documents for them under ``to_static``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype
+from ..core.tensor import Tensor, to_tensor
+from ._helpers import ensure_tensor, forward_op, patch_methods
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        out = jnp.argmax(v, axis=axis if axis is None else int(axis), keepdims=keepdim)
+        return out.astype(dt)
+
+    return forward_op("argmax", impl, [x], differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = canonical_dtype(dtype)
+
+    def impl(v):
+        out = jnp.argmin(v, axis=axis if axis is None else int(axis), keepdims=keepdim)
+        return out.astype(dt)
+
+    return forward_op("argmin", impl, [x], differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def impl(v):
+        idx = jnp.argsort(v, axis=int(axis), stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return forward_op("argsort", impl, [x], differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def impl(v):
+        return jnp.sort(v, axis=int(axis), stable=stable, descending=descending)
+
+    return forward_op("sort", impl, [x])
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+
+    def impl(v):
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vm, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return forward_op("topk", impl, [x])
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    kk = int(k)
+
+    def impl(v):
+        sv = jnp.sort(v, axis=int(axis))
+        si = jnp.argsort(v, axis=int(axis)).astype(jnp.int64)
+        vals = jnp.take(sv, kk - 1, axis=int(axis))
+        idx = jnp.take(si, kk - 1, axis=int(axis))
+        if keepdim:
+            vals = jnp.expand_dims(vals, int(axis))
+            idx = jnp.expand_dims(idx, int(axis))
+        return vals, idx
+
+    return forward_op("kthvalue", impl, [x])
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    vals, counts = np.unique(np.asarray(x._value), return_counts=True)
+    # simple host fallback for the uncommon op
+    arr = np.asarray(x._value)
+    mv = np.apply_along_axis(lambda a: np.bincount(
+        np.searchsorted(np.unique(a), a)).argmax(), int(axis), arr)
+    sorted_unique = np.sort(np.unique(arr))
+    out = np.apply_along_axis(
+        lambda a: sorted_unique[np.bincount(np.searchsorted(sorted_unique, a)).argmax()],
+        int(axis), arr)
+    idx = np.apply_along_axis(lambda a: int(np.where(a == a[np.argmax(
+        np.bincount(np.searchsorted(np.unique(a), a)))])[0][-1]) if a.size else 0,
+        int(axis), arr)
+    if keepdim:
+        out = np.expand_dims(out, int(axis))
+        idx = np.expand_dims(idx, int(axis))
+    return to_tensor(out), to_tensor(idx.astype(np.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return forward_op("where",
+                      lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                      [condition, ensure_tensor(x), ensure_tensor(y)])
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._rebind(out)
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (dynamic output shape)."""
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(to_tensor(i.astype(np.int64)) for i in nz)
+    return to_tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None) -> Tensor:
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return forward_op("searchsorted",
+                      lambda s, v: jnp.searchsorted(
+                          s, v, side="right" if right else "left").astype(dt),
+                      [ensure_tensor(sorted_sequence), ensure_tensor(values)],
+                      differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None) -> Tensor:
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def impl(v, i):
+        vm = jnp.moveaxis(v, int(axis), 0)
+        vm = vm.at[i.reshape(-1)].set(value)
+        return jnp.moveaxis(vm, 0, int(axis))
+
+    return forward_op("index_fill", impl, [x, index])
+
+
+def histogram(input, bins=100, min=0, max=0, name=None) -> Tensor:  # noqa: A002
+    input = ensure_tensor(input)
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+
+    def impl(v):
+        r = None if lo is None else (lo, hi)
+        h, _ = jnp.histogram(v.reshape(-1), bins=bins, range=r)
+        return h
+
+    return forward_op("histogram", impl, [input], differentiable=False)
+
+
+def bincount(x, weights=None, minlength=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    arr = np.asarray(x._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return to_tensor(np.bincount(arr, weights=w, minlength=minlength))
+
+
+patch_methods([
+    ("argmax", argmax), ("argmin", argmin), ("argsort", argsort), ("sort", sort),
+    ("topk", topk), ("kthvalue", kthvalue), ("mode", mode), ("where", where),
+    ("nonzero", nonzero), ("bucketize", bucketize), ("histogram", histogram),
+    ("bincount", bincount), ("index_fill", index_fill),
+])

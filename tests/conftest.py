@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's Gloo-on-localhost trick for
+testing collective logic without accelerators — see SURVEY.md §4): env must be set
+before jax initializes any backend, hence at conftest import time.
+"""
+
+import os
+
+# Force-assign (not setdefault): the parent env carries JAX_PLATFORMS=axon (real TPU
+# tunnel); tests must run on the virtual CPU mesh. NOTE: run pytest with PYTHONPATH=
+# (empty) — the /root/.axon_site sitecustomize claims the TPU at interpreter start,
+# before conftest can do anything.
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,216 @@
+"""Tape autograd: backward, accumulation, hooks, stop_gradient, paddle.grad,
+numeric gradient checks (the reference OpTest check_grad pattern via finite
+differences)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy array x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = f(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        f0 = f(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (f1 - f0) / (2 * eps)
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+
+def test_chain_and_broadcast():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.to_tensor(b, stop_gradient=False)
+    out = ((x + y) * 2.0).mean()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 2.0 / 12), rtol=1e-6)
+    np.testing.assert_allclose(y.grad.numpy(), np.full((4,), 3 * 2.0 / 12), rtol=1e-6)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_multi_use_fanout():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2 * 2 + 3])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([1.0], stop_gradient=True)
+    (x * y).backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only through the second factor
+
+
+def test_matmul_grad_vs_numeric():
+    a = np.random.rand(3, 4).astype(np.float64)
+    b = np.random.rand(4, 2).astype(np.float64)
+    x = paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+    w = paddle.to_tensor(b.astype(np.float32), stop_gradient=False)
+    loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    ng = numeric_grad(lambda aa: (aa @ b).sum(), a.copy())
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-3, atol=1e-3)
+
+
+def test_unary_grads_vs_numeric():
+    fns = [
+        (paddle.tanh, np.tanh),
+        (paddle.exp, np.exp),
+        (paddle.log, np.log),
+        (paddle.sqrt, np.sqrt),
+        (paddle.sigmoid, lambda v: 1 / (1 + np.exp(-v))),
+    ]
+    a = np.random.rand(5).astype(np.float64) + 0.5
+    for pf, nf in fns:
+        x = paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+        pf(x).sum().backward()
+        ng = numeric_grad(lambda v: nf(v).sum(), a.copy())
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3,
+                                   err_msg=pf.__name__)
+
+
+def test_backward_non_scalar_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    z = x * x
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    y = x * 3
+    y.register_hook(hook)
+    (y * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+    np.testing.assert_allclose(x.grad.numpy(), [30.0])  # 5 * 2 (hook) * 3
+
+
+def test_leaf_hook():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 1.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_double_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2, d2y/dx2 = 6x
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [27.0])
+    assert not g.stop_gradient
+    (gg,) = paddle.grad([g], [x])
+    np.testing.assert_allclose(gg.numpy(), [18.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 3
+
+    assert f(x).stop_gradient
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = parts[0].sum() * 1 + parts[2].sum() * 3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 0, 3], [1, 0, 3]], rtol=1e-6)
+
+
+def test_setitem_grad_flows():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    v = paddle.to_tensor([10.0], stop_gradient=False)
+    y = x * 1.0
+    y[1] = v  # functional scatter under the hood
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_inplace_add_keeps_tape():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.add_(paddle.to_tensor([5.0]))
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_nan_check_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([-1.0], stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            paddle.log(x)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})

@@ -1,0 +1,132 @@
+"""Tensor basics: creation, properties, conversion, indexing, in-place.
+
+Oracle pattern: numpy reference results (the reference's OpTest convention,
+test/legacy_test/op_test.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_python_float_uses_default_dtype():
+    x = paddle.to_tensor(3.14)
+    assert x.dtype == paddle.float32
+    paddle.set_default_dtype("float64")
+    try:
+        # float64 needs JAX_ENABLE_X64; default dtype machinery must still canonicalize
+        assert paddle.get_default_dtype() == np.dtype("float64")
+    finally:
+        paddle.set_default_dtype("float32")
+
+
+def test_dtype_strings():
+    assert paddle.to_tensor([1], dtype="int32").dtype == paddle.int32
+    assert paddle.to_tensor([1.0], dtype="bfloat16").dtype == paddle.bfloat16
+
+
+def test_creation_ops():
+    np.testing.assert_array_equal(paddle.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+    np.testing.assert_array_equal(paddle.ones([2]).numpy(), np.ones(2))
+    np.testing.assert_array_equal(paddle.full([2], 7).numpy(), np.full(2, 7))
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+
+
+def test_item_and_scalar_protocol():
+    x = paddle.to_tensor(2.5)
+    assert x.item() == 2.5
+    assert float(x) == 2.5
+    assert int(paddle.to_tensor(3)) == 3
+    assert bool(paddle.to_tensor(True))
+
+
+def test_indexing_and_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_array_equal(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+    # boolean mask read
+    m = x > 5
+    assert m.dtype == np.dtype("bool")
+
+
+def test_inplace_version_bumps():
+    x = paddle.ones([2, 2])
+    v0 = x.inplace_version
+    x.zero_()
+    assert x.inplace_version == v0 + 1
+    np.testing.assert_array_equal(x.numpy(), np.zeros((2, 2)))
+    x.fill_(5.0)
+    np.testing.assert_array_equal(x.numpy(), np.full((2, 2), 5.0))
+
+
+def test_operators_match_numpy():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32) + 0.5
+    x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-6)
+    np.testing.assert_allclose((x ** 2).numpy(), a ** 2, rtol=1e-6)
+    np.testing.assert_allclose((2.0 - x).numpy(), 2.0 - a, rtol=1e-6)
+    np.testing.assert_allclose((1.0 / y).numpy(), 1.0 / b, rtol=1e-5)
+    np.testing.assert_allclose((x @ y.T).numpy(), a @ b.T, rtol=1e-5)
+    np.testing.assert_array_equal((x > y).numpy(), a > b)
+    np.testing.assert_array_equal((-x).numpy(), -a)
+    np.testing.assert_allclose(abs(-x).numpy(), np.abs(a), rtol=1e-6)
+
+
+def test_astype_cast():
+    x = paddle.to_tensor([1.7, 2.3])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    np.testing.assert_array_equal(y.numpy(), [1, 2])
+    z = paddle.cast(y, "float32")
+    assert z.dtype == paddle.float32
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    c = x.clone()
+    d = x.detach()
+    assert not c.stop_gradient
+    assert d.stop_gradient
+    np.testing.assert_array_equal(c.numpy(), d.numpy())
+
+
+def test_parameter():
+    p = paddle.Parameter(np.zeros((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    p.trainable = False
+    assert p.stop_gradient
+
+
+def test_repr_smoke():
+    assert "Tensor" in repr(paddle.ones([2]))
+
+
+def test_iteration_and_len():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3))
+    assert len(x) == 2
+    rows = [r.numpy() for r in x]
+    np.testing.assert_array_equal(rows[1], [3, 4, 5])
+
+
+def test_tensor_hashable_identity():
+    x = paddle.ones([2])
+    y = paddle.ones([2])
+    d = {x: 1, y: 2}
+    assert d[x] == 1 and d[y] == 2
