@@ -1,0 +1,69 @@
+"""Checkpoint tier 1: paddle.save / paddle.load.
+
+Parity target: ``python/paddle/framework/io.py`` in the reference — pickle container
+with tensors converted to numpy, nested state dicts supported; ``paddle.load``
+returns Tensors again. (Tier 3, sharded distributed checkpoint, lives in
+distributed/checkpoint.py.)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+_SENTINEL = "__paddle_tpu_tensor__"
+_PARAM_SENTINEL = "__paddle_tpu_param__"
+
+
+def _encode(obj):
+    if isinstance(obj, Parameter):
+        return {_PARAM_SENTINEL: True, "value": obj.numpy(),
+                "trainable": obj.trainable, "name": obj.name}
+    if isinstance(obj, Tensor):
+        return {_SENTINEL: True, "value": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, dict):
+        if obj.get(_PARAM_SENTINEL):
+            p = Parameter(obj["value"], trainable=obj.get("trainable", True),
+                          name=obj.get("name"))
+            return p
+        if obj.get(_SENTINEL):
+            t = Tensor(obj["value"], stop_gradient=obj.get("stop_gradient", True))
+            if obj.get("name"):
+                t.name = obj["name"]
+            return t
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    if configs.get("return_numpy"):
+        return data
+    return _decode(data)

@@ -188,6 +188,28 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     jmode = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
              "trilinear": "trilinear", "bicubic": "cubic", "area": "linear"}[mode]
 
+    if align_corners and mode in ("linear", "bilinear", "trilinear"):
+        # paddle align_corners grid: src = dst * (in-1)/(out-1); separable 1-D lerp
+        def impl(v):
+            out = v
+            for ax, osz in zip(spatial_idx, out_spatial):
+                isz = out.shape[ax]
+                if osz == isz:
+                    continue
+                pos = jnp.linspace(0.0, isz - 1, osz) if osz > 1 else jnp.zeros((1,))
+                i0 = jnp.floor(pos).astype(jnp.int32)
+                i1 = jnp.minimum(i0 + 1, isz - 1)
+                w = (pos - i0).astype(v.dtype)
+                wshape = [1] * out.ndim
+                wshape[ax] = osz
+                w = w.reshape(wshape)
+                lo = jnp.take(out, i0, axis=ax)
+                hi = jnp.take(out, i1, axis=ax)
+                out = lo * (1 - w) + hi * w
+            return out.astype(v.dtype)
+
+        return forward_op("interpolate_ac", impl, [x])
+
     def impl(v):
         return jax.image.resize(v, tuple(out_shape), method=jmode).astype(v.dtype)
 

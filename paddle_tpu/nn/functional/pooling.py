@@ -15,11 +15,25 @@ from ...ops._helpers import ensure_tensor, forward_op
 from .conv import _padding, _tuple
 
 
-def _window(rank, kernel, stride, padding, channels_last, ceil_mode=False):
+def _window(rank, kernel, stride, padding, channels_last, ceil_mode=False,
+            in_spatial=None):
     k = _tuple(kernel, rank)
     s = _tuple(stride if stride is not None else kernel, rank)
     pad = _padding(padding, rank)
-    nd = rank + 2
+    if ceil_mode and not isinstance(pad, str):
+        # extend hi padding so the last (partial) window is included — the padded
+        # cells are the reducer's identity so results match paddle's ceil_mode
+        pad = list(pad)
+        for i in range(rank):
+            lo, hi = pad[i]
+            span = in_spatial[i] + lo + hi - k[i]
+            out_ceil = -(-span // s[i]) + 1
+            # torch/paddle clamp: drop a window that would start entirely inside
+            # the right padding
+            if (out_ceil - 1) * s[i] >= in_spatial[i] + lo:
+                out_ceil -= 1
+            extra = (out_ceil - 1) * s[i] + k[i] - (in_spatial[i] + lo + hi)
+            pad[i] = (lo, hi + max(0, extra))
     if channels_last:
         dims = (1,) + k + (1,)
         strides = (1,) + s + (1,)
@@ -38,8 +52,9 @@ def _pool(rank, reducer, init_val, avg=False):
              return_mask=False, name=None, count_include_pad=None):
         x = ensure_tensor(x)
         channels_last = data_format in ("NLC", "NHWC", "NDHWC")
+        in_spatial = x.shape[1:-1] if channels_last else x.shape[2:]
         dims, strides, pads, k, s, pad = _window(rank, kernel_size, stride, padding,
-                                                 channels_last, ceil_mode)
+                                                 channels_last, ceil_mode, in_spatial)
         if count_include_pad is not None:
             # paddle MaxPool uses `ceil_mode`; AvgPool's exclusive == not count_include_pad
             exclusive = not count_include_pad
@@ -77,9 +92,14 @@ def _pool_mask(x, k, s, pads, rank, channels_last):
     n, c, h, w = v.shape
     kh, kw = k
     sh, sw = s
-    ph, pw = (pads[2][0], pads[3][0]) if not isinstance(pads, str) else (0, 0)
-    oh = (h + 2 * ph - kh) // sh + 1
-    ow = (w + 2 * pw - kw) // sw + 1
+    if isinstance(pads, str):
+        ph = pw = 0
+        ph_hi = pw_hi = 0
+    else:
+        (ph, ph_hi), (pw, pw_hi) = pads[2], pads[3]
+    # use the (possibly ceil-extended) actual pads so the mask shape matches out
+    oh = (h + ph + ph_hi - kh) // sh + 1
+    ow = (w + pw + pw_hi - kw) // sw + 1
     out = np.zeros((n, c, oh, ow), np.int64)
     vp = np.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)), constant_values=-np.inf)
     for i in range(oh):
@@ -149,8 +169,9 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
     x = ensure_tensor(x)
     p = float(norm_type)
     channels_last = data_format == "NHWC"
+    in_spatial = x.shape[1:-1] if channels_last else x.shape[2:]
     dims, strides, pads, k, s, _ = _window(2, kernel_size, stride, padding,
-                                           channels_last, ceil_mode)
+                                           channels_last, ceil_mode, in_spatial)
 
     def impl(v):
         powed = jnp.abs(v) ** p

@@ -148,6 +148,8 @@ class Layer:
         p = Parameter(jnp.zeros(shape, dt), trainable=attr.trainable, name=attr.name)
         init = attr.initializer or default_initializer
         if init is None:
+            init = I._global_bias_init if is_bias else I._global_weight_init
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         init(p)
         if attr.learning_rate != 1.0:

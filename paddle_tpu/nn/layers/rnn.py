@@ -1,0 +1,318 @@
+"""Recurrent layers.
+
+Parity target: ``python/paddle/nn/layer/rnn.py`` (SimpleRNN/LSTM/GRU + cells, RNN
+wrapper, birnn). TPU redesign: the time loop is a single ``jax.lax.scan`` inside one
+traced op — XLA compiles the whole recurrence (no per-step Python dispatch, which is
+the part of Paddle's dygraph RNN that would be slowest on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+from .. import initializer as I
+from ..layer import Layer
+
+
+def _cell_params(layer: Layer, input_size: int, hidden_size: int, gates: int,
+                 suffix: str = ""):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    wi = layer.create_parameter([gates * hidden_size, input_size],
+                                default_initializer=u)
+    wh = layer.create_parameter([gates * hidden_size, hidden_size],
+                                default_initializer=u)
+    bi = layer.create_parameter([gates * hidden_size], is_bias=True,
+                                default_initializer=u)
+    bh = layer.create_parameter([gates * hidden_size], is_bias=True,
+                                default_initializer=u)
+    layer.add_parameter(f"weight_ih{suffix}", wi)
+    layer.add_parameter(f"weight_hh{suffix}", wh)
+    layer.add_parameter(f"bias_ih{suffix}", bi)
+    layer.add_parameter(f"bias_hh{suffix}", bh)
+    return wi, wh, bi, bh
+
+
+def _simple_rnn_step(activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(x, h, wi, wh, bi, bh):
+        return act(x @ wi.T + bi + h @ wh.T + bh)
+
+    return step
+
+
+def _lstm_step(x, hc, wi, wh, bi, bh):
+    h, c = hc
+    gates = x @ wi.T + bi + h @ wh.T + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(x, h, wi, wh, bi, bh):
+    xr = x @ wi.T + bi
+    hr = h @ wh.T + bh
+    xz, xr_, xn = jnp.split(xr, 3, axis=-1)
+    hz, hr_, hn = jnp.split(hr, 3, axis=-1)
+    z = jax.nn.sigmoid(xz + hz)
+    r = jax.nn.sigmoid(xr_ + hr_)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops.creation import zeros
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        step = _simple_rnn_step(self.activation)
+        out = forward_op("simple_rnn_cell", step,
+                         [inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh])
+        return out, out
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops.creation import zeros
+            z = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (z, z.clone())
+        h, c = states
+
+        def impl(x, hv, cv, wi, wh, bi, bh):
+            return _lstm_step(x, (hv, cv), wi, wh, bi, bh)
+
+        h2, c2 = forward_op("lstm_cell", impl,
+                            [inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh])
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops.creation import zeros
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        out = forward_op("gru_cell", _gru_step,
+                         [inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh])
+        return out, out
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrence compiled as lax.scan per layer."""
+
+    MODE = None  # "RNN_TANH" | "RNN_RELU" | "LSTM" | "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        gates = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        self._param_names = []
+        for layer_i in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer_i == 0 else \
+                    hidden_size * self.num_directions
+                sfx = f"_l{layer_i}" + ("_reverse" if d else "")
+                _cell_params(self, in_sz, hidden_size, gates, sfx)
+                self._param_names.append(sfx)
+
+    def _step_fn(self):
+        if self.MODE == "LSTM":
+            return _lstm_step
+        if self.MODE == "GRU":
+            return _gru_step
+        return _simple_rnn_step("relu" if self.MODE == "RNN_RELU" else "tanh")
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        is_lstm = self.MODE == "LSTM"
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+
+        if initial_states is None:
+            from ...ops.creation import zeros
+            h0 = zeros([L * D, b, H], inputs.dtype)
+            initial_states = (h0, h0.clone()) if is_lstm else h0
+
+        params = []
+        for sfx in self._param_names:
+            params += [getattr(self, "weight_ih" + sfx),
+                       getattr(self, "weight_hh" + sfx),
+                       getattr(self, "bias_ih" + sfx),
+                       getattr(self, "bias_hh" + sfx)]
+
+        step = self._step_fn()
+        time_major = self.time_major
+        dropout = self.dropout if self.training else 0.0
+        drop_keys = None
+        if dropout > 0 and L > 1:
+            from ...ops.random import _next_key
+            drop_keys = [_next_key() for _ in range(L - 1)]
+
+        state_args = list(initial_states) if is_lstm else [initial_states]
+
+        def impl(x, *flat):
+            if is_lstm:
+                h0v, c0v = flat[0], flat[1]
+                pvals = flat[2:]
+            else:
+                h0v = flat[0]
+                pvals = flat[1:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            layer_in = x
+            last_h, last_c = [], []
+            for li in range(L):
+                dir_outs = []
+                for d in range(D):
+                    pi = (li * D + d) * 4
+                    wi, wh, bi, bh = pvals[pi:pi + 4]
+                    sl = li * D + d
+                    if is_lstm:
+                        init = (h0v[sl], c0v[sl])
+
+                        def body(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                            h2, c2 = step(xt, carry, wi, wh, bi, bh)
+                            return (h2, c2), h2
+                    else:
+                        init = h0v[sl]
+
+                        def body(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                            h2 = step(xt, carry, wi, wh, bi, bh)
+                            return h2, h2
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+                    final, outs = jax.lax.scan(body, init, seq)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(outs)
+                    if is_lstm:
+                        last_h.append(final[0])
+                        last_c.append(final[1])
+                    else:
+                        last_h.append(final)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if D == 2 else dir_outs[0]
+                if dropout > 0 and li < L - 1 and drop_keys is not None:
+                    keep = jax.random.bernoulli(drop_keys[li], 1 - dropout,
+                                                layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1 - dropout), 0.0)
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            hN = jnp.stack(last_h)
+            if is_lstm:
+                return out, hN, jnp.stack(last_c)
+            return out, hN
+
+        res = forward_op(f"rnn_{self.MODE}", impl, [inputs] + state_args + params)
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        if activation == "relu":
+            self.MODE = "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (ref: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        from ...ops.manipulation import unbind, stack
+        axis = 0 if self.time_major else 1
+        steps = unbind(inputs, axis)
+        if self.is_reverse:
+            steps = steps[::-1]
+        outs = []
+        states = initial_states
+        for xt in steps:
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        from ...ops import concat as cat
+        return cat([out_fw, out_bw], axis=-1), (s_fw, s_bw)

@@ -1,0 +1,109 @@
+"""paddle.nn.utils (parity: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _wrap_value
+from .clip import clip_grad_norm_  # noqa: F401
+
+__all__ = ["clip_grad_norm_", "parameters_to_vector", "vector_to_parameters",
+           "weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    vals = [p._value.reshape(-1) for p in parameters]
+    return _wrap_value(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape))
+        p.set_value(vec._value[offset:offset + n].reshape(p._value.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize weight = g * v/||v|| (ref: paddle.nn.utils.weight_norm).
+    Implemented as a forward-pre-hook recomputing the weight."""
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    from ..core.tensor import Parameter
+
+    g = Parameter(jnp.linalg.norm(w._value, axis=axes, keepdims=True))
+    v = Parameter(w._value)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(l, inputs):
+        # rebuild the weight from the reparam each call so grads flow to g and v
+        from ..ops import divide, multiply
+        wt = multiply(v, divide(g, _clip_norm_tensor(v, axes)))
+        l._parameters[name] = wt
+        return None
+
+    h = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = h
+    return layer
+
+
+def _clip_norm_tensor(v, axes):
+    from ..core.dispatch import forward_op
+    return forward_op("wn_norm",
+                      lambda x: jnp.maximum(
+                          jnp.linalg.norm(x, axis=axes, keepdims=True), 1e-12), [v])
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_weight_norm_hook"):
+        layer._weight_norm_hook.remove()
+        del layer._weight_norm_hook
+    g = layer._parameters.pop(name + "_g", None)
+    v = layer._parameters.pop(name + "_v", None)
+    if g is not None and v is not None:
+        norm = jnp.linalg.norm(v._value, axis=tuple(
+            i for i in range(v.ndim) if g._value.shape[i] == 1), keepdims=True)
+        from ..core.tensor import Parameter
+        layer._parameters[name] = Parameter(g._value * v._value / jnp.maximum(norm, 1e-12))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """ref: paddle.nn.utils.spectral_norm — power-iteration reparam as a pre-hook."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    h = w.shape[dim]
+    wmat_cols = int(np.prod(w.shape)) // h
+    import jax
+    from ..ops.random import _next_key
+    from ..core.tensor import Parameter
+
+    u0 = jax.random.normal(_next_key(), (h,), jnp.float32)
+    layer.register_buffer(name + "_u", _wrap_value(u0 / jnp.linalg.norm(u0)))
+    v_param = Parameter(w._value)
+    layer.add_parameter(name + "_orig", v_param)
+
+    def hook(l, inputs):
+        from ..core.dispatch import forward_op
+        u = getattr(l, name + "_u")
+
+        def impl(wv, uv):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+            for _ in range(n_power_iterations):
+                vv = wm.T @ uv
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+                uv = wm @ vv
+                uv = uv / jnp.maximum(jnp.linalg.norm(uv), eps)
+            sigma = uv @ wm @ vv
+            return wv / sigma, uv
+
+        new_w, new_u = forward_op("spectral_norm_reparam", impl, [v_param, u])
+        u.set_value(new_u.numpy())
+        l._parameters[name] = new_w
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

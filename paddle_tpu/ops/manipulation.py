@@ -79,6 +79,10 @@ def split(x, num_or_sections, axis=0, name=None):
     ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
     dim = x._value.shape[ax]
     if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {dim} along axis {ax} is not divisible by "
+                f"{num_or_sections}")
         sizes = [dim // num_or_sections] * num_or_sections
     else:
         sizes = [int(s) for s in num_or_sections]
@@ -173,7 +177,8 @@ def tile(x, repeat_times, name=None) -> Tensor:
 def expand(x, shape, name=None) -> Tensor:
     x = ensure_tensor(x)
     shp = _shape_arg(shape)
-    shp = tuple(x._value.shape[len(shp) - x.ndim + i] if s == -1 else s
+    offset = len(shp) - x.ndim  # new leading dims prepended by broadcast
+    shp = tuple(x._value.shape[i - offset] if s == -1 else s
                 for i, s in enumerate(shp))
     return forward_op("expand", lambda v: jnp.broadcast_to(v, shp), [x])
 
@@ -210,10 +215,7 @@ def gather_nd(x, index, name=None) -> Tensor:
     x, index = ensure_tensor(x), ensure_tensor(index)
 
     def impl(v, idx):
-        depth = idx.shape[-1]
-        out = v[tuple(jnp.moveaxis(idx, -1, 0))] if depth == v.ndim else \
-            v[tuple(jnp.moveaxis(idx, -1, 0))]
-        return out
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
 
     return forward_op("gather_nd", impl, [x, index])
 

@@ -166,10 +166,6 @@ def increment(x, value=1.0, name=None):
     return x
 
 
-def multiply_(x, y):
-    return _inplace(x, multiply, y)
-
-
 def add_n(inputs, name=None):
     """Sum a list of tensors (ref: paddle.add_n / sum_op)."""
     ts = [ensure_tensor(t) for t in inputs]

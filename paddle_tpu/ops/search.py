@@ -92,23 +92,25 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis (host fallback; uncommon op)."""
     x = ensure_tensor(x)
-    vals, counts = np.unique(np.asarray(x._value), return_counts=True)
-    # simple host fallback for the uncommon op
     arr = np.asarray(x._value)
-    mv = np.apply_along_axis(lambda a: np.bincount(
-        np.searchsorted(np.unique(a), a)).argmax(), int(axis), arr)
-    sorted_unique = np.sort(np.unique(arr))
-    out = np.apply_along_axis(
-        lambda a: sorted_unique[np.bincount(np.searchsorted(sorted_unique, a)).argmax()],
-        int(axis), arr)
-    idx = np.apply_along_axis(lambda a: int(np.where(a == a[np.argmax(
-        np.bincount(np.searchsorted(np.unique(a), a)))])[0][-1]) if a.size else 0,
-        int(axis), arr)
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    out = np.empty(flat.shape[0], arr.dtype)
+    idx = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, inv, counts = np.unique(row, return_inverse=True, return_counts=True)
+        winner = counts.argmax()
+        out[i] = uniq[winner]
+        idx[i] = np.where(inv == winner)[0][-1]  # paddle returns the last occurrence
+    out = out.reshape(moved.shape[:-1])
+    idx = idx.reshape(moved.shape[:-1])
     if keepdim:
-        out = np.expand_dims(out, int(axis))
-        idx = np.expand_dims(idx, int(axis))
-    return to_tensor(out), to_tensor(idx.astype(np.int64))
+        out = np.expand_dims(out, ax)
+        idx = np.expand_dims(idx, ax)
+    return to_tensor(out), to_tensor(idx)
 
 
 def where(condition, x=None, y=None, name=None):

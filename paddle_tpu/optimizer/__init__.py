@@ -1,0 +1,8 @@
+"""paddle.optimizer namespace (parity: python/paddle/optimizer/__init__.py)."""
+
+from . import lr
+from .optimizer import (SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+                        Optimizer, RMSProp)
+
+__all__ = ["lr", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "RMSProp",
+           "Lamb", "Optimizer"]

@@ -1,0 +1,359 @@
+"""Optimizer base + concrete optimizers.
+
+Parity target: ``python/paddle/optimizer/`` in the reference (Optimizer base with
+accumulators, `step`/`clear_grad`/`minimize`, grad clip, regularization, LR
+scheduler integration, multi_precision master weights). TPU redesign: each optimizer
+update is one pure-jnp function over (param, grad, accumulators); under
+``jit.to_static`` the whole step fuses into the compiled program. Accumulators are
+plain Tensors keyed by parameter name (Paddle's accumulator convention).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor, _wrap_value, to_tensor
+from ..core import autograd
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[str, Tensor]] = defaultdict(dict)
+        self._master_weights: Dict[str, Tensor] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._lr = scheduler
+
+    # -- accumulators --------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Tensor, fill_value=0.0, dtype=None,
+                         shape=None):
+        store = self._accumulators[name]
+        if param.name not in store:
+            pending = getattr(self, "_pending_state", None)
+            key = f"{param.name}_{name}"
+            if pending and key in pending:
+                v = pending.pop(key)
+                store[param.name] = v if isinstance(v, Tensor) else to_tensor(v)
+            else:
+                shp = shape if shape is not None else param._value.shape
+                dt = dtype or (jnp.float32 if self._multi_precision
+                               else param._value.dtype)
+                store[param.name] = _wrap_value(jnp.full(shp, fill_value, dt))
+        return store[param.name]
+
+    def _get_accumulator(self, name: str, param: Tensor) -> Tensor:
+        return self._accumulators[name][param.name]
+
+    def _master(self, p: Parameter):
+        """fp32 master weight for low-precision params (ref: multi_precision /
+        master_weights in paddle optimizers + amp O2)."""
+        if not self._multi_precision or p._value.dtype == jnp.float32:
+            return None
+        if p.name not in self._master_weights:
+            self._master_weights[p.name] = _wrap_value(p._value.astype(jnp.float32))
+        return self._master_weights[p.name]
+
+    # -- the step ------------------------------------------------------------
+    def _params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        flat = []
+        for p in self._parameter_list:
+            if isinstance(p, dict):  # param group
+                flat.extend(p["params"])
+            else:
+                flat.append(p)
+        return flat
+
+    @autograd.no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._params()
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            param_lr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            g_val = g._value
+            wd = self._decay_value(p)
+            if wd and self._decay_is_l2():  # L2: fold into gradient (paddle semantics)
+                g_val = g_val + wd * p._value.astype(g_val.dtype)
+            master = self._master(p)
+            base = master._value if master is not None else p._value
+            new_base = self._apply_one(p, base, g_val.astype(base.dtype), param_lr)
+            if master is not None:
+                master._value = new_base
+                p._value = new_base.astype(p._value.dtype)
+            else:
+                p._value = new_base.astype(p._value.dtype)
+            p._version += 1
+
+    def _decay_value(self, p) -> float:
+        if getattr(p, "regularizer", None) is not None:
+            return float(getattr(p.regularizer, "coeff", 0.0))
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "coeff"):
+            return float(wd.coeff)
+        return float(wd)
+
+    def _decay_is_l2(self) -> bool:
+        return True
+
+    def _apply_one(self, p, value, grad, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params()]
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self) -> Dict:
+        out = {}
+        for acc_name, store in self._accumulators.items():
+            for pname, t in store.items():
+                out[f"{pname}_{acc_name}"] = t
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        out["@step"] = to_tensor(float(self._step_count))
+        if self._master_weights:
+            out["master_weights"] = dict(self._master_weights)
+        return out
+
+    def set_state_dict(self, state: Dict):
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if "@step" in state:
+            v = state["@step"]
+            self._step_count = int(v.item() if isinstance(v, Tensor) else v)
+        mw = state.get("master_weights", {})
+        for k, v in mw.items():
+            self._master_weights[k] = v if isinstance(v, Tensor) else to_tensor(v)
+        for acc_name, store in list(self._accumulators.items()):
+            for pname in list(store):
+                key = f"{pname}_{acc_name}"
+                if key in state:
+                    v = state[key]
+                    store[pname] = v if isinstance(v, Tensor) else to_tensor(v)
+        # keys for accumulators not yet created are applied lazily
+        self._pending_state = {k: v for k, v in state.items()
+                               if k not in ("LR_Scheduler", "@step", "master_weights")}
+
+class SGD(Optimizer):
+    """ref: python/paddle/optimizer/sgd.py"""
+
+    def _apply_one(self, p, value, grad, lr):
+        return value - lr * grad
+
+
+class Momentum(Optimizer):
+    """ref: python/paddle/optimizer/momentum.py (use_nesterov supported)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, value, grad, lr):
+        vel = self._add_accumulator("velocity", p, dtype=value.dtype)
+        new_v = self._momentum * vel._value + grad
+        vel._value = new_v
+        if self._nesterov:
+            return value - lr * (grad + self._momentum * new_v)
+        return value - lr * new_v
+
+
+class Adam(Optimizer):
+    """ref: python/paddle/optimizer/adam.py"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+
+    def _beta(self, b):
+        return float(b.item()) if isinstance(b, Tensor) else float(b)
+
+    def _apply_one(self, p, value, grad, lr):
+        b1, b2 = self._beta(self._beta1), self._beta(self._beta2)
+        m = self._add_accumulator("moment1", p, dtype=value.dtype)
+        v = self._add_accumulator("moment2", p, dtype=value.dtype)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=1.0,
+                                    dtype=jnp.float32, shape=())
+        b2p = self._add_accumulator("beta2_pow_acc", p, fill_value=1.0,
+                                    dtype=jnp.float32, shape=())
+        b1p._value = b1p._value * b1
+        b2p._value = b2p._value * b2
+        m._value = b1 * m._value + (1 - b1) * grad
+        v._value = b2 * v._value + (1 - b2) * jnp.square(grad)
+        mhat = m._value / (1 - b1p._value)
+        if self._amsgrad:
+            vmax = self._add_accumulator("moment2_max", p, dtype=value.dtype)
+            vmax._value = jnp.maximum(vmax._value, v._value)
+            vhat = vmax._value / (1 - b2p._value)
+        else:
+            vhat = v._value / (1 - b2p._value)
+        return value - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name, amsgrad=amsgrad)
+        self._wd = weight_decay
+        self._apply_decay_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decay_value(self, p):
+        return 0.0  # decay handled decoupled in _apply_one
+
+    def _apply_one(self, p, value, grad, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        wd = self._wd if not hasattr(self._wd, "coeff") else self._wd.coeff
+        if self._apply_decay_fun is None or self._apply_decay_fun(p.name):
+            value = value * (1.0 - lr * float(wd))
+        return super()._apply_one(p, value, grad, lr)
+
+
+class Adamax(Optimizer):
+    """ref: python/paddle/optimizer/adamax.py"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, value, grad, lr):
+        m = self._add_accumulator("moment", p, dtype=value.dtype)
+        u = self._add_accumulator("inf_norm", p, dtype=value.dtype)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=1.0,
+                                    dtype=jnp.float32, shape=())
+        b1p._value = b1p._value * self._beta1
+        m._value = self._beta1 * m._value + (1 - self._beta1) * grad
+        u._value = jnp.maximum(self._beta2 * u._value, jnp.abs(grad))
+        return value - lr / (1 - b1p._value) * m._value / (u._value + self._eps)
+
+
+class Adagrad(Optimizer):
+    """ref: python/paddle/optimizer/adagrad.py"""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, value, grad, lr):
+        acc = self._add_accumulator("moment", p, fill_value=self._init_acc,
+                                    dtype=value.dtype)
+        acc._value = acc._value + jnp.square(grad)
+        return value - lr * grad / (jnp.sqrt(acc._value) + self._eps)
+
+
+class RMSProp(Optimizer):
+    """ref: python/paddle/optimizer/rmsprop.py"""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _apply_one(self, p, value, grad, lr):
+        ms = self._add_accumulator("mean_square", p, dtype=value.dtype)
+        mom = self._add_accumulator("momentum", p, dtype=value.dtype)
+        ms._value = self._rho * ms._value + (1 - self._rho) * jnp.square(grad)
+        denom = ms._value
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p, dtype=value.dtype)
+            mg._value = self._rho * mg._value + (1 - self._rho) * grad
+            denom = denom - jnp.square(mg._value)
+        mom._value = self._momentum * mom._value + \
+            lr * grad / jnp.sqrt(denom + self._eps)
+        return value - mom._value
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py"""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, value, grad, lr):
+        m = self._add_accumulator("moment1", p, dtype=value.dtype)
+        v = self._add_accumulator("moment2", p, dtype=value.dtype)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=1.0,
+                                    dtype=jnp.float32, shape=())
+        b2p = self._add_accumulator("beta2_pow_acc", p, fill_value=1.0,
+                                    dtype=jnp.float32, shape=())
+        b1p._value = b1p._value * self._beta1
+        b2p._value = b2p._value * self._beta2
+        m._value = self._beta1 * m._value + (1 - self._beta1) * grad
+        v._value = self._beta2 * v._value + (1 - self._beta2) * jnp.square(grad)
+        mhat = m._value / (1 - b1p._value)
+        vhat = v._value / (1 - b2p._value)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._lamb_wd
+        update = r + wd * value
+        w_norm = jnp.linalg.norm(value)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return value - lr * trust * update

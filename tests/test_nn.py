@@ -1,0 +1,554 @@
+"""nn layers vs torch-CPU oracle (the reference OpTest pattern with torch standing in
+for the numpy reference where hand-writing it would be error-prone: conv, pooling,
+norms, losses)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestActivations:
+    def test_matches_torch(self):
+        a = np.random.randn(4, 7).astype(np.float32)
+        pairs = [
+            (F.relu, tF.relu), (F.gelu, lambda x: tF.gelu(x)),
+            (F.silu, tF.silu), (F.softplus, tF.softplus),
+            (F.leaky_relu, tF.leaky_relu), (F.elu, tF.elu),
+            (F.hardswish, tF.hardswish),
+            (F.log_softmax, lambda x: tF.log_softmax(x, -1)),
+            (F.softmax, lambda x: tF.softmax(x, -1)),
+            (F.mish, tF.mish), (F.relu6, tF.relu6),
+            (F.hardshrink, tF.hardshrink), (F.softshrink, tF.softshrink),
+            (F.tanhshrink, tF.tanhshrink), (F.selu, tF.selu),
+            (F.celu, tF.celu), (F.softsign, tF.softsign),
+        ]
+        for pf, tf in pairs:
+            got = pf(t(a)).numpy()
+            want = tf(torch.from_numpy(a)).numpy()
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                       err_msg=str(pf))
+
+    def test_gelu_approximate(self):
+        a = np.random.randn(10).astype(np.float32)
+        got = F.gelu(t(a), approximate=True).numpy()
+        want = tF.gelu(torch.from_numpy(a), approximate="tanh").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestLinearEmbedding:
+    def test_linear_layout(self):
+        # paddle weight layout is [in, out]
+        lin = nn.Linear(4, 3)
+        assert lin.weight.shape == [4, 3]
+        x = np.random.rand(2, 4).astype(np.float32)
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(lin(t(x)).numpy(), want, rtol=1e-5)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        assert np.all(emb.weight.numpy()[0] == 0)
+        idx = t(np.array([[0, 3], [5, 0]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        assert np.all(out.numpy()[0, 0] == 0)
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(5, 3)
+        out = emb(t(np.array([1, 1, 2])))
+        out.sum().backward()
+        g = emb.weight.grad.numpy()
+        assert g[1].sum() == pytest.approx(6.0)  # row 1 used twice
+        assert g[3].sum() == 0
+
+
+class TestConv:
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2), (1, "SAME", 1, 1),
+    ])
+    def test_conv2d_vs_torch(self, stride, padding, dilation, groups):
+        x = np.random.rand(2, 4, 9, 9).astype(np.float32)
+        w = np.random.rand(6, 4 // groups, 3, 3).astype(np.float32)
+        b = np.random.rand(6).astype(np.float32)
+        got = F.conv2d(t(x), t(w), t(b), stride=stride, padding=padding,
+                       dilation=dilation, groups=groups).numpy()
+        tpad = padding.lower() if isinstance(padding, str) else padding
+        want = tF.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                         torch.from_numpy(b), stride=stride, padding=tpad,
+                         dilation=dilation, groups=groups).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv1d_3d(self):
+        x1 = np.random.rand(2, 3, 16).astype(np.float32)
+        w1 = np.random.rand(5, 3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.conv1d(t(x1), t(w1), padding=1).numpy(),
+            tF.conv1d(torch.from_numpy(x1), torch.from_numpy(w1), padding=1).numpy(),
+            rtol=1e-4, atol=1e-4)
+        x3 = np.random.rand(1, 2, 5, 5, 5).astype(np.float32)
+        w3 = np.random.rand(4, 2, 3, 3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.conv3d(t(x3), t(w3)).numpy(),
+            tF.conv3d(torch.from_numpy(x3), torch.from_numpy(w3)).numpy(),
+            rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("stride,padding,output_padding", [
+        (1, 0, 0), (2, 1, 0), (2, 1, 1),
+    ])
+    def test_conv2d_transpose_vs_torch(self, stride, padding, output_padding):
+        x = np.random.rand(2, 4, 7, 7).astype(np.float32)
+        w = np.random.rand(4, 5, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+        got = F.conv2d_transpose(t(x), t(w), stride=stride, padding=padding,
+                                 output_padding=output_padding).numpy()
+        want = tF.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                                   stride=stride, padding=padding,
+                                   output_padding=output_padding).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = np.random.rand(1, 2, 5, 5).astype(np.float64)
+        w = np.random.rand(3, 2, 3, 3).astype(np.float64)
+        px, pw = t(x.astype(np.float32), sg=False), t(w.astype(np.float32), sg=False)
+        F.conv2d(px, pw).sum().backward()
+        tx = torch.from_numpy(x).requires_grad_()
+        tw = torch.from_numpy(w).requires_grad_()
+        tF.conv2d(tx, tw).sum().backward()
+        np.testing.assert_allclose(px.grad.numpy(), tx.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(pw.grad.numpy(), tw.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestPooling:
+    @pytest.mark.parametrize("k,s,p,ceil", [
+        (2, 2, 0, False), (3, 2, 1, False), (2, 2, 0, True), (3, 3, 0, True),
+    ])
+    def test_max_pool2d(self, k, s, p, ceil):
+        x = np.random.rand(2, 3, 7, 7).astype(np.float32)
+        got = F.max_pool2d(t(x), k, stride=s, padding=p, ceil_mode=ceil).numpy()
+        want = tF.max_pool2d(torch.from_numpy(x), k, stride=s, padding=p,
+                             ceil_mode=ceil).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_avg_pool2d(self):
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        got = F.avg_pool2d(t(x), 2).numpy()
+        want = tF.avg_pool2d(torch.from_numpy(x), 2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # padded + exclusive=False (count_include_pad)
+        got = F.avg_pool2d(t(x), 3, stride=2, padding=1, exclusive=False).numpy()
+        want = tF.avg_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                             count_include_pad=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # exclusive=True (paddle default) == torch count_include_pad=False
+        got = F.avg_pool2d(t(x), 3, stride=2, padding=1, exclusive=True).numpy()
+        want = tF.avg_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                             count_include_pad=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_adaptive_pools(self):
+        x = np.random.rand(2, 3, 7, 5).astype(np.float32)
+        got = F.adaptive_avg_pool2d(t(x), 3).numpy()
+        want = tF.adaptive_avg_pool2d(torch.from_numpy(x), 3).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        got = F.adaptive_max_pool2d(t(x), (4, 2)).numpy()
+        want = tF.adaptive_max_pool2d(torch.from_numpy(x), (4, 2)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestNorms:
+    def test_layer_norm(self):
+        x = np.random.rand(4, 6, 8).astype(np.float32)
+        ln = nn.LayerNorm(8)
+        got = ln(t(x)).numpy()
+        tln = torch.nn.LayerNorm(8)
+        tln.weight.data = torch.from_numpy(ln.weight.numpy())
+        tln.bias.data = torch.from_numpy(ln.bias.numpy())
+        np.testing.assert_allclose(got, tln(torch.from_numpy(x)).detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_and_eval(self):
+        x = np.random.rand(8, 3, 4, 4).astype(np.float32)
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch momentum = 1 - paddle
+        bn.train()
+        tbn.train()
+        got = bn(t(x)).numpy()
+        want = tbn(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(bn._mean.numpy(),
+                                   tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(bn._variance.numpy(),
+                                   tbn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+        bn.eval()
+        tbn.eval()
+        got = bn(t(x)).numpy()
+        want = tbn(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_group_instance_norm(self):
+        x = np.random.rand(2, 6, 5, 5).astype(np.float32)
+        got = F.group_norm(t(x), 3).numpy()
+        want = tF.group_norm(torch.from_numpy(x), 3).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        got = F.instance_norm(t(x)).numpy()
+        want = tF.instance_norm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        x = np.random.rand(3, 7).astype(np.float32)
+        w = np.random.rand(7).astype(np.float32)
+        got = F.rms_norm(t(x), t(w)).numpy()
+        ms = (x.astype(np.float64) ** 2).mean(-1, keepdims=True)
+        want = (x / np.sqrt(ms + 1e-6)) * w
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(6, 5).astype(np.float32)
+        labels = np.array([0, 1, 2, 3, 4, 1])
+        got = F.cross_entropy(t(logits), t(labels)).numpy()
+        want = tF.cross_entropy(torch.from_numpy(logits),
+                                torch.from_numpy(labels)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_and_smoothing(self):
+        logits = np.random.randn(6, 5).astype(np.float32)
+        labels = np.array([0, -100, 2, 3, -100, 1])
+        got = F.cross_entropy(t(logits), t(labels), ignore_index=-100).numpy()
+        want = tF.cross_entropy(torch.from_numpy(logits),
+                                torch.from_numpy(labels), ignore_index=-100).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        labels2 = np.array([0, 1, 2, 3, 4, 1])
+        got = F.cross_entropy(t(logits), t(labels2), label_smoothing=0.1).numpy()
+        want = tF.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels2),
+                                label_smoothing=0.1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(3), 4).astype(np.float32)
+        got = F.cross_entropy(t(logits), t(soft), soft_label=True).numpy()
+        want = tF.cross_entropy(torch.from_numpy(logits),
+                                torch.from_numpy(soft)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_bce_variants(self):
+        p = np.random.rand(8).astype(np.float32) * 0.98 + 0.01
+        z = np.random.randn(8).astype(np.float32)
+        y = (np.random.rand(8) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(t(p), t(y)).numpy(),
+            tF.binary_cross_entropy(torch.from_numpy(p), torch.from_numpy(y)).numpy(),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(t(z), t(y)).numpy(),
+            tF.binary_cross_entropy_with_logits(torch.from_numpy(z),
+                                                torch.from_numpy(y)).numpy(),
+            rtol=1e-4)
+        pw = np.array([2.0], np.float32)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(t(z), t(y),
+                                               pos_weight=t(pw)).numpy(),
+            tF.binary_cross_entropy_with_logits(
+                torch.from_numpy(z), torch.from_numpy(y),
+                pos_weight=torch.from_numpy(pw)).numpy(),
+            rtol=1e-4)
+
+    def test_l1_mse_smooth(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        b = np.random.randn(5, 3).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(),
+                                   tF.mse_loss(torch.from_numpy(a),
+                                               torch.from_numpy(b)).numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(),
+                                   tF.l1_loss(torch.from_numpy(a),
+                                              torch.from_numpy(b)).numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            F.smooth_l1_loss(t(a), t(b)).numpy(),
+            tF.smooth_l1_loss(torch.from_numpy(a), torch.from_numpy(b)).numpy(),
+            rtol=1e-5)
+
+    def test_kl_nll(self):
+        logp = tF.log_softmax(torch.randn(4, 5), -1)
+        target = tF.softmax(torch.randn(4, 5), -1)
+        got = F.kl_div(t(logp.numpy()), t(target.numpy()),
+                       reduction="batchmean").numpy()
+        want = tF.kl_div(logp, target, reduction="batchmean").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        labels = np.array([1, 0, 4, 2])
+        got = F.nll_loss(t(logp.numpy()), t(labels)).numpy()
+        want = tF.nll_loss(logp, torch.from_numpy(labels)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ctc_loss(self):
+        T, B, C, S = 12, 2, 6, 4
+        torch.manual_seed(0)
+        logits = torch.randn(T, B, C)
+        labels = torch.randint(1, C, (B, S))
+        in_len = torch.full((B,), T, dtype=torch.long)
+        lab_len = torch.tensor([S, S - 1])
+        want = tF.ctc_loss(tF.log_softmax(logits, -1), labels, in_len, lab_len,
+                           blank=0, reduction="mean").numpy()
+        got = F.ctc_loss(t(logits.numpy()), t(labels.numpy()),
+                         t(in_len.numpy()), t(lab_len.numpy()),
+                         blank=0, reduction="mean").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestLayerMechanics:
+    def test_state_dict_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        x = t(np.random.rand(3, 4).astype(np.float32))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+        # save/load through paddle.save
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(sd, path)
+        loaded = paddle.load(path)
+        m2.set_state_dict(loaded)
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters_and_buffers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.BatchNorm1D(2, data_format="NCL"))
+        names = [n for n, _ in m.named_parameters()]
+        assert "0.weight" in names and "1.weight" in names
+        bnames = [n for n, _ in m.named_buffers()]
+        assert "1._mean" in bnames
+        sd = m.state_dict()
+        assert "1._variance" in sd
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        x = t(np.ones((4, 2), np.float32))
+        np.testing.assert_allclose(m[1](x).numpy(), np.ones((4, 2)))
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h1 = lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+        h2 = lin.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+        lin(t(np.zeros((1, 2), np.float32)))
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        lin(t(np.zeros((1, 2), np.float32)))
+        assert calls == ["pre", "post"]
+
+    def test_apply_and_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        count = []
+        m.apply(lambda l: count.append(type(l).__name__))
+        assert count.count("Linear") == 2
+        assert len(m.sublayers()) == 3
+
+    def test_parameters_dedup(self):
+        shared = nn.Linear(3, 3)
+        m = nn.LayerList([shared, shared])
+        assert len(m.parameters()) == 2  # weight+bias counted once
+
+
+class TestOptimizers:
+    def _train(self, opt_fn, steps=60):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = opt_fn(m.parameters())
+        X = np.random.rand(64, 4).astype(np.float32)
+        Y = (X.sum(1, keepdims=True) * 0.7).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            pred = m(t(X))
+            loss = F.mse_loss(pred, t(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    @pytest.mark.parametrize("name,fn", [
+        ("sgd", lambda p: paddle.optimizer.SGD(0.1, parameters=p)),
+        ("momentum", lambda p: paddle.optimizer.Momentum(0.05, parameters=p)),
+        ("adam", lambda p: paddle.optimizer.Adam(0.01, parameters=p)),
+        ("adamw", lambda p: paddle.optimizer.AdamW(0.01, parameters=p)),
+        ("rmsprop", lambda p: paddle.optimizer.RMSProp(0.005, parameters=p)),
+        ("lamb", lambda p: paddle.optimizer.Lamb(0.01, parameters=p)),
+    ])
+    def test_optimizers_converge(self, name, fn):
+        losses = self._train(fn)
+        assert losses[-1] < losses[0] * 0.25, f"{name}: {losses[0]} -> {losses[-1]}"
+
+    def test_adam_matches_torch(self):
+        w0 = np.random.rand(3, 2).astype(np.float32)
+        g = np.random.rand(3, 2).astype(np.float32)
+        p = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.Adam(0.1, parameters=[p])
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = torch.optim.Adam([tp], lr=0.1)
+        for _ in range(5):
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+            tp.grad = torch.from_numpy(g)
+            topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                                   atol=2e-5)
+
+    def test_adamw_matches_torch(self):
+        w0 = np.random.rand(3, 2).astype(np.float32)
+        g = np.random.rand(3, 2).astype(np.float32)
+        p = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.AdamW(0.1, parameters=[p], weight_decay=0.05)
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = torch.optim.AdamW([tp], lr=0.1, weight_decay=0.05)
+        for _ in range(5):
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+            tp.grad = torch.from_numpy(g)
+            topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                                   atol=2e-5)
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.Parameter(np.zeros((4,), np.float32))
+        opt = paddle.optimizer.SGD(1.0, parameters=[p],
+                                   grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        p.grad = paddle.to_tensor(np.full(4, 10.0, np.float32))
+        opt.step()
+        # grad norm 20 clipped to 1 -> update has norm 1
+        assert np.linalg.norm(p.numpy()) == pytest.approx(1.0, rel=1e-4)
+
+    def test_lr_scheduler_integration(self):
+        p = paddle.Parameter(np.zeros((1,), np.float32))
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=[p])
+        lrs = []
+        for _ in range(5):
+            lrs.append(opt.get_lr())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        p = paddle.Parameter(np.ones((2,), np.float32), name="p0")
+        opt = paddle.optimizer.Adam(0.01, parameters=[p])
+        p.grad = paddle.to_tensor(np.ones(2, np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(sd, path)
+
+        p2 = paddle.Parameter(p.numpy().copy(), name="p0")
+        opt2 = paddle.optimizer.Adam(0.01, parameters=[p2])
+        opt2.set_state_dict(paddle.load(path))
+        p.grad = paddle.to_tensor(np.ones(2, np.float32))
+        p2.grad = paddle.to_tensor(np.ones(2, np.float32))
+        opt.step()
+        opt2.step()
+        np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+
+class TestRNN:
+    def test_lstm_matches_torch(self):
+        torch.manual_seed(0)
+        B, T, I, H = 2, 5, 3, 4
+        x = np.random.rand(B, T, I).astype(np.float32)
+        lstm = nn.LSTM(I, H)
+        tl = torch.nn.LSTM(I, H, batch_first=True)
+        # copy weights: torch layout matches ours [4H, I]
+        lstm.weight_ih_l0.set_value(tl.weight_ih_l0.detach().numpy())
+        lstm.weight_hh_l0.set_value(tl.weight_hh_l0.detach().numpy())
+        lstm.bias_ih_l0.set_value(tl.bias_ih_l0.detach().numpy())
+        lstm.bias_hh_l0.set_value(tl.bias_hh_l0.detach().numpy())
+        out, (h, c) = lstm(t(x))
+        tout, (th, tc) = tl(torch.from_numpy(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gru_bidirectional_shapes(self):
+        gru = nn.GRU(3, 4, num_layers=2, direction="bidirect")
+        out, h = gru(t(np.random.rand(2, 5, 3).astype(np.float32)))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [4, 2, 4]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(3, 4)
+        x = t(np.random.rand(2, 5, 3).astype(np.float32), sg=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+
+class TestTransformer:
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = t(np.random.rand(2, 5, 8).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 5, 8]
+
+    def test_encoder_decoder(self):
+        enc_layer = nn.TransformerEncoderLayer(8, 2, 16)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        src = t(np.random.rand(2, 4, 8).astype(np.float32))
+        mem = enc(src)
+        assert mem.shape == [2, 4, 8]
+        model = nn.Transformer(d_model=8, nhead=2, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=16)
+        tgt = t(np.random.rand(2, 3, 8).astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 8]
+
+    def test_causal_mask_effect(self):
+        # with causal mask, position 0 output must not depend on later positions
+        mha = nn.MultiHeadAttention(4, 1)
+        mha.eval()
+        x1 = np.random.rand(1, 3, 4).astype(np.float32)
+        x2 = x1.copy()
+        x2[0, 2] += 1.0  # perturb last position
+        mask = nn.Transformer.generate_square_subsequent_mask(3)
+        o1 = mha(t(x1), attn_mask=mask).numpy()
+        o2 = mha(t(x2), attn_mask=mask).numpy()
+        np.testing.assert_allclose(o1[0, 0], o2[0, 0], rtol=1e-5)
+        assert not np.allclose(o1[0, 2], o2[0, 2])
+
+
+class TestMLPTraining:
+    def test_mlp_classifier_converges(self):
+        paddle.seed(42)
+        np.random.seed(42)
+        X = np.random.randn(128, 10).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.int64)
+        m = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 2))
+        opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+        ce = nn.CrossEntropyLoss()
+        first = last = None
+        for i in range(100):
+            logits = m(t(X))
+            loss = ce(logits, t(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.1, (first, last)
+        acc = (np.argmax(m(t(X)).numpy(), 1) == y).mean()
+        assert acc > 0.95
