@@ -8,9 +8,7 @@ before jax initializes any backend, hence at conftest import time.
 import os
 
 # Force-assign (not setdefault): the parent env carries JAX_PLATFORMS=axon (real TPU
-# tunnel); tests must run on the virtual CPU mesh. NOTE: run pytest with PYTHONPATH=
-# (empty) — the /root/.axon_site sitecustomize claims the TPU at interpreter start,
-# before conftest can do anything.
+# tunnel); tests must run on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
@@ -18,6 +16,15 @@ if "xla_force_host_platform_device_count" not in prev:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+
+# The /root/.axon_site sitecustomize may have claimed the real TPU at interpreter
+# start (before this conftest ran). Tear that backend down and re-resolve on CPU so
+# the env vars above take effect regardless of how pytest was invoked.
+jax.config.update("jax_platforms", "cpu")
+if jax.default_backend() != "cpu" or jax.device_count() < 8:
+    from jax._src import xla_bridge
+    xla_bridge._clear_backends()
+assert jax.default_backend() == "cpu" and jax.device_count() >= 8
 
 jax.config.update("jax_default_matmul_precision", "highest")
 
