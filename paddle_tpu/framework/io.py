@@ -61,9 +61,19 @@ def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
         pickle.dump(_encode(obj), f, protocol=protocol)
 
 
+def _decode_numpy(obj):
+    if isinstance(obj, dict):
+        if obj.get(_PARAM_SENTINEL) or obj.get(_SENTINEL):
+            return np.asarray(obj["value"])
+        return {k: _decode_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode_numpy(v) for v in obj)
+    return obj
+
+
 def load(path: str, **configs) -> Any:
     with open(path, "rb") as f:
         data = pickle.load(f)
     if configs.get("return_numpy"):
-        return data
+        return _decode_numpy(data)
     return _decode(data)

@@ -5,7 +5,8 @@ from .activation import (celu, elu, gelu, glu, gumbel_softmax, hardshrink,
                          log_softmax, logsigmoid, maxout, mish, prelu, relu, relu6,
                          rrelu, selu, sigmoid, silu, softmax, softplus, softshrink,
                          softsign, stanh, swish, tanh, tanhshrink, thresholded_relu)
-from .attention import (flash_attention, scaled_dot_product_attention, sequence_mask)
+from .attention import (attention_probs, flash_attention,
+                        scaled_dot_product_attention, sequence_mask)
 from .common import (alpha_dropout, channel_shuffle, cosine_similarity, dropout,
                      dropout2d, dropout3d, embedding, interpolate, label_smooth,
                      linear, normalize, one_hot, pad, pixel_shuffle, pixel_unshuffle,

@@ -82,11 +82,34 @@ def _pallas_ok(q, mask, dropout_p) -> bool:
         dev = jax.devices()[0]
         if dev.platform not in ("tpu", "axon"):
             return False
+        from ...kernels import flash_attention  # noqa: F401 — kernel available?
     except Exception:
         return False
     d = q.shape[-1]
     sq = q.shape[1]
     return d % 128 == 0 and sq % 128 == 0
+
+
+def attention_probs(query, key, attn_mask=None, scale=None):
+    """Materialized softmax attention weights [B, H, Sq, Sk] (need_weights path)."""
+    query, key = ensure_tensor(query), ensure_tensor(key)
+    args = [query, key]
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+
+    def impl(q, k, *m):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+        if m:
+            mask = m[0]
+            if jnp.issubdtype(mask.dtype, jnp.bool_):
+                logits = jnp.where(mask, logits, -jnp.inf)
+            else:
+                logits = logits + mask
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+
+    return forward_op("attention_probs", impl, args)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -83,15 +83,30 @@ class MultiHeadAttention(Layer):
             else:
                 new_cache = None
 
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=_convert_attn_mask(attn_mask, q.dtype),
-            dropout_p=self.dropout, training=self.training)
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        if self.need_weights:
+            # materialized-probs path: flash/SDPA never exposes the weights
+            from ...ops.linalg import matmul
+            weights = F.attention_probs(q, k, attn_mask=mask)
+            if self.dropout and self.training:
+                weights = F.dropout(weights, self.dropout)
+            # [B,H,Sq,Sk] @ [B,Sk,H,D] -> [B,Sq,H,D]
+            vh = manipulation.transpose(v, [0, 2, 1, 3])
+            out = manipulation.transpose(matmul(weights, vh), [0, 2, 1, 3])
+        else:
+            weights = None
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask,
+                dropout_p=self.dropout, training=self.training)
         b, s = out.shape[0], out.shape[1]
         out = manipulation.reshape(out, [b, s, self.embed_dim])
         out = self.out_proj(out)
+        outs = (out,)
+        if self.need_weights:
+            outs += (weights,)
         if cache is not None and new_cache is not None:
-            return out, new_cache
-        return out
+            outs += (new_cache,)
+        return outs if len(outs) > 1 else out
 
 
 class TransformerEncoderLayer(Layer):

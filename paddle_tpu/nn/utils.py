@@ -34,15 +34,22 @@ def weight_norm(layer, name="weight", dim=0):
 
     g = Parameter(jnp.linalg.norm(w._value, axis=axes, keepdims=True))
     v = Parameter(w._value)
+    # the original weight is replaced by the reparam: drop it from _parameters so it
+    # no longer reaches parameters()/state_dict() (paddle deletes it at setup too)
+    del layer._parameters[name]
     layer.add_parameter(name + "_g", g)
     layer.add_parameter(name + "_v", v)
 
-    def hook(l, inputs):
+    def compute():
         # rebuild the weight from the reparam each call so grads flow to g and v
         from ..ops import divide, multiply
-        wt = multiply(v, divide(g, _clip_norm_tensor(v, axes)))
-        l._parameters[name] = wt
+        return multiply(v, divide(g, _clip_norm_tensor(v, axes)))
+
+    def hook(l, inputs):
+        object.__setattr__(l, name, compute())
         return None
+
+    object.__setattr__(layer, name, compute())
 
     h = layer.register_forward_pre_hook(hook)
     layer._weight_norm_hook = h
@@ -66,6 +73,7 @@ def remove_weight_norm(layer, name="weight"):
         norm = jnp.linalg.norm(v._value, axis=tuple(
             i for i in range(v.ndim) if g._value.shape[i] == 1), keepdims=True)
         from ..core.tensor import Parameter
+        layer.__dict__.pop(name, None)  # drop the computed-weight attribute
         layer._parameters[name] = Parameter(g._value * v._value / jnp.maximum(norm, 1e-12))
     return layer
 
@@ -84,6 +92,7 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
     u0 = jax.random.normal(_next_key(), (h,), jnp.float32)
     layer.register_buffer(name + "_u", _wrap_value(u0 / jnp.linalg.norm(u0)))
     v_param = Parameter(w._value)
+    del layer._parameters[name]  # replaced by the reparam (see weight_norm)
     layer.add_parameter(name + "_orig", v_param)
 
     def hook(l, inputs):
@@ -102,8 +111,9 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
 
         new_w, new_u = forward_op("spectral_norm_reparam", impl, [v_param, u])
         u.set_value(new_u.numpy())
-        l._parameters[name] = new_w
+        object.__setattr__(l, name, new_w)
         return None
 
+    object.__setattr__(layer, name, _wrap_value(w._value))
     layer.register_forward_pre_hook(hook)
     return layer

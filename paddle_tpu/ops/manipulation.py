@@ -178,8 +178,17 @@ def expand(x, shape, name=None) -> Tensor:
     x = ensure_tensor(x)
     shp = _shape_arg(shape)
     offset = len(shp) - x.ndim  # new leading dims prepended by broadcast
-    shp = tuple(x._value.shape[i - offset] if s == -1 else s
-                for i, s in enumerate(shp))
+    resolved = []
+    for i, s in enumerate(shp):
+        if s == -1:
+            if i < offset:
+                raise ValueError(
+                    f"expand: -1 is not allowed for a newly added leading dim "
+                    f"(dim {i} of target shape {tuple(shp)} for input shape "
+                    f"{tuple(x._value.shape)})")
+            s = x._value.shape[i - offset]
+        resolved.append(s)
+    shp = tuple(resolved)
     return forward_op("expand", lambda v: jnp.broadcast_to(v, shp), [x])
 
 

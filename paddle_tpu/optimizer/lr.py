@@ -123,8 +123,13 @@ class LinearWarmup(LRScheduler):
         if self.last_epoch < self.warmup_steps:
             return (self.end_lr - self.start_lr) * \
                 self.last_epoch / self.warmup_steps + self.start_lr
+        if isinstance(self.lr_after, ReduceOnPlateau):
+            # metric-driven, not epoch-indexed: the user drives its .step(metrics);
+            # here just read its current lr
+            return self.lr_after()
         if isinstance(self.lr_after, LRScheduler):
-            self.lr_after.step(self.last_epoch - self.warmup_steps)
+            self.lr_after.last_epoch = self.last_epoch - self.warmup_steps
+            self.lr_after.last_lr = self.lr_after.get_lr()
             return self.lr_after()
         return float(self.lr_after)
 

@@ -99,9 +99,10 @@ class Optimizer:
         for p, g in params_grads:
             param_lr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             g_val = g._value
-            wd = self._decay_value(p)
-            if wd and self._decay_is_l2():  # L2: fold into gradient (paddle semantics)
-                g_val = g_val + wd * p._value.astype(g_val.dtype)
+            wd, l1 = self._decay_value(p)
+            if wd:  # fold into gradient (paddle regularizer semantics)
+                pv = p._value.astype(g_val.dtype)
+                g_val = g_val + wd * (jnp.sign(pv) if l1 else pv)
             master = self._master(p)
             base = master._value if master is not None else p._value
             new_base = self._apply_one(p, base, g_val.astype(base.dtype), param_lr)
@@ -112,18 +113,20 @@ class Optimizer:
                 p._value = new_base.astype(p._value.dtype)
             p._version += 1
 
-    def _decay_value(self, p) -> float:
-        if getattr(p, "regularizer", None) is not None:
-            return float(getattr(p.regularizer, "coeff", 0.0))
+    def _decay_value(self, p):
+        """Returns (coeff, is_l1). Per-param regularizer wins over the optimizer's
+        weight_decay (paddle semantics)."""
+        from ..regularizer import L1Decay
+
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            return float(getattr(reg, "coeff", 0.0)), isinstance(reg, L1Decay)
         wd = self._weight_decay
         if wd is None:
-            return 0.0
+            return 0.0, False
         if hasattr(wd, "coeff"):
-            return float(wd.coeff)
-        return float(wd)
-
-    def _decay_is_l2(self) -> bool:
-        return True
+            return float(wd.coeff), isinstance(wd, L1Decay)
+        return float(wd), False
 
     def _apply_one(self, p, value, grad, lr):
         raise NotImplementedError
@@ -252,7 +255,7 @@ class AdamW(Adam):
         self._lr_ratio = lr_ratio
 
     def _decay_value(self, p):
-        return 0.0  # decay handled decoupled in _apply_one
+        return 0.0, False  # decay handled decoupled in _apply_one
 
     def _apply_one(self, p, value, grad, lr):
         if self._lr_ratio is not None:

@@ -1,0 +1,101 @@
+"""Regression tests for review findings (io return_numpy, L1 decay, LinearWarmup
+with ReduceOnPlateau, weight_norm param removal, expand -1 validation,
+MultiHeadAttention need_weights)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_load_return_numpy(tmp_path):
+    p = str(tmp_path / "ck.pdparams")
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    paddle.save({"w": t, "nested": {"b": t}, "x": 3}, p)
+    out = paddle.load(p, return_numpy=True)
+    assert isinstance(out["w"], np.ndarray) and out["w"].shape == (2, 3)
+    assert isinstance(out["nested"]["b"], np.ndarray)
+    assert out["x"] == 3
+
+
+def test_l1_decay_is_sign_based():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+
+    w0 = np.array([2.0, -3.0], np.float32)
+    lr, coeff = 0.1, 0.5
+    for reg, expect_extra in ((L1Decay(coeff), coeff * np.sign(w0)),
+                              (L2Decay(coeff), coeff * w0)):
+        p = paddle.create_parameter([2], "float32")
+        p.set_value(w0)
+        opt = paddle.optimizer.SGD(learning_rate=lr, parameters=[p],
+                                   weight_decay=reg)
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), w0 - lr * expect_extra, rtol=1e-6)
+
+
+def test_linear_warmup_reduce_on_plateau():
+    rop = paddle.optimizer.lr.ReduceOnPlateau(learning_rate=0.1, patience=2,
+                                              factor=0.5)
+    sched = paddle.optimizer.lr.LinearWarmup(rop, warmup_steps=3, start_lr=0.0,
+                                             end_lr=0.1)
+    for _ in range(10):
+        sched.step()
+    # without any metrics reported, plateau scheduler must not have decayed
+    assert sched() == pytest.approx(0.1)
+    rop.step(1.0), rop.step(1.0), rop.step(1.0), rop.step(1.0)
+    sched.step()
+    assert sched() == pytest.approx(0.05)
+
+
+def test_linear_warmup_wrapped_scheduler():
+    inner = paddle.optimizer.lr.ExponentialDecay(learning_rate=0.1, gamma=0.5)
+    sched = paddle.optimizer.lr.LinearWarmup(inner, warmup_steps=2, start_lr=0.0,
+                                             end_lr=0.1)
+    lrs = []
+    for _ in range(5):
+        lrs.append(sched())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.0, 0.05, 0.1, 0.05, 0.025], rtol=1e-6)
+
+
+def test_weight_norm_removes_original_param():
+    lin = nn.Linear(4, 4)
+    wn = nn.utils.weight_norm(lin)
+    names = [n for n, _ in wn.named_parameters()]
+    assert "weight" not in names
+    assert set(names) == {"weight_g", "weight_v", "bias"}
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    y = wn(x)
+    assert y.shape == [2, 4]
+    # grads flow to g and v
+    y.sum().backward()
+    assert wn.weight_g.grad is not None and wn.weight_v.grad is not None
+    # remove restores a plain weight parameter
+    nn.utils.remove_weight_norm(wn)
+    names = [n for n, _ in wn.named_parameters()]
+    assert "weight" in names and "weight_g" not in names
+    y2 = wn(x)
+    np.testing.assert_allclose(y2.numpy(), y.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_expand_rejects_minus_one_new_dim():
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    with pytest.raises(ValueError):
+        paddle.expand(x, [-1, 3])
+    out = paddle.expand(x, [2, -1])  # -1 for an existing dim is fine
+    assert out.shape == [2, 3]
+
+
+def test_mha_need_weights():
+    mha = nn.MultiHeadAttention(16, 4, need_weights=True)
+    x = paddle.to_tensor(np.random.rand(2, 5, 16).astype(np.float32))
+    out, weights = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    assert weights.shape == [2, 4, 5, 5]
+    np.testing.assert_allclose(weights.numpy().sum(-1), 1.0, rtol=1e-5)
+    # parity with the flash path
+    mha.need_weights = False
+    out2 = mha(x, x, x)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-5, atol=1e-6)
