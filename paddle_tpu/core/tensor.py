@@ -33,17 +33,43 @@ def _auto_name(prefix="generated_tensor"):
     return f"{prefix}_{next(_name_counter)}"
 
 
+class _TraceHook:
+    """Active trace context slot (set by jit.trace); checked on every _value access.
+    A plain module-level mutable holder keeps the hot path to one attribute load."""
+    ctx = None
+
+
+_trace_hook = _TraceHook
+
+
 class Tensor:
-    __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
+    __slots__ = ("_raw", "stop_gradient", "grad", "name", "persistable",
                  "_grad_node", "_node_index", "_hooks", "_retain_grads", "_version",
                  "__weakref__", "__dict__")
+
+    @property
+    def _value(self):
+        ctx = _trace_hook.ctx
+        if ctx is not None:
+            ctx.note_read(self)
+        return self._raw
+
+    @_value.setter
+    def _value(self, v):
+        ctx = _trace_hook.ctx
+        if ctx is not None:
+            ctx.note_write(self, v)
+        self._raw = v
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
             value = value._value
         if not isinstance(value, jax.Array):
             value = jnp.asarray(value)
-        self._value = value
+        ctx = _trace_hook.ctx
+        if ctx is not None:
+            ctx.note_create(self)
+        self._raw = value
         self.stop_gradient = stop_gradient
         self.grad: Optional[Tensor] = None
         self.name = name or _auto_name()
@@ -364,7 +390,10 @@ class Parameter(Tensor):
 def _wrap_value(value, stop_gradient: bool = True, node=None, index: int = 0,
                 name: Optional[str] = None) -> Tensor:
     t = Tensor.__new__(Tensor)
-    t._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+    ctx = _trace_hook.ctx
+    if ctx is not None:
+        ctx.note_create(t)
+    t._raw = value if isinstance(value, jax.Array) else jnp.asarray(value)
     t.stop_gradient = stop_gradient
     t.grad = None
     t.name = name or _auto_name()

@@ -1,0 +1,9 @@
+"""``paddle.jit`` — dynamic-to-static compilation (see api.py / trace.py)."""
+
+from .api import (InputSpec, StaticFunction, TranslatedLayer, enable_to_static,
+                  ignore_module, load, not_to_static, save, to_static)
+from .control_flow import cond, fori_loop, scan, while_loop
+
+__all__ = ["InputSpec", "StaticFunction", "TranslatedLayer", "enable_to_static",
+           "ignore_module", "load", "not_to_static", "save", "to_static",
+           "cond", "fori_loop", "scan", "while_loop"]

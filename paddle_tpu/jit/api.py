@@ -1,0 +1,323 @@
+"""``paddle.jit`` public API: to_static / save / load / InputSpec.
+
+Parity target: ``python/paddle/jit/api.py`` (``to_static``, ``jit.save``,
+``jit.load``) and ``dy2static/program_translator.py`` (``StaticFunction`` signature
+cache) in the reference. TPU redesign: programs are jax.jit-compiled XLA
+executables (see trace.py); ``jit.save`` exports a StableHLO artifact via
+``jax.export`` instead of a ProgramDesc, with weights in a separate pickle
+(.pdmodel/.pdiparams file-pair parity).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonical_dtype, get_default_dtype
+from ..core.tensor import Tensor, _wrap_value
+from .trace import CompiledProgram
+
+__all__ = ["InputSpec", "StaticFunction", "to_static", "not_to_static", "ignore_module",
+           "save", "load", "TranslatedLayer", "enable_to_static"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool = True):
+    """ProgramTranslator().enable() parity — globally bypass compilation."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity. ``None`` dims are symbolic (batch etc.)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = canonical_dtype(dtype) or get_default_dtype()
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, name=None):
+        return cls(t.shape, t.dtype, name or t.name, t.stop_gradient)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def _example(self) -> Tensor:
+        shape = tuple(1 if (d is None or d < 0) else int(d) for d in self.shape)
+        t = _wrap_value(jnp.zeros(shape, self.dtype),
+                        stop_gradient=self.stop_gradient)
+        if self.name:
+            t.name = self.name
+        return t
+
+    def _export_spec(self, scope):
+        """jax.ShapeDtypeStruct with symbolic dims for jax.export."""
+        dims = []
+        for i, d in enumerate(self.shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                dims.append(scope.setdefault(f"d{len(scope)}", None) or f"d{i}")
+            else:
+                dims.append(d)
+        if any(isinstance(d, str) for d in dims):
+            from jax import export as jexport
+            sym = jexport.symbolic_shape(
+                ",".join(str(d) for d in dims))
+            return jax.ShapeDtypeStruct(sym, self.dtype)
+        return jax.ShapeDtypeStruct(tuple(dims), self.dtype)
+
+
+class StaticFunction:
+    """Signature-cached compiled wrapper (ProgramTranslator StaticFunction parity).
+
+    Call 1 per function runs eagerly (lets lazy state — optimizer accumulators,
+    lazily-built sublayers — initialize with real values); later calls hit the
+    compiled program cache keyed by (tree structure, shapes, dtypes, training flags).
+    """
+
+    def __init__(self, function, input_spec=None, donate_states=False, layer=None):
+        self._fn = function
+        self._input_spec = input_spec
+        self._donate = donate_states
+        self._layer = layer
+        self._programs = {}
+        self._warmed_up = False
+
+    @property
+    def _train_flags(self):
+        if self._layer is None:
+            return ()
+        return tuple(m.training for m in self._layer.sublayers(include_self=True))
+
+    def _sig(self, args, kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        parts = []
+        for l in leaves:
+            if isinstance(l, Tensor):
+                parts.append(("T", tuple(l.shape), str(l.dtype)))
+            elif isinstance(l, (jax.Array, np.ndarray)):
+                parts.append(("A", tuple(l.shape), str(l.dtype)))
+            else:
+                try:
+                    parts.append(("S", hash(l)))
+                except TypeError:
+                    parts.append(("S", repr(l)))
+        return (treedef, tuple(parts), self._train_flags)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled or autograd_under_trace():
+            return self._fn(*args, **kwargs)
+        if not self._warmed_up:
+            self._warmed_up = True
+            return self._fn(*args, **kwargs)
+        key = self._sig(args, kwargs)
+        prog = self._programs.get(key)
+        if prog is None:
+            try:
+                prog = CompiledProgram(self._fn, args, kwargs,
+                                       donate_states=self._donate,
+                                       layer=self._layer)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError) as e:
+                raise RuntimeError(
+                    "to_static: data-dependent Python control flow (if/while on a "
+                    "tensor value) cannot be traced. Use paddle_tpu.jit.cond / "
+                    "while_loop / scan, or fall back to eager mode.\n"
+                    f"original error: {e}") from None
+            self._programs[key] = prog
+        return prog(args, kwargs)
+
+    # paddle API compat
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except (OSError, TypeError):
+            return "<source unavailable>"
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        return self._fn
+
+
+def autograd_under_trace() -> bool:
+    """True when already inside a trace (nested to_static collapses to inline)."""
+    from ..core.tensor import _trace_hook
+    return _trace_hook.ctx is not None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, donate_states=False, **kwargs):
+    """``@paddle.jit.to_static`` parity. Also accepts a Layer instance."""
+
+    def decorate(fn):
+        from ..nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            orig_forward = layer.forward
+            sf = StaticFunction(lambda *a, **k: orig_forward(*a, **k),
+                                input_spec, donate_states, layer=layer)
+            layer.forward = sf
+            layer._static_function = sf
+            layer._orig_forward = orig_forward
+            return layer
+        sf = StaticFunction(fn, input_spec, donate_states)
+        import functools
+        functools.update_wrapper(sf, fn)
+        return sf
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    """Marker: never compile this function (paddle.jit.not_to_static parity)."""
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+# ---------------------------------------------------------------------------
+# save / load (StableHLO artifact + weights pickle)
+# ---------------------------------------------------------------------------
+
+_MODEL_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """``paddle.jit.save`` parity: serialize an inference program + weights.
+
+    The program is the layer's forward traced in eval mode with parameters and
+    buffers lifted to explicit inputs, exported to portable StableHLO bytes
+    (``jax.export``), so it can be reloaded and run without the python model code.
+    """
+    from ..core import autograd as _ag
+    from ..nn.layer import Layer
+    from jax import export as jexport
+
+    if isinstance(layer, StaticFunction):
+        fn = layer._fn
+        model_layer = layer._layer
+    elif isinstance(layer, Layer):
+        model_layer = layer
+        fn = getattr(layer, "_orig_forward", None) or layer.forward
+        if isinstance(fn, StaticFunction):
+            fn = fn._fn
+    else:
+        model_layer, fn = None, layer
+
+    if input_spec is None:
+        spec_src = getattr(layer, "_static_function", None)
+        input_spec = getattr(spec_src, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (list of InputSpec or "
+                         "example Tensors)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+
+    # collect weights (params + buffers), fixed order
+    named = []
+    if model_layer is not None:
+        was_training = model_layer.training
+        model_layer.eval()
+        named = list(model_layer.named_parameters()) + \
+            list(model_layer.named_buffers())
+    names = [n for n, _ in named]
+    tensors = [t for _, t in named]
+
+    def pure(param_vals, arg_vals):
+        saved = [t._raw for t in tensors]
+        for t, v in zip(tensors, param_vals):
+            t._raw = v
+        try:
+            with _ag.no_grad():
+                args = [_wrap_value(v, stop_gradient=True) for v in arg_vals]
+                out = fn(*args)
+            leaves, _ = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            return [l._raw if isinstance(l, Tensor) else jnp.asarray(l)
+                    for l in leaves]
+        finally:
+            for t, v in zip(tensors, saved):
+                t._raw = v
+
+    scope: dict = {}
+    param_specs = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in tensors]
+    arg_specs = [s._export_spec(scope) for s in specs]
+    exported = jexport.export(jax.jit(pure))(param_specs, arg_specs)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + _MODEL_SUFFIX, "wb") as f:
+        pickle.dump({"stablehlo": blob, "param_names": names,
+                     "input_specs": [(s.shape, str(np.dtype(s.dtype).name),
+                                      s.name) for s in specs]}, f)
+    with open(path + _PARAMS_SUFFIX, "wb") as f:
+        pickle.dump({n: np.asarray(t._raw) for n, t in zip(names, tensors)}, f)
+    if model_layer is not None and was_training:
+        model_layer.train()
+
+
+class TranslatedLayer:
+    """Reloaded inference program (paddle.jit.TranslatedLayer parity)."""
+
+    def __init__(self, exported, params: List, param_names: List[str]):
+        self._exported = exported
+        self._params = params
+        self._param_names = param_names
+
+    def __call__(self, *args):
+        arg_vals = [a._raw if isinstance(a, Tensor) else jnp.asarray(a)
+                    for a in args]
+        outs = self._exported.call(self._params, arg_vals)
+        wrapped = [_wrap_value(o) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is an inference artifact; re-train "
+                           "from the original model code")
+
+    def parameters(self):
+        return [_wrap_value(p) for p in self._params]
+
+    def state_dict(self):
+        return {n: _wrap_value(p) for n, p in zip(self._param_names, self._params)}
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    """``paddle.jit.load`` parity: reload a saved inference artifact."""
+    from jax import export as jexport
+
+    with open(path + _MODEL_SUFFIX, "rb") as f:
+        meta = pickle.load(f)
+    with open(path + _PARAMS_SUFFIX, "rb") as f:
+        weights = pickle.load(f)
+    exported = jexport.deserialize(meta["stablehlo"])
+    params = [jnp.asarray(weights[n]) for n in meta["param_names"]]
+    return TranslatedLayer(exported, params, meta["param_names"])

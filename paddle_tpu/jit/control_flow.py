@@ -1,0 +1,140 @@
+"""Structured control flow over Tensors for use inside ``to_static``.
+
+Parity target: the reference's dy2static lowering of python ``if``/``for``/``while``
+to ``cond``/``while_loop`` ops (``python/paddle/jit/dy2static/transformers/``,
+``paddle.static.nn.cond/while_loop``). TPU redesign: these are thin Tensor wrappers
+over ``lax.cond`` / ``lax.while_loop`` / ``lax.scan`` — the XLA-native control-flow
+primitives — usable both eagerly and under a trace. ``cond`` and ``scan`` are
+differentiable through the tape (the recorded vjp differentiates the whole lax
+primitive); ``while_loop`` is forward-only (XLA has no reverse-mode while; use scan
+for differentiable loops — same limitation the reference documents for dynamic
+shapes under CINN).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import autograd
+from ..core.tensor import Tensor, _wrap_value
+from ..core.dispatch import forward_op
+
+__all__ = ["cond", "while_loop", "scan", "fori_loop"]
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(_wrap_value, tree)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """paddle.static.nn.cond parity, differentiable w.r.t. tensor operands."""
+    pred_tensor = pred if isinstance(pred, Tensor) else _wrap_value(jnp.asarray(pred))
+    flat_ops, tree = jax.tree_util.tree_flatten(
+        operands, is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_slots = [i for i, o in enumerate(flat_ops) if isinstance(o, Tensor)]
+    tensor_args = [flat_ops[i] for i in tensor_slots]
+
+    def impl(p, *vals):
+        rebuilt = list(flat_ops)
+        for i, v in zip(tensor_slots, vals):
+            rebuilt[i] = v
+
+        def run(fn):
+            def branch(rb):
+                leaves = [(_wrap_value(v) if k in tensor_slots else v)
+                          for k, v in enumerate(rb)]
+                ops = jax.tree_util.tree_unflatten(tree, leaves)
+                with autograd.no_grad():
+                    out = fn(*ops) if ops else fn()
+                return _unwrap_tree(out)
+            return branch
+
+        return lax.cond(jnp.asarray(p).astype(bool).reshape(()),
+                        run(true_fn), run(false_fn), rebuilt)
+
+    return forward_op("cond", impl, [pred_tensor] + tensor_args)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
+    """paddle.static.nn.while_loop parity (forward-only)."""
+    is_seq = isinstance(loop_vars, (list, tuple))
+    vals = _unwrap_tree(tuple(loop_vars) if is_seq else (loop_vars,))
+
+    def c(vs):
+        out = cond_fn(*_wrap_tree(vs))
+        return (out._value if isinstance(out, Tensor)
+                else jnp.asarray(out)).reshape(())
+
+    def b(vs):
+        out = body_fn(*_wrap_tree(vs))
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return _unwrap_tree(tuple(out))
+
+    with autograd.no_grad():
+        res = lax.while_loop(c, b, vals)
+    wrapped = tuple(_wrap_value(v) for v in res)
+    return list(wrapped) if is_seq else wrapped[0]
+
+
+def scan(body_fn: Callable, init, xs=None, length=None, reverse=False):
+    """Differentiable loop: carry, ys = scan(f, init, xs) (lax.scan over Tensors)."""
+    init_vals = _unwrap_tree(init)
+    xs_vals = _unwrap_tree(xs) if xs is not None else None
+    carry_tensors = [t for t in jax.tree_util.tree_leaves(
+        init, is_leaf=lambda x: isinstance(x, Tensor)) if isinstance(t, Tensor)]
+    xs_tensors = [t for t in jax.tree_util.tree_leaves(
+        xs, is_leaf=lambda x: isinstance(x, Tensor)) if isinstance(t, Tensor)] \
+        if xs is not None else []
+
+    init_tree = jax.tree_util.tree_structure(
+        init, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def impl(*flat):
+        n = len(carry_tensors)
+        c0 = jax.tree_util.tree_unflatten(init_tree, flat[:n])
+        x_leaves = flat[n:]
+        if xs is not None:
+            xs_tree = jax.tree_util.tree_structure(
+                xs, is_leaf=lambda x: isinstance(x, Tensor))
+            xs_full = jax.tree_util.tree_unflatten(xs_tree, x_leaves)
+        else:
+            xs_full = None
+
+        def step(carry, x):
+            with autograd.no_grad():
+                out = body_fn(_wrap_tree(carry),
+                              _wrap_tree(x) if x is not None else None)
+            new_carry, y = out
+            return _unwrap_tree(new_carry), _unwrap_tree(y)
+
+        return lax.scan(step, c0, xs_full, length=length, reverse=reverse)
+
+    carry, ys = forward_op("scan", impl, carry_tensors + xs_tensors)
+    return carry, ys
+
+
+def fori_loop(lower, upper, body_fn: Callable, init):
+    """lax.fori_loop over Tensors (forward-only)."""
+    init_vals = _unwrap_tree(init)
+
+    def b(i, vs):
+        out = body_fn(_wrap_value(jnp.asarray(i)), _wrap_tree(vs))
+        return _unwrap_tree(out)
+
+    with autograd.no_grad():
+        res = lax.fori_loop(int(lower) if not isinstance(lower, Tensor) else
+                            lower._value,
+                            int(upper) if not isinstance(upper, Tensor) else
+                            upper._value, b, init_vals)
+    return _wrap_tree(res)

@@ -1,0 +1,313 @@
+"""Functionalization machinery for ``paddle.jit.to_static``.
+
+Parity target: the reference's dygraph-to-static stack (``python/paddle/jit/``:
+``ProgramTranslator``/``StaticFunction`` trace-and-cache, ``PartialProgramLayer``
+running a captured program inside dygraph — see SURVEY.md §3.3). TPU redesign: instead
+of AST rewriting + a ProgramDesc interpreter, the imperative API is *functionalized*
+onto ``jax.jit``:
+
+1. discovery trace (``jax.make_jaxpr``) runs the python function with tracer
+   arguments while the real framework state (Parameters, optimizer accumulators,
+   RNG, lr) stays live; hooks on ``Tensor._value`` record every pre-existing tensor
+   that is read or written — that set is the program's implicit state;
+2. the compile trace binds that state as explicit inputs/outputs of a pure function
+   and hands it to ``jax.jit`` — in-place mutation of parameters by
+   ``optimizer.step`` becomes the state-out slot, ``loss.backward()``'s tape runs
+   on tracers and is compiled into the same program.
+
+The Paddle concepts map as: ConcreteProgram -> CompiledProgram here; program cache
+keyed by input signature -> ``StaticFunction._programs``; ``run_program`` op ->
+the compiled XLA executable; scope/variable transfer -> state binding below.
+"""
+
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor, _trace_hook, _wrap_value
+
+__all__ = ["TraceContext", "activate", "current_ctx", "CompiledProgram",
+           "build_program"]
+
+
+def current_ctx():
+    return _trace_hook.ctx
+
+
+class _Activate:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = _trace_hook.ctx
+        _trace_hook.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _trace_hook.ctx = self.prev
+        return False
+
+
+def activate(ctx):
+    return _Activate(ctx)
+
+
+class TraceContext:
+    """Records reads/writes of pre-existing tensors while a trace runs.
+
+    mode="discover": the first (state-discovery) trace — real state stays bound,
+    reads note candidates, writes save originals for restoration.
+    mode="trace": the compile trace — state is pre-bound to tracers by the caller;
+    this ctx only records *extra* writes (write-only state) and RNG/host inputs.
+    """
+
+    def __init__(self, mode: str):
+        assert mode in ("discover", "trace")
+        self.mode = mode
+        self.created: set = set()
+        self.created_refs: List[Any] = []
+        self.reads: "OrderedDict[int, Any]" = OrderedDict()    # id -> weakref
+        self.writes: "OrderedDict[int, Any]" = OrderedDict()   # id -> weakref
+        self.saved_values: Dict[int, Any] = {}
+        self.saved_grads: Dict[int, Any] = {}
+        self.host_inputs: "OrderedDict[Any, Callable]" = OrderedDict()
+        self.host_tracers: Dict[Any, Any] = {}
+        self.rng_used = False
+        self.rng_counter = 0
+        self.rng_tracer = None
+        self.state_ids: set = set()   # trace mode: ids of pre-bound state tensors
+
+    # -- Tensor hooks (called from core.tensor property accessors) ----------
+    def note_create(self, t):
+        self.created.add(id(t))
+        self.created_refs.append(weakref.ref(t))
+
+    def note_read(self, t):
+        i = id(t)
+        if i in self.created or i in self.reads or i in self.state_ids:
+            return
+        self.reads[i] = weakref.ref(t)
+        self.saved_grads.setdefault(i, t.grad)
+
+    def note_write(self, t, new_value):
+        i = id(t)
+        if i in self.created or i in self.state_ids:
+            return  # state binding/restoration is the caller's job in trace mode
+        if i not in self.saved_values:
+            self.saved_values[i] = t._raw
+            self.saved_grads.setdefault(i, t.grad)
+        self.writes[i] = weakref.ref(t)
+
+    # -- host-scalar inputs (e.g. the optimizer's current lr) ---------------
+    def host_scalar(self, tag, provider: Callable[[], float]):
+        if self.mode == "discover":
+            self.host_inputs[tag] = provider
+            return provider()
+        tr = self.host_tracers.get(tag)
+        if tr is None:
+            # not seen during discovery: bake the current value as a constant
+            return provider()
+        return tr
+
+    # -- RNG --------------------------------------------------------------
+    def rng_key(self):
+        self.rng_used = True
+        if self.mode == "discover":
+            from ..ops import random as _random
+            return _random.default_generator().next_key()
+        self.rng_counter += 1
+        return jax.random.fold_in(self.rng_tracer, self.rng_counter)
+
+    # -- restoration --------------------------------------------------------
+    def restore(self):
+        for i, val in self.saved_values.items():
+            ref = self.writes.get(i) or self.reads.get(i)
+            t = ref() if ref is not None else None
+            if t is not None:
+                t._raw = val
+        # undo tracer grads attached by a backward() inside the trace
+        for i, g0 in self.saved_grads.items():
+            ref = self.writes.get(i) or self.reads.get(i)
+            t = ref() if ref is not None else None
+            if t is not None:
+                t.grad = g0
+
+
+def _check_no_escaped_tracers(ctx):
+    """Tensors *created* during a trace that are still alive with tracer values
+    were stored into long-lived objects (e.g. lazily-initialized optimizer
+    accumulators) — state the functionalization can't transport. One eager
+    warmup call creates such state with real values (StaticFunction does this)."""
+    import gc
+
+    gc.collect()
+    escaped = []
+    for ref in ctx.created_refs:
+        t = ref()
+        if t is not None and isinstance(t._raw, jax.core.Tracer):
+            escaped.append(t.name)
+    if escaped:
+        raise RuntimeError(
+            "to_static: state was lazily created during tracing and escaped the "
+            f"trace ({escaped[:5]}...). Run the function eagerly once before "
+            "compiling (StaticFunction's first call does this automatically).")
+
+
+class CompiledProgram:
+    """One compiled (signature-specialized) program: the XLA executable plus the
+    state-binding plan (Paddle ConcreteProgram + run_program equivalent)."""
+
+    def __init__(self, fn, example_args, example_kwargs, donate_states=False,
+                 layer=None):
+        self._fn = fn
+        self._donate = donate_states
+        self._layer = layer
+        self._build(example_args, example_kwargs)
+
+    # -- build --------------------------------------------------------------
+    def _build(self, args, kwargs):
+        from ..ops import random as _random
+
+        leaves, self._in_tree = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        self._tensor_pos = [i for i, l in enumerate(leaves)
+                            if isinstance(l, Tensor)]
+        self._static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
+        self._arg_meta = [(bool(leaves[i].stop_gradient), leaves[i].name)
+                          for i in self._tensor_pos]
+        example_vals = [leaves[i]._raw for i in self._tensor_pos]
+
+        # ---- pass 1: state discovery --------------------------------------
+        gen = _random.default_generator()
+        saved_key = gen.key
+        ctx = TraceContext("discover")
+
+        def discover(*arr_ins):
+            with activate(ctx):
+                call_args, call_kwargs = self._rebuild(arr_ins)
+                self._fn(*call_args, **call_kwargs)
+            return 0
+
+        try:
+            jax.make_jaxpr(discover)(*example_vals)
+        finally:
+            ctx.restore()
+            gen.key = saved_key
+        _check_no_escaped_tracers(ctx)
+
+        state: List[Tensor] = []
+        seen = set()
+        for store in (ctx.reads, ctx.writes):
+            for i, ref in store.items():
+                t = ref()
+                if t is not None and i not in seen:
+                    seen.add(i)
+                    state.append(t)
+        self._state = state
+        self._host_tags = list(ctx.host_inputs.keys())
+        self._host_providers = list(ctx.host_inputs.values())
+        self._rng_used = ctx.rng_used
+
+        # ---- pass 2: compile ----------------------------------------------
+        # structure discovered during the jit trace, captured via these cells
+        self._out_tree = None
+        self._out_is_tensor: List[bool] = []
+        self._extra_state: List[Tensor] = []
+        self._grad_slots: List[int] = []
+        state_list = self._state
+
+        def pure_fn(arr_ins, state_vals, host_vals, rng_key):
+            ctx2 = TraceContext("trace")
+            ctx2.host_tracers = dict(zip(self._host_tags, host_vals))
+            ctx2.rng_tracer = rng_key
+            ctx2.state_ids = {id(t) for t in state_list}
+            saved = [(t._raw, t.grad, t._grad_node, t._node_index)
+                     for t in state_list]
+            for t, v in zip(state_list, state_vals):
+                t._raw = v
+                t.grad = None
+                t._grad_node = None
+                t._node_index = 0
+            try:
+                with activate(ctx2):
+                    call_args, call_kwargs = self._rebuild(arr_ins)
+                    out = self._fn(*call_args, **call_kwargs)
+                out_leaves, out_tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                self._out_tree = out_tree
+                self._out_is_tensor = [isinstance(l, Tensor) for l in out_leaves]
+                out_vals = [l._raw if isinstance(l, Tensor) else l
+                            for l in out_leaves]
+                new_state = [t._raw for t in state_list]
+                extra = []
+                extra_vals = []
+                for i, ref in ctx2.writes.items():
+                    t = ref()
+                    if t is not None and i not in ctx2.state_ids:
+                        extra.append(t)
+                        extra_vals.append(t._raw)
+                self._extra_state = extra
+                self._grad_slots = [k for k, t in enumerate(state_list)
+                                    if t.grad is not None]
+                grad_vals = [state_list[k].grad._raw for k in self._grad_slots]
+                return out_vals, new_state, extra_vals, grad_vals
+            finally:
+                for t, (v, g, n, ix) in zip(state_list, saved):
+                    t._raw = v
+                    t.grad = g
+                    t._grad_node = n
+                    t._node_index = ix
+                ctx2.restore()
+
+        donate = (1,) if self._donate else ()
+        self._compiled = jax.jit(pure_fn, donate_argnums=donate)
+        # Trace now (aot) so the structure cells are filled before first use.
+        self._lowered = None
+
+    def _rebuild(self, arr_ins):
+        leaves = list(self._static_leaves)
+        for pos, v, (sg, name) in zip(self._tensor_pos, arr_ins, self._arg_meta):
+            t = _wrap_value(v, stop_gradient=sg)
+            t.name = name
+            leaves[pos] = t
+        return jax.tree_util.tree_unflatten(self._in_tree, leaves)
+
+    # -- run ----------------------------------------------------------------
+    def __call__(self, args, kwargs):
+        from ..ops import random as _random
+
+        leaves, _ = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        arr_ins = [leaves[i]._raw for i in self._tensor_pos]
+        state_vals = [t._raw for t in self._state]
+        host_vals = [jnp.asarray(p(), jnp.float32) for p in self._host_providers]
+        rng = (_random.default_generator().next_key() if self._rng_used
+               else jnp.zeros((2,), jnp.uint32))
+        out_vals, new_state, extra_vals, grad_vals = self._compiled(
+            arr_ins, state_vals, host_vals, rng)
+        for t, v in zip(self._state, new_state):
+            t._raw = v
+            t._version += 1
+        for t, v in zip(self._extra_state, extra_vals):
+            t._raw = v
+            t._version += 1
+        for k, v in zip(self._grad_slots, grad_vals):
+            self._state[k].grad = _wrap_value(v)
+        out_leaves = []
+        for is_t, v in zip(self._out_is_tensor, out_vals):
+            out_leaves.append(_wrap_value(v) if is_t else v)
+        return jax.tree_util.tree_unflatten(self._out_tree, out_leaves)
+
+
+def build_program(fn, args, kwargs, donate_states=False, layer=None):
+    prog = CompiledProgram(fn, args, kwargs, donate_states=donate_states,
+                           layer=layer)
+    return prog

@@ -177,6 +177,11 @@ class Layer:
                 seen.add(id(p))
                 yield (f"{name}.{pname}" if name else pname), p
 
+    def clear_gradients(self):
+        """paddle.nn.Layer.clear_gradients parity."""
+        for p in self.parameters():
+            p.clear_grad()
+
     def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
         return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
 

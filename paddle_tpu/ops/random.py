@@ -70,6 +70,12 @@ def set_rng_state(state_list):
 
 
 def _next_key():
+    # under a to_static trace the key is an implicit program input (fresh
+    # randomness per compiled call instead of a baked trace-time constant)
+    from ..core.tensor import _trace_hook
+    ctx = _trace_hook.ctx
+    if ctx is not None:
+        return ctx.rng_key()
     return _default_generator.next_key()
 
 

@@ -34,10 +34,19 @@ class Optimizer:
         self._step_count = 0
 
     # -- lr ------------------------------------------------------------------
-    def get_lr(self) -> float:
+    def _lr_value(self) -> float:
         if isinstance(self._lr, LRScheduler):
             return float(self._lr())
         return float(self._lr)
+
+    def get_lr(self):
+        # under a to_static trace the lr is a host-scalar program input, so a
+        # scheduler stepping between compiled calls takes effect without retracing
+        from ..core.tensor import _trace_hook
+        ctx = _trace_hook.ctx
+        if ctx is not None:
+            return ctx.host_scalar(("opt_lr", id(self)), self._lr_value)
+        return self._lr_value()
 
     def set_lr(self, value: float):
         if isinstance(self._lr, LRScheduler):

@@ -1,0 +1,225 @@
+"""to_static: dygraph-vs-compiled parity (the reference's dy2static test oracle —
+run the model both ways, assert output/loss-trajectory parity; see SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+
+
+def make_data(n=32, din=4):
+    rng = np.random.RandomState(7)
+    X = rng.rand(n, din).astype(np.float32)
+    Y = (X @ rng.rand(din, 1).astype(np.float32) + 0.1).astype(np.float32)
+    return X, Y
+
+
+class TestFunctionCompile:
+    def test_pure_fn_parity_and_cache(self):
+        calls = {"n": 0}
+
+        @jit.to_static
+        def f(x, y):
+            calls["n"] += 1
+            return paddle.matmul(x, y) + 1.0
+
+        a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+        want = (paddle.matmul(a, b) + 1.0).numpy()
+        r1 = f(a, b)  # eager warmup
+        r2 = f(a, b)  # build + compiled
+        r3 = f(a, b)  # cached compiled: python fn must NOT run again
+        np.testing.assert_allclose(r1.numpy(), want, rtol=1e-6)
+        np.testing.assert_allclose(r2.numpy(), want, rtol=1e-6)
+        np.testing.assert_allclose(r3.numpy(), want, rtol=1e-6)
+        assert calls["n"] == 3  # warmup + discovery + jit trace
+        # new shape retraces
+        a2 = paddle.to_tensor(np.random.rand(6, 4).astype(np.float32))
+        f(a2, b)
+        assert calls["n"] == 5
+
+    def test_static_kwargs_in_cache_key(self):
+        @jit.to_static
+        def f(x, flag=False):
+            return x * 2 if flag else x * 3
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        f(x, flag=True)  # warmup
+        assert f(x, flag=True).numpy()[0] == 2
+        assert f(x, flag=False).numpy()[0] == 3
+        assert f(x, flag=True).numpy()[0] == 2
+
+
+class TestTrainStepCompile:
+    def _run(self, compiled: bool, steps=8):
+        paddle.seed(42)
+        X, Y = make_data()
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=model.parameters())
+
+        def step(x, y):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        if compiled:
+            step = jit.to_static(step)
+        losses = []
+        for _ in range(steps):
+            losses.append(float(step(paddle.to_tensor(X),
+                                     paddle.to_tensor(Y)).numpy()))
+        return losses
+
+    def test_loss_trajectory_parity(self):
+        eager = self._run(False)
+        static = self._run(True)
+        np.testing.assert_allclose(eager, static, rtol=1e-4, atol=1e-6)
+        assert static[-1] < static[0]
+
+    def test_scheduler_lr_feeds_compiled_step(self):
+        paddle.seed(0)
+        X, Y = make_data()
+        model = nn.Linear(4, 1)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.5, step_size=1,
+                                              gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=model.parameters())
+
+        @jit.to_static
+        def step(x, y):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+        step(x, y)  # warmup (lr=0.5)
+        w_before = model.weight.numpy().copy()
+        step(x, y)  # compiled, lr=0.5
+        d1 = np.abs(model.weight.numpy() - w_before).max()
+        sched.step()  # lr -> 0.05
+        sched.step()  # lr -> 0.005
+        w_before = model.weight.numpy().copy()
+        step(x, y)  # same compiled program, much smaller lr
+        d2 = np.abs(model.weight.numpy() - w_before).max()
+        assert d2 < d1 * 0.2, (d1, d2)
+
+    def test_rng_fresh_per_compiled_call(self):
+        drop = nn.Dropout(0.5)
+        drop.train()
+
+        @jit.to_static
+        def f(x):
+            return drop(x)
+
+        x = paddle.to_tensor(np.ones((4, 64), np.float32))
+        f(x)  # warmup
+        m1 = f(x).numpy()
+        m2 = f(x).numpy()
+        assert (m1 != m2).any(), "compiled dropout must draw fresh masks"
+
+    def test_grads_visible_after_compiled_backward(self):
+        model = nn.Linear(4, 1)
+
+        @jit.to_static
+        def fwd_bwd(x, y):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            return loss
+
+        X, Y = make_data()
+        x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+        fwd_bwd(x, y)
+        model.clear_gradients()
+        fwd_bwd(x, y)  # compiled
+        fwd_bwd(x, y)
+        assert model.weight.grad is not None
+        g = model.weight.grad.numpy()
+        assert np.abs(g).max() > 0
+
+
+class TestTrainEvalModes:
+    def test_training_flag_in_cache_key(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.9))
+        static_model = jit.to_static(model)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        static_model(x)  # warmup
+        static_model(x)
+        model.eval()
+        out_eval1 = static_model(x).numpy()
+        out_eval2 = static_model(x).numpy()
+        np.testing.assert_array_equal(out_eval1, out_eval2)  # no dropout in eval
+        model.train()
+        outs = [static_model(x).numpy() for _ in range(3)]
+        assert any((o != outs[0]).any() for o in outs[1:])
+
+
+class TestControlFlow:
+    def test_cond(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        out = jit.cond(paddle.to_tensor(True), lambda a: a * 2, lambda a: a * 3, x)
+        assert out.numpy()[0] == 4.0
+        out.sum().backward()
+        assert x.grad.numpy()[0] == 2.0
+        out2 = jit.cond(paddle.to_tensor(False), lambda a: a * 2, lambda a: a * 3, x)
+        assert out2.numpy()[0] == 6.0
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        i2, s2 = jit.while_loop(lambda i, s: i < 5,
+                                lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(i2.numpy()) == 5 and float(s2.numpy()) == 10.0
+
+    def test_scan_differentiable(self):
+        xs = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+        c0 = paddle.to_tensor(np.array(1.0, np.float32), stop_gradient=False)
+
+        def body(c, x):
+            new = c * x
+            return new, new
+
+        carry, ys = jit.scan(body, c0, xs)
+        assert float(carry.numpy()) == 0.0  # 1*0*1*2*3
+        carry2, _ = jit.scan(body, paddle.to_tensor(np.array(1.0, np.float32),
+                                                    stop_gradient=False),
+                             paddle.to_tensor(np.array([2., 3.], np.float32),
+                                              stop_gradient=False))
+        carry2.backward()
+
+    def test_data_dependent_branch_raises_helpfully(self):
+        @jit.to_static
+        def f(x):
+            if (x.sum() > 0).item():
+                return x * 2
+            return x * 3
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        f(x)  # warmup, eager: fine
+        with pytest.raises(Exception) as ei:
+            f(x)
+        assert "cond" in str(ei.value) or "Tracer" in str(ei.value)
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model.eval()
+        path = str(tmp_path / "infer/model")
+        jit.save(model, path, input_spec=[jit.InputSpec([None, 4], "float32")])
+        loaded = jit.load(path)
+        x = np.random.rand(5, 4).astype(np.float32)
+        want = model(paddle.to_tensor(x)).numpy()
+        got = loaded(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # polymorphic batch: different batch size without re-export
+        x2 = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x2)).numpy(),
+                                   model(paddle.to_tensor(x2)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
