@@ -94,12 +94,19 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """RMSNorm (ref: paddle.incubate.nn.functional.fused_rms_norm). The Pallas kernel
-    path (kernels/rmsnorm.py) is used by models on TPU; this is the jnp fallback."""
+    """RMSNorm (ref: paddle.incubate.nn.functional.fused_rms_norm). Dispatches to
+    the fused Pallas kernel (kernels/rms_norm.py) when a weight is given and the
+    feature dim is lane-aligned; jnp fallback otherwise."""
     x = ensure_tensor(x)
     args = [x] + ([ensure_tensor(weight)] if weight is not None else [])
+    d = x.shape[-1]
+    use_kernel = weight is not None and d % 128 == 0 and \
+        int(np.prod(x.shape[:-1])) % 8 == 0
 
     def impl(v, *w):
+        if use_kernel:
+            from ...kernels.rms_norm import rms_norm as rms_kernel
+            return rms_kernel(v, w[0], epsilon)
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
         out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
         if w:

@@ -1,0 +1,163 @@
+"""Pallas kernels vs pure-jax oracle (the reference OpTest numpy-oracle +
+gradient-check pattern, SURVEY.md §4), run in interpret mode on the CPU mesh."""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels import (apply_rope, flash_attention,
+                                flash_attention_with_lse, rms_norm,
+                                rope_cos_sin)
+
+
+def sdpa_ref(q, k, v, causal=False):
+    d = q.shape[-1]
+    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    if kh.shape[1] != qh.shape[1]:  # GQA: repeat kv heads
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+
+def rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape).astype(dtype))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity(self, causal):
+        q = rand((2, 256, 4, 64), 0)
+        k = rand((2, 256, 4, 64), 1)
+        v = rand((2, 256, 4, 64), 2)
+        out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+        want = sdpa_ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa(self):
+        q = rand((1, 128, 8, 64), 0)
+        k = rand((1, 128, 2, 64), 1)
+        v = rand((1, 128, 2, 64), 2)
+        out = flash_attention(q, k, v, causal=True)
+        want = sdpa_ref(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_vs_reference(self, causal):
+        q = rand((1, 128, 2, 64), 3)
+        k = rand((1, 128, 2, 64), 4)
+        v = rand((1, 128, 2, 64), 5)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (sdpa_ref(q, k, v, causal) ** 2).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_gqa_grads(self):
+        q = rand((1, 128, 4, 64), 6)
+        k = rand((1, 128, 2, 64), 7)
+        v = rand((1, 128, 2, 64), 8)
+        g1 = jax.grad(lambda *a: (flash_attention(*a, causal=True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: (sdpa_ref(*a, causal=True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_lse(self):
+        q = rand((1, 128, 1, 64), 9)
+        k = rand((1, 128, 1, 64), 10)
+        v = rand((1, 128, 1, 64), 11)
+        _, lse = flash_attention_with_lse(q, k, v)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / 8.0
+        want = jax.scipy.special.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16(self):
+        q = rand((1, 128, 2, 64), 0).astype(jnp.bfloat16)
+        k = rand((1, 128, 2, 64), 1).astype(jnp.bfloat16)
+        v = rand((1, 128, 2, 64), 2).astype(jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        want = sdpa_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), True)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+class TestRMSNorm:
+    def test_forward(self):
+        x = rand((4, 32, 256), 0)
+        w = rand((256,), 1) * 0.1 + 1.0
+        out = rms_norm(x, w, 1e-6)
+        want = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads(self):
+        x = rand((8, 128), 2)
+        w = rand((128,), 3) * 0.1 + 1.0
+
+        def ref(x, w):
+            return (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)
+                    * w)
+
+        g1 = jax.grad(lambda x, w: (rms_norm(x, w, 1e-6) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: (ref(x, w) ** 2).sum(), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestRoPE:
+    def test_forward_and_inverse(self):
+        x = rand((2, 16, 4, 64), 0)
+        cos, sin = rope_cos_sin(16, 64)
+        out = apply_rope(x, cos, sin)
+
+        # reference rotate-half
+        x1, x2 = x[..., :32], x[..., 32:]
+        rot = jnp.concatenate([-x2, x1], -1)
+        want = x * cos[None, :, None, :] + rot * sin[None, :, None, :]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        # rotation by -theta inverts
+        back = apply_rope(out, cos, -sin)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_is_exact_adjoint(self):
+        x = rand((1, 8, 2, 32), 1)
+        cos, sin = rope_cos_sin(8, 32)
+        g1 = jax.grad(lambda x: (apply_rope(x, cos, sin) ** 2).sum())(x)
+
+        def ref(x):
+            x1, x2 = x[..., :16], x[..., 16:]
+            rot = jnp.concatenate([-x2, x1], -1)
+            return x * cos[None, :, None, :] + rot * sin[None, :, None, :]
+
+        g2 = jax.grad(lambda x: (ref(x) ** 2).sum())(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-5)
