@@ -1,0 +1,44 @@
+"""``paddle.distributed`` — TPU-native distributed stack.
+
+Parity target: ``python/paddle/distributed/`` in the reference (communication/,
+fleet/, auto_parallel/, sharding/, launch/). TPU redesign summary (SURVEY.md §5
+"Distributed communication backend"): process groups -> named mesh axes;
+NCCL collectives -> XLA HLO collectives over ICI/DCN; TCPStore rendezvous ->
+jax.distributed coordination service; DistTensor/SPMD rules -> GSPMD.
+"""
+
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                            dtensor_from_fn, get_mesh, reshard, set_mesh,
+                            shard_layer, shard_tensor)
+from .collective import (ReduceOp, all_gather, all_reduce, alltoall, barrier,
+                         broadcast, get_rank, get_world_size, init_parallel_env,
+                         is_initialized, reduce, reduce_scatter, scatter)
+from .parallel import DataParallel, ParallelEnv
+from .sharding import group_sharded_parallel
+from .topology import (HybridCommunicateGroup, build_mesh,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import fleet
+from . import sharding
+
+__all__ = [
+    "Partial", "Placement", "ProcessMesh", "Replicate", "Shard",
+    "dtensor_from_fn", "get_mesh", "reshard", "set_mesh", "shard_layer",
+    "shard_tensor", "ReduceOp", "all_gather", "all_reduce", "alltoall",
+    "barrier", "broadcast", "get_rank", "get_world_size", "init_parallel_env",
+    "is_initialized", "reduce", "reduce_scatter", "scatter", "DataParallel",
+    "ParallelEnv", "group_sharded_parallel", "HybridCommunicateGroup",
+    "build_mesh", "get_hybrid_communicate_group", "fleet", "sharding",
+    "spawn", "launch",
+]
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """paddle.distributed.spawn parity. Single-controller JAX sees every local
+    device from one process, so spawn degenerates to a direct call."""
+    return func(*args)
+
+
+def launch():
+    from .launch.main import main
+    return main()

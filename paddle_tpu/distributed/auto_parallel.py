@@ -1,0 +1,232 @@
+"""Auto-parallel (SPMD) API: ProcessMesh + placements + shard_tensor.
+
+Parity target: ``python/paddle/distributed/auto_parallel/api.py`` and the C++
+DistTensor machinery (``paddle/phi/core/distributed/auto_parallel/``: dist_tensor,
+dist_attr, per-op SPMD rules in ``phi/infermeta/spmd_rules/``, reshard functions).
+TPU redesign: this maps ~1:1 onto GSPMD — ``ProcessMesh`` wraps
+``jax.sharding.Mesh``, ``Shard(d)/Replicate()/Partial()`` become a
+``PartitionSpec``, ``shard_tensor`` is ``jax.device_put`` with a ``NamedSharding``,
+per-op sharding propagation is XLA's GSPMD pass (the entire spmd_rules/ library
+collapses into the compiler), and ``reshard`` is another device_put. See SURVEY.md
+§3.5: the one subsystem where the TPU stack is strictly stronger than the
+reference's hand-written rules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, _wrap_value
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "dtensor_from_fn", "shard_layer", "get_mesh", "set_mesh",
+           "placements_to_spec"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard the tensor's dim ``d`` across this mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. NamedSharding cannot express partial values
+    on an eager array; eagerly resharding a Partial runs the reduction (matching
+    the reference's p_to_r reshard). Inside compiled programs XLA tracks partials
+    natively."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh parity wrapping jax.sharding.Mesh."""
+
+    def __init__(self, mesh, dim_names: Optional[List[str]] = None):
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+            self.shape = list(mesh.devices.shape)
+            self.dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        devices = np.array(jax.devices(), dtype=object)
+        if arr.size > devices.size:
+            raise ValueError(f"ProcessMesh needs {arr.size} devices, have "
+                             f"{devices.size}")
+        picked = devices[arr.reshape(-1)].reshape(arr.shape)
+        self._mesh = Mesh(picked, tuple(dim_names))
+        self.shape = list(arr.shape)
+        self.dim_names = list(dim_names)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self.shape))))
+
+    def get_dim_size(self, name: str) -> int:
+        return self.shape[self.dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: Union[ProcessMesh, Mesh]):
+    global _global_mesh
+    _global_mesh = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: Mesh,
+                       ndim: int) -> P:
+    """[per-mesh-dim placements] -> PartitionSpec over tensor dims."""
+    entries: List[Optional[object]] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis_name = mesh.axis_names[mesh_dim]
+            d = pl.dim if pl.dim >= 0 else pl.dim + ndim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return P(*entries)
+
+
+def shard_tensor(x, mesh: Union[ProcessMesh, Mesh], placements: Sequence[Placement],
+                 dtype=None, stop_gradient=None) -> Tensor:
+    """paddle.distributed.shard_tensor parity: annotate + distribute a tensor."""
+    t = ensure_tensor(x)
+    jmesh = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else mesh
+    if any(isinstance(p, Partial) for p in placements):
+        raise ValueError("shard_tensor cannot create Partial placements eagerly; "
+                         "Partial arises from computation inside compiled programs")
+    spec = placements_to_spec(placements, jmesh, t.ndim)
+    val = jax.device_put(t._value, NamedSharding(jmesh, spec))
+    out = _wrap_value(val, stop_gradient=t.stop_gradient if stop_gradient is None
+                      else stop_gradient)
+    out.name = t.name
+    out.placements = list(placements)
+    out.process_mesh = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(jmesh)
+    if isinstance(t, Tensor) and hasattr(t, "optimize_attr"):
+        out.optimize_attr = t.optimize_attr
+    from ..core.tensor import Parameter
+    if isinstance(x, Parameter):
+        p = Parameter(val, trainable=not x.stop_gradient, name=x.name)
+        p._raw = val
+        p.placements = list(placements)
+        p.process_mesh = out.process_mesh
+        return p
+    return out
+
+
+def reshard(x, mesh, placements) -> Tensor:
+    """Explicit relayout (the reference's reshard function chain == device_put)."""
+    return shard_tensor(x, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """paddle.distributed.shard_layer parity: apply shard_fn(name, layer, mesh)
+    to every sublayer (default: replicate parameters over the mesh)."""
+
+    def default_shard(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None or getattr(p, "process_mesh", None) is not None:
+                continue
+            n_mesh_dims = len(mesh.shape if isinstance(mesh, ProcessMesh)
+                              else mesh.devices.shape)
+            sublayer._parameters[pname] = shard_tensor(
+                p, mesh, [Replicate()] * n_mesh_dims)
+
+    fn = shard_fn or default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer

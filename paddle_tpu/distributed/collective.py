@@ -1,0 +1,248 @@
+"""Imperative collective API (``paddle.distributed.all_reduce`` et al).
+
+Parity target: ``python/paddle/distributed/communication/`` over
+``ProcessGroupNCCL`` (``paddle/fluid/distributed/collective/``) in the reference.
+TPU redesign: there is no NCCL — a collective is an XLA HLO op on a named mesh
+axis, compiled and run over ICI. The single-controller encoding of "each rank holds
+its own tensor" is an array with a leading rank dimension sharded over the group's
+axis; each collective is a cached jit(shard_map(lax_collective)). Inside an
+already-sharded region (shard_map / pjit trace), the same functions emit the raw
+``lax.psum``-family op directly — the façade the reference reaches via
+process_group dispatch.
+
+Group argument: a ``ParallelAxis`` (from topology), an axis name string, or None
+(default = the whole default mesh flattened).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.tensor import Tensor, _wrap_value
+from ..ops._helpers import ensure_tensor, forward_op
+from .topology import ParallelAxis, get_hybrid_communicate_group
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "alltoall", "broadcast",
+           "reduce", "scatter", "barrier", "ReduceOp", "get_rank",
+           "get_world_size", "is_initialized", "init_parallel_env",
+           "in_shard_region"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstrap (``paddle.distributed.init_parallel_env`` parity). Multi-host
+    initialization goes through jax.distributed (the coordination service is the
+    TCPStore equivalent); single-host is a no-op beyond marking initialized."""
+    global _initialized
+    import os
+
+    if not _initialized and os.environ.get("PADDLE_TRAINERS_NUM", "1") not in ("", "1"):
+        # multi-host: the launcher sets the coordination env; jax.distributed
+        # wires every host's local devices into one global slice
+        jax.distributed.initialize()
+    _initialized = True
+    return None
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    axis = _resolve_axis(group)
+    return int(axis.mesh.shape[axis.name]) if axis is not None else \
+        jax.device_count()
+
+
+def in_shard_region() -> bool:
+    """True when called under a shard_map/pjit trace with mesh axes bound."""
+    try:
+        lax.axis_index(_resolve_axis(None).name)
+        return True
+    except Exception:
+        return False
+
+
+def _resolve_axis(group) -> Optional[ParallelAxis]:
+    if isinstance(group, ParallelAxis):
+        return group
+    hcg = get_hybrid_communicate_group()
+    if group is None:
+        # largest non-trivial axis, else dp
+        for name in ("dp", "mp", "sharding", "sep", "pp"):
+            if hcg.degrees.get(name, 1) > 1:
+                return ParallelAxis(hcg.mesh, name)
+        return ParallelAxis(hcg.mesh, "dp")
+    if isinstance(group, str):
+        return ParallelAxis(hcg.mesh, group)
+    raise TypeError(f"unsupported group: {group!r}")
+
+
+def _axis_bound(name: str) -> bool:
+    try:
+        lax.axis_index(name)
+        return True
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_collective(op: str, mesh: Mesh, axis: str, shape, dtype, extra=None):
+    n = int(mesh.shape[axis])
+
+    def body(x):
+        # x is the local shard [1, ...] (one row of the per-rank encoding)
+        if op == "all_reduce_sum":
+            return lax.psum(x, axis)
+        if op == "all_reduce_max":
+            return lax.pmax(x, axis)
+        if op == "all_reduce_min":
+            return lax.pmin(x, axis)
+        if op == "all_reduce_avg":
+            return lax.pmean(x, axis)
+        if op == "all_reduce_prod":
+            return jnp.exp(lax.psum(jnp.log(x), axis))
+        if op == "all_gather":
+            return lax.all_gather(x[0], axis, axis=0, tiled=True)[None]
+        if op == "reduce_scatter":
+            return lax.psum_scatter(x[0], axis, scatter_dimension=0,
+                                    tiled=True)[None]
+        if op == "alltoall":
+            return lax.all_to_all(x[0], axis, split_axis=0, concat_axis=0,
+                                  tiled=True)[None]
+        if op == "broadcast":
+            src = extra
+            me = lax.axis_index(axis)
+            return lax.psum(jnp.where(me == src, x, jnp.zeros_like(x)), axis)
+        raise ValueError(op)
+
+    spec = P(axis)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)
+
+
+def _per_rank(value, axis: ParallelAxis):
+    """Validate + shard the leading rank dimension over the axis."""
+    n = axis.nranks
+    if value.shape[0] != n:
+        raise ValueError(
+            f"collective input must have leading rank dim {n} (the "
+            f"single-controller per-rank encoding), got shape {value.shape}")
+    sharding = NamedSharding(axis.mesh, P(axis.name))
+    return jax.device_put(value, sharding)
+
+
+def _run_collective(op: str, t, group, extra=None, differentiable=True):
+    t = ensure_tensor(t)
+    axis = _resolve_axis(group)
+    if _axis_bound(axis.name):
+        # in-graph path: emit the raw collective on the bound axis
+        return forward_op(op, lambda x: _ingraph(op, x, axis.name, extra), [t],
+                          differentiable=differentiable)
+    fn = _compiled_collective(op, axis.mesh, axis.name, None, None, extra)
+
+    def impl(x):
+        return fn(_per_rank(x, axis))
+
+    return forward_op(op, impl, [t], differentiable=differentiable)
+
+
+def _ingraph(op, x, axis, extra):
+    if op == "all_reduce_sum":
+        return lax.psum(x, axis)
+    if op == "all_reduce_max":
+        return lax.pmax(x, axis)
+    if op == "all_reduce_min":
+        return lax.pmin(x, axis)
+    if op == "all_reduce_avg":
+        return lax.pmean(x, axis)
+    if op == "all_gather":
+        return lax.all_gather(x, axis, axis=0, tiled=True)
+    if op == "reduce_scatter":
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op == "alltoall":
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+    if op == "broadcast":
+        me = lax.axis_index(axis)
+        return lax.psum(jnp.where(me == extra, x, jnp.zeros_like(x)), axis)
+    raise ValueError(op)
+
+
+# -- public API -------------------------------------------------------------
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
+    name = {ReduceOp.SUM: "all_reduce_sum", ReduceOp.MAX: "all_reduce_max",
+            ReduceOp.MIN: "all_reduce_min", ReduceOp.AVG: "all_reduce_avg",
+            ReduceOp.PROD: "all_reduce_prod"}[op]
+    out = _run_collective(name, tensor, group)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True):
+    """paddle two-call-convention parity: all_gather(out_list, t) appends each
+    rank's tensor; all_gather(t) returns the gathered Tensor. In the per-rank
+    encoding the r-th gathered piece is row r of the input."""
+    if isinstance(tensor_or_list, list) and tensor is not None:
+        t = ensure_tensor(tensor)
+        for r in range(get_world_size(group)):
+            tensor_or_list.append(t[r])
+        return tensor_or_list
+    return _run_collective("all_gather", tensor_or_list, group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op: bool = True):
+    return _run_collective("reduce_scatter", tensor, group)
+
+
+def alltoall(in_tensor_or_list, out_tensor_list=None, group=None,
+             sync_op: bool = True):
+    return _run_collective("alltoall", in_tensor_or_list, group)
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
+    out = _run_collective("broadcast", tensor, group, extra=int(src))
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # single-controller: reduce == all_reduce (every shard sees the result)
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    # single-controller: the per-rank encoding already is the scattered layout
+    return ensure_tensor(tensor)
+
+
+def barrier(group=None):
+    """Device-level barrier: block until all pending device work completes."""
+    jnp.zeros(()).block_until_ready()
+    return None

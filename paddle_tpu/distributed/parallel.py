@@ -1,0 +1,106 @@
+"""Data parallelism + parallel environment.
+
+Parity target: ``paddle.DataParallel`` (``python/paddle/parallel.py``) and the C++
+``EagerReducer`` bucketed-allreduce machinery
+(``paddle/fluid/distributed/collective/reducer.cc``). TPU redesign: under GSPMD a
+DataParallel model is a *sharding declaration*, not a communication wrapper —
+inputs are sharded on the dp mesh axis, parameters are replicated, and XLA inserts
+the gradient psum where the batch dim is contracted (the entire reducer: bucketing,
+hooks, overlap — is the XLA scheduler's job). No grad-hook plumbing survives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, _wrap_value
+from ..nn.layer import Layer
+from .topology import get_hybrid_communicate_group
+
+__all__ = ["DataParallel", "ParallelEnv"]
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def device_id(self) -> int:
+        return jax.devices()[0].id
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    local_rank = rank
+
+    @property
+    def dev_id(self) -> int:
+        return self.device_id
+
+
+class DataParallel(Layer):
+    """Shard the batch over the dp axis; replicate parameters.
+
+    ``paddle.DataParallel(model)`` parity: forward delegates to the wrapped layer
+    with inputs sharded on the data-parallel mesh axis.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        hcg = get_hybrid_communicate_group()
+        self._mesh = hcg.mesh
+        self._axis = "dp"
+        # replicate parameters across the mesh so GSPMD sees the dp layout
+        rep = NamedSharding(self._mesh, P())
+        for p in layers.parameters():
+            p._raw = jax.device_put(p._raw, rep)
+
+    def _shard_input(self, t):
+        if not isinstance(t, Tensor) or t.ndim == 0:
+            return t
+        n = int(self._mesh.shape[self._axis])
+        if t.shape[0] % n != 0:
+            return t
+        sharding = NamedSharding(self._mesh, P(self._axis))
+        out = _wrap_value(jax.device_put(t._value, sharding),
+                          stop_gradient=t.stop_gradient)
+        out.name = t.name
+        return out
+
+    def forward(self, *args, **kwargs):
+        args = tuple(self._shard_input(a) for a in args)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+    # delegate the module surface to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss  # grads are exact sums under GSPMD; no loss rescale needed
+
+    def apply_collective_grads(self):
+        return None  # XLA already inserted the reduction

@@ -1,0 +1,95 @@
+"""ZeRO-style sharded data parallelism (group sharded, stages 1-3).
+
+Parity target: ``python/paddle/distributed/sharding/group_sharded.py`` +
+``fleet/meta_parallel/sharding/`` (DygraphShardingOptimizer = stage 1,
+GroupShardedStage2/3) in the reference. TPU redesign: each stage is a *sharding
+layout* on a pytree, not a runtime protocol — optimizer states (stage 1), and
+parameters (stage 3) get a NamedSharding split over the ``sharding`` mesh axis;
+XLA inserts the reduce-scatter/all-gather the reference implements by hand with
+NCCL hooks. Grad sharding (stage 2) falls out inside compiled steps where the
+grads never materialize replicated; in eager mode grads follow the param layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .topology import HybridCommunicateGroup, get_hybrid_communicate_group
+
+__all__ = ["group_sharded_parallel", "shard_optimizer_states", "shard_params"]
+
+
+def _shard_spec(shape, mesh, axis: str) -> P:
+    """Shard along the first dim divisible by the axis size; replicate if none."""
+    n = int(mesh.shape[axis])
+    for d, s in enumerate(shape):
+        if s % n == 0 and s > 0:
+            return P(*([None] * d + [axis]))
+    return P()
+
+
+def _apply_sharding(t, mesh, axis: str):
+    if t is None or not isinstance(t, Tensor) or t.ndim == 0:
+        return
+    spec = _shard_spec(t.shape, mesh, axis)
+    t._raw = jax.device_put(t._raw, NamedSharding(mesh, spec))
+
+
+def shard_optimizer_states(optimizer, hcg: Optional[HybridCommunicateGroup] = None):
+    """Stage 1: split optimizer accumulators (and master weights) over the
+    sharding axis. Already-created accumulators are resharded; future ones are
+    sharded at creation via a hook on _add_accumulator."""
+    hcg = hcg or get_hybrid_communicate_group()
+    mesh, axis = hcg.mesh, "sharding"
+
+    for store in optimizer._accumulators.values():
+        for t in store.values():
+            _apply_sharding(t, mesh, axis)
+    for t in getattr(optimizer, "_master_weights", {}).values():
+        _apply_sharding(t, mesh, axis)
+
+    orig = optimizer._add_accumulator
+
+    def sharded_add(name, p, **kw):
+        existed = p.name in optimizer._accumulators.get(name, {})
+        t = orig(name, p, **kw)
+        if not existed:
+            from ..core.tensor import _trace_hook
+            if _trace_hook.ctx is None:  # don't reshard tracers mid-trace
+                _apply_sharding(t, mesh, axis)
+        return t
+
+    optimizer._add_accumulator = sharded_add
+    optimizer._sharding_axis = axis
+    return optimizer
+
+
+def shard_params(model, hcg: Optional[HybridCommunicateGroup] = None):
+    """Stage 3: parameters themselves live sharded; XLA all-gathers on use."""
+    hcg = hcg or get_hybrid_communicate_group()
+    for p in model.parameters():
+        _apply_sharding(p, hcg.mesh, "sharding")
+    return model
+
+
+def group_sharded_parallel(model, optimizer, level: str = "os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel parity.
+
+    level: "os" (stage 1) | "os_g" (stage 2) | "p_g_os" (stage 3).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"unknown group_sharded level {level!r}")
+    hcg = get_hybrid_communicate_group()
+    shard_optimizer_states(optimizer, hcg)
+    if level == "p_g_os":
+        shard_params(model, hcg)
+    return model, optimizer, scaler

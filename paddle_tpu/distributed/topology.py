@@ -1,0 +1,137 @@
+"""Hybrid-parallel topology: degrees -> a named jax.sharding.Mesh.
+
+Parity target: ``python/paddle/distributed/fleet/base/topology.py`` in the reference
+(``CommunicateTopology`` + ``HybridCommunicateGroup``: rank -> coordinate in the
+[dp, pp, sharding, sep, mp] grid, one NCCL comm per sub-group). TPU redesign: the
+grid IS a ``jax.sharding.Mesh`` over the device slice — every "communication group"
+is a named mesh axis, and collectives are XLA HLO ops riding ICI on that axis (no
+communicator objects to create). Axis order puts mp (tensor parallel) innermost so
+its collectives map onto the closest ICI neighbors, then sep/sharding, with dp/pp
+outermost — the standard ICI-locality layout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["HybridCommunicateGroup", "ParallelAxis", "get_hybrid_communicate_group",
+           "build_mesh", "set_hybrid_communicate_group"]
+
+# outermost -> innermost (mp innermost = nearest-neighbor ICI)
+_AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build the hybrid mesh from axis degrees (missing axes get size 1)."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = [int(degrees.get(a, 1)) for a in _AXIS_ORDER]
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(
+            f"hybrid degrees {dict(zip(_AXIS_ORDER, shape))} require {n} devices, "
+            f"got {len(devices)}")
+    arr = np.array(devices, dtype=object).reshape(shape)
+    return Mesh(arr, _AXIS_ORDER)
+
+
+class ParallelAxis:
+    """One parallel dimension (the reference's per-axis comm group equivalent)."""
+
+    def __init__(self, mesh: Mesh, name: str):
+        self.mesh = mesh
+        self.name = name
+
+    @property
+    def nranks(self) -> int:
+        return int(self.mesh.shape[self.name])
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def __repr__(self):
+        return f"ParallelAxis({self.name}, size={self.nranks})"
+
+
+class HybridCommunicateGroup:
+    """fleet topology singleton (HybridCommunicateGroup parity).
+
+    Reference API parity: ``get_data_parallel_world_size``,
+    ``get_model_parallel_group`` etc., with groups replaced by named mesh axes.
+    """
+
+    def __init__(self, dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
+                 sep: int = 1, devices: Optional[Sequence] = None):
+        self.degrees = {"dp": dp, "mp": mp, "pp": pp, "sharding": sharding,
+                        "sep": sep}
+        self.mesh = build_mesh(self.degrees, devices)
+        self._axes = {a: ParallelAxis(self.mesh, a) for a in _AXIS_ORDER}
+
+    # -- degree queries (reference method names) ----------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self.degrees["dp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.degrees["mp"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.degrees["pp"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.degrees["sharding"]
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self.degrees["sep"]
+
+    # -- axis ("group") handles --------------------------------------------
+    def get_data_parallel_group(self) -> ParallelAxis:
+        return self._axes["dp"]
+
+    def get_model_parallel_group(self) -> ParallelAxis:
+        return self._axes["mp"]
+
+    def get_pipe_parallel_group(self) -> ParallelAxis:
+        return self._axes["pp"]
+
+    def get_sharding_parallel_group(self) -> ParallelAxis:
+        return self._axes["sharding"]
+
+    def get_sep_parallel_group(self) -> ParallelAxis:
+        return self._axes["sep"]
+
+    # single-controller: the "local rank" along an axis is a compiled-program
+    # concept (lax.axis_index), not a python value; 0 is reported for API parity
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def topology(self):
+        return self.degrees
+
+    def __repr__(self):
+        return f"HybridCommunicateGroup({self.degrees})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: Optional[HybridCommunicateGroup]):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        # default: pure data parallel over all devices
+        _hcg = HybridCommunicateGroup(dp=len(jax.devices()))
+    return _hcg
