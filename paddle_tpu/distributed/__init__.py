@@ -40,5 +40,9 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
 
 
 def launch():
-    from .launch.main import main
+    try:
+        from .launch.main import main
+    except ImportError as e:
+        raise NotImplementedError(
+            "paddle_tpu.distributed.launch module is not available") from e
     return main()

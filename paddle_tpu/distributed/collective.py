@@ -71,17 +71,12 @@ def get_rank(group=None) -> int:
 
 def get_world_size(group=None) -> int:
     axis = _resolve_axis(group)
-    return int(axis.mesh.shape[axis.name]) if axis is not None else \
-        jax.device_count()
+    return axis.nranks if axis is not None else jax.device_count()
 
 
 def in_shard_region() -> bool:
     """True when called under a shard_map/pjit trace with mesh axes bound."""
-    try:
-        lax.axis_index(_resolve_axis(None).name)
-        return True
-    except Exception:
-        return False
+    return _axis_bound(_resolve_axis(None).name)
 
 
 def _resolve_axis(group) -> Optional[ParallelAxis]:
@@ -89,28 +84,33 @@ def _resolve_axis(group) -> Optional[ParallelAxis]:
         return group
     hcg = get_hybrid_communicate_group()
     if group is None:
-        # largest non-trivial axis, else dp
-        for name in ("dp", "mp", "sharding", "sep", "pp"):
-            if hcg.degrees.get(name, 1) > 1:
-                return ParallelAxis(hcg.mesh, name)
-        return ParallelAxis(hcg.mesh, "dp")
+        # default group = the whole world: every non-trivial mesh axis (the
+        # reference's global default process group; spanning one axis only when
+        # one is non-trivial keeps specs simple in the common pure-dp case)
+        live = tuple(a for a in hcg.mesh.axis_names
+                     if hcg.degrees.get(a, 1) > 1)
+        if not live:
+            return ParallelAxis(hcg.mesh, "dp")
+        return ParallelAxis(hcg.mesh, live[0] if len(live) == 1 else live)
     if isinstance(group, str):
         return ParallelAxis(hcg.mesh, group)
+    if isinstance(group, (tuple, list)):
+        return ParallelAxis(hcg.mesh, tuple(group))
     raise TypeError(f"unsupported group: {group!r}")
 
 
-def _axis_bound(name: str) -> bool:
+def _axis_bound(name) -> bool:
+    names = name if isinstance(name, tuple) else (name,)
     try:
-        lax.axis_index(name)
+        for a in names:
+            lax.axis_index(a)
         return True
-    except Exception:
+    except NameError:  # "unbound axis name" — not inside shard_map/pjit
         return False
 
 
 @functools.lru_cache(maxsize=256)
-def _compiled_collective(op: str, mesh: Mesh, axis: str, shape, dtype, extra=None):
-    n = int(mesh.shape[axis])
-
+def _compiled_collective(op: str, mesh: Mesh, axis, shape, dtype, extra=None):
     def body(x):
         # x is the local shard [1, ...] (one row of the per-rank encoding)
         if op == "all_reduce_sum":
@@ -122,7 +122,10 @@ def _compiled_collective(op: str, mesh: Mesh, axis: str, shape, dtype, extra=Non
         if op == "all_reduce_avg":
             return lax.pmean(x, axis)
         if op == "all_reduce_prod":
-            return jnp.exp(lax.psum(jnp.log(x), axis))
+            # exact for any sign/zero: gather the factors, multiply locally
+            # (reference NCCL prod semantics; log/exp would NaN on negatives)
+            g = lax.all_gather(x, axis, axis=0, tiled=True)
+            return jnp.prod(g, axis=0, keepdims=True)
         if op == "all_gather":
             return lax.all_gather(x[0], axis, axis=0, tiled=True)[None]
         if op == "reduce_scatter":
@@ -177,6 +180,8 @@ def _ingraph(op, x, axis, extra):
         return lax.pmin(x, axis)
     if op == "all_reduce_avg":
         return lax.pmean(x, axis)
+    if op == "all_reduce_prod":
+        return jnp.prod(lax.all_gather(x, axis, axis=0, tiled=False), axis=0)
     if op == "all_gather":
         return lax.all_gather(x, axis, axis=0, tiled=True)
     if op == "reduce_scatter":
@@ -238,8 +243,39 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
-    # single-controller: the per-rank encoding already is the scattered layout
-    return ensure_tensor(tensor)
+    """Scatter ``tensor_list[r]`` to rank r (paddle convention: out arg first).
+
+    Single-controller encoding: the result is the per-rank stack — row r is what
+    rank r receives. With no ``tensor_list``, ``tensor`` is the full value held
+    by ``src`` and is split evenly along dim 0 into per-rank rows.
+    """
+    axis = _resolve_axis(group)
+    n = axis.nranks
+    sharding = NamedSharding(axis.mesh, P(axis.name))
+    if tensor_list is not None:
+        if len(tensor_list) != n:
+            raise ValueError(
+                f"scatter: tensor_list has {len(tensor_list)} entries but the "
+                f"group has {n} ranks")
+        parts = [ensure_tensor(t) for t in tensor_list]
+        out = forward_op(
+            "scatter",
+            lambda *xs: jax.device_put(jnp.stack(xs, axis=0), sharding),
+            parts)
+    else:
+        t = ensure_tensor(tensor)
+        if t.shape[0] % n != 0:
+            raise ValueError(
+                f"scatter: leading dim {t.shape[0]} not divisible by group "
+                f"size {n}")
+        new_shape = (n, t.shape[0] // n) + tuple(t.shape[1:])
+        out = forward_op(
+            "scatter",
+            lambda x: jax.device_put(x.reshape(new_shape), sharding), [t])
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
 
 
 def barrier(group=None):

@@ -54,16 +54,21 @@ def init(role_maker=None, is_collective: bool = True,
     strategy = strategy or DistributedStrategy()
     cfg = strategy.hybrid_configs
     _collective.init_parallel_env()
-    degrees = {k: int(cfg.get(f"{k}_degree", 1))
+    # upstream convention: degree <= 0 (usually -1) means "auto-infer"; only dp
+    # is auto-filled from the remaining devices, other axes normalize to 1
+    degrees = {k: max(int(cfg.get(f"{k}_degree", 1)), 1)
                for k in ("dp", "mp", "pp", "sharding", "sep")}
+    dp_requested = int(cfg.get("dp_degree", 1))
     product = 1
     for v in degrees.values():
         product *= v
     n = len(jax.devices())
     if product == 1:
         degrees["dp"] = n  # plain fleet.init() == pure data parallel (reference)
-    elif cfg.get("dp_degree", 1) in (1, -1) and n % product == 0 and product < n:
-        degrees["dp"] = n // product  # dp fills the remaining devices
+    elif dp_requested <= 1:
+        non_dp = product // degrees["dp"]
+        if n % non_dp == 0 and non_dp <= n:
+            degrees["dp"] = n // non_dp  # dp fills the remaining devices
     hcg = HybridCommunicateGroup(
         dp=degrees["dp"], mp=degrees["mp"], pp=degrees["pp"],
         sharding=degrees["sharding"], sep=degrees["sep"])
@@ -79,7 +84,12 @@ def distributed_model(model):
     from ...nn.layer import Layer
 
     if hcg.get_pipe_parallel_world_size() > 1:
-        from ..pipeline import PipelineParallel
+        try:
+            from ..pipeline import PipelineParallel
+        except ImportError as e:  # keep the pp path honest if the module is absent
+            raise NotImplementedError(
+                "pipeline parallelism requires paddle_tpu.distributed.pipeline"
+            ) from e
         return PipelineParallel(model, hcg, _fleet_state.get("strategy"))
     if hcg.get_data_parallel_world_size() > 1 or \
             hcg.get_sharding_parallel_world_size() > 1:

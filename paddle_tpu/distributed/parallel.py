@@ -74,6 +74,11 @@ class DataParallel(Layer):
             return t
         n = int(self._mesh.shape[self._axis])
         if t.shape[0] % n != 0:
+            import warnings
+            warnings.warn(
+                f"DataParallel: batch dim {t.shape[0]} is not divisible by "
+                f"dp degree {n}; input stays replicated (no data parallelism "
+                f"for this tensor)", RuntimeWarning, stacklevel=3)
             return t
         sharding = NamedSharding(self._mesh, P(self._axis))
         out = _wrap_value(jax.device_put(t._value, sharding),

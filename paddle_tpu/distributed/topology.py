@@ -39,15 +39,27 @@ def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> M
 
 
 class ParallelAxis:
-    """One parallel dimension (the reference's per-axis comm group equivalent)."""
+    """One parallel dimension (the reference's per-axis comm group equivalent).
 
-    def __init__(self, mesh: Mesh, name: str):
+    ``name`` may be a single mesh-axis name or a tuple of names — the latter is a
+    group spanning the product of those axes (e.g. the default "world" group over
+    every non-trivial axis, matching the reference's global default group).
+    """
+
+    def __init__(self, mesh: Mesh, name):
         self.mesh = mesh
-        self.name = name
+        self.name = tuple(name) if isinstance(name, (tuple, list)) else name
+
+    @property
+    def names(self) -> tuple:
+        return self.name if isinstance(self.name, tuple) else (self.name,)
 
     @property
     def nranks(self) -> int:
-        return int(self.mesh.shape[self.name])
+        n = 1
+        for a in self.names:
+            n *= int(self.mesh.shape[a])
+        return n
 
     @property
     def world_size(self) -> int:
@@ -103,16 +115,47 @@ class HybridCommunicateGroup:
     def get_sep_parallel_group(self) -> ParallelAxis:
         return self._axes["sep"]
 
-    # single-controller: the "local rank" along an axis is a compiled-program
-    # concept (lax.axis_index), not a python value; 0 is reported for API parity
+    # Rank semantics (single-controller): inside a shard_map/pjit trace the rank
+    # is the traced lax.axis_index; at the python level it is the coordinate of
+    # this *process's* devices along the axis. A process that owns every
+    # coordinate of the axis (single-host) is all ranks at once — 0 is returned
+    # as the canonical coordinate. A process whose devices straddle several-but-
+    # not-all coordinates has no well-defined rank and raises.
+    def _axis_rank(self, name: str):
+        from jax import lax
+        if self.degrees.get(name, 1) <= 1:
+            return 0
+        try:
+            return lax.axis_index(name)  # traced value under shard_map
+        except NameError:
+            pass
+        ax = list(self.mesh.axis_names).index(name)
+        local_ids = {d.id for d in jax.local_devices()}
+        coords = {idx[ax] for idx, d in np.ndenumerate(self.mesh.devices)
+                  if d.id in local_ids}
+        if len(coords) == 1:
+            return coords.pop()
+        if len(coords) == self.degrees[name]:
+            return 0  # this process owns the whole axis (single-controller)
+        raise RuntimeError(
+            f"process devices span {sorted(coords)} along mesh axis {name!r}; "
+            f"per-axis rank is undefined — query lax.axis_index({name!r}) "
+            f"inside the sharded program instead")
+
     def get_data_parallel_rank(self) -> int:
-        return 0
+        return self._axis_rank("dp")
 
     def get_model_parallel_rank(self) -> int:
-        return 0
+        return self._axis_rank("mp")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._axis_rank("sep")
 
     def get_stage_id(self) -> int:
-        return 0
+        return self._axis_rank("pp")
 
     def topology(self):
         return self.degrees

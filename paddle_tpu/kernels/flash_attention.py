@@ -41,7 +41,7 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k, num_kv_blocks):
+                scale, causal, causal_offset, block_q, block_k, num_kv_blocks):
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -61,7 +61,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            # bottom-right alignment (flash-attention-2 / _sdpa_ref tril(k=Sk-Sq)
+            # convention): query i attends keys j with j <= i + (Sk - Sq)
+            rows = q_start + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -76,8 +78,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:, 0] = m_cur
 
     if causal:
-        # skip blocks strictly above the diagonal
-        @pl.when(k_start <= q_start + block_q - 1)
+        # skip blocks strictly above the (bottom-right-aligned) diagonal
+        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
         def _():
             run()
     else:
@@ -101,6 +103,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
     grid = (B, H, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          causal_offset=Sk - Sq,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk),
         grid=grid,
         in_specs=[
@@ -137,7 +140,8 @@ def _vmem(shape, dtype):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, block_q, block_k, num_kv_blocks):
+                   acc_ref, *, scale, causal, causal_offset, block_q, block_k,
+                   num_kv_blocks):
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -158,7 +162,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            rows = q_start + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -171,7 +175,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1)
+        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
         def _():
             run()
     else:
@@ -184,7 +188,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, block_q, block_k, num_q_blocks):
+                    scale, causal, causal_offset, block_q, block_k, num_q_blocks):
     qb = pl.program_id(3)
     ki = pl.program_id(2)
 
@@ -206,7 +210,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            rows = q_start + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -221,7 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(k_start <= q_start + block_q - 1)
+        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
         def _():
             run()
     else:
@@ -248,6 +252,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          causal_offset=Sk - Sq,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk),
         grid=(B, H, nq, nk),
         in_specs=[
@@ -270,6 +275,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     # dk/dv accumulate over q blocks, one pass per kv head group member then sum
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          causal_offset=Sk - Sq,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq),
         grid=(B, H, nk, nq),
         in_specs=[
@@ -334,6 +340,13 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     if Sq % block_q or Sk % block_k:
         raise ValueError(f"flash_attention: seq lens ({Sq},{Sk}) must divide "
                          f"block sizes ({block_q},{block_k})")
+    if causal and Sq > Sk:
+        # bottom-right alignment leaves rows i < Sq-Sk attending nothing; the
+        # softmax there is undefined (the jnp oracle yields NaN) — reject rather
+        # than return silently wrong finite values
+        raise ValueError(f"flash_attention: causal with Sq ({Sq}) > Sk ({Sk}) "
+                         f"has fully-masked query rows; mask them explicitly "
+                         f"or pad keys")
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
