@@ -20,7 +20,18 @@ from .. import collective as _collective
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group", "worker_num",
-           "worker_index", "is_first_worker", "barrier_worker"]
+           "worker_index", "is_first_worker", "barrier_worker", "layers",
+           "utils", "meta_parallel", "recompute"]
+
+
+def __getattr__(name):
+    # heavy sub-namespaces (layers/utils/meta_parallel) load lazily
+    if name in ("layers", "utils", "meta_parallel", "recompute"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class DistributedStrategy:

@@ -1,0 +1,94 @@
+"""Tensor-parallel RNG state tracking.
+
+Parity target: ``python/paddle/distributed/fleet/layers/mpu/random.py`` in the
+reference (``RNGStatesTracker`` — named CUDA RNG states so dropout inside a
+model-parallel region draws *different* randomness per mp rank while replicated
+regions stay identical). TPU redesign: JAX PRNG keys are values, not device
+state — a "tracker state" is a base key, and entering a region folds the mp
+``lax.axis_index`` into it (inside shard_map) so each rank's stream decorrelates
+deterministically. Under GSPMD (full logical tensors) masks are computed
+globally and sharded, which is already correct — the tracker then only scopes
+the named stream.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....collective import _axis_bound
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+        self._active = None
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+        self._active = None
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name!r} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name!r} does not exist")
+        prev = self._active
+        self._active = name
+        try:
+            yield
+        finally:
+            self._active = prev
+
+    def next_key(self, axis: str = "mp") -> jax.Array:
+        """Split the active stream; fold the mp rank in inside shard_map so each
+        model-parallel rank decorrelates (the reference's per-rank CUDA state)."""
+        name = self._active
+        if name is None:
+            from .....ops import random as _r
+            return _r._next_key()
+        key, self.states_[name] = tuple(jax.random.split(self.states_[name]))
+        if _axis_bound(axis):
+            key = jax.random.fold_in(key, lax.axis_index(axis))
+        return key
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 100):
+    """Seed the tracker (ref: mpu.random.model_parallel_random_seed): a global
+    stream shared by all ranks + the model-parallel stream that decorrelates."""
+    import paddle_tpu as paddle
+
+    _TRACKER.reset()
+    paddle.seed(seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, seed + 1024)

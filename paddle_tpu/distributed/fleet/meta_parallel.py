@@ -1,0 +1,22 @@
+"""``fleet.meta_parallel`` namespace parity.
+
+Reference: ``python/paddle/distributed/fleet/meta_parallel/__init__.py`` —
+re-exports the parallel layer zoo (mpu layers, PipelineLayer, sharding stages).
+"""
+
+from .layers.mpu import (ColumnParallelLinear, ParallelCrossEntropy,
+                         RowParallelLinear, VocabParallelEmbedding,
+                         get_rng_state_tracker, model_parallel_random_seed)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+           "ParallelCrossEntropy", "get_rng_state_tracker",
+           "model_parallel_random_seed", "PipelineLayer", "LayerDesc",
+           "SharedLayerDesc"]
+
+
+def __getattr__(name):
+    if name in ("PipelineLayer", "LayerDesc", "SharedLayerDesc",
+                "PipelineParallel"):
+        from .. import pipeline
+        return getattr(pipeline, name)
+    raise AttributeError(name)

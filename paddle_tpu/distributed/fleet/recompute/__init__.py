@@ -1,0 +1,4 @@
+"""ref layout parity: python/paddle/distributed/fleet/recompute/ package."""
+from .recompute import recompute, recompute_sequential
+
+__all__ = ["recompute", "recompute_sequential"]

@@ -1,0 +1,187 @@
+"""Activation recomputation (gradient checkpointing).
+
+Parity target: ``python/paddle/distributed/fleet/recompute/recompute.py`` in the
+reference (PyLayer-based re-forward with CUDA RNG state stashing). TPU redesign:
+``jax.checkpoint`` IS the mechanism — the recomputed region becomes one tape op
+whose vjp saves only its inputs and re-traces the body in backward; RNG
+preservation is automatic because the drawn keys are constants/closures of the
+checkpointed function (the same values replay in the rematerialized pass).
+
+The implicit state of ``function`` (layer parameters, buffers) is discovered with
+the same state-discovery trace jit.to_static uses (jit/trace.py) and bound as
+explicit inputs so parameter gradients flow through the checkpointed op.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ....core import autograd
+from ....core.tensor import Tensor, _wrap_value
+from ....jit.trace import TraceContext, activate
+from ....ops._helpers import forward_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+# function -> [Tensor state] cache (weak keys; Layers/bound callables are
+# stable across steps, lambdas recreated per call just miss the cache).
+# Only populated from eager discovery; a structure change to the layer after
+# first use requires a fresh callable (documented limitation).
+import weakref
+
+_STATE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _cache_key(function):
+    # bound method objects are recreated per access — key on (__self__, __func__)
+    if hasattr(function, "__self__") and hasattr(function, "__func__"):
+        return function.__self__
+    return function
+
+
+def _discovered_state(function):
+    from ....core.tensor import _trace_hook
+    if _trace_hook.ctx is not None:
+        return None  # under an outer trace: always rediscover (values differ)
+    try:
+        entry = _STATE_CACHE.get(_cache_key(function))
+    except TypeError:
+        return None
+    if entry is None:
+        return None
+    state = [ref() for ref in entry]
+    return None if any(t is None for t in state) else state
+
+
+def _remember_state(function, state):
+    from ....core.tensor import _trace_hook
+    if _trace_hook.ctx is not None:
+        return
+    try:
+        _STATE_CACHE[_cache_key(function)] = [weakref.ref(t) for t in state]
+    except TypeError:
+        pass  # unhashable/unweakrefable callable: no caching
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Run ``function(*args)`` without storing its internal activations; the
+    backward pass recomputes them from the inputs (ref: fleet.utils.recompute).
+
+    Keyword-only knobs (reference parity; inert ones documented):
+    ``preserve_rng_state`` — always true here (keys replay by construction).
+    ``use_reentrant`` — accepted, irrelevant (no autograd engine re-entry).
+    """
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+    offload = kwargs.pop("offload", False)
+    if offload:
+        warnings.warn("recompute: offload is not supported on TPU (HBM-resident "
+                      "checkpointing only); ignoring", RuntimeWarning)
+    if not autograd.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    arg_leaves, in_tree = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_pos = [i for i, l in enumerate(arg_leaves) if isinstance(l, Tensor)]
+    static_leaves = [None if isinstance(l, Tensor) else l for l in arg_leaves]
+    arg_tensors = [arg_leaves[i] for i in tensor_pos]
+    explicit = {id(t) for t in arg_tensors}
+
+    # -- pass 1: discover the implicit state (params/buffers) ---------------
+    # Cached per stable callable (a Layer instance, typically) so steady-state
+    # steps skip the extra eager forward and don't consume the RNG stream.
+    state = _discovered_state(function)
+    if state is None:
+        ctx = TraceContext("discover")
+        try:
+            with activate(ctx):
+                function(*args, **kwargs)
+        finally:
+            ctx.restore()
+        if ctx.writes:
+            warnings.warn(
+                "recompute: function mutates framework state (e.g. BN running "
+                "stats); running it un-checkpointed to keep the writes correct",
+                RuntimeWarning)
+            return function(*args, **kwargs)
+        state = []
+        for i, ref in ctx.reads.items():
+            t = ref()
+            if t is not None and i not in explicit:
+                state.append(t)
+        _remember_state(function, state)
+    else:
+        state = [t for t in state if id(t) not in explicit]
+    n_args = len(arg_tensors)
+    arg_sg = [bool(t.stop_gradient) for t in arg_tensors]
+    cell = {}
+
+    def pure(*vals):
+        arg_vals, state_vals = vals[:n_args], vals[n_args:]
+        saved = [(t._raw, t._grad_node, t._node_index) for t in state]
+        for t, v in zip(state, state_vals):
+            t._raw = v
+            t._grad_node = None
+            t._node_index = 0
+        try:
+            leaves = list(static_leaves)
+            for pos, v, sg in zip(tensor_pos, arg_vals, arg_sg):
+                leaves[pos] = _wrap_value(v, stop_gradient=sg)
+            call_args, call_kwargs = jax.tree_util.tree_unflatten(in_tree, leaves)
+            out = function(*call_args, **call_kwargs)
+            out_leaves, out_tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            cell["tree"] = out_tree
+            cell["is_tensor"] = [isinstance(l, Tensor) for l in out_leaves]
+            vals = tuple(l._raw if isinstance(l, Tensor) else l
+                         for l in out_leaves)
+            # a 1-tuple would be recorded as a single-output op whose vjp then
+            # receives a bare cotangent — return the bare value instead
+            return vals[0] if len(vals) == 1 else vals
+        finally:
+            for t, (v, n, ix) in zip(state, saved):
+                t._raw = v
+                t._grad_node = n
+                t._node_index = ix
+
+    out_vals = forward_op("recompute", jax.checkpoint(pure),
+                          arg_tensors + state)
+    out_vals = out_vals if isinstance(out_vals, tuple) else (out_vals,)
+    # leaves the function returned as raw (non-Tensor) values come back unwrapped
+    out_leaves = [v if is_t else v._value for v, is_t in
+                  zip(out_vals, cell["is_tensor"])]
+    return jax.tree_util.tree_unflatten(cell["tree"], out_leaves)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Checkpoint a Sequential in ``segments`` chunks
+    (ref: recompute_sequential — the Sequential protocol threads exactly one
+    activation between layers)."""
+    segments = int((ctx or {}).get("segments", 1))
+    fns = list(functions)
+    if len(args) != 1:
+        raise ValueError(
+            "recompute_sequential threads a single activation through the "
+            f"layer list (Sequential protocol); got {len(args)} positional "
+            "args — use recompute() directly for multi-input functions")
+    if len(fns) == 0:
+        return args[0]
+    import math
+    seg_len = max(1, math.ceil(len(fns) / segments))
+
+    def run_chunk(chunk):
+        def f(x):
+            for layer in chunk:
+                x = layer(x)
+            return x
+        return f
+
+    x = args[0]
+    for s in range(0, len(fns), seg_len):
+        chunk = fns[s:s + seg_len]
+        x = recompute(run_chunk(chunk), x, **kwargs)
+    return x

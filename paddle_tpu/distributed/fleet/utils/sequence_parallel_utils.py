@@ -1,0 +1,241 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity target: ``python/paddle/distributed/fleet/utils/sequence_parallel_utils.py``
+in the reference (``ScatterOp``/``GatherOp``/``AllGatherOp``/``ReduceScatterOp``
+PyLayers + ``ColumnSequenceParallelLinear``/``RowSequenceParallelLinear`` — the
+activation is sharded along the sequence dim outside tensor-parallel matmul
+regions, with all-gather/reduce-scatter at the region edges). TPU redesign:
+
+* **GSPMD path**: scatter/gather are sharding constraints on the seq dim over
+  the ``mp`` axis; XLA inserts the edge collectives and their transposes.
+* **shard_map path**: real ``lax`` collectives with ``jax.custom_vjp`` pairing
+  (scatter↔all-gather, reduce-scatter↔all-gather), matching the reference's
+  PyLayer forward/backward tables exactly.
+
+Layout note: paddle's sequence-parallel utilities operate on ``[s, b, h]``
+tensors (seq first); these default to ``axis=0`` but accept ``axis=`` for the
+batch-first ``[b, s, h]`` layout used elsewhere in this framework.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....ops._helpers import ensure_tensor, forward_op
+from ...collective import _axis_bound
+from ..layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear
+from ..layers.mpu.mp_ops import _put, mp_axis_name
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]
+
+_MP = "mp"
+
+
+def _seq_spec(ndim: int, seq_axis: int, mp_axis: str) -> P:
+    parts = [None] * ndim
+    parts[seq_axis] = mp_axis
+    return P(*parts)
+
+
+# -- raw collectives (shard_map path), custom-vjp paired ---------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _scatter_seq(x, axis_name, dim):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    piece = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, me * piece, piece, axis=dim)
+
+
+def _scatter_fwd(x, axis_name, dim):
+    return _scatter_seq(x, axis_name, dim), None
+
+
+def _scatter_bwd(axis_name, dim, _, g):
+    return (lax.all_gather(g, axis_name, axis=dim, tiled=True),)
+
+
+_scatter_seq.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_seq(x, axis_name, dim):
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _gather_fwd(x, axis_name, dim):
+    return _gather_seq(x, axis_name, dim), None
+
+
+def _gather_bwd(axis_name, dim, _, g):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    piece = g.shape[dim] // n
+    return (lax.dynamic_slice_in_dim(g, me * piece, piece, axis=dim),)
+
+
+_gather_seq.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _allgather_rs(x, axis_name, dim):
+    """forward all-gather / backward reduce-scatter (AllGatherOp pairing)."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _ag_fwd(x, axis_name, dim):
+    return _allgather_rs(x, axis_name, dim), None
+
+
+def _ag_bwd(axis_name, dim, _, g):
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=dim, tiled=True),)
+
+
+_allgather_rs.defvjp(_ag_fwd, _ag_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _rs_ag(x, axis_name, dim):
+    """forward reduce-scatter / backward all-gather (ReduceScatterOp pairing)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def _rs_fwd(x, axis_name, dim):
+    return _rs_ag(x, axis_name, dim), None
+
+
+def _rs_bwd(axis_name, dim, _, g):
+    return (lax.all_gather(g, axis_name, axis=dim, tiled=True),)
+
+
+_rs_ag.defvjp(_rs_fwd, _rs_bwd)
+
+
+# -- PyLayer-parity static ops ----------------------------------------------
+
+class _SeqOp:
+    _raw = None          # shard_map collective
+    _gspmd_spec = None   # "seq" (shard seq dim) or "rep" (replicate)
+
+    @classmethod
+    def apply(cls, x, axis: int = 0, group=None):
+        mp = mp_axis_name(group)
+        t = ensure_tensor(x)
+        dim = axis % t.ndim
+        if _axis_bound(mp):
+            raw = cls._raw
+            return forward_op(cls.__name__,
+                              lambda v: raw(v, mp, dim), [t])
+        spec = _seq_spec(t.ndim, dim, mp) if cls._gspmd_spec == "seq" else P()
+        return forward_op(cls.__name__, lambda v: _put(v, spec), [t])
+
+
+class ScatterOp(_SeqOp):
+    """forward: split seq over mp; backward: all-gather."""
+    _raw = staticmethod(_scatter_seq)
+    _gspmd_spec = "seq"
+
+
+class GatherOp(_SeqOp):
+    """forward: all-gather seq; backward: split (slice my chunk)."""
+    _raw = staticmethod(_gather_seq)
+    _gspmd_spec = "rep"
+
+
+class AllGatherOp(_SeqOp):
+    """forward: all-gather seq; backward: reduce-scatter."""
+    _raw = staticmethod(_allgather_rs)
+    _gspmd_spec = "rep"
+
+
+class ReduceScatterOp(_SeqOp):
+    """forward: reduce-scatter seq; backward: all-gather."""
+    _raw = staticmethod(_rs_ag)
+    _gspmd_spec = "seq"
+
+
+def scatter(x, axis: int = 0, group=None):
+    return ScatterOp.apply(x, axis, group)
+
+
+def all_gather(x, axis: int = 0, group=None):
+    return AllGatherOp.apply(x, axis, group)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """ref: marks params (norms/biases outside TP regions) whose grads need an
+    mp-group allreduce. Under GSPMD those params are replicated over mp and the
+    grad reduction is emitted by XLA — the mark is metadata for parity/tools."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return bool(getattr(parameter, "sequence_parallel", False))
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """ref: installs backward hooks allreducing marked params' grads over mp.
+    GSPMD already reduces grads of replicated params; nothing to install."""
+    return None
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """ColumnParallel entered from a seq-sharded activation (ref: the
+    AllGatherOp(x) -> local matmul pattern). ``seq_axis`` selects the sequence
+    dim (0 for the reference's [s,b,h], 1 for batch-first [b,s,h])."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, seq_axis: int = 0, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+        self.seq_axis = seq_axis
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x, self.seq_axis, self.axis)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """RowParallel exiting into a seq-sharded activation (ref: local matmul ->
+    ReduceScatterOp pattern; replaces the plain mp allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, seq_axis: int = 0, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias, mp_group=mp_group,
+                         name=name)
+        self.seq_axis = seq_axis
+
+    def forward(self, x):
+        from ....nn import functional as F
+        from ..layers.mpu import mp_ops
+        from ..layers.mpu.mp_layers import _local_shard
+        w = self.weight
+        if _axis_bound(self.axis):
+            w = _local_shard(w, self.axis, self.in_features, 0)
+            if not self.input_is_parallel:
+                x = mp_ops.c_split(x, self.axis)
+        elif not self.input_is_parallel:
+            x = mp_ops.c_constrain(
+                x, P(*([None] * (ensure_tensor(x).ndim - 1) + [self.axis])))
+        y = F.linear(x, w)  # partial sums over the mp shards
+        y = ReduceScatterOp.apply(y, self.seq_axis, self.axis)
+        if self.bias is not None:
+            y = y + self.bias
+        return y

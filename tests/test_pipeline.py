@@ -1,0 +1,202 @@
+"""Pipeline parallelism tests (oracle: loss parity vs serial — SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.pipeline import (LayerDesc, PipelineLayer,
+                                             PipelineParallel, pipeline_scan)
+from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+
+@pytest.fixture
+def pp_mesh():
+    st = fleet.DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4}
+    fleet.init(is_collective=True, strategy=st)
+    yield fleet.get_hybrid_communicate_group()
+    set_hybrid_communicate_group(None)
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+class TestPipelineScan:
+    def test_forward_parity(self, pp_mesh):
+        S, M, B, H = 4, 6, 2, 8
+        rng = np.random.RandomState(0)
+        ws = jnp.asarray(rng.randn(S, H, H).astype(np.float32) * 0.3)
+        bs = jnp.asarray(rng.randn(S, H).astype(np.float32) * 0.1)
+        xs = jnp.asarray(rng.randn(M, B, H).astype(np.float32))
+
+        out = pipeline_scan(_stage_fn, (ws, bs), xs, mesh=pp_mesh.mesh)
+
+        ref = xs
+        for s in range(S):
+            ref = jax.vmap(lambda x: _stage_fn((ws[s], bs[s]), x))(ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_grad_parity(self, pp_mesh):
+        S, M, B, H = 4, 5, 2, 8
+        rng = np.random.RandomState(1)
+        ws = jnp.asarray(rng.randn(S, H, H).astype(np.float32) * 0.3)
+        bs = jnp.asarray(rng.randn(S, H).astype(np.float32) * 0.1)
+        xs = jnp.asarray(rng.randn(M, B, H).astype(np.float32))
+
+        def pp_loss(params):
+            return pipeline_scan(_stage_fn, params, xs,
+                                 mesh=pp_mesh.mesh).sum()
+
+        def ref_loss(params):
+            ws_, bs_ = params
+            y = xs
+            for s in range(S):
+                y = jnp.tanh(y @ ws_[s] + bs_[s])
+            return y.sum()
+
+        g_pp = jax.grad(pp_loss)((ws, bs))
+        g_ref = jax.grad(ref_loss)((ws, bs))
+        np.testing.assert_allclose(np.asarray(g_pp[0]), np.asarray(g_ref[0]),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(g_pp[1]), np.asarray(g_ref[1]),
+                                   atol=1e-4)
+
+    def test_remat_matches(self, pp_mesh):
+        S, M, B, H = 4, 4, 2, 8
+        rng = np.random.RandomState(2)
+        ws = jnp.asarray(rng.randn(S, H, H).astype(np.float32) * 0.3)
+        bs = jnp.asarray(rng.randn(S, H).astype(np.float32) * 0.1)
+        xs = jnp.asarray(rng.randn(M, B, H).astype(np.float32))
+
+        def loss(params, remat):
+            return pipeline_scan(_stage_fn, params, xs, mesh=pp_mesh.mesh,
+                                 remat=remat).sum()
+
+        g0 = jax.grad(lambda p: loss(p, False))((ws, bs))
+        g1 = jax.grad(lambda p: loss(p, True))((ws, bs))
+        np.testing.assert_allclose(np.asarray(g0[0]), np.asarray(g1[0]),
+                                   atol=1e-5)
+
+    def test_jit_compiles(self, pp_mesh):
+        """The whole schedule (micro-batch loop included) is one XLA program."""
+        S, M, B, H = 4, 4, 2, 8
+        rng = np.random.RandomState(3)
+        ws = jnp.asarray(rng.randn(S, H, H).astype(np.float32) * 0.3)
+        bs = jnp.asarray(rng.randn(S, H).astype(np.float32) * 0.1)
+        xs = jnp.asarray(rng.randn(M, B, H).astype(np.float32))
+        f = jax.jit(lambda p, x: pipeline_scan(_stage_fn, p, x,
+                                               mesh=pp_mesh.mesh))
+        out = f((ws, bs), xs)
+        assert out.shape == (M, B, H)
+
+    def test_single_stage_mesh(self):
+        """pp=1 degenerates to a plain scan."""
+        ws = jnp.ones((1, 4, 4), jnp.float32) * 0.1
+        bs = jnp.zeros((1, 4), jnp.float32)
+        xs = jnp.ones((3, 2, 4), jnp.float32)
+        from paddle_tpu.distributed.topology import HybridCommunicateGroup
+        hcg = HybridCommunicateGroup(dp=8)
+        out = pipeline_scan(_stage_fn, (ws, bs), xs, mesh=hcg.mesh)
+        ref = jnp.tanh(xs @ ws[0] + bs[0])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestPipelineLayer:
+    def test_uniform_segmentation(self, pp_mesh):
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(10)]
+        pl = PipelineLayer(layers=descs, num_stages=4)
+        assert pl.segment_parts == [0, 3, 6, 8, 10]
+        assert sum(len(pl.get_stage_layers(s)) for s in range(4)) == 10
+
+    def test_layer_mark_segmentation(self, pp_mesh):
+        descs = []
+        for _ in range(4):
+            descs.append(LayerDesc(nn.Linear, 8, 8))
+            descs.append(LayerDesc(nn.ReLU))
+        pl = PipelineLayer(layers=descs, num_stages=4, seg_method="layer:Linear")
+        # each stage starts at a Linear mark
+        for s in range(4):
+            assert type(pl.get_stage_layers(s)[0]).__name__ == "Linear"
+
+    def test_serial_forward(self, pp_mesh):
+        descs = [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+                 LayerDesc(nn.Linear, 8, 4), LayerDesc(nn.ReLU)]
+        pl = PipelineLayer(layers=descs, num_stages=4)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+        assert list(pl(x).shape) == [2, 4]
+
+    def test_too_few_layers(self, pp_mesh):
+        with pytest.raises(ValueError):
+            PipelineLayer(layers=[LayerDesc(nn.Linear, 4, 4)], num_stages=4)
+
+
+class TestPipelineParallel:
+    def test_distributed_model_wraps(self, pp_mesh):
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+        pl = PipelineLayer(layers=descs, num_stages=4,
+                           loss_fn=nn.MSELoss())
+        model = fleet.distributed_model(pl)
+        assert isinstance(model, PipelineParallel)
+
+    def test_requires_pipeline_layer(self, pp_mesh):
+        with pytest.raises(TypeError):
+            PipelineParallel(nn.Linear(4, 4), pp_mesh)
+
+    def test_train_batch_parity_vs_serial(self, pp_mesh):
+        """pp train_batch (micro-batched) == serial grad-accumulation SGD."""
+        def make(seed):
+            paddle.seed(seed)
+            return [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh)]
+
+        st = fleet.DistributedStrategy()
+        st.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        pl = PipelineLayer(layers=make(7), num_stages=4, loss_fn=nn.MSELoss())
+        model = PipelineParallel(pl, pp_mesh, st)
+        serial = nn.Sequential(nn.Linear(8, 8), nn.Tanh(),
+                               nn.Linear(8, 8), nn.Tanh())
+        sd = pl.state_dict()
+        serial.set_state_dict({k.replace("0.", "0.", 1): v
+                               for k, v in zip(serial.state_dict().keys(),
+                                               sd.values())})
+        from paddle_tpu.optimizer import SGD
+        opt_pp = SGD(learning_rate=0.1, parameters=model.parameters())
+        opt_s = SGD(learning_rate=0.1, parameters=serial.parameters())
+        mse = nn.MSELoss()
+
+        rng = np.random.RandomState(5)
+        for _ in range(2):
+            xb = rng.randn(8, 8).astype("float32")
+            yb = rng.randn(8, 8).astype("float32")
+            loss_pp = model.train_batch(
+                (paddle.to_tensor(xb), paddle.to_tensor(yb)), opt_pp)
+            # serial grad accumulation with the same micro-batching
+            total = 0.0
+            for m in range(4):
+                xm = paddle.to_tensor(xb[m * 2:(m + 1) * 2])
+                ym = paddle.to_tensor(yb[m * 2:(m + 1) * 2])
+                loss = mse(serial(xm), ym)
+                (loss / 4).backward()
+                total += float(loss)
+            opt_s.step()
+            opt_s.clear_grad()
+            np.testing.assert_allclose(float(loss_pp), total / 4, atol=1e-5)
+
+        for (k1, v1), (k2, v2) in zip(pl.state_dict().items(),
+                                      serial.state_dict().items()):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy(), atol=1e-5)
+
+    def test_fleet_no_ghost_import(self, pp_mesh):
+        """VERDICT weak#2 regression: pp path must not ImportError."""
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+        pl = PipelineLayer(layers=descs, num_stages=4, loss_fn=nn.MSELoss())
+        model = fleet.distributed_model(pl)  # must not raise
+        assert model is not None
