@@ -239,7 +239,10 @@ class ParallelCrossEntropy(Layer):
                 n = lax.axis_size(axis)
                 vocab_local = lg.shape[-1]
                 start = lax.axis_index(axis) * vocab_local
-                m = lax.pmax(jnp.max(lg, axis=-1), axis)
+                # stop_gradient on the INPUT: the max shift cancels in the CE
+                # gradient, and lax.pmax has no differentiation rule, so pmax
+                # must never see a tangent-carrying tracer
+                m = lax.pmax(jnp.max(lax.stop_gradient(lg), axis=-1), axis)
                 z = lg - m[..., None]
                 sumexp = lax.psum(jnp.sum(jnp.exp(z), axis=-1), axis)
                 lb_ = jnp.squeeze(lb, -1) if lb.ndim == lg.ndim else lb

@@ -107,42 +107,52 @@ def _pib_bwd(axis, _, g):
 _psum_identity_bwd.defvjp(_pib_fwd, _pib_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _split_dim(x, axis, dim):
+    """Slice this rank's chunk along ``dim`` / backward all-gather."""
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    piece = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, me * piece, piece, axis=dim)
+
+
+def _split_fwd(x, axis, dim):
+    return _split_dim(x, axis, dim), None
+
+
+def _split_bwd(axis, dim, _, g):
+    return (lax.all_gather(g, axis, axis=dim, tiled=True),)
+
+
+_split_dim.defvjp(_split_fwd, _split_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _concat_dim(x, axis, dim):
+    """All-gather along ``dim`` / backward slice this rank's chunk."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _concat_fwd(x, axis, dim):
+    return _concat_dim(x, axis, dim), None
+
+
+def _concat_bwd(axis, dim, _, g):
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    piece = g.shape[dim] // n
+    return (lax.dynamic_slice_in_dim(g, me * piece, piece, axis=dim),)
+
+
+_concat_dim.defvjp(_concat_fwd, _concat_bwd)
+
+
 def _split_last(x, axis):
-    n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
-    piece = x.shape[-1] // n
-    return lax.dynamic_slice_in_dim(x, me * piece, piece, axis=x.ndim - 1)
+    return _split_dim(x, axis, x.ndim - 1)
 
 
-def _split_fwd(x, axis):
-    return _split_last(x, axis), None
-
-
-def _split_bwd(axis, _, g):
-    return (lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
-
-
-_split_last.defvjp(_split_fwd, _split_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _concat_last(x, axis):
-    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
-
-
-def _concat_fwd(x, axis):
-    return _concat_last(x, axis), None
-
-
-def _concat_bwd(axis, _, g):
-    n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
-    piece = g.shape[-1] // n
-    return (lax.dynamic_slice_in_dim(g, me * piece, piece, axis=g.ndim - 1),)
-
-
-_concat_last.defvjp(_concat_fwd, _concat_bwd)
+    return _concat_dim(x, axis, x.ndim - 1)
 
 
 # -- public primitives -------------------------------------------------------

@@ -36,21 +36,26 @@ import weakref
 _STATE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _cache_key(function):
-    # bound method objects are recreated per access — key on (__self__, __func__)
+def _cache_entry(function):
+    """(weak-key, sub-key): bound methods are recreated per attribute access,
+    so key on __self__ with a per-object sub-dict keyed by __func__ — two
+    different methods of one object must NOT share a state entry."""
     if hasattr(function, "__self__") and hasattr(function, "__func__"):
-        return function.__self__
-    return function
+        return function.__self__, function.__func__
+    return function, None
 
 
 def _discovered_state(function):
     from ....core.tensor import _trace_hook
     if _trace_hook.ctx is not None:
         return None  # under an outer trace: always rediscover (values differ)
+    key, sub = _cache_entry(function)
     try:
-        entry = _STATE_CACHE.get(_cache_key(function))
+        entry = _STATE_CACHE.get(key)
     except TypeError:
         return None
+    if isinstance(entry, dict):
+        entry = entry.get(sub)
     if entry is None:
         return None
     state = [ref() for ref in entry]
@@ -61,8 +66,14 @@ def _remember_state(function, state):
     from ....core.tensor import _trace_hook
     if _trace_hook.ctx is not None:
         return
+    key, sub = _cache_entry(function)
+    refs = [weakref.ref(t) for t in state]
     try:
-        _STATE_CACHE[_cache_key(function)] = [weakref.ref(t) for t in state]
+        per_obj = _STATE_CACHE.get(key)
+        if not isinstance(per_obj, dict):
+            per_obj = {}
+            _STATE_CACHE[key] = per_obj
+        per_obj[sub] = refs
     except TypeError:
         pass  # unhashable/unweakrefable callable: no caching
 

@@ -47,43 +47,10 @@ def _seq_spec(ndim: int, seq_axis: int, mp_axis: str) -> P:
 
 
 # -- raw collectives (shard_map path), custom-vjp paired ---------------------
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def _scatter_seq(x, axis_name, dim):
-    n = lax.axis_size(axis_name)
-    me = lax.axis_index(axis_name)
-    piece = x.shape[dim] // n
-    return lax.dynamic_slice_in_dim(x, me * piece, piece, axis=dim)
-
-
-def _scatter_fwd(x, axis_name, dim):
-    return _scatter_seq(x, axis_name, dim), None
-
-
-def _scatter_bwd(axis_name, dim, _, g):
-    return (lax.all_gather(g, axis_name, axis=dim, tiled=True),)
-
-
-_scatter_seq.defvjp(_scatter_fwd, _scatter_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def _gather_seq(x, axis_name, dim):
-    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
-
-
-def _gather_fwd(x, axis_name, dim):
-    return _gather_seq(x, axis_name, dim), None
-
-
-def _gather_bwd(axis_name, dim, _, g):
-    n = lax.axis_size(axis_name)
-    me = lax.axis_index(axis_name)
-    piece = g.shape[dim] // n
-    return (lax.dynamic_slice_in_dim(g, me * piece, piece, axis=dim),)
-
-
-_gather_seq.defvjp(_gather_fwd, _gather_bwd)
+# scatter/gather are the dim-general split/concat pairings from mp_ops (single
+# source of truth for the slice/all-gather forward-backward tables).
+from ..layers.mpu.mp_ops import _concat_dim as _gather_seq  # noqa: E402
+from ..layers.mpu.mp_ops import _split_dim as _scatter_seq  # noqa: E402
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
@@ -205,8 +172,25 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
         self.seq_axis = seq_axis
 
     def forward(self, x):
+        from ..layers.mpu import mp_ops
+        from ..layers.mpu.mp_layers import _local_shard
+        from ....nn import functional as F
         x = AllGatherOp.apply(x, self.seq_axis, self.axis)
-        return super().forward(x)
+        # NOTE: deliberately no c_identity here — AllGatherOp's backward
+        # reduce-scatter IS the mp-group grad reduction; stacking c_identity's
+        # backward psum on top would double-count (grads scaled by mp degree).
+        if mp_ops.in_mp_region(self.axis):
+            w = _local_shard(self.weight, self.axis, self.out_features, 1)
+            b = _local_shard(self.bias, self.axis, self.out_features, 0)
+            y = F.linear(x, w, b)
+        else:
+            y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return mp_ops.c_concat(y, self.axis)
+        if not mp_ops.in_mp_region(self.axis):
+            y = mp_ops.c_constrain(
+                y, P(*([None] * (ensure_tensor(y).ndim - 1) + [self.axis])))
+        return y
 
 
 class RowSequenceParallelLinear(RowParallelLinear):

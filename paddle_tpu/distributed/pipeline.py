@@ -48,7 +48,8 @@ __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
 # ---------------------------------------------------------------------------
 
 def pipeline_scan(stage_fn: Callable, stage_params, xs, *, mesh: Mesh = None,
-                  axis: str = "pp", remat: bool = False):
+                  axis: str = "pp", remat: bool = False,
+                  batch_spec: Optional[P] = None):
     """Run ``M`` micro-batches through ``S`` pipeline stages as one compiled
     shard_map program (GPipe/1F1B schedule; ref: pipeline_parallel.py
     ``forward_backward_pipeline`` — here the schedule is the scan and XLA owns
@@ -61,10 +62,14 @@ def pipeline_scan(stage_fn: Callable, stage_params, xs, *, mesh: Mesh = None,
       xs: micro-batched input ``[M, B, ...]`` (fed to stage 0).
       mesh: defaults to the fleet hybrid mesh.
       remat: checkpoint each stage application (activation recomputation).
+      batch_spec: PartitionSpec for ``xs`` over the OTHER mesh axes (e.g.
+        ``P(None, "dp")`` to keep the batch dim dp-sharded through the
+        pipeline); defaults to replicated.
 
     Returns ``[M, B, ...]`` outputs of the last stage, replicated over ``pp``.
     """
     mesh = mesh or get_hybrid_communicate_group().mesh
+    bspec = batch_spec if batch_spec is not None else P()
     S = int(mesh.shape[axis])
     M = xs.shape[0]
     if S == 1:
@@ -101,7 +106,7 @@ def pipeline_scan(stage_fn: Callable, stage_params, xs, *, mesh: Mesh = None,
         return lax.psum(outs, axis)
 
     shmap = shard_map(
-        body, mesh=mesh, in_specs=(in_axes_spec, P()), out_specs=P(),
+        body, mesh=mesh, in_specs=(in_axes_spec, bspec), out_specs=bspec,
         check_vma=False)
     return shmap(stage_params, xs)
 

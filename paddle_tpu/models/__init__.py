@@ -1,0 +1,12 @@
+"""Flagship model zoo (the reference ships these via PaddleNLP/PaddleClas —
+SURVEY §2.6 ecosystem row; here they are first-class so the framework is
+benchmarkable end-to-end).
+
+``llama`` is the flagship decoder family: a pure-functional, scan-over-stacked-
+layers implementation designed for XLA (single trace regardless of depth,
+pipeline-ready stacked params) plus sharding-spec builders for the hybrid mesh.
+"""
+
+from . import llama  # noqa: F401
+from .llama import (LlamaConfig, LlamaForCausalLM, init_params, forward,
+                    loss_fn, param_specs)  # noqa: F401

@@ -1,0 +1,362 @@
+"""LLaMA-family decoder — the flagship model.
+
+Capability target: the reference's LLaMA implementation lives in PaddleNLP
+(``paddlenlp/transformers/llama/modeling.py``, built from the fleet mpu layers —
+SURVEY §2.5 TP/MP and §2.6 ecosystem rows); the hybrid-parallel pretrain of this
+model is the reference's headline benchmark (BASELINE.md north star).
+
+TPU redesign, not a translation:
+
+* **Pure-functional core** — ``init_params`` / ``forward`` / ``loss_fn`` operate
+  on a plain pytree. Per-layer weights are STACKED on a leading ``[L, ...]`` dim
+  and the depth loop is a ``lax.scan``: one trace + one compile regardless of
+  depth, and the stacked layout is exactly what the compiled pipeline schedule
+  (``distributed.pipeline.pipeline_scan``) consumes.
+* **Sharding by annotation** — ``param_specs``/``batch_spec`` return
+  ``PartitionSpec`` pytrees (Megatron layout over the ``mp`` axis, optional
+  ZeRO-3-style extra sharding over the ``sharding`` axis); GSPMD inserts the
+  collectives the reference writes by hand in ``mp_layers.py``.
+* **Kernel path** — ``use_kernels=True`` routes RMSNorm/RoPE/attention through
+  the Pallas kernels (``paddle_tpu.kernels``); the jnp reference path is the
+  numerics oracle and the GSPMD-partitionable fallback.
+* **Eager wrapper** — :class:`LlamaForCausalLM` exposes the same network as a
+  ``nn.Layer`` for the imperative / ``to_static`` API surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "param_specs",
+           "batch_spec", "make_train_step", "LlamaForCausalLM", "num_params"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5504
+    num_hidden_layers: int = 16
+    num_attention_heads: int = 16
+    num_key_value_heads: Optional[int] = None   # None -> MHA
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_kernels: bool = False        # Pallas flash-attn / fused rmsnorm / rope
+    dtype: Any = jnp.float32         # activation/compute dtype
+    param_dtype: Any = jnp.float32   # storage dtype
+    remat: bool = False              # jax.checkpoint each decoder layer
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    E, I, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    kvd = cfg.kv_heads * cfg.head_dim
+    per_layer = E * E + 2 * E * kvd + E * E + 3 * E * I + 2 * E
+    n = V * E + L * per_layer + E
+    if not cfg.tie_word_embeddings:
+        n += E * V
+    return n
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
+    """Stacked-[L, ...] parameter pytree (truncated-normal / scaled init)."""
+    E, I, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    D = cfg.head_dim
+    H, Hk = cfg.num_attention_heads, cfg.kv_heads
+    ks = jax.random.split(key, 10)
+    pd = cfg.param_dtype
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) /
+                math.sqrt(fan_in)).astype(pd)
+
+    params = {
+        "embed": dense(ks[0], (V, E), E),
+        "layers": {
+            "wq": dense(ks[1], (L, E, H * D), E),
+            "wk": dense(ks[2], (L, E, Hk * D), E),
+            "wv": dense(ks[3], (L, E, Hk * D), E),
+            "wo": dense(ks[4], (L, H * D, E), H * D),
+            "w_gate": dense(ks[5], (L, E, I), E),
+            "w_up": dense(ks[6], (L, E, I), E),
+            "w_down": dense(ks[7], (L, I, E), I),
+            "ln_attn": jnp.ones((L, E), pd),
+            "ln_mlp": jnp.ones((L, E), pd),
+        },
+        "ln_f": jnp.ones((E,), pd),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(ks[8], (E, V), E)
+    return params
+
+
+def param_specs(cfg: LlamaConfig, mp_axis: Optional[str] = "mp",
+                fsdp_axis: Optional[str] = None) -> Dict:
+    """Megatron-layout PartitionSpecs for the stacked param pytree.
+
+    ``mp_axis`` shards attention heads / ffn intermediate dim (TP);
+    ``fsdp_axis`` additionally shards the other matmul dim (ZeRO-3 layout over
+    the ``sharding`` axis — ref: GroupShardedStage3, here just a layout).
+    """
+    mp, fs = mp_axis, fsdp_axis
+    specs = {
+        "embed": P(mp, fs),                  # vocab-sharded (VocabParallelEmbedding)
+        "layers": {
+            "wq": P(None, fs, mp),           # column-parallel
+            "wk": P(None, fs, mp),
+            "wv": P(None, fs, mp),
+            "wo": P(None, mp, fs),           # row-parallel
+            "w_gate": P(None, fs, mp),
+            "w_up": P(None, fs, mp),
+            "w_down": P(None, mp, fs),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "ln_f": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(fs, mp)         # vocab-sharded logits
+    return specs
+
+
+def batch_spec(dp_axes=("dp",), sep_axis: Optional[str] = None) -> P:
+    """[B, S] token batches: batch over the data axes, seq over sep (CP)."""
+    return P(tuple(a for a in dp_axes if a), sep_axis)
+
+
+def shard_params(params, mesh: Mesh, cfg: LlamaConfig, mp_axis="mp",
+                 fsdp_axis=None):
+    specs = param_specs(cfg, mp_axis, fsdp_axis)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _rms_norm(x, w, eps, use_kernels):
+    if use_kernels:
+        from ..kernels.rms_norm import rms_norm as fused
+        return fused(x, w, eps)
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (y * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, cos, sin, use_kernels):
+    if use_kernels:
+        from ..kernels.rope import apply_rope
+        return apply_rope(x, cos, sin)
+    # x: [B, S, H, D]; cos/sin: [S, D]
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return x * c + rot * s
+
+
+def _attention(q, k, v, cfg: LlamaConfig):
+    """Causal self-attention on [B, S, H(k), D]."""
+    if cfg.use_kernels:
+        from ..kernels.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    B, S, H, D = q.shape
+    Hk = k.shape[2]
+    if Hk != H:  # GQA: expand kv heads
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o.astype(q.dtype)
+
+
+def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig):
+    """One pre-norm decoder block on un-stacked layer params ``lp``."""
+    B, S, E = x.shape
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = _rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_kernels)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, D)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, Hk, D)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, Hk, D)
+    q = _rope(q, cos, sin, cfg.use_kernels)
+    k = _rope(k, cos, sin, cfg.use_kernels)
+    o = _attention(q, k, v, cfg).reshape(B, S, H * D)
+    x = x + o @ lp["wo"].astype(dt)
+
+    h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_kernels)
+    g = jax.nn.silu(h @ lp["w_gate"].astype(dt)) * (h @ lp["w_up"].astype(dt))
+    return x + g @ lp["w_down"].astype(dt)
+
+
+def forward(params: Dict, input_ids, cfg: LlamaConfig):
+    """``input_ids [B, S] -> logits [B, S, V]`` (single trace via lax.scan)."""
+    from ..kernels.rope import rope_cos_sin
+    B, S = input_ids.shape
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.dtype)
+    cos, sin = rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
+
+    layer = partial(decoder_layer, cos=cos, sin=sin, cfg=cfg)
+    if cfg.remat:
+        layer = jax.checkpoint(layer)
+
+    def scan_body(h, lp):
+        return layer(lp, h), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_kernels)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    return x @ head.astype(cfg.dtype)
+
+
+def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig):
+    """Mean next-token cross-entropy (labels already shifted; -100 ignored)."""
+    logits = forward(params, input_ids, cfg).astype(jnp.float32)
+    V = logits.shape[-1]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(
+        logits, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+    mask = labels >= 0
+    per_tok = jnp.where(mask, lse - tgt, 0.0)
+    return per_tok.sum() / jnp.maximum(mask.sum(), 1)
+
+
+# ---------------------------------------------------------------------------
+# functional train step (AdamW, fp32 master weights)
+# ---------------------------------------------------------------------------
+
+def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
+                    eps=1e-8, weight_decay=0.0):
+    """Returns ``(init_opt_state, train_step)`` pure functions.
+
+    ``train_step(params, opt_state, input_ids, labels) ->
+    (params, opt_state, loss)``. AdamW on fp32 master state regardless of
+    param storage dtype (the reference's multi_precision optimizer path).
+    """
+
+    def init_opt_state(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return {"m": zeros,
+                "v": jax.tree_util.tree_map(jnp.copy, zeros),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(params, opt_state, input_ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, cfg)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m = beta1 * m + (1 - beta1) * g
+            v = beta2 * v + (1 - beta2) * (g * g)
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            pf = p.astype(jnp.float32)
+            if weight_decay:
+                u = u + weight_decay * pf
+            return (pf - lr * u).astype(p.dtype), m, v
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(opt_state["m"])
+        flat_v = treedef.flatten_up_to(opt_state["v"])
+        new = [upd(p, g, m, v) for p, g, m, v
+               in zip(flat_p, flat_g, flat_m, flat_v)]
+        params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
+        m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
+        v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
+        return params, {"m": m, "v": v, "step": step}, loss
+
+    return init_opt_state, train_step
+
+
+# ---------------------------------------------------------------------------
+# eager nn.Layer wrapper (imperative API parity)
+# ---------------------------------------------------------------------------
+
+class LlamaForCausalLM:
+    """Eager wrapper exposing the functional model as an ``nn.Layer``.
+
+    Implemented lazily (class body built on first instantiation) to keep the
+    functional core import-light for bench/driver entry points.
+    """
+
+    def __new__(cls, config: LlamaConfig, key: Optional[jax.Array] = None):
+        from ..core.tensor import Parameter, Tensor
+        from ..core.dispatch import forward_op
+        from ..nn.layer import Layer
+
+        class _Llama(Layer):
+            def __init__(self, cfg, key):
+                super().__init__()
+                self.config = cfg
+                key = key if key is not None else jax.random.PRNGKey(0)
+                raw = init_params(cfg, key)
+                flat, self._treedef = jax.tree_util.tree_flatten(raw)
+                self._flat_params = []
+                for i, leaf in enumerate(flat):
+                    p = Parameter(leaf)
+                    self.add_parameter(f"p{i}", p)
+                    self._flat_params.append(p)
+
+            def params_pytree(self):
+                return jax.tree_util.tree_unflatten(
+                    self._treedef, [p._value for p in self._flat_params])
+
+            def forward(self, input_ids, labels=None):
+                cfg = self.config
+                n = len(self._flat_params)
+
+                if labels is None:
+                    def f(ids, *leaves):
+                        params = jax.tree_util.tree_unflatten(
+                            self._treedef, list(leaves))
+                        return forward(params, ids, cfg)
+                    return forward_op("llama_forward", f,
+                                      [input_ids, *self._flat_params])
+
+                def f(ids, lbl, *leaves):
+                    params = jax.tree_util.tree_unflatten(
+                        self._treedef, list(leaves))
+                    return loss_fn(params, ids, lbl, cfg)
+                return forward_op("llama_loss", f,
+                                  [input_ids, labels, *self._flat_params])
+
+        _Llama.__name__ = "LlamaForCausalLM"
+        return _Llama(config, key)

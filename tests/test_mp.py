@@ -408,11 +408,12 @@ class TestReviewFixes:
     def test_recompute_state_cache_hit(self):
         from paddle_tpu.distributed.fleet.recompute import recompute as rc
         from paddle_tpu.distributed.fleet.recompute.recompute import (
-            _STATE_CACHE, _cache_key)
+            _STATE_CACHE, _cache_entry)
         m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 4))
         x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
         rc(m, x)
-        assert _cache_key(m) in _STATE_CACHE
+        key, sub = _cache_entry(m)
+        assert key in _STATE_CACHE and sub in _STATE_CACHE[key]
         y2 = rc(m, x)  # cache-hit path
         np.testing.assert_allclose(y2.numpy(), m(x).numpy(), atol=1e-6)
 
@@ -460,3 +461,82 @@ class TestReviewFixes:
             rmod.recompute = real_rc
         assert len(n_chunks) == 3
         np.testing.assert_allclose(y.numpy(), 8 * np.ones((2, 2)), atol=1e-6)
+
+
+class TestRound2ReviewFixes:
+    def test_seq_parallel_column_grads_not_scaled(self, mp_mesh):
+        """shard_map path: AllGatherOp's reduce-scatter backward must REPLACE
+        c_identity's psum, not stack on it (was: input grads x mp_degree)."""
+        col = spu.ColumnSequenceParallelLinear(16, 32, gather_output=False,
+                                               seq_axis=0)
+        ser = _clone_linear(col, 16, 32)
+        x = np.random.randn(8, 4, 16).astype("float32")
+
+        def f(v):
+            def body(vl):
+                from paddle_tpu.core.tensor import _wrap_value
+                t = _wrap_value(vl)  # local seq shard [2,4,16]
+                y = col(t)
+                return y._raw
+            out = shard_map(body, mesh=mp_mesh.mesh,
+                            in_specs=P("mp", None, None),
+                            out_specs=P("mp", None, None),
+                            check_vma=False)(v)
+            return (out ** 2).sum()
+
+        g = jax.grad(f)(jnp.asarray(x))
+
+        def f_ser(v):
+            import paddle_tpu.nn.functional as Fn
+            y = Fn.linear(paddle.to_tensor(v), ser.weight, ser.bias)
+            return (y._raw.astype(jnp.float32) ** 2).sum()
+
+        g_ser = jax.grad(lambda v: f_ser(v))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ser),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_parallel_ce_trainable_logits_shard_map(self, mp_mesh):
+        """pmax path must be differentiable (stop_gradient'ed max shift)."""
+        logits = np.random.randn(6, 64).astype("float32")
+        lab = np.random.randint(0, 64, (6, 1))
+
+        def f(lg):
+            def body(lg_local, lb):
+                from paddle_tpu.core.tensor import _wrap_value
+                pce = ParallelCrossEntropy()
+                t = _wrap_value(lg_local, stop_gradient=False)
+                return pce(t, _wrap_value(lb))._raw
+            out = shard_map(body, mesh=mp_mesh.mesh,
+                            in_specs=(P(None, "mp"), P()), out_specs=P(),
+                            check_vma=False)(lg, jnp.asarray(lab))
+            return out.sum()
+
+        g = jax.grad(f)(jnp.asarray(logits))
+        # oracle: d(sum CE)/dlogits = softmax - onehot
+        p = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+        onehot = jax.nn.one_hot(jnp.asarray(lab)[:, 0], 64)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(p - onehot),
+                                   atol=1e-4)
+
+    def test_recompute_two_methods_same_object(self, mp_mesh):
+        """State cache must key (obj, method) — second method of the same
+        object must not reuse the first method's parameter list."""
+        class TwoHeads(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 8)
+                self.fc2 = nn.Linear(8, 8)
+
+            def head1(self, x):
+                return self.fc1(x)
+
+            def head2(self, x):
+                return self.fc2(x)
+
+        m = TwoHeads()
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        (recompute(m.head1, x) ** 2).mean().backward()
+        assert m.fc1.weight.grad is not None
+        (recompute(m.head2, x) ** 2).mean().backward()
+        assert m.fc2.weight.grad is not None
+        assert float(np.abs(m.fc2.weight.grad.numpy()).sum()) > 0
