@@ -329,14 +329,25 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, g):
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _default_blocks(Sq: int, Sk: int):
+    """TPU-tuned defaults (v5e sweep, S=2048/D=64: (1024,512) beats the jnp
+    reference ~1.5x; tiny 128x128 blocks were 1.7x SLOWER than reference).
+    Interpret mode (CPU tests) keeps small blocks for speed."""
+    if _interpret():
+        return min(128, Sq), min(128, Sk)
+    return min(1024, Sq), min(512, Sk)
+
+
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128):
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None):
     """[B, S, H, D] flash attention returning (out, lse[B, H, S])."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
+    dq, dk = _default_blocks(Sq, Sk)
+    block_q = min(block_q or dq, Sq)
+    block_k = min(block_k or dk, Sk)
     if Sq % block_q or Sk % block_k:
         raise ValueError(f"flash_attention: seq lens ({Sq},{Sk}) must divide "
                          f"block sizes ({block_q},{block_k})")
@@ -357,7 +368,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: Optional[int] = None, block_k: Optional[int] = None):
     """[B, S, H, D] flash attention (the paddle flash_attn kernel equivalent)."""
     out, _ = flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k)
     return out

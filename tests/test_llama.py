@@ -40,7 +40,7 @@ class TestFunctionalCore:
     def test_kernel_path_matches_ref(self):
         # Pallas kernels run in interpret mode on CPU — numerics oracle
         cfg_ref = tiny_cfg()
-        cfg_ker = tiny_cfg(use_kernels=True)
+        cfg_ker = tiny_cfg(use_kernels=True, use_fused_norm=True)
         params = llama.init_params(cfg_ref, jax.random.PRNGKey(1))
         ids = jnp.arange(2 * 8).reshape(2, 8) % cfg_ref.vocab_size
         ref = llama.forward(params, ids, cfg_ref)
