@@ -1,0 +1,23 @@
+"""``paddle.amp`` parity: auto_cast, GradScaler, decorate, op lists.
+
+Parity target: ``python/paddle/amp/`` in the reference (auto_cast O1/O2 with
+white/black op lists enforced in the generated eager AMP hooks, GradScaler
+with dynamic loss scaling, ``decorate`` for O2 params + master weights).
+
+TPU redesign: the compute dtype is **bfloat16** (MXU-native; fp16 is accepted
+but bf16 is the platform default). The cast hook lives in the eager
+dispatcher (``core/dispatch.forward_op``) so it applies identically in eager
+mode and under a ``to_static`` trace — the compiled program bakes the casts
+in. Loss scaling is numerically supported but unnecessary for bf16 (same
+exponent range as fp32); GradScaler defaults to dynamic scaling for fp16
+parity and becomes a transparent no-op when ``enable=False``.
+"""
+
+from .auto_cast import (amp_guard, auto_cast, autocast, decorate,
+                        is_bfloat16_supported, is_float16_supported,
+                        white_list, black_list, _amp_state)
+from .grad_scaler import AmpScaler, GradScaler
+
+__all__ = ["auto_cast", "autocast", "amp_guard", "decorate", "GradScaler",
+           "AmpScaler", "is_float16_supported", "is_bfloat16_supported",
+           "white_list", "black_list"]

@@ -1,0 +1,163 @@
+"""auto_cast context + op lists + decorate.
+
+ref: ``python/paddle/amp/auto_cast.py`` and the op lists in
+``python/paddle/amp/amp_lists.py`` (white = matmul/conv-class ops that are
+fast and safe in low precision; black = reductions/transcendentals that need
+fp32 accumulation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+# -- op lists (keyed by forward_op names) ------------------------------------
+
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "linear", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "addmm",
+    "scaled_dot_product_attention", "flash_attention", "llama_forward",
+    "llama_loss",
+}
+
+BLACK_LIST: Set[str] = {
+    "exp", "expm1", "log", "log2", "log10", "log1p", "pow", "square", "sqrt",
+    "rsqrt", "softmax", "log_softmax", "logsumexp", "cross_entropy",
+    "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "kl_div", "cosh", "sinh",
+    "tan", "asin", "acos", "atan", "mean", "sum", "prod", "cumsum", "cumprod",
+    "norm", "p_norm", "var", "std", "renorm", "erfinv", "logit",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "rms_norm",
+}
+
+
+def white_list():
+    return frozenset(WHITE_LIST)
+
+
+def black_list():
+    return frozenset(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enable = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white: Set[str] = set()
+        self.black: Set[str] = set()
+
+
+_amp_state = _AmpState()
+
+
+def _cast_val(v, dtype):
+    if hasattr(v, "dtype") and v.dtype == jnp.float32:
+        return v.astype(dtype)
+    return v
+
+
+def _uncast_val(v):
+    if hasattr(v, "dtype") and v.dtype in (jnp.bfloat16, jnp.float16):
+        return v.astype(jnp.float32)
+    return v
+
+
+def amp_cast_inputs(name: str, vals):
+    """Dispatcher hook (called from core.dispatch.forward_op): rewrite the raw
+    input values of op ``name`` per the active auto_cast state."""
+    st = _amp_state
+    if not st.enable:
+        return vals
+    if name in st.black:
+        return [_uncast_val(v) for v in vals]  # fp32 islands
+    if name in st.white or st.level == "O2":
+        return [_cast_val(v, st.dtype) for v in vals]
+    return vals
+
+
+def amp_active() -> bool:
+    return _amp_state.enable
+
+
+_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+           "float32": jnp.float32}
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """``paddle.amp.auto_cast`` parity. ``level``: O1 (white-list casts) or
+    O2 (everything except black list). ``dtype`` defaults to bfloat16 — the
+    TPU-native low precision."""
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"auto_cast level must be O0/O1/O2/OD, got {level!r}")
+    if dtype not in _DTYPES:
+        raise ValueError(f"auto_cast dtype must be one of {list(_DTYPES)}")
+    st = _amp_state
+    prev = (st.enable, st.dtype, st.level, st.white, st.black)
+    st.enable = bool(enable) and level != "O0"
+    st.dtype = _DTYPES[dtype]
+    st.level = "O1" if level == "OD" else level
+    st.white = (WHITE_LIST | set(custom_white_list or ())) - \
+        set(custom_black_list or ())
+    st.black = BLACK_LIST | set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enable, st.dtype, st.level, st.white, st.black) = prev
+
+
+autocast = auto_cast
+amp_guard = auto_cast  # legacy alias (paddle.fluid.dygraph.amp.amp_guard)
+
+
+def is_float16_supported(device=None) -> bool:
+    return True  # storage works everywhere; bf16 is preferred on TPU
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype: Optional[str] = None,
+             master_grad: bool = False, excluded_layers=None):
+    """``paddle.amp.decorate`` parity: O2 casts model params to ``dtype`` and
+    switches the optimizer to fp32 master weights."""
+    from ..nn.layer import Layer
+
+    if level not in ("O1", "O2"):
+        raise ValueError(f"decorate level must be O1 or O2, got {level!r}")
+    target = _DTYPES[dtype]
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    excluded = tuple(excluded_layers or ())
+    if level == "O2":
+        for m in model_list:
+            if not isinstance(m, Layer):
+                raise TypeError(f"decorate expects nn.Layer, got {type(m)}")
+            for layer in m.sublayers(include_self=True):
+                if excluded and isinstance(layer, excluded):
+                    continue
+                from ..nn.layers.norm import BatchNorm1D, BatchNorm2D, \
+                    BatchNorm3D, LayerNorm
+                if isinstance(layer, (LayerNorm, BatchNorm1D, BatchNorm2D,
+                                      BatchNorm3D)):
+                    continue  # norm layers stay fp32 (reference behavior)
+                for p in layer.parameters(include_sublayers=False):
+                    if p._value.dtype == jnp.float32:
+                        p._value = p._value.astype(target)
+    if optimizers is not None:
+        opt_list = optimizers if isinstance(optimizers, (list, tuple)) \
+            else [optimizers]
+        use_master = master_weight if master_weight is not None \
+            else (level == "O2")
+        for opt in opt_list:
+            opt._multi_precision = bool(use_master)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -246,6 +246,17 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             if _flags.flag("FLAGS_check_nan_inf"):
                 _check_nan_inf(node.name + "_grad", in_cots)
 
+        def match_dtype(c, dt):
+            # Under AMP a consumer may have cast its input (fp32<->bf16), so
+            # the cotangent it emits carries the CAST dtype; re-cast to the
+            # producer's recorded output dtype (the reference's generated AMP
+            # grad nodes do the same cast). astype on a Tensor keeps the
+            # cast on the tape for create_graph.
+            cur = getattr(c, "dtype", None)
+            if cur is None or cur == dt or not jnp.issubdtype(dt, jnp.inexact):
+                return c
+            return c.astype(dt)
+
         for e, c in zip(node.inputs, in_cots):
             if c is None:
                 continue
@@ -257,9 +268,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                     c = lift(r)
             if e.node is None:
                 if not t.stop_gradient and (_leaf_filter is None or id(t) in _leaf_filter):
-                    t._accumulate_grad(c)
+                    t._accumulate_grad(match_dtype(c, t._value.dtype))
             else:
                 pslot = cots.setdefault(id(e.node), [None] * e.node.n_outputs)
+                c = match_dtype(c, e.node.out_avals[e.index][1])
                 pslot[e.index] = acc(pslot[e.index], c)
                 if not t.stop_gradient and (t._retain_grads or
                                             _flags.flag("FLAGS_retain_grad_for_all_tensor")):

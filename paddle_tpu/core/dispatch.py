@@ -67,6 +67,12 @@ def forward_op(name: str, fn: Callable, args: Sequence[Any],
     kwargs = kwargs or {}
     vals = [a._value if isinstance(a, Tensor) else a for a in args]
 
+    # AMP autocast hook (reference: the generated *_ad_func AMP checks).
+    # Lazy import: amp imports core.
+    from ..amp.auto_cast import amp_active, amp_cast_inputs
+    if amp_active():
+        vals = amp_cast_inputs(name, vals)
+
     diff_idx = []
     if differentiable and autograd.is_grad_enabled():
         for i, a in enumerate(args):

@@ -1,0 +1,205 @@
+"""paddle.amp parity tests: auto_cast op-list semantics, GradScaler dynamics,
+decorate O2 master weights, end-to-end mixed-precision training."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import amp
+from paddle_tpu.optimizer import SGD, AdamW
+
+
+class TestAutoCast:
+    def test_white_op_casts_to_bf16(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        with amp.auto_cast():
+            y = paddle.matmul(x, w)
+        assert y.dtype == jnp.bfloat16
+        y2 = paddle.matmul(x, w)
+        assert y2.dtype == jnp.float32  # state restored
+
+    def test_black_op_stays_fp32(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast():
+            h = F.relu(x)          # neither list: input dtype preserved
+            s = F.softmax(x)       # black: fp32
+        assert h.dtype == jnp.float32
+        assert s.dtype == jnp.float32
+
+    def test_black_op_upcasts_bf16_input(self):
+        x = paddle.to_tensor(
+            np.random.randn(4, 8).astype("float32")).astype("bfloat16")
+        with amp.auto_cast():
+            s = F.softmax(x)
+        assert s.dtype == jnp.float32
+
+    def test_o2_casts_everything_but_black(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast(level="O2"):
+            h = F.relu(x)
+            s = F.softmax(x)
+        assert h.dtype == jnp.bfloat16
+        assert s.dtype == jnp.float32
+
+    def test_custom_lists(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast(custom_white_list={"relu"}):
+            h = F.relu(x)
+        assert h.dtype == jnp.bfloat16
+        with amp.auto_cast(custom_black_list={"matmul"}):
+            y = paddle.matmul(x, paddle.transpose(x, [1, 0]))
+        assert y.dtype == jnp.float32
+
+    def test_disable(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast(enable=False):
+            y = paddle.matmul(x, paddle.transpose(x, [1, 0]))
+        assert y.dtype == jnp.float32
+
+    def test_level_validation(self):
+        with pytest.raises(ValueError):
+            with amp.auto_cast(level="O9"):
+                pass
+
+    def test_backward_through_autocast(self):
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast():
+            loss = (lin(x) ** 2).mean()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+class TestGradScaler:
+    def _mini(self):
+        lin = nn.Linear(4, 4)
+        opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        return lin, opt, x
+
+    def test_scale_and_step(self):
+        lin, opt, x = self._mini()
+        scaler = amp.GradScaler(init_loss_scaling=128.0)
+        w0 = lin.weight.numpy().copy()
+        loss = (lin(x) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        assert not np.allclose(lin.weight.numpy(), w0)
+
+    def test_unscale_restores_grad_magnitude(self):
+        lin, opt, x = self._mini()
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        loss = (lin(x) ** 2).mean()
+        scaler.scale(loss).backward()
+        g_scaled = lin.weight.grad.numpy().copy()
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(lin.weight.grad.numpy(),
+                                   g_scaled / 1024.0, rtol=1e-6)
+
+    def test_inf_skips_step_and_shrinks_scale(self):
+        lin, opt, x = self._mini()
+        scaler = amp.GradScaler(init_loss_scaling=256.0)
+        w0 = lin.weight.numpy().copy()
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        lin.weight.grad.set_value(
+            np.full_like(lin.weight.grad.numpy(), np.inf))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(lin.weight.numpy(), w0)  # skipped
+        assert scaler.get_init_loss_scaling() == 128.0  # 256 * 0.5
+
+    def test_scale_grows_after_n_good_steps(self):
+        lin, opt, x = self._mini()
+        scaler = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2)
+        for _ in range(2):
+            loss = (lin(x) ** 2).mean()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+        assert scaler.get_init_loss_scaling() == 4.0
+
+    def test_disabled_passthrough(self):
+        lin, opt, x = self._mini()
+        scaler = amp.GradScaler(enable=False)
+        loss = (lin(x) ** 2).mean()
+        assert scaler.scale(loss) is loss
+        loss.backward()
+        scaler.step(opt)  # plain step
+        scaler.update()
+
+    def test_state_dict_roundtrip(self):
+        s1 = amp.GradScaler(init_loss_scaling=99.0)
+        s2 = amp.GradScaler()
+        s2.load_state_dict(s1.state_dict())
+        assert s2.get_init_loss_scaling() == 99.0
+
+
+class TestDecorate:
+    def test_o2_casts_params_and_master_weights(self):
+        model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2")
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert opt._multi_precision
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        with amp.auto_cast(level="O2"):
+            loss = (model(x).astype("float32") ** 2).mean()
+        loss.backward()
+        opt.step()
+        # master weights exist in fp32
+        assert opt._master_weights
+        for mw in opt._master_weights.values():
+            assert mw.dtype == jnp.float32
+
+    def test_norm_layers_stay_fp32(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.LayerNorm(8))
+        amp.decorate(model, level="O2")
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert model[1].weight.dtype == jnp.float32
+
+
+class TestEndToEnd:
+    def test_amp_training_matches_fp32_direction(self):
+        """bf16-autocast training tracks the fp32 loss curve (tolerance)."""
+        def build():
+            paddle.seed(7)
+            m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 1))
+            o = SGD(learning_rate=0.05, parameters=m.parameters())
+            return m, o
+
+        rng = np.random.default_rng(0)
+        xs = rng.standard_normal((5, 8, 16)).astype("float32")
+        ys = rng.standard_normal((5, 8, 1)).astype("float32")
+
+        def run(use_amp):
+            m, o = build()
+            losses = []
+            scaler = amp.GradScaler(enable=use_amp)
+            for i in range(5):
+                x, y = paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])
+                if use_amp:
+                    with amp.auto_cast():
+                        loss = ((m(x) - y) ** 2).mean()
+                else:
+                    loss = ((m(x) - y) ** 2).mean()
+                scaler.scale(loss).backward()
+                scaler.step(o)
+                scaler.update()
+                o.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        fp32 = run(False)
+        mixed = run(True)
+        assert mixed[-1] < mixed[0]  # converging
+        np.testing.assert_allclose(mixed, fp32, rtol=0.1, atol=0.05)

@@ -1,0 +1,210 @@
+"""paddle.io parity tests (datasets, samplers, DataLoader incl. workers)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ChainDataset, ComposeDataset,
+                           ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler,
+                           get_worker_info, random_split)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class Stream(IterableDataset):
+    def __init__(self, n=17):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        # the DataLoader pre-slices the stream per worker; plain range here
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        a = np.arange(12).reshape(6, 2).astype("float32")
+        b = np.arange(6).astype("int64")
+        ds = TensorDataset([paddle.to_tensor(a), b])
+        assert len(ds) == 6
+        x, y = ds[3]
+        np.testing.assert_array_equal(x, a[3])
+        assert y == 3
+
+    def test_tensor_dataset_mismatch(self):
+        with pytest.raises(ValueError):
+            TensorDataset([np.zeros((3, 2)), np.zeros((4,))])
+
+    def test_concat_subset_split(self):
+        d1, d2 = SquareDataset(5), SquareDataset(7)
+        cat = ConcatDataset([d1, d2])
+        assert len(cat) == 12
+        assert cat[6][0] == np.float32(1)  # second dataset idx 1
+        sub = Subset(cat, [0, 6, 11])
+        assert len(sub) == 3 and sub[1][0] == np.float32(1)
+        parts = random_split(SquareDataset(10), [7, 3])
+        assert [len(p) for p in parts] == [7, 3]
+        seen = sorted(int(p[i][0]) for p in parts for i in range(len(p)))
+        assert seen == list(range(10))
+
+    def test_random_split_fractions(self):
+        parts = random_split(SquareDataset(10), [0.5, 0.5])
+        assert [len(p) for p in parts] == [5, 5]
+
+    def test_compose_chain(self):
+        comp = ComposeDataset([SquareDataset(4), SquareDataset(4)])
+        item = comp[2]
+        assert len(item) == 4
+        ch = ChainDataset([Stream(3), Stream(2)])
+        assert len(list(ch)) == 5
+
+
+class TestSamplers:
+    def test_sequence_random(self):
+        ds = SquareDataset(10)
+        assert list(SequenceSampler(ds)) == list(range(10))
+        r = list(RandomSampler(ds))
+        assert sorted(r) == list(range(10))
+
+    def test_weighted(self):
+        w = [0.0, 0.0, 1.0]
+        idx = list(WeightedRandomSampler(w, 8))
+        assert idx == [2] * 8
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(SquareDataset(10), batch_size=3)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        bs = BatchSampler(SquareDataset(10), batch_size=3, drop_last=True)
+        assert [len(b) for b in list(bs)] == [3, 3, 3]
+        assert len(bs) == 3
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = SquareDataset(10)
+        seen = []
+        for rank in range(2):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                        rank=rank)
+            for b in s:
+                seen.extend(b)
+        # padded to 10 -> each rank gets 5; union covers the dataset
+        assert len(seen) == 10
+        assert set(seen) == set(range(10))
+
+    def test_distributed_epoch_shuffle(self):
+        ds = SquareDataset(10)
+        s = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0,
+                                    shuffle=True)
+        s.set_epoch(0)
+        e0 = [i for b in s for i in b]
+        s.set_epoch(1)
+        e1 = [i for b in s for i in b]
+        assert e0 != e1
+
+
+class TestDataLoader:
+    def test_single_process(self):
+        dl = DataLoader(SquareDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert isinstance(x, paddle.Tensor) and list(x.shape) == [4]
+        np.testing.assert_allclose(y.numpy(), x.numpy() ** 2)
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(SquareDataset(12), batch_size=4, shuffle=True)
+        xs = np.concatenate([b[0].numpy() for b in dl])
+        assert sorted(xs.tolist()) == list(range(12))
+
+    def test_dict_samples(self):
+        class D(Dataset):
+            def __getitem__(self, i):
+                return {"x": np.float32(i), "y": np.int64(i % 2)}
+
+            def __len__(self):
+                return 6
+
+        batch = next(iter(DataLoader(D(), batch_size=3)))
+        assert set(batch.keys()) == {"x", "y"}
+        assert list(batch["x"].shape) == [3]
+
+    def test_multiprocess_parity(self):
+        dl0 = DataLoader(SquareDataset(23), batch_size=5, num_workers=0)
+        dl2 = DataLoader(SquareDataset(23), batch_size=5, num_workers=2)
+        b0 = [b[0].numpy() for b in dl0]
+        b2 = [b[0].numpy() for b in dl2]
+        assert len(b0) == len(b2)
+        for a, b in zip(b0, b2):
+            np.testing.assert_array_equal(a, b)  # ordering preserved
+
+    def test_worker_exception_propagates(self):
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                if i == 3:
+                    raise ValueError("boom at 3")
+                return np.float32(i)
+
+            def __len__(self):
+                return 6
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="boom at 3"):
+            list(dl)
+
+    def test_worker_info_and_init_fn(self):
+        class WhoAmI(Dataset):
+            def __getitem__(self, i):
+                info = get_worker_info()
+                return np.int64(-1 if info is None else info.id)
+
+            def __len__(self):
+                return 8
+
+        ids = np.concatenate([b.numpy() for b in
+                              DataLoader(WhoAmI(), batch_size=2,
+                                         num_workers=2)])
+        assert set(ids.tolist()) <= {0, 1}
+        ids0 = np.concatenate([b.numpy() for b in
+                               DataLoader(WhoAmI(), batch_size=2)])
+        assert set(ids0.tolist()) == {-1}
+
+    def test_iterable_single(self):
+        dl = DataLoader(Stream(10), batch_size=4)
+        got = np.concatenate([b.numpy() for b in dl])
+        assert sorted(got.tolist()) == list(range(10))
+
+    def test_iterable_multiworker_no_dup(self):
+        dl = DataLoader(Stream(21), batch_size=4, num_workers=2)
+        got = np.concatenate([b.numpy() for b in dl])
+        assert sorted(got.tolist()) == list(range(21))
+
+    def test_iterable_drop_last(self):
+        dl = DataLoader(Stream(10), batch_size=4, drop_last=True)
+        batches = [b.numpy() for b in dl]
+        assert all(len(b) == 4 for b in batches)
+        assert len(batches) == 2
+
+    def test_batch_sampler_exclusive(self):
+        with pytest.raises(ValueError):
+            DataLoader(SquareDataset(10),
+                       batch_sampler=BatchSampler(SquareDataset(10),
+                                                  batch_size=2),
+                       batch_size=4)
+
+    def test_len(self):
+        assert len(DataLoader(SquareDataset(10), batch_size=3)) == 4
+        with pytest.raises(TypeError):
+            len(DataLoader(Stream(10), batch_size=3))
