@@ -50,6 +50,10 @@ def __getattr__(name):
         from .hapi import summary as fn
         globals()[name] = fn
         return fn
+    if name == "Model":
+        from .hapi import Model as cls
+        globals()[name] = cls
+        return cls
     if name == "DataParallel":
         from .distributed.parallel import DataParallel as cls
         globals()[name] = cls

@@ -1,0 +1,13 @@
+"""``paddle.audio`` parity: spectral features.
+
+Reference surface: ``python/paddle/audio/`` (functional: frame/stft helpers,
+mel/fbank matrices, dct; features: Spectrogram/MelSpectrogram/LogMelSpectrogram
+/MFCC layers). Implemented on jnp FFT — tape-differentiable and jit-friendly.
+"""
+
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,
+                       Spectrogram)  # noqa: F401
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,80 @@
+"""Audio feature layers (ref: ``python/paddle/audio/features/layers.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length
+        self.win_length = win_length
+        self.window = window
+        self.power = power
+        self.center = center
+
+    def forward(self, x):
+        return AF.stft_magnitude(x, self.n_fft, self.hop_length,
+                                 self.win_length, self.window, self.power,
+                                 self.center)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        spec = self.spectrogram(x)               # [..., bins, frames]
+        return matmul(self.fbank, spec)          # [..., n_mels, frames]
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None, **kw):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 13, n_mels: int = 64,
+                 **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr, n_mels=n_mels, **kw)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        from ..ops.manipulation import transpose
+        logmel = self.log_mel(x)                 # [..., n_mels, frames]
+        nd = logmel.ndim
+        perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+        swapped = transpose(logmel, perm)        # [..., frames, n_mels]
+        out = matmul(swapped, self.dct)          # [..., frames, n_mfcc]
+        return transpose(out, perm)              # [..., n_mfcc, frames]

@@ -1,0 +1,169 @@
+"""Audio functional ops (ref: ``python/paddle/audio/functional/``)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window", "frame", "stft_magnitude"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = freq.numpy() if isinstance(freq, Tensor) else freq
+    import numpy as np
+    f = np.asarray(f, np.float32)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10) /
+                                             min_log_hz) / logstep, mels)
+        out = mels
+    return to_tensor(out.astype(np.float32)) if isinstance(freq, Tensor) \
+        else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    import numpy as np
+    m = mel.numpy() if isinstance(mel, Tensor) else np.asarray(mel, np.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+    return to_tensor(out.astype(np.float32)) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    import numpy as np
+    lo = hz_to_mel(np.float32(f_min), htk)
+    hi = hz_to_mel(np.float32(f_max), htk)
+    return to_tensor(mel_to_hz(np.linspace(lo, hi, n_mels), htk).astype(
+        np.float32))
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    import numpy as np
+    return to_tensor(np.linspace(0, sr / 2, n_fft // 2 + 1).astype(np.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """Triangular mel filterbank [n_mels, n_fft//2+1] (librosa-compatible)."""
+    import numpy as np
+    f_max = f_max or sr / 2
+    fftfreqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    lo = float(np.asarray(hz_to_mel(np.float32(f_min), htk)))
+    hi = float(np.asarray(hz_to_mel(np.float32(f_max), htk)))
+    mel_f = mel_to_hz(np.linspace(lo, hi, n_mels + 2), htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return to_tensor(weights.astype(np.float32))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """DCT-II matrix [n_mels, n_mfcc] (ref: audio.functional.create_dct)."""
+    import numpy as np
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return to_tensor(dct.T.astype(np.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return forward_op("power_to_db", f, [ensure_tensor(spect)])
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    import numpy as np
+    N = win_length
+    n = np.arange(N)
+    denom = N if fftbins else N - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / denom)
+    elif window in ("rect", "boxcar", "rectangular", "ones"):
+        w = np.ones(N)
+    elif window == "blackman":
+        w = 0.42 - 0.5 * np.cos(2 * math.pi * n / denom) + \
+            0.08 * np.cos(4 * math.pi * n / denom)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return to_tensor(w.astype(np.float32))
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    """Slide a window over the last axis -> [..., n_frames, frame_length]."""
+    t = ensure_tensor(x)
+
+    def f(v):
+        n = v.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(frame_length)[None, :])
+        return v[..., idx]
+    return forward_op("audio_frame", f, [t])
+
+
+def stft_magnitude(x, n_fft: int = 512, hop_length: Optional[int] = None,
+                   win_length: Optional[int] = None, window: str = "hann",
+                   power: float = 2.0, center: bool = True):
+    """|STFT|^power on the last axis -> [..., n_fft//2+1, n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = get_window(window, win_length)._value
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def f(v):
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode="reflect")
+        n = v.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        frames = v[..., idx] * w                     # [..., F, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1)         # [..., F, n_fft//2+1]
+        mag = jnp.abs(spec) ** power
+        return jnp.swapaxes(mag, -1, -2)             # [..., bins, frames]
+    return forward_op("stft_magnitude", f, [ensure_tensor(x)])

@@ -1,0 +1,112 @@
+"""``paddle.autograd`` surface.
+
+Parity target: ``python/paddle/autograd/`` in the reference (backward, grad,
+no_grad/enable_grad, PyLayer custom ops, hooks, saved-tensor utilities).
+The engine itself lives in ``core/autograd.py`` (tape of jax.vjp closures);
+this module adds the public namespace plus :class:`PyLayer` — user-defined
+forward/backward pairs recorded as a single tape op.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .core.autograd import (backward, enable_grad, grad, is_grad_enabled,
+                            no_grad, set_grad_enabled)
+from .core.tensor import Tensor, _wrap_value
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    """ref: paddle.autograd.PyLayerContext — save_for_backward/saved_tensor
+    plus arbitrary attribute stashing between forward and backward."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        self._non_diff = a
+
+
+class PyLayer:
+    """User-defined differentiable op (ref: paddle.autograd.PyLayer).
+
+    Subclass with ``@staticmethod forward(ctx, *args)`` and
+    ``@staticmethod backward(ctx, *grads)``; invoke via ``apply``. TPU
+    redesign: the pair becomes ONE tape op whose vjp calls the user's
+    backward — the user functions receive/return Tensors (eager semantics),
+    and under a to_static trace the same path records into the program.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError("PyLayer subclass must define forward")
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError("PyLayer subclass must define backward")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core import autograd as ag
+        from .core.autograd import Edge, GradNode
+
+        ctx = PyLayerContext()
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        if not ag.is_grad_enabled() or not any(
+                not args[i].stop_gradient for i in tensor_idx):
+            return out
+
+        diff_inputs = [args[i] for i in tensor_idx
+                       if not args[i].stop_gradient]
+
+        def vjp_fn(cots):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            gt = [(_wrap_value(c) if not isinstance(c, Tensor) else c)
+                  for c in cots]
+            with ag.no_grad():
+                gin = cls.backward(ctx, *gt)
+            gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+            if len(gin) not in (len(diff_inputs), len(tensor_idx)):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gin)} grads for "
+                    f"{len(diff_inputs)} differentiable inputs")
+            vals = []
+            src = list(gin)
+            for t in diff_inputs:
+                g = src.pop(0)
+                vals.append(None if g is None else
+                            (g._value if isinstance(g, Tensor) else g))
+            return tuple(vals)
+
+        edges = [Edge(t._grad_node, t._node_index, t) for t in diff_inputs]
+        avals = [(o._value.shape, o._value.dtype) for o in outs]
+        node = GradNode(cls.__name__, vjp_fn, edges, avals)
+        wrapped = tuple(
+            _wrap_value(o._value, stop_gradient=False, node=node, index=i)
+            for i, o in enumerate(outs))
+        return wrapped if multi else wrapped[0]
+
+
+def saved_tensors_hooks(*a, **k):
+    raise NotImplementedError(
+        "saved_tensors_hooks: tensor offloading hooks are not supported on "
+        "TPU (HBM-resident tape); use recompute() for memory savings")

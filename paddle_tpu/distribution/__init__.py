@@ -1,0 +1,379 @@
+"""``paddle.distribution`` parity: probability distributions.
+
+Reference surface: ``python/paddle/distribution/`` (Distribution base with
+sample/rsample/log_prob/entropy/kl_divergence, Normal, Uniform, Categorical,
+Bernoulli, Exponential, Laplace, Gumbel, ...). TPU redesign: sampling draws
+from the framework RNG stream (``ops.random._next_key``) so ``paddle.seed``
+governs reproducibility; math is tape-differentiable jnp.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor, forward_op
+from ..ops.random import _next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "Gumbel", "kl_divergence",
+           "register_kl"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return forward_op("dist_prob", jnp.exp,
+                          [self.log_prob(value)])
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc).astype("float32")
+        self.scale = ensure_tensor(scale).astype("float32")
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        key = _next_key()
+        return forward_op(
+            "normal_rsample",
+            lambda l, s: l + s * jax.random.normal(key, shape),
+            [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        return forward_op(
+            "normal_log_prob",
+            lambda v, l, s: -((v - l) ** 2) / (2 * s * s) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            [ensure_tensor(value), self.loc, self.scale])
+
+    def entropy(self):
+        return forward_op(
+            "normal_entropy",
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            [self.loc, self.scale])
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low).astype("float32")
+        self.high = ensure_tensor(high).astype("float32")
+        super().__init__(jnp.broadcast_shapes(self.low._value.shape,
+                                              self.high._value.shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        key = _next_key()
+        return forward_op(
+            "uniform_rsample",
+            lambda lo, hi: lo + (hi - lo) * jax.random.uniform(key, shape),
+            [self.low, self.high])
+
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        return forward_op(
+            "uniform_log_prob",
+            lambda v, lo, hi: jnp.where((v >= lo) & (v < hi),
+                                        -jnp.log(hi - lo), -jnp.inf),
+            [ensure_tensor(value), self.low, self.high])
+
+    def entropy(self):
+        return forward_op("uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+                          [self.low, self.high])
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits).astype("float32")
+        super().__init__(self.logits._value.shape[:-1])
+
+    def sample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape)
+        from ..core import autograd
+        with autograd.no_grad():
+            return forward_op(
+                "categorical_sample",
+                lambda lg: jax.random.categorical(
+                    key, lg, shape=shape + lg.shape[:-1]),
+                [self.logits], differentiable=False)
+
+    def log_prob(self, value):
+        def f(lg, v):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            v = v.astype(jnp.int32)
+            if lg.ndim == 1:  # single distribution, any batch of values
+                return jnp.take(logp, v, axis=-1)
+            return jnp.take_along_axis(
+                logp, v[..., None], axis=-1)[..., 0]
+        return forward_op("categorical_log_prob", f,
+                          [self.logits, ensure_tensor(value)])
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return forward_op("categorical_entropy", f, [self.logits])
+
+    def probs(self, value=None):
+        p = forward_op("categorical_probs",
+                       lambda lg: jax.nn.softmax(lg, axis=-1), [self.logits])
+        if value is None:
+            return p
+        def take(pv, v):
+            v = v.astype(jnp.int32)
+            if pv.ndim == 1:
+                return jnp.take(pv, v, axis=-1)
+            return jnp.take_along_axis(pv, v[..., None], axis=-1)[..., 0]
+        return forward_op("categorical_probs_take", take,
+                          [p, ensure_tensor(value)])
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = ensure_tensor(probs).astype("float32")
+        super().__init__(self.probs._value.shape)
+
+    def sample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        from ..core import autograd
+        with autograd.no_grad():
+            return forward_op(
+                "bernoulli_sample",
+                lambda p: jax.random.bernoulli(key, p, shape).astype(
+                    jnp.float32),
+                [self.probs], differentiable=False)
+
+    def log_prob(self, value):
+        return forward_op(
+            "bernoulli_log_prob",
+            lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+            [self.probs, ensure_tensor(value)])
+
+    def entropy(self):
+        return forward_op(
+            "bernoulli_entropy",
+            lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+            [self.probs])
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate).astype("float32")
+        super().__init__(self.rate._value.shape)
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "exponential_rsample",
+            lambda r: jax.random.exponential(key, shape) / r, [self.rate])
+
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        return forward_op("exponential_log_prob",
+                          lambda r, v: jnp.log(r) - r * v,
+                          [self.rate, ensure_tensor(value)])
+
+    def entropy(self):
+        return forward_op("exponential_entropy", lambda r: 1.0 - jnp.log(r),
+                          [self.rate])
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc).astype("float32")
+        self.scale = ensure_tensor(scale).astype("float32")
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "laplace_rsample",
+            lambda l, s: l + s * jax.random.laplace(key, shape),
+            [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        return forward_op(
+            "laplace_log_prob",
+            lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            [self.loc, self.scale, ensure_tensor(value)])
+
+    def entropy(self):
+        return forward_op("laplace_entropy",
+                          lambda s: 1.0 + jnp.log(2 * s), [self.scale])
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc).astype("float32")
+        self.scale = ensure_tensor(scale).astype("float32")
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "gumbel_rsample",
+            lambda l, s: l + s * jax.random.gumbel(key, shape),
+            [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        def f(l, s, v):  # noqa: E741
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return forward_op("gumbel_log_prob", f,
+                          [self.loc, self.scale, ensure_tensor(value)])
+
+    def entropy(self):
+        euler = 0.5772156649015329
+        return forward_op("gumbel_entropy",
+                          lambda s: jnp.log(s) + 1.0 + euler, [self.scale])
+
+
+# -- KL registry (ref: python/paddle/distribution/kl.py) ---------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return forward_op("kl_normal_normal", f,
+                      [p.loc, p.scale, q.loc, q.scale])
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def f(pl, ql):
+        lp = jax.nn.log_softmax(pl, -1)
+        lq = jax.nn.log_softmax(ql, -1)
+        return (jnp.exp(lp) * (lp - lq)).sum(-1)
+    return forward_op("kl_cat_cat", f, [p.logits, q.logits])
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(plo, phi, qlo, qhi):
+        ok = (qlo <= plo) & (phi <= qhi)
+        return jnp.where(ok, jnp.log((qhi - qlo) / (phi - plo)), jnp.inf)
+    return forward_op("kl_uniform_uniform", f,
+                      [p.low, p.high, q.low, q.high])
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    def f(pp, qp):
+        return pp * (jnp.log(pp) - jnp.log(qp)) + \
+            (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+    return forward_op("kl_bern_bern", f, [p.probs, q.probs])
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    def f(pr, qr):
+        return jnp.log(pr) - jnp.log(qr) + qr / pr - 1.0
+    return forward_op("kl_exp_exp", f, [p.rate, q.rate])

@@ -1,0 +1,94 @@
+"""``paddle.fft`` parity — spectral ops over ``jnp.fft`` (XLA FFT).
+
+Reference surface: ``python/paddle/fft.py``. All ops go through the eager
+dispatcher so they are tape-differentiable and trace into compiled programs.
+Norm semantics ("backward"/"ortho"/"forward") follow the reference/numpy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._helpers import axes_arg, ensure_tensor, forward_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    if norm not in (None, "backward", "ortho", "forward"):
+        raise ValueError(f"fft norm must be backward/ortho/forward, got {norm!r}")
+    return norm or "backward"
+
+
+def _mk1(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return forward_op(name, lambda v: jfn(v, n=n, axis=axis,
+                                              norm=_norm(norm)),
+                          [ensure_tensor(x)])
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} (jnp.fft-backed; reference parity)."
+    return op
+
+
+def _mkn(name):
+    jfn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return forward_op(name, lambda v: jfn(v, s=s, axes=axes,
+                                              norm=_norm(norm)),
+                          [ensure_tensor(x)])
+    op.__name__ = name
+    op.__doc__ = f"paddle.fft.{name} (jnp.fft-backed; reference parity)."
+    return op
+
+
+def _mk2(name):
+    nfn = _mkn(name.replace("2", "n") if name.endswith("2") else name)
+
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        return nfn(x, s=s, axes=axes, norm=norm)
+    op.__name__ = name
+    return op
+
+
+fft = _mk1("fft")
+ifft = _mk1("ifft")
+rfft = _mk1("rfft")
+irfft = _mk1("irfft")
+hfft = _mk1("hfft")
+ihfft = _mk1("ihfft")
+fftn = _mkn("fftn")
+ifftn = _mkn("ifftn")
+rfftn = _mkn("rfftn")
+irfftn = _mkn("irfftn")
+fft2 = _mk2("fft2")
+ifft2 = _mk2("ifft2")
+rfft2 = _mk2("rfft2")
+irfft2 = _mk2("irfft2")
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    from .core.dtype import canonical_dtype
+    return Tensor(jnp.fft.fftfreq(n, d).astype(canonical_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    from .core.dtype import canonical_dtype
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(canonical_dtype(dtype)))
+
+
+def fftshift(x, axes=None, name=None):
+    return forward_op("fftshift",
+                      lambda v: jnp.fft.fftshift(v, axes=axes_arg(axes)),
+                      [ensure_tensor(x)])
+
+
+def ifftshift(x, axes=None, name=None):
+    return forward_op("ifftshift",
+                      lambda v: jnp.fft.ifftshift(v, axes=axes_arg(axes)),
+                      [ensure_tensor(x)])

@@ -1,0 +1,11 @@
+"""``paddle.hapi`` — the Keras-like high-level API.
+
+Reference surface: ``python/paddle/hapi/model.py`` (Model.prepare/fit/
+evaluate/predict/save/load, train_batch/eval_batch), ``model_summary.py``
+(paddle.summary), callbacks in ``python/paddle/callbacks``.
+"""
+
+from .model import Model
+from .model_summary import summary
+
+__all__ = ["Model", "summary"]

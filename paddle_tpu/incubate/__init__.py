@@ -1,0 +1,19 @@
+"""``paddle.incubate`` namespace.
+
+Reference surface: ``python/paddle/incubate/`` — experimental features that
+graduated into the main namespaces here are re-exported (the reference keeps
+both paths alive); MoE lives under ``incubate.distributed.models.moe``
+(reference location) with the implementation in
+``paddle_tpu.distributed.moe``.
+"""
+
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+
+__all__ = ["nn", "distributed", "softmax_mask_fuse"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """ref: incubate.softmax_mask_fuse — XLA fuses this chain natively."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)

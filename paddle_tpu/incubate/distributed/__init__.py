@@ -1,0 +1,5 @@
+"""incubate.distributed (ref: ``python/paddle/incubate/distributed/``)."""
+
+from . import models  # noqa: F401
+
+__all__ = ["models"]

@@ -1,0 +1,5 @@
+"""incubate.distributed.models (ref: MoE lives here upstream)."""
+
+from . import moe  # noqa: F401
+
+__all__ = ["moe"]

@@ -1,0 +1,7 @@
+"""Reference import location for MoE (``python/paddle/incubate/distributed/
+models/moe/``); implementation in ``paddle_tpu.distributed.moe``."""
+
+from paddle_tpu.distributed.moe import (GShardGate, MoELayer, NaiveGate,
+                                        SwitchGate)
+
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate"]

@@ -1,0 +1,18 @@
+"""incubate.nn — fused-layer names (ref: ``python/paddle/incubate/nn/``).
+
+The reference's Fused* layers exist for CUDA kernel fusion; on TPU, XLA
+performs these fusions on the standard layers, so the incubate names alias
+the standard implementations (documented equivalence, not stubs).
+"""
+
+from ...nn.layers.transformer import (MultiHeadAttention,
+                                      TransformerEncoderLayer)
+from ...nn.layers.norm import RMSNorm
+
+__all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer",
+           "FusedRMSNorm"]
+
+# XLA-fused equivalents of the reference's hand-fused CUDA layers
+FusedMultiHeadAttention = MultiHeadAttention
+FusedTransformerEncoderLayer = TransformerEncoderLayer
+FusedRMSNorm = RMSNorm

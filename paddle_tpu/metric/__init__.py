@@ -1,0 +1,175 @@
+"""``paddle.metric`` parity: streaming metrics.
+
+Reference surface: ``python/paddle/metric/metrics.py`` (Metric base,
+Accuracy, Precision, Recall, Auc) — accumulate over batches on host numpy
+(metrics are not in the compiled hot path), ``reset``/``update``/
+``accumulate``/``name`` protocol used by hapi ``Model.fit``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing run inside the program; default identity."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """top-k accuracy (ref: metric.Accuracy; default k=1)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        p = _np(pred)
+        l = _np(label)  # noqa: E741
+        if l.ndim == p.ndim and l.shape[-1] == 1:
+            l = l[..., 0]  # noqa: E741
+        maxk = max(self.topk)
+        top = np.argsort(-p, axis=-1)[..., :maxk]
+        return (top == l[..., None]).astype(np.float32)
+
+    def update(self, correct, *args):
+        c = _np(correct)
+        n = int(np.prod(c.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self.total[i] += c[..., :k].sum()
+            self.count[i] += n
+        res = self.total / np.maximum(self.count, 1)
+        return res[0] if len(self.topk) == 1 else res
+
+    def accumulate(self):
+        res = (self.total / np.maximum(self.count, 1)).tolist()
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """binary precision over 0/1 labels (ref: metric.Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)  # noqa: E741
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return float(self.tp) / d if d else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)  # noqa: E741
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return float(self.tp) / d if d else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via the reference's threshold-bucket approximation
+    (ref: metric.Auc, num_thresholds buckets)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        if curve != "ROC":
+            raise ValueError("only ROC curve is supported (reference parity)")
+        self.num_thresholds = int(num_thresholds)
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]  # probability of the positive class
+        p = p.reshape(-1)
+        l = _np(labels).reshape(-1).astype(np.int64)  # noqa: E741
+        idx = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[l == 1], 1)
+        np.add.at(self._neg, idx[l == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # walk buckets from the highest threshold down
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """Functional top-k accuracy (ref: paddle.metric.accuracy)."""
+    from ..core.tensor import to_tensor
+    p = _np(input)
+    l = _np(label)  # noqa: E741
+    if l.ndim == p.ndim and l.shape[-1] == 1:
+        l = l[..., 0]  # noqa: E741
+    top = np.argsort(-p, axis=-1)[..., :k]
+    acc = (top == l[..., None]).any(-1).mean()
+    return to_tensor(np.asarray(acc, np.float32))

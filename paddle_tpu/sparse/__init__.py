@@ -1,0 +1,255 @@
+"""``paddle.sparse`` parity: COO/CSR sparse tensors.
+
+Reference surface: ``python/paddle/sparse/`` (sparse_coo_tensor,
+sparse_csr_tensor, to_dense/to_sparse_coo, elementwise + matmul + nn ops on
+sparse operands). TPU redesign stance: XLA has no native sparse kernels and
+TPUs are dense-matmul machines — sparse storage here is a real COO/CSR
+container with conversion, indexing and the core math surface, computed by
+scatter/gather + dense contraction (the honest TPU lowering; the reference's
+cuSPARSE paths have no MXU analogue). Suitable for preprocessing and
+moderate sparsity, documented as such.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _wrap_value, to_tensor
+from ..ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_same_shape", "add", "subtract",
+           "multiply", "matmul", "masked_matmul", "relu", "coalesce"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices [ndim, nnz]`` + ``values [nnz, ...]``."""
+
+    def __init__(self, indices: Tensor, values: Tensor, shape: Sequence[int],
+                 coalesced: bool = False):
+        self.indices_ = ensure_tensor(indices).astype("int32")
+        self.values_ = ensure_tensor(values)
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- reference accessors -------------------------------------------------
+    def indices(self) -> Tensor:
+        return self.indices_
+
+    def values(self) -> Tensor:
+        return self.values_
+
+    def nnz(self) -> int:
+        return int(self.values_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def to_dense(self) -> Tensor:
+        shape = tuple(self.shape)
+
+        def f(idx, vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[tuple(idx[d] for d in range(len(shape)))].add(vals)
+        return forward_op("sparse_to_dense", f, [self.indices_, self.values_])
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.ndim != 2:
+            raise ValueError("to_sparse_csr requires a 2-D COO tensor")
+        idx = np.asarray(self.indices_.numpy())
+        vals = self.values_
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        crows = np.zeros(self.shape[0] + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        vals_sorted = forward_op("csr_sort", lambda v: v[jnp.asarray(order)],
+                                 [vals])
+        return SparseCsrTensor(to_tensor(crows), to_tensor(cols.astype(np.int32)),
+                               vals_sorted, self.shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sums values) and sort."""
+        idx = np.asarray(self.indices_.numpy())
+        keys = np.ravel_multi_index(tuple(idx), tuple(self.shape))
+        uniq, inv = np.unique(keys, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(uniq, tuple(self.shape))).astype(
+            np.int32)
+
+        def f(vals):
+            return jnp.zeros((len(uniq),) + vals.shape[1:], vals.dtype).at[
+                jnp.asarray(inv)].add(vals)
+        new_vals = forward_op("sparse_coalesce", f, [self.values_])
+        return SparseCooTensor(to_tensor(new_idx), new_vals, self.shape,
+                               coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor,
+                 shape: Sequence[int]):
+        self.crows_ = ensure_tensor(crows).astype("int32")
+        self.cols_ = ensure_tensor(cols).astype("int32")
+        self.values_ = ensure_tensor(values)
+        self.shape = list(int(s) for s in shape)
+
+    def crows(self):
+        return self.crows_
+
+    def cols(self):
+        return self.cols_
+
+    def values(self):
+        return self.values_
+
+    def nnz(self):
+        return int(self.values_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def to_dense(self) -> Tensor:
+        crows = np.asarray(self.crows_.numpy())
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows)).astype(
+            np.int32)
+        shape = tuple(self.shape)
+
+        def f(cols, vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[jnp.asarray(rows), cols].add(vals)
+        return forward_op("csr_to_dense", f, [self.cols_, self.values_])
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        crows = np.asarray(self.crows_.numpy())
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows)).astype(
+            np.int32)
+        idx = np.stack([rows, np.asarray(self.cols_.numpy())])
+        return SparseCooTensor(to_tensor(idx), self.values_, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    idx = ensure_tensor(indices)
+    vals = ensure_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        mx = np.asarray(idx.numpy()).max(axis=1) + 1
+        shape = mx.tolist()
+    if not stop_gradient:
+        vals.stop_gradient = False
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    vals = ensure_tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if not stop_gradient:
+        vals.stop_gradient = False
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _binary(name, x: SparseCooTensor, y: SparseCooTensor, op):
+    if not isinstance(x, SparseCooTensor) or not isinstance(y, SparseCooTensor):
+        raise TypeError(f"sparse.{name} expects SparseCooTensor operands")
+    if x.shape != y.shape:
+        raise ValueError(f"sparse.{name}: shape mismatch {x.shape} vs {y.shape}")
+    # dense lowering (documented TPU stance)
+    d = op(x.to_dense(), y.to_dense())
+    return _dense_to_coo(d)
+
+
+def _dense_to_coo(d: Tensor) -> SparseCooTensor:
+    arr = d.numpy()
+    idx = np.stack(np.nonzero(arr)).astype(np.int32)
+    def f(v):
+        return v[tuple(jnp.asarray(idx[i]) for i in range(idx.shape[0]))]
+    vals = forward_op("dense_to_coo_values", f, [d])
+    return SparseCooTensor(to_tensor(idx), vals, list(arr.shape))
+
+
+def add(x, y, name=None):
+    return _binary("add", x, y, lambda a, b: a + b)
+
+
+def subtract(x, y, name=None):
+    return _binary("subtract", x, y, lambda a, b: a - b)
+
+
+def multiply(x, y, name=None):
+    return _binary("multiply", x, y, lambda a, b: a * b)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (the TPU-relevant case: dense contraction on
+    the MXU after scatter materialization)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xd = x.to_dense()
+    else:
+        xd = ensure_tensor(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = y.to_dense()
+    from ..ops.linalg import matmul as dense_matmul
+    return dense_matmul(xd, ensure_tensor(y))
+
+
+def masked_matmul(x, y, mask: SparseCooTensor, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (ref: sddmm)."""
+    from ..ops.linalg import matmul as dense_matmul
+    prod = dense_matmul(ensure_tensor(x), ensure_tensor(y))
+    idx = mask.indices_
+
+    def f(p, i):
+        return p[tuple(i[d] for d in range(i.shape[0]))]
+    vals = forward_op("masked_matmul_sample", f, [prod, idx])
+    return SparseCooTensor(idx, vals, mask.shape)
+
+
+def relu(x: SparseCooTensor, name=None) -> SparseCooTensor:
+    from ..nn import functional as F
+    return SparseCooTensor(x.indices_, F.relu(x.values_), x.shape)
+
+
+class nn:  # namespace parity: paddle.sparse.nn.ReLU
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -1,0 +1,112 @@
+"""``paddle.static`` shim.
+
+Parity stance (SURVEY §7, recorded scope): the reference's static graph mode
+(Program/Executor/append_backward) is replaced wholesale by the jit stack —
+``to_static`` traces imperative code into ONE compiled XLA program, which IS
+the static graph. This module keeps the load-bearing names working:
+
+* ``InputSpec`` — real (shared with jit).
+* ``save_inference_model`` / ``load_inference_model`` — map onto
+  ``jit.save`` / ``jit.load`` (StableHLO artifact).
+* ``enable_static`` — warns and keeps eager+jit semantics (imperative code
+  under this framework is already compiled via to_static).
+* Program/Executor-class APIs raise with a pointer to the jit equivalent
+  rather than silently half-working.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from ..jit.api import InputSpec
+
+__all__ = ["InputSpec", "enable_static", "disable_static", "Program",
+           "Executor", "default_main_program", "default_startup_program",
+           "program_guard", "save_inference_model", "load_inference_model",
+           "name_scope", "device_guard"]
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    if not _static_mode:
+        warnings.warn(
+            "paddle.static: static graph mode maps onto the jit stack on "
+            "this framework — code keeps eager semantics and is compiled "
+            "via paddle.jit.to_static; Program/Executor APIs are not "
+            "available", stacklevel=2)
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def _unsupported(name: str):
+    raise NotImplementedError(
+        f"paddle.static.{name}: the ProgramDesc/Executor machinery is "
+        f"replaced by XLA compilation — use @paddle.jit.to_static for "
+        f"compiled training steps and paddle.jit.save/load for artifacts "
+        f"(SURVEY §7 design stance)")
+
+
+class Program:
+    def __init__(self, *a, **k):
+        _unsupported("Program")
+
+
+class Executor:
+    def __init__(self, *a, **k):
+        _unsupported("Executor")
+
+
+def default_main_program():
+    _unsupported("default_main_program")
+
+
+def default_startup_program():
+    _unsupported("default_startup_program")
+
+
+def program_guard(*a, **k):
+    _unsupported("program_guard")
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        yield
+    return _scope()
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        yield
+    return _scope()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Maps onto jit.save: ``fetch_vars`` must be the traced layer/function
+    (the reference signature's executor is meaningless here)."""
+    from ..jit import api as jit_api
+    program = kwargs.get("program")
+    layer = program if program is not None else fetch_vars
+    specs = feed_vars if feed_vars else None
+    return jit_api.save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import api as jit_api
+    return jit_api.load(path_prefix)

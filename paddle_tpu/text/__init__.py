@@ -1,0 +1,107 @@
+"""``paddle.text`` parity.
+
+Reference surface: ``python/paddle/text/`` — dataset downloaders (Imdb,
+Conll05, ...) plus ``viterbi_decode``/``ViterbiDecoder``. This environment is
+hermetic (zero egress), so the dataset downloaders raise with a clear
+message; the decoding ops are real implementations.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Conll05st", "Movielens",
+           "UCIHousing", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decoding (ref: paddle.text.viterbi_decode).
+
+    potentials [B, T, N]; transition_params [N, N] (or [N+2, N+2] with
+    BOS/EOS rows when include_bos_eos_tag); lengths [B].
+    Returns (scores [B], paths [B, T]).
+    """
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)
+
+    def decode(p, tr, ln):
+        B, T, N = p.shape
+        if include_bos_eos_tag:
+            # rows/cols N..N+1 of tr are BOS/EOS (reference convention)
+            start = tr[N, :N] if tr.shape[0] > N else jnp.zeros((N,))
+            stop = tr[:N, N + 1] if tr.shape[0] > N + 1 else jnp.zeros((N,))
+            tr_core = tr[:N, :N]
+        else:
+            start = jnp.zeros((N,), p.dtype)
+            stop = jnp.zeros((N,), p.dtype)
+            tr_core = tr
+
+        alpha0 = p[:, 0] + start[None]
+
+        def step(carry, t):
+            alpha, _ = carry
+            # [B, from, to]
+            scores = alpha[:, :, None] + tr_core[None]
+            best_prev = jnp.argmax(scores, axis=1)               # [B, N]
+            alpha_t = jnp.max(scores, axis=1) + p[:, t]
+            # frozen past length: keep alpha
+            active = (t < ln)[:, None]
+            alpha_new = jnp.where(active, alpha_t, alpha)
+            return (alpha_new, None), jnp.where(active, best_prev, -1)
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha0, None), jnp.arange(1, T))
+        final = alpha + stop[None]
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)                      # [B]
+
+        def backtrack(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            tag_new = jnp.where(prev >= 0, prev, tag)
+            return tag_new, tag
+
+        _, path_rev = jax.lax.scan(backtrack, last_tag, backptrs[::-1])
+        paths = jnp.concatenate(
+            [path_rev[::-1].T, last_tag[:, None]], axis=1)         # [B, T]
+        return scores, paths.astype(jnp.int32)
+
+    return forward_op("viterbi_decode", decode, [pot, trans, lens],
+                      differentiable=False)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (ref: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _no_download(name):
+    class _D:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"paddle.text.{name}: dataset download needs network access; "
+                f"this environment is hermetic — construct a paddle.io.Dataset "
+                f"over local files instead")
+    _D.__name__ = name
+    return _D
+
+
+Imdb = _no_download("Imdb")
+Conll05st = _no_download("Conll05st")
+Movielens = _no_download("Movielens")
+UCIHousing = _no_download("UCIHousing")
+WMT14 = _no_download("WMT14")
+WMT16 = _no_download("WMT16")

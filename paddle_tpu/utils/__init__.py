@@ -1,0 +1,30 @@
+"""``paddle.utils`` parity: unique_name, deprecated, try_import, dlpack."""
+
+from . import dlpack, unique_name  # noqa: F401
+from .deprecated import deprecated
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """ref: paddle.utils.try_import — import or raise a friendly error."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; this "
+            f"environment is hermetic (no pip) — gate the feature instead")
+
+
+def run_check():
+    """ref: paddle.utils.run_check — sanity-check the device stack."""
+    import jax
+    import numpy as np
+    from ..core.tensor import to_tensor
+    x = to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert np.allclose(y, 2.0), y
+    print(f"paddle_tpu is installed successfully! backend="
+          f"{jax.default_backend()}, devices={jax.device_count()}")
+
+
+__all__ = ["unique_name", "deprecated", "dlpack", "try_import", "run_check"]

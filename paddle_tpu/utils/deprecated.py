@@ -1,0 +1,32 @@
+"""Deprecation decorator (ref: ``python/paddle/utils/deprecated.py``)."""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    def decorator(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+        if level == 2:
+            @functools.wraps(fn)
+            def dead(*a, **k):
+                raise RuntimeError(msg)
+            return dead
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return wrapper
+    return decorator

@@ -1,0 +1,19 @@
+"""DLPack interop (ref: ``python/paddle/utils/dlpack.py``) — zero-copy
+exchange with torch/numpy via jax's dlpack support."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor, _wrap_value
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x: Tensor):
+    import jax.dlpack
+    return jax.dlpack.to_dlpack(x._value)
+
+
+def from_dlpack(capsule) -> Tensor:
+    import jax.dlpack
+    # jax accepts either a raw capsule or any __dlpack__-capable object
+    return _wrap_value(jax.dlpack.from_dlpack(capsule))

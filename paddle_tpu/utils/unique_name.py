@@ -1,0 +1,33 @@
+"""Unique name generator (ref: ``python/paddle/utils/unique_name.py`` →
+``base/unique_name.py``): per-prefix counters, ``guard`` for scoped resets."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+_counters = defaultdict(int)
+
+
+def generate(key: str) -> str:
+    n = _counters[key]
+    _counters[key] += 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None else defaultdict(int)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,31 @@
+"""Version info (ref: generated ``python/paddle/version.py``)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_pip = False
+
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+tpu = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native; jax/XLA/PJRT backend)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False

@@ -1,0 +1,34 @@
+"""``paddle.vision`` parity: transforms, models, datasets.
+
+Reference surface: ``python/paddle/vision/`` (transforms on HWC images,
+model zoo incl. ResNet family, dataset downloaders). Downloaders raise (zero
+egress); transforms are pure-numpy so they run inside DataLoader worker
+subprocesses (which must never touch the PJRT client); models build on the
+framework's nn layers.
+"""
+
+from . import datasets, models, transforms  # noqa: F401
+from .models import (LeNet, ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, vgg11, vgg13, vgg16, vgg19, VGG)  # noqa: F401
+
+__all__ = ["transforms", "models", "datasets", "ResNet", "LeNet", "VGG",
+           "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+           "vgg11", "vgg13", "vgg16", "vgg19", "set_image_backend",
+           "get_image_backend", "image_load"]
+
+
+def set_image_backend(backend: str):
+    if backend not in ("cv2", "pil", "numpy", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    transforms._IMAGE_BACKEND = backend
+
+
+def get_image_backend() -> str:
+    return transforms._IMAGE_BACKEND
+
+
+def image_load(path: str, backend=None):
+    raise NotImplementedError(
+        "vision.image_load: no image decoder (PIL/cv2) in this hermetic "
+        "environment — load arrays with numpy and feed HWC ndarrays to the "
+        "transforms")

@@ -1,0 +1,97 @@
+"""Vision datasets (ref: ``python/paddle/vision/datasets/``).
+
+Downloaders need network access (hermetic environment -> raise with
+guidance); ``FakeData``-style synthetic dataset provided for pipelines and
+benchmarks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "DatasetFolder", "ImageFolder", "FakeImageDataset"]
+
+
+class FakeImageDataset(Dataset):
+    """Synthetic image/label pairs (deterministic per index)."""
+
+    def __init__(self, num_samples: int = 1024, image_shape=(3, 224, 224),
+                 num_classes: int = 1000, transform=None, dtype="float32"):
+        self.num_samples = int(num_samples)
+        self.image_shape = tuple(image_shape)
+        self.num_classes = int(num_classes)
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _needs_download(name):
+    class _D(Dataset):
+        def __init__(self, *a, download=True, **k):
+            raise RuntimeError(
+                f"vision.datasets.{name}: dataset download needs network "
+                f"access; this environment is hermetic — point a "
+                f"DatasetFolder-style paddle.io.Dataset at local files, or "
+                f"use FakeImageDataset for pipeline tests")
+    _D.__name__ = name
+    return _D
+
+
+MNIST = _needs_download("MNIST")
+FashionMNIST = _needs_download("FashionMNIST")
+Cifar10 = _needs_download("Cifar10")
+Cifar100 = _needs_download("Cifar100")
+Flowers = _needs_download("Flowers")
+VOC2012 = _needs_download("VOC2012")
+
+
+class DatasetFolder(Dataset):
+    """Filesystem class-per-directory dataset (numpy ``.npy`` loader by
+    default — no image decoder in this environment)."""
+
+    def __init__(self, root: str, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    pass

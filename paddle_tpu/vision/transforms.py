@@ -1,0 +1,316 @@
+"""Image transforms (ref: ``python/paddle/vision/transforms/transforms.py``).
+
+Pure numpy on HWC images (uint8 or float), so they are safe inside
+DataLoader worker subprocesses. ``ToTensor`` produces CHW float32 numpy
+(Tensor conversion happens in the DataLoader parent, reference data_format
+semantics preserved).
+"""
+
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomResizedCrop", "Transpose", "Pad",
+           "BrightnessTransform", "ContrastTransform", "Grayscale",
+           "to_tensor", "normalize", "resize", "center_crop", "hflip", "vflip",
+           "crop", "pad"]
+
+_IMAGE_BACKEND = "numpy"
+
+
+def _hwc(img) -> np.ndarray:
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if a.ndim != 3:
+        raise ValueError(f"expected HW or HWC image, got shape {a.shape}")
+    return a
+
+
+# -- functional --------------------------------------------------------------
+
+def to_tensor(img, data_format: str = "CHW") -> np.ndarray:
+    a = _hwc(img).astype(np.float32)
+    if np.issubdtype(np.asarray(img).dtype, np.integer):
+        a = a / 255.0
+    if data_format.upper() == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return a
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb: bool = False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format.upper() == "CHW":
+        return (a - mean[:, None, None]) / std[:, None, None]
+    return (a - mean) / std
+
+
+def _resize_bilinear(a: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    h, w, c = a.shape
+    if (h, w) == (out_h, out_w):
+        return a
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    af = a.astype(np.float32)
+    top = af[y0][:, x0] * (1 - wx) + af[y0][:, x1] * wx
+    bot = af[y1][:, x0] * (1 - wx) + af[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(a.dtype) if np.issubdtype(a.dtype, np.floating) \
+        else np.clip(np.round(out), 0, 255).astype(a.dtype)
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    if isinstance(size, numbers.Number):
+        # short side -> size, keep aspect (reference semantics)
+        if h <= w:
+            oh, ow = int(size), max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), int(size)
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    if interpolation == "nearest":
+        yi = np.clip((np.arange(oh) * h / oh).astype(np.int64), 0, h - 1)
+        xi = np.clip((np.arange(ow) * w / ow).astype(np.int64), 0, w - 1)
+        return a[yi][:, xi]
+    return _resize_bilinear(a, oh, ow)
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = _hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = a.shape[:2]
+    return crop(a, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    a = _hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    if padding_mode == "constant":
+        return np.pad(a, ((pt, pb), (pl, pr), (0, 0)), constant_values=fill)
+    return np.pad(a, ((pt, pb), (pl, pr), (0, 0)), mode=padding_mode)
+
+
+# -- transform classes -------------------------------------------------------
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW",
+                 to_rgb: bool = False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed: bool = False,
+                 fill=0, padding_mode: str = "constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        a = _hwc(img)
+        if self.padding is not None:
+            a = pad(a, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = a.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            a = pad(a, (0, 0, max(0, tw - w), max(0, th - h)), self.fill,
+                    self.padding_mode)
+            h, w = a.shape[:2]
+        top = pyrandom.randint(0, max(0, h - th))
+        left = pyrandom.randint(0, max(0, w - tw))
+        return crop(a, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if pyrandom.random() < self.prob else _hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if pyrandom.random() < self.prob else _hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation: str = "bilinear", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import math
+        a = _hwc(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = math.exp(pyrandom.uniform(math.log(self.ratio[0]),
+                                           math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = pyrandom.randint(0, h - ch)
+                left = pyrandom.randint(0, w - cw)
+                return resize(crop(a, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size, self.interpolation)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return np.transpose(_hwc(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode: str = "constant",
+                 keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        a = _hwc(img).astype(np.float32)
+        factor = 1.0 + pyrandom.uniform(-self.value, self.value)
+        out = a * factor
+        if np.issubdtype(np.asarray(img).dtype, np.integer):
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        a = _hwc(img).astype(np.float32)
+        factor = 1.0 + pyrandom.uniform(-self.value, self.value)
+        mean = a.mean()
+        out = (a - mean) * factor + mean
+        if np.issubdtype(np.asarray(img).dtype, np.integer):
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels: int = 1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        a = _hwc(img).astype(np.float32)
+        gray = (0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2])
+        out = np.repeat(gray[..., None], self.num_output_channels, axis=-1)
+        if np.issubdtype(np.asarray(img).dtype, np.integer):
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
