@@ -60,6 +60,8 @@ class LlamaConfig:
     dtype: Any = jnp.float32         # activation/compute dtype
     param_dtype: Any = jnp.float32   # storage dtype
     remat: bool = False              # jax.checkpoint each decoder layer
+    sep_axis: Optional[str] = None   # context-parallel mesh axis (e.g. "sep")
+    cp_impl: str = "ring"            # "ring" | "ulysses" attention over sep
 
     @property
     def head_dim(self) -> int:
@@ -187,6 +189,29 @@ def _rope(x, cos, sin, use_kernels):
 
 def _attention(q, k, v, cfg: LlamaConfig):
     """Causal self-attention on [B, S, H(k), D]."""
+    if cfg.sep_axis is not None:
+        # context parallelism: seq stays sharded over the sep axis; ring or
+        # Ulysses attention as an explicit shard_map region inside the
+        # compiled program (composes with dp GSPMD; mp must be 1 here)
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from ..distributed.context_parallel import (ring_flash_attention,
+                                                    ulysses_attention)
+        from ..distributed.topology import get_hybrid_communicate_group
+        Hk, H = k.shape[2], q.shape[2]
+        if Hk != H:  # ring/ulysses paths expect matched heads; expand GQA
+            rep = H // Hk
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        fn = ring_flash_attention if cfg.cp_impl == "ring" \
+            else ulysses_attention
+        mesh = get_hybrid_communicate_group().mesh
+        spec = P(None, cfg.sep_axis, None, None)
+        region = shard_map(
+            lambda a, b, c: fn(a, b, c, cfg.sep_axis, True, cfg.use_kernels),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return region(q, k, v)
     if cfg.use_kernels:
         from ..kernels.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True)
