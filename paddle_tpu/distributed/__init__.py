@@ -18,8 +18,14 @@ from .sharding import group_sharded_parallel
 from .topology import (HybridCommunicateGroup, build_mesh,
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
+from . import checkpoint
 from . import fleet
 from . import sharding
+from .checkpoint import load_state_dict, save_state_dict
+from .context_parallel import sep_parallel_attention
+from .moe import MoELayer
+from .pipeline import LayerDesc, PipelineLayer, PipelineParallel, \
+    SharedLayerDesc, pipeline_scan
 
 __all__ = [
     "Partial", "Placement", "ProcessMesh", "Replicate", "Shard",
@@ -29,6 +35,9 @@ __all__ = [
     "is_initialized", "reduce", "reduce_scatter", "scatter", "DataParallel",
     "ParallelEnv", "group_sharded_parallel", "HybridCommunicateGroup",
     "build_mesh", "get_hybrid_communicate_group", "fleet", "sharding",
+    "checkpoint", "save_state_dict", "load_state_dict",
+    "sep_parallel_attention", "MoELayer", "PipelineLayer", "LayerDesc",
+    "SharedLayerDesc", "PipelineParallel", "pipeline_scan",
     "spawn", "launch",
 ]
 

@@ -1,0 +1,167 @@
+"""Distributed checkpoint: sharded save + reshard-on-load.
+
+Parity target: ``python/paddle/distributed/checkpoint/`` in the reference
+(``save_state_dict``/``load_state_dict`` with per-rank shard files + a global
+metadata file mapping logical tensors -> shard slices; load reshards so a
+run can resume under a DIFFERENT parallel topology). TPU redesign:
+
+* Save walks each array's ``addressable_shards`` — the shard layout IS the
+  ``NamedSharding``, no bookkeeping of parallel strategy needed. Each
+  process writes one ``data_<rank>.pkl`` with its local shard payloads and
+  unique-owner de-duplication (replicated values are written once).
+* Load reads the metadata, assembles each logical tensor from shard slices,
+  and ``jax.device_put``s onto the DESTINATION tensor's current sharding —
+  reshard-on-load is exactly one device_put (SURVEY §5 checkpoint tier 3).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+_META = "metadata.pkl"
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict[str, Any]:
+    flat = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def _raw(v):
+    if isinstance(v, Tensor):
+        return v._value
+    return v
+
+
+def _index_tuple(index) -> tuple:
+    """Normalize a shard index (tuple of slices) into picklable bounds."""
+    out = []
+    for s in index:
+        out.append((s.start or 0, s.stop, s.step or 1))
+    return tuple(out)
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    async_save: bool = False) -> None:
+    """Write ``state_dict`` (nested dicts of Tensors/arrays/scalars) as a
+    sharded checkpoint directory."""
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    flat = _flatten(state_dict)
+    meta: Dict[str, Dict] = {}
+    payload: Dict[str, list] = {}
+
+    for name, v in flat.items():
+        arr = _raw(v)
+        if not hasattr(arr, "shape"):  # python scalar / misc metadata
+            meta[name] = {"kind": "object", "value": arr}
+            continue
+        jarr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
+        entry = {"kind": "array", "shape": tuple(jarr.shape),
+                 "dtype": str(np.dtype(jarr.dtype)) if jarr.dtype != jax.numpy.bfloat16
+                 else "bfloat16", "shards": []}
+        shards = []
+        seen_indices = set()
+        for sh in jarr.addressable_shards:
+            idx = _index_tuple(sh.index)
+            if idx in seen_indices:
+                continue  # replicated copy — unique-owner dedup
+            seen_indices.add(idx)
+            shards.append((idx, np.asarray(sh.data)))
+            entry["shards"].append({"file": f"data_{rank}.pkl", "index": idx})
+        meta[name] = entry
+        payload[name] = shards
+
+    with open(os.path.join(path, f"data_{rank}.pkl"), "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, _META), "wb") as f:
+            pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _assemble(entry: Dict, files: Dict[str, Dict], name: str) -> np.ndarray:
+    shape = entry["shape"]
+    dtype = entry["dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+        npdt = ml_dtypes.bfloat16
+    else:
+        npdt = np.dtype(dtype)
+    out = np.empty(shape, npdt)
+    filled = np.zeros(shape, bool) if shape else None
+    for rec in entry["shards"]:
+        payload = files[rec["file"]]
+        for idx, data in payload.get(name, ()):
+            if idx == rec["index"]:
+                sl = tuple(slice(a, b, c) for a, b, c in idx)
+                out[sl] = data
+                if filled is not None:
+                    filled[sl] = True
+    if filled is not None and not filled.all():
+        raise RuntimeError(
+            f"checkpoint shard coverage incomplete for {name!r} — missing "
+            f"{int((~filled).sum())} elements (corrupt or partial save)")
+    return out
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    offload: bool = False) -> None:
+    """Fill ``state_dict``'s tensors IN PLACE from the checkpoint at
+    ``path``, resharding each value onto the destination tensor's current
+    sharding (so the target topology may differ from the saving one)."""
+    with open(os.path.join(path, _META), "rb") as f:
+        meta = pickle.load(f)
+    files: Dict[str, Dict] = {}
+    for fname in sorted(os.listdir(path)):
+        if fname.startswith("data_") and fname.endswith(".pkl"):
+            with open(os.path.join(path, fname), "rb") as f:
+                files[fname] = pickle.load(f)
+
+    flat = _flatten(state_dict)
+    missing = [k for k in flat if k not in meta]
+    if missing:
+        raise KeyError(f"checkpoint at {path} lacks keys: {missing[:5]}"
+                       f"{'...' if len(missing) > 5 else ''}")
+    for name, dst in flat.items():
+        entry = meta[name]
+        if entry["kind"] == "object":
+            continue  # scalars restored only via explicit assignment
+        full = _assemble(entry, files, name)
+        cur = _raw(dst)
+        if tuple(full.shape) != tuple(cur.shape):
+            raise ValueError(
+                f"shape mismatch for {name!r}: checkpoint {full.shape} vs "
+                f"destination {cur.shape}")
+        if isinstance(cur, jax.Array) and hasattr(cur, "sharding"):
+            new = jax.device_put(full, cur.sharding)  # reshard-on-load
+        else:
+            new = jax.numpy.asarray(full)
+        if isinstance(dst, Tensor):
+            dst._value = new.astype(cur.dtype)
+        else:
+            # raw-array leaves can't be replaced in place; caller gets the
+            # loaded value through the dict
+            state_dict_set(state_dict, name, new.astype(cur.dtype))
+
+
+def state_dict_set(d: Dict, dotted: str, value) -> None:
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        d = d[k]
+    d[keys[-1]] = value

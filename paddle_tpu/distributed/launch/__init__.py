@@ -1,0 +1,10 @@
+"""``python -m paddle_tpu.distributed.launch`` — the process launcher.
+
+Parity target: ``python/paddle/distributed/launch/`` in the reference
+(spawns per-rank processes, sets ``PADDLE_TRAINER_*`` env, per-rank log
+files, watches children, elastic restart). See ``main.py``.
+"""
+
+from .main import main  # noqa: F401
+
+__all__ = ["main"]

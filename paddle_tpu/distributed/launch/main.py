@@ -1,0 +1,166 @@
+"""Launcher implementation.
+
+Parity target: ``python/paddle/distributed/launch/main.py`` +
+``controllers/collective.py`` in the reference (process spawn, env plumbing,
+workerlog.N files, failure watch, elastic restarts). TPU redesign: the unit
+of launch is one process per HOST (single-controller JAX sees every local
+chip), so ``--nproc_per_node`` defaults to 1; values > 1 run the multi-
+process CPU simulation (each child gets a ``jax.distributed`` process id and
+a localhost coordinator — the reference's Gloo-on-localhost testing trick,
+SURVEY §4).
+
+Env contract exported to children (reference names + their JAX equivalents):
+  PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER
+  PADDLE_DIST_COORDINATOR (host:port for jax.distributed.initialize)
+  PADDLE_DIST_PROCESS_ID / PADDLE_DIST_NUM_PROCESSES
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["main", "launch_procs"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a (multi-process) training job")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: auto on localhost)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", "--rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="1 = single-controller TPU (default); >1 = "
+                        "multi-process CPU simulation")
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="visible device ids (exported as TPU_VISIBLE_DEVICES)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", "--elastic_level", type=int, default=0,
+                   dest="max_restart",
+                   help="elastic: restart the job this many times on failure")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class _Proc:
+    def __init__(self, rank: int, popen: subprocess.Popen, log_path: str):
+        self.rank = rank
+        self.popen = popen
+        self.log_path = log_path
+
+
+def _spawn(args, restart_round: int) -> List[_Proc]:
+    os.makedirs(args.log_dir, exist_ok=True)
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_DIST_COORDINATOR": master,
+            "PADDLE_DIST_PROCESS_ID": str(rank),
+            "PADDLE_DIST_NUM_PROCESSES": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_RESTART_ROUND": str(restart_round),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        if args.devices is not None:
+            env["TPU_VISIBLE_DEVICES"] = args.devices
+        if world > 1 and nproc > 1:
+            # multi-process CPU simulation: children must not fight over the
+            # single local TPU
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        logf = open(log_path, "ab", buffering=0)
+        logf.write(f"==== launch rank {rank} round {restart_round} "
+                   f"{time.strftime('%F %T')} ====\n".encode())
+        popen = subprocess.Popen(
+            [sys.executable, args.training_script, *args.training_script_args],
+            env=env, stdout=logf, stderr=subprocess.STDOUT)
+        procs.append(_Proc(rank, popen, log_path))
+    return procs
+
+
+def _watch(procs: List[_Proc]) -> int:
+    """Wait for all children; on any nonzero exit kill the rest (the
+    reference's kill-all-on-one-failure policy). Returns the job rc."""
+    try:
+        while True:
+            alive = 0
+            for p in procs:
+                rc = p.popen.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    for q in procs:
+                        if q.popen.poll() is None:
+                            q.popen.send_signal(signal.SIGTERM)
+                    deadline = time.time() + 10
+                    for q in procs:
+                        timeout = max(0.1, deadline - time.time())
+                        try:
+                            q.popen.wait(timeout=timeout)
+                        except subprocess.TimeoutExpired:
+                            q.popen.kill()
+                    print(f"rank {p.rank} exited with {rc} "
+                          f"(log: {p.log_path}); peers terminated",
+                          file=sys.stderr)
+                    return rc
+            if alive == 0:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.popen.poll() is None:
+                q.popen.terminate()
+        return 130
+
+
+def launch_procs(args) -> int:
+    """Run the job with elastic restarts (checkpoint-resume contract: the
+    script must resume from its own checkpoints; the launcher only supplies
+    a fresh rendezvous — SURVEY §5 failure-detection stance)."""
+    rounds = args.max_restart + 1
+    rc = 1
+    for attempt in range(rounds):
+        procs = _spawn(args, attempt)
+        rc = _watch(procs)
+        if rc == 0 or rc == 130:
+            return rc
+        if attempt < rounds - 1:
+            print(f"elastic: restarting job (attempt {attempt + 2}/{rounds})",
+                  file=sys.stderr)
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parse(argv)
+    rc = launch_procs(args)
+    if rc != 0:
+        sys.exit(rc)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Launcher tests: env plumbing, per-rank logs, failure kill-all, elastic
+restart. Children are plain python scripts (no jax init needed)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch.main import _parse, launch_procs
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "train.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _args(tmp_path, script, *extra):
+    return _parse([*extra, "--log_dir", str(tmp_path / "log"), script])
+
+
+class TestLaunch:
+    def test_single_proc_env_and_log(self, tmp_path):
+        script = _script(tmp_path, """
+            import os
+            print("rank", os.environ["PADDLE_TRAINER_ID"],
+                  "world", os.environ["PADDLE_TRAINERS_NUM"],
+                  "master", os.environ["PADDLE_MASTER"])
+        """)
+        rc = launch_procs(_args(tmp_path, script))
+        assert rc == 0
+        log = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "rank 0 world 1" in log
+
+    def test_multi_proc_ranks(self, tmp_path):
+        script = _script(tmp_path, """
+            import os
+            print("R%s/%s" % (os.environ["PADDLE_TRAINER_ID"],
+                              os.environ["PADDLE_DIST_NUM_PROCESSES"]))
+        """)
+        rc = launch_procs(_args(tmp_path, script, "--nproc_per_node", "3"))
+        assert rc == 0
+        logs = [(tmp_path / "log" / f"workerlog.{r}").read_text()
+                for r in range(3)]
+        for r in range(3):
+            assert f"R{r}/3" in logs[r]
+
+    def test_failure_propagates_and_kills_peers(self, tmp_path):
+        script = _script(tmp_path, """
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(3)
+            time.sleep(30)   # would time out unless killed by the launcher
+        """)
+        import time
+        t0 = time.time()
+        rc = launch_procs(_args(tmp_path, script, "--nproc_per_node", "2"))
+        assert rc == 3
+        assert time.time() - t0 < 25  # rank 0 was terminated, not waited out
+
+    def test_elastic_restart_until_success(self, tmp_path):
+        marker = tmp_path / "attempts"
+        script = _script(tmp_path, f"""
+            import os, sys
+            p = {str(marker)!r}
+            n = int(open(p).read()) if os.path.exists(p) else 0
+            open(p, "w").write(str(n + 1))
+            sys.exit(0 if n >= 2 else 1)   # succeed on the 3rd attempt
+        """)
+        rc = launch_procs(_args(tmp_path, script, "--max_restart", "3"))
+        assert rc == 0
+        assert marker.read_text() == "3"
+
+    def test_elastic_exhausted(self, tmp_path):
+        script = _script(tmp_path, "import sys; sys.exit(9)")
+        rc = launch_procs(_args(tmp_path, script, "--max_restart", "1"))
+        assert rc == 9
+
+    def test_module_entrypoint(self, tmp_path):
+        script = _script(tmp_path, "print('hello from child')")
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"), script],
+            cwd="/root/repo", env={**env, "PYTHONPATH": "/root/repo"},
+            capture_output=True, timeout=120)
+        assert out.returncode == 0
+        assert "hello from child" in \
+            (tmp_path / "log" / "workerlog.0").read_text()
