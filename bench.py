@@ -152,9 +152,54 @@ def bench_attention(seq=2048, batch=4, heads=16, head_dim=64, steps=10):
     return results
 
 
+def bench_resnet(batch=32, steps=8, image=224):
+    """ResNet-50 train step through the framework's own eager->to_static
+    path (BASELINE.md ResNet-50 images/sec row)."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import amp
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.vision.models import resnet50
+
+    net = resnet50(num_classes=1000)
+    opt = Momentum(learning_rate=0.1, momentum=0.9,
+                   parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, 3, image, image)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
+
+    @to_static
+    def train_step(x, y):
+        with amp.auto_cast():  # bf16 matmuls/convs
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    t0 = time.time()
+    float(train_step(x, y))  # warmup eager pass (state discovery)
+    float(train_step(x, y))  # compile
+    compile_s = time.time() - t0
+    float(train_step(x, y))  # drain
+    t0 = time.time()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    final = float(loss)
+    per_step = (time.time() - t0) / steps
+    assert np.isfinite(final)
+    return {"images_per_s": batch / per_step, "step_time_s": per_step,
+            "compile_s": compile_s, "loss": final}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--attn", action="store_true")
+    ap.add_argument("--resnet", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
@@ -192,6 +237,13 @@ def main():
         print(json.dumps({"attn_flash_s": round(a["flash"], 4),
                           "attn_ref_s": round(a["ref"], 4),
                           "flash_speedup": round(a["ref"] / a["flash"], 2)}),
+              file=sys.stderr)
+
+    if args.resnet:
+        rn = bench_resnet(steps=args.steps)
+        print(json.dumps({"resnet50_images_per_s": round(rn["images_per_s"]),
+                          "resnet50_step_s": round(rn["step_time_s"], 4),
+                          "resnet50_compile_s": round(rn["compile_s"], 1)}),
               file=sys.stderr)
 
     # ONE JSON line on stdout (driver contract); north star = 50% MFU

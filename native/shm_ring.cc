@@ -1,0 +1,175 @@
+// shm_ring — POSIX shared-memory ring buffer for DataLoader batch transport.
+//
+// Parity target: the reference DataLoader's C++ shared-memory tensor
+// transport (python/paddle/io/dataloader worker shm + core memory mapping):
+// worker subprocesses hand batches to the parent through mmap'd shared
+// memory instead of pickling over a pipe. Single-producer single-consumer
+// ring of fixed slots; cross-process sync via process-shared semaphores.
+// Consumed from Python over a C ABI via ctypes.
+
+#include <fcntl.h>
+#include <semaphore.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+#include <string>
+
+namespace {
+
+struct RingHeader {
+  uint64_t slots;
+  uint64_t slot_bytes;
+  uint64_t head;  // next slot to write (producer-owned)
+  uint64_t tail;  // next slot to read  (consumer-owned)
+  sem_t free_slots;
+  sem_t used_slots;
+};
+
+struct SlotHeader {
+  uint64_t len;
+};
+
+struct Ring {
+  std::string name;
+  bool owner;
+  size_t total;
+  RingHeader* hdr;
+};
+
+size_t ring_bytes(uint64_t slots, uint64_t slot_bytes) {
+  return sizeof(RingHeader) + slots * (sizeof(SlotHeader) + slot_bytes);
+}
+
+uint8_t* slot_ptr(RingHeader* hdr, uint64_t i) {
+  auto* base = reinterpret_cast<uint8_t*>(hdr + 1);
+  return base + i * (sizeof(SlotHeader) + hdr->slot_bytes);
+}
+
+int timed_wait(sem_t* sem, int timeout_ms) {
+  if (timeout_ms < 0) {
+    int r;
+    while ((r = sem_wait(sem)) == -1 && errno == EINTR) {
+    }
+    return r;
+  }
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  int r;
+  while ((r = sem_timedwait(sem, &ts)) == -1 && errno == EINTR) {
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, uint64_t slots, uint64_t slot_bytes) {
+  shm_unlink(name);  // stale ring from a dead process
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = ring_bytes(slots, slot_bytes);
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<RingHeader*>(mem);
+  hdr->slots = slots;
+  hdr->slot_bytes = slot_bytes;
+  hdr->head = 0;
+  hdr->tail = 0;
+  if (sem_init(&hdr->free_slots, 1, static_cast<unsigned>(slots)) != 0 ||
+      sem_init(&hdr->used_slots, 1, 0) != 0) {
+    munmap(mem, total);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* r = new Ring{name, true, total, hdr};
+  return r;
+}
+
+void* shm_ring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* r = new Ring{name, false, static_cast<size_t>(st.st_size),
+                     static_cast<RingHeader*>(mem)};
+  return r;
+}
+
+uint64_t shm_ring_slot_bytes(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->slot_bytes;
+}
+
+// 0 on success, -1 on timeout/error, -2 if payload exceeds slot capacity.
+int shm_ring_push(void* handle, const void* buf, uint64_t len,
+                  int timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  RingHeader* hdr = r->hdr;
+  if (len > hdr->slot_bytes) return -2;
+  if (timed_wait(&hdr->free_slots, timeout_ms) != 0) return -1;
+  uint8_t* slot = slot_ptr(hdr, hdr->head % hdr->slots);
+  auto* sh = reinterpret_cast<SlotHeader*>(slot);
+  sh->len = len;
+  if (len) std::memcpy(slot + sizeof(SlotHeader), buf, len);
+  hdr->head++;
+  sem_post(&hdr->used_slots);
+  return 0;
+}
+
+// Returns payload length (copied into buf up to cap), -1 on timeout/error.
+int64_t shm_ring_pop(void* handle, void* buf, uint64_t cap, int timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  RingHeader* hdr = r->hdr;
+  if (timed_wait(&hdr->used_slots, timeout_ms) != 0) return -1;
+  uint8_t* slot = slot_ptr(hdr, hdr->tail % hdr->slots);
+  auto* sh = reinterpret_cast<SlotHeader*>(slot);
+  uint64_t len = sh->len;
+  if (len) std::memcpy(buf, slot + sizeof(SlotHeader),
+                       len < cap ? len : cap);
+  hdr->tail++;
+  sem_post(&hdr->free_slots);
+  return static_cast<int64_t>(len);
+}
+
+void shm_ring_close(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  bool owner = r->owner;
+  std::string name = r->name;
+  if (owner) {
+    sem_destroy(&r->hdr->free_slots);
+    sem_destroy(&r->hdr->used_slots);
+  }
+  munmap(r->hdr, r->total);
+  if (owner) shm_unlink(name.c_str());
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,295 @@
+// TCPStore — C++ rendezvous key-value store.
+//
+// Parity target: paddle/fluid/distributed/store/tcp_store.cc in the
+// reference (master-hosted TCP KV with set/get/wait/add used to exchange
+// bootstrap info between ranks). This is the native-runtime piece of the
+// rebuild's coordination layer: a threaded socket server + blocking client
+// exposed through a C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Protocol (little-endian):
+//   request : u8 op | u32 klen | key bytes | u64 arg/vlen | value bytes
+//   response: u64 vlen | value bytes            (GET/WAIT)
+//             i64 result                        (ADD)
+//             u8 ack                            (SET)
+// Ops: 1=SET 2=GET(blocking wait) 3=ADD 4=CHECK(nonblocking) 5=DELETE
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> kv;
+  std::atomic<bool> stop{false};
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void serve_client(Store* st, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, key.data(), klen)) break;
+    uint64_t arg;
+    if (!read_full(fd, &arg, 8)) break;
+
+    if (op == 1) {  // SET
+      std::vector<uint8_t> val(arg);
+      if (arg && !read_full(fd, val.data(), arg)) break;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        st->kv[key] = std::move(val);
+      }
+      st->cv.notify_all();
+      uint8_t ack = 1;
+      if (!write_full(fd, &ack, 1)) break;
+    } else if (op == 2) {  // GET (block until present or server stop)
+      std::unique_lock<std::mutex> lk(st->mu);
+      st->cv.wait(lk, [&] {
+        return st->stop.load() || st->kv.count(key) > 0;
+      });
+      if (st->stop.load() && !st->kv.count(key)) break;
+      const auto& v = st->kv[key];
+      uint64_t vlen = v.size();
+      if (!write_full(fd, &vlen, 8)) break;
+      if (vlen && !write_full(fd, v.data(), vlen)) break;
+    } else if (op == 3) {  // ADD (create-if-absent counter)
+      int64_t delta;
+      std::memcpy(&delta, &arg, 8);
+      int64_t result;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        int64_t cur = 0;
+        auto it = st->kv.find(key);
+        if (it != st->kv.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        result = cur + delta;
+        std::vector<uint8_t> v(8);
+        std::memcpy(v.data(), &result, 8);
+        st->kv[key] = std::move(v);
+      }
+      st->cv.notify_all();
+      if (!write_full(fd, &result, 8)) break;
+    } else if (op == 4) {  // CHECK
+      uint64_t present;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        present = st->kv.count(key) ? 1 : 0;
+      }
+      if (!write_full(fd, &present, 8)) break;
+    } else if (op == 5) {  // DELETE
+      uint64_t erased;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        erased = st->kv.erase(key);
+      }
+      st->cv.notify_all();
+      if (!write_full(fd, &erased, 8)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Store* st) {
+  for (;;) {
+    int fd = ::accept(st->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (st->stop.load()) return;
+      continue;
+    }
+    if (st->stop.load()) {
+      ::close(fd);
+      return;
+    }
+    st->workers.emplace_back(serve_client, st, fd);
+  }
+}
+
+int connect_to(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  // retry connect for up to ~30s (server may not be up yet — rendezvous)
+  for (int i = 0; i < 300; i++) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    usleep(100000);
+  }
+  ::close(fd);
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- server ------------------------------------------------------------------
+
+void* tcp_store_server_start(int port) {
+  auto* st = new Store();
+  st->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (st->listen_fd < 0) {
+    delete st;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(st->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(st->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(st->listen_fd, 64) != 0) {
+    ::close(st->listen_fd);
+    delete st;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(st->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  st->port = ntohs(addr.sin_port);
+  st->accept_thread = std::thread(accept_loop, st);
+  return st;
+}
+
+int tcp_store_server_port(void* handle) {
+  return static_cast<Store*>(handle)->port;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* st = static_cast<Store*>(handle);
+  st->stop.store(true);
+  st->cv.notify_all();
+  ::shutdown(st->listen_fd, SHUT_RDWR);
+  ::close(st->listen_fd);
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  for (auto& w : st->workers)
+    if (w.joinable()) w.join();
+  delete st;
+}
+
+// -- client ------------------------------------------------------------------
+
+void* tcp_store_client_connect(const char* host, int port) {
+  int fd = connect_to(host, port);
+  if (fd < 0) return nullptr;
+  return new int(fd);
+}
+
+static bool send_req(int fd, uint8_t op, const char* key, uint64_t arg,
+                     const void* val, uint64_t vlen) {
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_full(fd, &op, 1)) return false;
+  if (!write_full(fd, &klen, 4)) return false;
+  if (klen && !write_full(fd, key, klen)) return false;
+  if (!write_full(fd, &arg, 8)) return false;
+  if (vlen && !write_full(fd, val, vlen)) return false;
+  return true;
+}
+
+int tcp_store_set(void* client, const char* key, const void* val,
+                  uint64_t vlen) {
+  int fd = *static_cast<int*>(client);
+  if (!send_req(fd, 1, key, vlen, val, vlen)) return -1;
+  uint8_t ack;
+  return read_full(fd, &ack, 1) ? 0 : -1;
+}
+
+// Returns the value length; caller provides a buffer of cap bytes (value is
+// truncated if larger). Blocks until the key exists. -1 on error.
+int64_t tcp_store_get(void* client, const char* key, void* buf, uint64_t cap) {
+  int fd = *static_cast<int*>(client);
+  if (!send_req(fd, 2, key, 0, nullptr, 0)) return -1;
+  uint64_t vlen;
+  if (!read_full(fd, &vlen, 8)) return -1;
+  std::vector<uint8_t> tmp(vlen);
+  if (vlen && !read_full(fd, tmp.data(), vlen)) return -1;
+  std::memcpy(buf, tmp.data(), vlen < cap ? vlen : cap);
+  return static_cast<int64_t>(vlen);
+}
+
+int64_t tcp_store_add(void* client, const char* key, int64_t delta) {
+  int fd = *static_cast<int*>(client);
+  uint64_t arg;
+  std::memcpy(&arg, &delta, 8);
+  if (!send_req(fd, 3, key, arg, nullptr, 0)) return INT64_MIN;
+  int64_t result;
+  return read_full(fd, &result, 8) ? result : INT64_MIN;
+}
+
+int tcp_store_check(void* client, const char* key) {
+  int fd = *static_cast<int*>(client);
+  if (!send_req(fd, 4, key, 0, nullptr, 0)) return -1;
+  uint64_t present;
+  return read_full(fd, &present, 8) ? static_cast<int>(present) : -1;
+}
+
+int tcp_store_delete(void* client, const char* key) {
+  int fd = *static_cast<int*>(client);
+  if (!send_req(fd, 5, key, 0, nullptr, 0)) return -1;
+  uint64_t erased;
+  return read_full(fd, &erased, 8) ? static_cast<int>(erased) : -1;
+}
+
+void tcp_store_client_close(void* client) {
+  int* fd = static_cast<int*>(client);
+  ::close(*fd);
+  delete fd;
+}
+
+}  // extern "C"

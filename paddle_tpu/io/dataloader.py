@@ -87,11 +87,44 @@ class _ExceptionWrapper:
             f"DataLoader worker raised {self.exc_type}: {self.msg}")
 
 
+class _RingSource:
+    """Round-robin poll of per-worker shm rings behind a Queue-like .get."""
+
+    def __init__(self, rings):
+        self.rings = list(rings)
+        self._next = 0
+
+    def get(self, timeout=None):
+        import pickle
+        import time
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            for _ in range(len(self.rings)):
+                r = self.rings[self._next]
+                self._next = (self._next + 1) % len(self.rings)
+                data = r.pop(timeout_ms=2)
+                if data is not None:
+                    return pickle.loads(data)
+            if deadline is not None and time.time() > deadline:
+                raise pyqueue.Empty
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
-                 worker_id, num_workers, seed, iterable):
+                 worker_id, num_workers, seed, iterable, ring=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
     np.random.seed(seed % (2 ** 31))
+    if ring is not None:
+        import pickle
+
+        class _RingPut:
+            def put(self, item):
+                try:
+                    ring.push(pickle.dumps(item,
+                                           protocol=pickle.HIGHEST_PROTOCOL))
+                except ValueError as e:  # payload exceeds slot capacity
+                    ring.push(pickle.dumps((item[0], _ExceptionWrapper(e))))
+        result_queue = _RingPut()
     try:
         if init_fn is not None:
             init_fn(worker_id)
@@ -159,6 +192,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = bool(use_shared_memory)
+        self.shm_slot_bytes = 32 << 20
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -207,10 +242,27 @@ class DataLoader:
             return
         yield from self._multiprocess_batches()
 
+    def _make_rings(self, nw):
+        """Shared-memory transport (native C++ ring; reference shm parity).
+        Falls back to mp.Queue when the native lib is unavailable."""
+        if not self.use_shared_memory:
+            return None
+        try:
+            import os
+            from ..native import ShmRing
+            tag = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+            return [ShmRing(f"{tag}_{w}", slots=4,
+                            slot_bytes=self.shm_slot_bytes)
+                    for w in range(nw)]
+        except Exception:
+            return None
+
     def _multiprocess_batches(self):
         ctx = mp.get_context("fork")  # workers reuse the parent's dataset
         nw = self.num_workers
         result_queue = ctx.Queue()
+        rings = self._make_rings(nw)
+        result_src = _RingSource(rings) if rings else result_queue
         index_queues, workers = [], []
         base_seed = np.random.randint(0, 2 ** 31 - 1)
         for w in range(nw):
@@ -219,16 +271,16 @@ class DataLoader:
                 target=_worker_loop,
                 args=(self.dataset, iq, result_queue, self.collate_fn,
                       self.worker_init_fn, w, nw, base_seed + w,
-                      self._iterable),
+                      self._iterable, rings[w] if rings else None),
                 daemon=True)
             p.start()
             index_queues.append(iq)
             workers.append(p)
         try:
             if self._iterable:
-                yield from self._mp_iterable(index_queues, result_queue, nw)
+                yield from self._mp_iterable(index_queues, result_src, nw)
             else:
-                yield from self._mp_map(index_queues, result_queue, nw)
+                yield from self._mp_map(index_queues, result_src, nw)
         finally:
             for iq in index_queues:
                 try:
@@ -239,6 +291,9 @@ class DataLoader:
                 p.join(timeout=1.0)
                 if p.is_alive():
                     p.terminate()
+            if rings:
+                for r in rings:
+                    r.close()
 
     def _get(self, result_queue):
         timeout = self.timeout if self.timeout else None

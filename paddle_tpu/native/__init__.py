@@ -1,0 +1,226 @@
+"""Native (C++) runtime components, consumed via ctypes.
+
+The reference implements its coordination store and DataLoader shared-memory
+transport in C++ (``paddle/fluid/distributed/store/tcp_store.cc``, the
+dataloader shm transport); these are their TPU-rebuild equivalents, compiled
+from ``native/*.cc`` with g++ on first use (no pybind11 in this image — the
+bindings are a plain C ABI + ctypes).
+
+Public surface: :class:`TCPStore` (master-hosted rendezvous KV with
+set/get/wait/add) and :class:`ShmRing` (single-producer single-consumer
+shared-memory ring used by ``io.DataLoader`` when ``use_shared_memory``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["TCPStore", "ShmRing", "lib", "build_native"]
+
+_REPO_NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> str:
+    """Compile native/*.cc into one shared library (cached by mtime)."""
+    srcs = [os.path.join(_REPO_NATIVE, f)
+            for f in sorted(os.listdir(_REPO_NATIVE)) if f.endswith(".cc")]
+    if not srcs:
+        raise RuntimeError(f"no native sources found in {_REPO_NATIVE}")
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not force and os.path.exists(_LIB_PATH):
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
+            return _LIB_PATH
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *srcs, "-o", _LIB_PATH, "-lrt"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            path = build_native()
+            L = ctypes.CDLL(path)
+            # tcp_store
+            L.tcp_store_server_start.restype = ctypes.c_void_p
+            L.tcp_store_server_start.argtypes = [ctypes.c_int]
+            L.tcp_store_server_port.restype = ctypes.c_int
+            L.tcp_store_server_port.argtypes = [ctypes.c_void_p]
+            L.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+            L.tcp_store_client_connect.restype = ctypes.c_void_p
+            L.tcp_store_client_connect.argtypes = [ctypes.c_char_p,
+                                                   ctypes.c_int]
+            L.tcp_store_set.restype = ctypes.c_int
+            L.tcp_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_char_p, ctypes.c_uint64]
+            L.tcp_store_get.restype = ctypes.c_int64
+            L.tcp_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_void_p, ctypes.c_uint64]
+            L.tcp_store_add.restype = ctypes.c_int64
+            L.tcp_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int64]
+            L.tcp_store_check.restype = ctypes.c_int
+            L.tcp_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            L.tcp_store_delete.restype = ctypes.c_int
+            L.tcp_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            L.tcp_store_client_close.argtypes = [ctypes.c_void_p]
+            # shm_ring
+            L.shm_ring_create.restype = ctypes.c_void_p
+            L.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_uint64]
+            L.shm_ring_open.restype = ctypes.c_void_p
+            L.shm_ring_open.argtypes = [ctypes.c_char_p]
+            L.shm_ring_slot_bytes.restype = ctypes.c_uint64
+            L.shm_ring_slot_bytes.argtypes = [ctypes.c_void_p]
+            L.shm_ring_push.restype = ctypes.c_int
+            L.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64, ctypes.c_int]
+            L.shm_ring_pop.restype = ctypes.c_int64
+            L.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_uint64, ctypes.c_int]
+            L.shm_ring_close.argtypes = [ctypes.c_void_p]
+            _lib = L
+    return _lib
+
+
+class TCPStore:
+    """ref: paddle.distributed's TCPStore (C++ master KV).
+
+    ``is_master=True`` hosts the server in-process; every instance is also a
+    client. ``get`` blocks until the key is set (rendezvous semantics).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: int = 900):
+        L = lib()
+        self._L = L
+        self._server = None
+        if is_master:
+            self._server = L.tcp_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: failed to bind port {port}")
+            port = L.tcp_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        self._client = L.tcp_store_client_connect(host.encode(), port)
+        if not self._client:
+            if self._server:
+                L.tcp_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._L.tcp_store_set(self._client, key.encode(), data,
+                                 len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self._L.tcp_store_get(self._client, key.encode(), buf, cap)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        r = self._L.tcp_store_add(self._client, key.encode(), amount)
+        if r == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(r)
+
+    def check(self, key: str) -> bool:
+        r = self._L.tcp_store_check(self._client, key.encode())
+        if r < 0:
+            raise RuntimeError("TCPStore.check failed")
+        return bool(r)
+
+    def delete_key(self, key: str) -> bool:
+        return bool(self._L.tcp_store_delete(self._client, key.encode()))
+
+    def wait(self, keys) -> None:
+        for k in ([keys] if isinstance(keys, str) else keys):
+            self.get(k)
+
+    def barrier(self, name: str, world_size: int) -> None:
+        """All participants call this; returns once all arrived."""
+        import time
+        n = self.add(f"__barrier/{name}", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        else:
+            self.get(f"__barrier/{name}/done")
+
+    def close(self):
+        if self._client:
+            self._L.tcp_store_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._L.tcp_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """SPSC shared-memory ring (the DataLoader worker->parent transport)."""
+
+    def __init__(self, name: str, slots: int = 8,
+                 slot_bytes: int = 16 << 20, create: bool = True):
+        L = lib()
+        self._L = L
+        self.name = name
+        if create:
+            self._h = L.shm_ring_create(name.encode(), slots, slot_bytes)
+        else:
+            self._h = L.shm_ring_open(name.encode())
+        if not self._h:
+            raise RuntimeError(f"ShmRing: cannot "
+                               f"{'create' if create else 'open'} {name!r}")
+        self.slot_bytes = int(L.shm_ring_slot_bytes(self._h))
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> bool:
+        r = self._L.shm_ring_push(self._h, data, len(data), timeout_ms)
+        if r == -2:
+            raise ValueError(
+                f"ShmRing: payload {len(data)}B exceeds slot capacity "
+                f"{self.slot_bytes}B — raise use_shared_memory slot size")
+        return r == 0
+
+    def pop(self, timeout_ms: int = -1) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(self.slot_bytes)
+        n = self._L.shm_ring_pop(self._h, buf, self.slot_bytes, timeout_ms)
+        if n < 0:
+            return None
+        return buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._L.shm_ring_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -208,3 +208,85 @@ class TestDataLoader:
         assert len(DataLoader(SquareDataset(10), batch_size=3)) == 4
         with pytest.raises(TypeError):
             len(DataLoader(Stream(10), batch_size=3))
+
+
+class TestNativeTransport:
+    def test_tcp_store_cross_process(self):
+        """Real rendezvous: a child process sets, the parent waits."""
+        import multiprocessing as mp
+        from paddle_tpu.native import TCPStore
+        store = TCPStore(is_master=True)
+
+        def child(port):
+            from paddle_tpu.native import TCPStore as TS
+            c = TS(port=port)
+            c.set("from_child", b"payload-123")
+            assert c.add("counter", 1) >= 1
+            c.close()
+
+        p = mp.get_context("fork").Process(target=child, args=(store.port,))
+        p.start()
+        assert store.get("from_child") == b"payload-123"  # blocks until set
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        assert store.add("counter", 0) == 1
+        store.close()
+
+    def test_tcp_store_barrier(self):
+        import multiprocessing as mp
+        from paddle_tpu.native import TCPStore
+        store = TCPStore(is_master=True)
+
+        def child(port):
+            from paddle_tpu.native import TCPStore as TS
+            c = TS(port=port)
+            c.barrier("b1", 2)
+            c.close()
+
+        p = mp.get_context("fork").Process(target=child, args=(store.port,))
+        p.start()
+        store.barrier("b1", 2)  # returns only when both arrived
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        store.close()
+
+    def test_shm_ring_blocking_and_capacity(self):
+        from paddle_tpu.native import ShmRing
+        r = ShmRing("/pt_io_test", slots=2, slot_bytes=64)
+        r.push(b"a" * 10)
+        r.push(b"b" * 20)
+        assert not r.push(b"c", timeout_ms=50)  # full -> timeout
+        assert r.pop() == b"a" * 10
+        assert r.pop() == b"b" * 20
+        assert r.pop(timeout_ms=50) is None     # empty -> timeout
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="slot capacity"):
+            r.push(b"x" * 100)
+        r.close()
+
+    def test_dataloader_shm_transport_parity(self):
+        dl_q = DataLoader(SquareDataset(23), batch_size=5, num_workers=2,
+                          use_shared_memory=False)
+        dl_s = DataLoader(SquareDataset(23), batch_size=5, num_workers=2,
+                          use_shared_memory=True)
+        assert dl_s._make_rings(2) is not None  # native transport active
+        b_q = [b[0].numpy() for b in dl_q]
+        b_s = [b[0].numpy() for b in dl_s]
+        assert len(b_q) == len(b_s)
+        for a, b in zip(b_q, b_s):
+            np.testing.assert_array_equal(a, b)
+
+    def test_dataloader_shm_worker_exception(self):
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("shm boom")
+                return np.float32(i)
+
+            def __len__(self):
+                return 6
+
+        dl = DataLoader(Bad(), batch_size=2, num_workers=2,
+                        use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="shm boom"):
+            list(dl)
