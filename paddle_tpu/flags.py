@@ -95,8 +95,17 @@ def set_flags(flags_dict: Dict[str, Any]) -> None:
 # ---------------------------------------------------------------------------
 # Core flags (Paddle equivalents noted).
 # ---------------------------------------------------------------------------
+def _wire_debug_nans(value: bool) -> None:
+    # jit-path coverage: XLA traps NaN production inside compiled programs
+    # (the eager scan below cannot see into a jitted step)
+    import jax
+    jax.config.update("jax_debug_nans", bool(value))
+
+
 define_flag("FLAGS_check_nan_inf", False, "Scan every op output for NaN/Inf in eager "
-            "mode (ref: FLAGS_check_nan_inf / nan_inf_utils_detail).", bool)
+            "mode AND enable jax_debug_nans for compiled programs "
+            "(ref: FLAGS_check_nan_inf / nan_inf_utils_detail).", bool,
+            on_change=_wire_debug_nans)
 define_flag("FLAGS_retain_grad_for_all_tensor", False,
             "Accumulate .grad for non-leaf tensors too.", bool)
 define_flag("FLAGS_eager_op_jit", True,

