@@ -7,7 +7,7 @@ these modules populate it and patch methods onto Tensor (mirroring how the refer
 
 import types as _types
 
-from . import creation, linalg, logic, manipulation, math, random, search
+from . import creation, extras, linalg, logic, manipulation, math, random, search
 
 _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
             "register_op", "patch_methods", "unary_factory", "binary_factory",
@@ -27,6 +27,6 @@ def _export(module):
 
 __all__ = sorted(set(
     _export(creation) + _export(math) + _export(manipulation) + _export(linalg)
-    + _export(logic) + _export(search) + _export(random)))
+    + _export(logic) + _export(search) + _export(random) + _export(extras)))
 from .random import Generator, default_generator  # noqa: E402
 from .creation import to_tensor  # noqa: E402

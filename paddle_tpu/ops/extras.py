@@ -1,0 +1,335 @@
+"""Secondary op surface — stacking/splitting variants, distance/statistics,
+scatter-style updates, complex views, misc math.
+
+Parity targets: scattered across ``python/paddle/tensor/{manipulation,math,
+linalg,stat}.py`` in the reference. All jnp-backed through the dispatcher
+(tape-differentiable, jit-traceable).
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import (axes_arg, binary_factory, ensure_tensor, forward_op,
+                       patch_methods, unary_factory)
+
+__all__ = [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack", "tensor_split",
+    "hsplit", "vsplit", "dsplit", "unflatten", "block_diag", "rot90",
+    "diagonal_scatter", "select_scatter", "positive", "signbit", "sinc",
+    "vander", "trapezoid", "cumulative_trapezoid", "renorm", "cdist", "pdist",
+    "cartesian_prod", "combinations", "view_as_complex", "view_as_real",
+    "is_complex", "is_floating_point", "aminmax", "baddbmm", "isin",
+    "histogramdd", "as_complex", "as_real", "polar",
+]
+
+
+# -- stacking / splitting ----------------------------------------------------
+
+def _tensors(xs):
+    return [ensure_tensor(x) for x in xs]
+
+
+def hstack(x, name=None):
+    return forward_op("hstack", lambda *vs: jnp.hstack(vs), _tensors(x))
+
+
+def vstack(x, name=None):
+    return forward_op("vstack", lambda *vs: jnp.vstack(vs), _tensors(x))
+
+
+def dstack(x, name=None):
+    return forward_op("dstack", lambda *vs: jnp.dstack(vs), _tensors(x))
+
+
+def column_stack(x, name=None):
+    return forward_op("column_stack", lambda *vs: jnp.column_stack(vs),
+                      _tensors(x))
+
+
+row_stack = vstack
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    t = ensure_tensor(x)
+    if isinstance(num_or_indices, int):
+        parts = jnp.array_split(np.arange(t.shape[axis]), num_or_indices)
+        bounds = np.cumsum([len(p) for p in parts])[:-1].tolist()
+    else:
+        bounds = list(num_or_indices)
+    outs = forward_op(
+        "tensor_split",
+        lambda v: tuple(jnp.split(v, bounds, axis=axis)), [t])
+    return list(outs)
+
+
+def hsplit(x, num_or_indices, name=None):
+    t = ensure_tensor(x)
+    return tensor_split(t, num_or_indices, axis=0 if t.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None):
+    t = ensure_tensor(x)
+    axis = axis % t.ndim
+    shape = [int(s) for s in shape]
+    full = list(t.shape)
+    new = full[:axis] + shape + full[axis + 1:]
+    if -1 in shape:
+        i = shape.index(-1)
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[i] = full[axis] // known
+        new = full[:axis] + shape + full[axis + 1:]
+    return forward_op("unflatten", lambda v: v.reshape(new), [t])
+
+
+def block_diag(inputs, name=None):
+    ts = _tensors(inputs)
+
+    def f(*vs):
+        vs = [v[None, None] if v.ndim == 0 else
+              (v[None] if v.ndim == 1 else v) for v in vs]
+        rows = sum(v.shape[0] for v in vs)
+        cols = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((rows, cols), vs[0].dtype)
+        r = c = 0
+        for v in vs:
+            out = out.at[r:r + v.shape[0], c:c + v.shape[1]].set(v)
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+    return forward_op("block_diag", f, ts)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return forward_op("rot90", lambda v: jnp.rot90(v, k, axes),
+                      [ensure_tensor(x)])
+
+
+# -- scatter-style functional updates ---------------------------------------
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    t, s = ensure_tensor(x), ensure_tensor(y)
+
+    def f(v, src):
+        n1, n2 = v.shape[axis1], v.shape[axis2]
+        idx = jnp.arange(max(n1, n2))
+        i = idx if offset >= 0 else idx - offset
+        j = idx + offset if offset >= 0 else idx
+        keep = (i < n1) & (j < n2)
+        i, j = i[keep[: len(i)]], j[keep[: len(j)]]
+        ix = [slice(None)] * v.ndim
+        ix[axis1], ix[axis2] = i, j
+        return v.at[tuple(ix)].set(src)
+    return forward_op("diagonal_scatter", f, [t, s])
+
+
+def select_scatter(x, values, axis, index, name=None):
+    t, s = ensure_tensor(x), ensure_tensor(values)
+
+    def f(v, src):
+        ix = [slice(None)] * v.ndim
+        ix[axis % v.ndim] = index
+        return v.at[tuple(ix)].set(src)
+    return forward_op("select_scatter", f, [t, s])
+
+
+# -- elementwise / math ------------------------------------------------------
+
+positive = unary_factory("positive", lambda v: +v)
+signbit = unary_factory("signbit", jnp.signbit)
+sinc = unary_factory("sinc", jnp.sinc)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return forward_op(
+        "vander", lambda v: jnp.vander(v, n, increasing=increasing),
+        [ensure_tensor(x)])
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    t = ensure_tensor(y)
+    if x is not None:
+        return forward_op("trapezoid",
+                          lambda v, xv: jnp.trapezoid(v, xv, axis=axis),
+                          [t, ensure_tensor(x)])
+    return forward_op("trapezoid",
+                      lambda v: jnp.trapezoid(v, dx=dx or 1.0, axis=axis), [t])
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    t = ensure_tensor(y)
+
+    def f(v, xv=None):
+        sl1 = [slice(None)] * v.ndim
+        sl2 = [slice(None)] * v.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (v[tuple(sl1)] + v[tuple(sl2)]) / 2.0
+        if xv is not None:
+            d = xv[tuple(sl1)] - xv[tuple(sl2)]
+        else:
+            d = dx or 1.0
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is not None:
+        return forward_op("cumulative_trapezoid", f, [t, ensure_tensor(x)])
+    return forward_op("cumulative_trapezoid", f, [t])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    t = ensure_tensor(x)
+
+    def f(v):
+        dims = tuple(d for d in range(v.ndim) if d != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return forward_op("renorm", f, [t])
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return forward_op(
+        "baddbmm",
+        lambda b, a, c: beta * b + alpha * jnp.einsum("bij,bjk->bik", a, c),
+        [ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)])
+
+
+def aminmax(x, axis=None, keepdim=False, name=None):
+    t = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return forward_op(
+        "aminmax",
+        lambda v: (jnp.min(v, axis=ax, keepdims=keepdim),
+                   jnp.max(v, axis=ax, keepdims=keepdim)), [t])
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return forward_op(
+        "isin", lambda v, tv: jnp.isin(v, tv, invert=invert),
+        [ensure_tensor(x), ensure_tensor(test_x)], differentiable=False)
+
+
+# -- distances / statistics --------------------------------------------------
+
+def cdist(x, y, p=2.0, compute_mode=None, name=None):
+    """Pairwise p-norm distance [..., M, N] (ref: paddle.cdist)."""
+    t1, t2 = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return forward_op("cdist", f, [t1, t2])
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] -> [N*(N-1)/2]."""
+    t = ensure_tensor(x)
+    n = t.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def f(v):
+        diff = v[:, None, :] - v[None, :, :]
+        if p == 2.0:
+            d = jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        else:
+            d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+        return d[iu]
+    return forward_op("pdist", f, [t])
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    t = ensure_tensor(x)
+    w = None if weights is None else ensure_tensor(weights)
+
+    def f(v, wv=None):
+        return jnp.histogramdd(v, bins=bins, range=ranges, density=density,
+                               weights=wv)
+    args = [t] if w is None else [t, w]
+    hist, edges = forward_op("histogramdd", lambda *a: f(*a)[0], args,
+                             differentiable=False), None
+    import numpy as _np
+    edges_np = _np.histogramdd(_np.asarray(t._value), bins=bins, range=ranges)[1]
+    from ..core.tensor import Tensor
+    return hist, [Tensor(jnp.asarray(e)) for e in edges_np]
+
+
+def cartesian_prod(x, name=None):
+    ts = _tensors(x)
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return forward_op("cartesian_prod", f, ts)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    t = ensure_tensor(x)
+    import itertools
+    n = t.shape[0]
+    src = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(src), np.int32).reshape(-1, r)
+
+    def f(v):
+        return v[jnp.asarray(idx)]
+    return forward_op("combinations", f, [t])
+
+
+# -- complex views -----------------------------------------------------------
+
+def view_as_complex(x, name=None):
+    """[..., 2] float -> complex (ref: paddle.as_complex)."""
+    return forward_op(
+        "view_as_complex",
+        lambda v: jax.lax.complex(v[..., 0], v[..., 1]), [ensure_tensor(x)])
+
+
+def view_as_real(x, name=None):
+    return forward_op(
+        "view_as_real",
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+        [ensure_tensor(x)])
+
+
+as_complex = view_as_complex
+as_real = view_as_real
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    return forward_op(
+        "polar",
+        lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+        [ensure_tensor(abs), ensure_tensor(angle)])
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(ensure_tensor(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(ensure_tensor(x).dtype, jnp.floating))
+
+
+patch_methods([
+    ("unflatten", unflatten), ("rot90", rot90),
+    ("diagonal_scatter", diagonal_scatter),
+    ("select_scatter", select_scatter), ("signbit", signbit),
+    ("sinc", sinc), ("trapezoid", trapezoid), ("renorm", renorm),
+    ("cdist", cdist), ("pdist", pdist), ("aminmax", aminmax),
+    ("isin", isin), ("baddbmm", baddbmm),
+    ("is_complex", is_complex), ("is_floating_point", is_floating_point),
+])

@@ -268,3 +268,130 @@ class TestRandom:
         probs = paddle.to_tensor([0.0, 0.0, 1.0])
         s = paddle.multinomial(probs, 5, replacement=True)
         assert (s.numpy() == 2).all()
+
+
+class TestExtras:
+    """Secondary op surface (ops/extras.py) vs numpy oracles."""
+
+    def test_stacking(self):
+        a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        b = paddle.to_tensor(np.arange(6, 12).reshape(2, 3).astype("float32"))
+        np.testing.assert_array_equal(paddle.hstack([a, b]).numpy(),
+                                      np.hstack([a.numpy(), b.numpy()]))
+        np.testing.assert_array_equal(paddle.vstack([a, b]).numpy(),
+                                      np.vstack([a.numpy(), b.numpy()]))
+        np.testing.assert_array_equal(paddle.dstack([a, b]).numpy(),
+                                      np.dstack([a.numpy(), b.numpy()]))
+        c1 = paddle.to_tensor(np.arange(3).astype("float32"))
+        np.testing.assert_array_equal(
+            paddle.column_stack([c1, c1]).numpy(),
+            np.column_stack([c1.numpy(), c1.numpy()]))
+
+    def test_splits(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+        parts = paddle.tensor_split(x, 2, axis=1)
+        assert [list(p.shape) for p in parts] == [[3, 2], [3, 2]]
+        parts = paddle.tensor_split(x, [1], axis=0)
+        assert [list(p.shape) for p in parts] == [[1, 4], [2, 4]]
+        hs = paddle.hsplit(x, 2)
+        assert [list(p.shape) for p in hs] == [[3, 2], [3, 2]]
+
+    def test_unflatten_blockdiag_rot90(self):
+        x = paddle.to_tensor(np.arange(24).reshape(2, 12).astype("float32"))
+        u = paddle.unflatten(x, 1, [3, 4])
+        assert list(u.shape) == [2, 3, 4]
+        u2 = x.unflatten(1, [3, -1])
+        assert list(u2.shape) == [2, 3, 4]
+        import scipy.linalg as sla
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((1, 3), np.float32)
+        got = paddle.block_diag([paddle.to_tensor(a),
+                                 paddle.to_tensor(b)]).numpy()
+        np.testing.assert_array_equal(got, sla.block_diag(a, b))
+        r = paddle.rot90(paddle.to_tensor(np.arange(4).reshape(2, 2)))
+        np.testing.assert_array_equal(r.numpy(),
+                                      np.rot90(np.arange(4).reshape(2, 2)))
+
+    def test_scatter_views(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        d = paddle.diagonal_scatter(x, paddle.to_tensor(
+            np.ones(3, np.float32)))
+        np.testing.assert_array_equal(d.numpy(), np.eye(3))
+        s = paddle.select_scatter(x, paddle.to_tensor(
+            np.full(3, 7.0, np.float32)), axis=0, index=1)
+        assert (s.numpy()[1] == 7).all() and (s.numpy()[0] == 0).all()
+
+    def test_math_extras(self):
+        x = np.asarray([-1.5, 0.0, 2.5], np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.signbit(t).numpy(),
+                                      np.signbit(x))
+        np.testing.assert_allclose(paddle.sinc(t).numpy(), np.sinc(x),
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.trapezoid(paddle.to_tensor(
+                np.asarray([1.0, 2.0, 3.0], np.float32))).numpy(),
+            np.trapezoid([1.0, 2.0, 3.0]), atol=1e-6)
+        v = paddle.vander(paddle.to_tensor(np.asarray([1., 2., 3.],
+                                                      np.float32)))
+        np.testing.assert_allclose(v.numpy(), np.vander([1., 2., 3.]))
+
+    def test_renorm(self):
+        x = np.random.randn(4, 5).astype("float32") * 10
+        out = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0,
+                            max_norm=1.0).numpy()
+        norms = np.linalg.norm(out.reshape(4, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_distances(self):
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(5, 3).astype("float32")
+        got = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        expect = np.linalg.norm(a[:, None] - b[None], axis=-1)
+        np.testing.assert_allclose(got, expect, atol=1e-4)
+        pd = paddle.pdist(paddle.to_tensor(a)).numpy()
+        assert pd.shape == (6,)
+        np.testing.assert_allclose(pd[0], np.linalg.norm(a[0] - a[1]),
+                                   atol=1e-4)
+
+    def test_aminmax_isin_baddbmm(self):
+        x = np.random.randn(3, 4).astype("float32")
+        mn, mx = paddle.aminmax(paddle.to_tensor(x))
+        np.testing.assert_allclose(float(mn), x.min(), atol=1e-6)
+        np.testing.assert_allclose(float(mx), x.max(), atol=1e-6)
+        got = paddle.isin(paddle.to_tensor(np.asarray([1, 2, 3])),
+                          paddle.to_tensor(np.asarray([2]))).numpy()
+        np.testing.assert_array_equal(got, [False, True, False])
+        a = np.random.randn(2, 3, 4).astype("float32")
+        b = np.random.randn(2, 4, 5).astype("float32")
+        c = np.random.randn(2, 3, 5).astype("float32")
+        got = paddle.baddbmm(paddle.to_tensor(c), paddle.to_tensor(a),
+                             paddle.to_tensor(b), beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(got, 0.5 * c + 2.0 * (a @ b), atol=1e-4)
+
+    def test_cartesian_combinations(self):
+        a = paddle.to_tensor(np.asarray([1, 2], np.float32))
+        b = paddle.to_tensor(np.asarray([3, 4, 5], np.float32))
+        cp = paddle.cartesian_prod([a, b]).numpy()
+        assert cp.shape == (6, 2)
+        cb = paddle.combinations(b).numpy()
+        np.testing.assert_allclose(cb, [[3, 4], [3, 5], [4, 5]])
+
+    def test_complex_views(self):
+        x = np.random.randn(4, 2).astype("float32")
+        c = paddle.view_as_complex(paddle.to_tensor(x))
+        assert paddle.is_complex(c)
+        back = paddle.view_as_real(c).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-6)
+        p = paddle.polar(paddle.to_tensor(np.ones(3, np.float32)),
+                         paddle.to_tensor(np.zeros(3, np.float32)))
+        np.testing.assert_allclose(np.asarray(p._value).real, 1.0, atol=1e-6)
+        assert paddle.is_floating_point(paddle.to_tensor(x))
+
+    def test_grads_flow(self):
+        x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"),
+                             stop_gradient=False)
+        y = paddle.cdist(x, x).sum() + paddle.renorm(x, 2.0, 0, 1.0).sum()
+        y.backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
