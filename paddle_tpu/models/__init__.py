@@ -7,6 +7,8 @@ layers implementation designed for XLA (single trace regardless of depth,
 pipeline-ready stacked params) plus sharding-spec builders for the hybrid mesh.
 """
 
-from . import llama  # noqa: F401
+from . import bert, llama  # noqa: F401
+from .bert import (BertConfig, BertForPretraining,
+                   BertForSequenceClassification, BertModel)  # noqa: F401
 from .llama import (LlamaConfig, LlamaForCausalLM, init_params, forward,
                     loss_fn, param_specs)  # noqa: F401

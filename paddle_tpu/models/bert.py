@@ -1,0 +1,157 @@
+"""BERT-family encoder — the fine-tune benchmark model.
+
+Capability target: PaddleNLP's BERT/ERNIE implementation
+(``paddlenlp/transformers/bert/modeling.py`` — SURVEY §2.6 ecosystem row;
+BERT-base fine-tune is a BASELINE.md config). Built on the framework's own
+``nn.TransformerEncoder`` stack (pre/post-norm, SDPA -> flash-attention on
+TPU), eager Layers + ``to_static``-compilable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..nn import (Dropout, Embedding, LayerNorm, Linear, Tanh,
+                  TransformerEncoder, TransformerEncoderLayer)
+from ..nn.layer import Layer
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..ops.creation import arange, zeros_like
+        from ..ops.manipulation import expand
+        B, S = input_ids.shape
+        if position_ids is None:
+            position_ids = expand(arange(S, dtype="int64"), [B, S])
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """ref: paddlenlp BertModel (embeddings + encoder + pooler)."""
+
+    def __init__(self, config: BertConfig = None, **kwargs):
+        super().__init__()
+        cfg = config or BertConfig(**kwargs)
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 mask -> additive [B, 1, 1, S]: 0 visible, -1e4 masked
+            from ..ops.manipulation import reshape
+            from ..ops.math import cast
+            m = cast(attention_mask, "float32")
+            B, S = input_ids.shape
+            attention_mask = (reshape(m, [B, 1, 1, S]) - 1.0) * 1e4
+        seq = self.encoder(x, attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig = None, num_classes: int = 2,
+                 dropout: Optional[float] = None, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        cfg = self.bert.config
+        self.dropout = Dropout(dropout if dropout is not None
+                               else cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            from ..nn import functional as F
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (ref: BertForPretraining)."""
+
+    def __init__(self, config: BertConfig = None, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        cfg = self.bert.config
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_ln = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.decoder = Linear(cfg.hidden_size, cfg.vocab_size)
+        self.seq_relationship = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        from ..nn import functional as F
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq)))
+        mlm_logits = self.decoder(h)
+        nsp_logits = self.seq_relationship(pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, nsp_logits
+        mlm = F.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100)
+        loss = mlm
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits,
+                                          next_sentence_labels)
+        return loss

@@ -1,0 +1,83 @@
+"""BERT-family model tests (fine-tune + pretrain heads, masking, jit)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                    BertForSequenceClassification, BertModel)
+
+
+def tiny_cfg():
+    return BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=64,
+                      max_position_embeddings=64, type_vocab_size=2)
+
+
+def _ids(B=2, S=12, V=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, V, (B, S)).astype("int64"))
+
+
+class TestBertModel:
+    def test_forward_shapes(self):
+        m = BertModel(tiny_cfg())
+        m.eval()
+        seq, pooled = m(_ids())
+        assert list(seq.shape) == [2, 12, 32]
+        assert list(pooled.shape) == [2, 32]
+
+    def test_attention_mask_blocks_padding(self):
+        m = BertModel(tiny_cfg())
+        m.eval()
+        ids = _ids()
+        mask = np.ones((2, 12), np.int64)
+        mask[:, 8:] = 0
+        # changing PADDED positions must not change unpadded outputs
+        ids2_np = ids.numpy().copy()
+        ids2_np[:, 8:] = 5
+        seq1, _ = m(ids, attention_mask=paddle.to_tensor(mask))
+        seq2, _ = m(paddle.to_tensor(ids2_np),
+                    attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(seq1.numpy()[:, :8], seq2.numpy()[:, :8],
+                                   atol=1e-5)
+
+    def test_finetune_trains(self):
+        model = BertForSequenceClassification(tiny_cfg(), num_classes=3)
+        from paddle_tpu.optimizer import AdamW
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        ids = _ids()
+        labels = paddle.to_tensor(np.asarray([0, 2]))
+        losses = []
+        for _ in range(5):
+            loss, logits = model(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert list(logits.shape) == [2, 3]
+
+    def test_pretraining_heads(self):
+        model = BertForPretraining(tiny_cfg())
+        ids = _ids()
+        mlm_labels = ids.numpy().copy()
+        mlm_labels[:, ::2] = -100  # only odd positions contribute
+        loss = model(ids, masked_lm_labels=paddle.to_tensor(mlm_labels),
+                     next_sentence_labels=paddle.to_tensor(
+                         np.asarray([0, 1])))
+        assert np.isfinite(float(loss))
+        loss.backward()
+        grads = [p.grad for p in model.parameters() if p.grad is not None]
+        assert grads
+
+    def test_to_static_parity(self):
+        from paddle_tpu.jit import to_static
+        model = BertForSequenceClassification(tiny_cfg(), num_classes=2)
+        model.eval()
+        ids = _ids()
+        eager = model(ids).numpy()
+        fn = to_static(lambda x: model(x))
+        fn(ids)  # warmup (eager)
+        compiled = fn(ids).numpy()
+        np.testing.assert_allclose(eager, compiled, atol=1e-5)
