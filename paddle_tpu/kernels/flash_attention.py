@@ -43,6 +43,7 @@ def _interpret() -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
                 m_ref, l_ref, *, scale, causal, causal_offset, block_q,
                 block_k, num_kv_blocks, use_seg):
+    bb = pl.program_id(0)
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -71,7 +72,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
             # varlen/packed sequences: attend only within a segment
-            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         m_prev = m_ref[:, 0]                          # [Bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -131,8 +132,8 @@ def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
-            pl.BlockSpec((1, block_q), lambda b, h, qi, kb: (b, qi)),
-            pl.BlockSpec((1, block_k), lambda b, h, qi, kb: (b, kb)),
+            pl.BlockSpec((B, block_q), lambda b, h, qi, kb: (0, qi)),
+            pl.BlockSpec((B, block_k), lambda b, h, qi, kb: (0, kb)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -163,6 +164,7 @@ def _vmem(shape, dtype):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                    sk_ref, dq_ref, acc_ref, *, scale, causal, causal_offset,
                    block_q, block_k, num_kv_blocks, use_seg):
+    bb = pl.program_id(0)
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -189,7 +191,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
-            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if use_seg:  # fully-masked rows have lse == _NEG_INF: avoid exp(0)=1
@@ -216,6 +218,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                     sk_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                     scale, causal, causal_offset, block_q, block_k,
                     num_q_blocks, use_seg):
+    bb = pl.program_id(0)
     qb = pl.program_id(3)
     ki = pl.program_id(2)
 
@@ -243,7 +246,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
-            seg_mask = sq_ref[0][:, None] == sk_ref[0][None, :]
+            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])                                  # [Bq,Bk]
         if use_seg:
@@ -297,8 +300,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, h, qi, kb: (b, qi)),
-            pl.BlockSpec((1, block_k), lambda b, h, qi, kb: (b, kb)),
+            pl.BlockSpec((B, block_q), lambda b, h, qi, kb: (0, qi)),
+            pl.BlockSpec((B, block_k), lambda b, h, qi, kb: (0, kb)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -322,8 +325,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
-            pl.BlockSpec((1, block_q), lambda b, h, ki, qb: (b, qb)),
-            pl.BlockSpec((1, block_k), lambda b, h, ki, qb: (b, ki)),
+            pl.BlockSpec((B, block_q), lambda b, h, ki, qb: (0, qb)),
+            pl.BlockSpec((B, block_k), lambda b, h, ki, qb: (0, ki)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qb: (b, h, ki, 0)),
