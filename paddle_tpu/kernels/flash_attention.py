@@ -40,10 +40,13 @@ def _interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
-                m_ref, l_ref, *, scale, causal, causal_offset, block_q,
+def _fwd_kernel(*refs, scale, causal, causal_offset, block_q,
                 block_k, num_kv_blocks, use_seg):
-    bb = pl.program_id(0)
+    if use_seg:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -72,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
             # varlen/packed sequences: attend only within a segment
-            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
+            seg_mask = sq_ref[0, :, 0][:, None] == sk_ref[0, :, 0][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         m_prev = m_ref[:, 0]                          # [Bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -103,13 +106,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc_ref,
         lse_ref[0, 0, :, 0] = m_ref[:, 0] + jnp.log(safe_l)
 
 
-def _seg_arrays(seg_q, seg_k, B, Sq, Sk):
-    use_seg = seg_q is not None
-    if not use_seg:
-        seg_q = jnp.zeros((B, Sq), jnp.int32)
-        seg_k = jnp.zeros((B, Sk), jnp.int32)
-    return (jnp.asarray(seg_q, jnp.int32), jnp.asarray(seg_k, jnp.int32),
-            use_seg)
+def _seg_operands(seg_q, seg_k, block_q, block_k, q_grid_dim: int = 2):
+    """Segment ids as [B, S, 1] with per-batch (1, block, 1) blocks.
+    ``q_grid_dim`` names which grid dim walks q blocks (2 for fwd/dq whose
+    grid is (B,H,nq,nk); 3 for dkv whose grid is (B,H,nk,nq)).
+    Returns ([], []) on the dense path: no operands, no wasted bandwidth."""
+    if seg_q is None:
+        return [], []
+    # [B, S, 1] with (1, block, 1) blocks — same layout family as the
+    # lse/delta operands (minor dim 1 equals the array dim, second-to-minor
+    # is the 8-divisible block), per-batch DMA traffic
+    sq = jnp.asarray(seg_q, jnp.int32)[..., None]
+    sk = jnp.asarray(seg_k, jnp.int32)[..., None]
+    if q_grid_dim == 2:
+        qmap = lambda b, h, i2, i3: (b, i2, 0)  # noqa: E731
+        kmap = lambda b, h, i2, i3: (b, i3, 0)  # noqa: E731
+    else:
+        qmap = lambda b, h, i2, i3: (b, i3, 0)  # noqa: E731
+        kmap = lambda b, h, i2, i3: (b, i2, 0)  # noqa: E731
+    specs = [pl.BlockSpec((1, block_q, 1), qmap),
+             pl.BlockSpec((1, block_k, 1), kmap)]
+    return [sq, sk], specs
 
 
 def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
@@ -118,13 +135,14 @@ def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
     group = H // Hk
     nq = Sq // block_q
     nk = Sk // block_k
-    seg_q, seg_k, use_seg = _seg_arrays(seg_q, seg_k, B, Sq, Sk)
+    seg_ops, seg_specs = _seg_operands(seg_q, seg_k, block_q, block_k)
 
     grid = (B, H, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           causal_offset=Sk - Sq, block_q=block_q,
-                          block_k=block_k, num_kv_blocks=nk, use_seg=use_seg),
+                          block_k=block_k, num_kv_blocks=nk,
+                          use_seg=bool(seg_ops)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -132,8 +150,7 @@ def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, qi, kb, g=group: (b, h // g, kb, 0)),
-            pl.BlockSpec((B, block_q), lambda b, h, qi, kb: (0, qi)),
-            pl.BlockSpec((B, block_k), lambda b, h, qi, kb: (0, kb)),
+            *seg_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
@@ -149,7 +166,7 @@ def _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
             _vmem((block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, seg_q, seg_k)
+    )(q, k, v, *seg_ops)
     return out, lse
 
 
@@ -161,10 +178,14 @@ def _vmem(shape, dtype):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
-                   sk_ref, dq_ref, acc_ref, *, scale, causal, causal_offset,
+def _bwd_dq_kernel(*refs, scale, causal, causal_offset,
                    block_q, block_k, num_kv_blocks, use_seg):
-    bb = pl.program_id(0)
+    if use_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dq_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_ref) = refs
     kb = pl.program_id(3)
     qi = pl.program_id(2)
 
@@ -191,7 +212,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
-            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
+            seg_mask = sq_ref[0, :, 0][:, None] == sk_ref[0, :, 0][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if use_seg:  # fully-masked rows have lse == _NEG_INF: avoid exp(0)=1
@@ -214,11 +235,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
-                    sk_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale, causal, causal_offset, block_q, block_k,
+def _bwd_dkv_kernel(*refs, scale, causal, causal_offset, block_q, block_k,
                     num_q_blocks, use_seg):
-    bb = pl.program_id(0)
+    if use_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
     qb = pl.program_id(3)
     ki = pl.program_id(2)
 
@@ -246,7 +270,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if use_seg:
-            seg_mask = sq_ref[bb][:, None] == sk_ref[bb][None, :]
+            seg_mask = sq_ref[0, :, 0][:, None] == sk_ref[0, :, 0][None, :]
             s = jnp.where(seg_mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])                                  # [Bq,Bk]
         if use_seg:
@@ -280,7 +304,9 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     group = H // Hk
     nq = Sq // block_q
     nk = Sk // block_k
-    seg_q, seg_k, use_seg = _seg_arrays(seg_q, seg_k, B, Sq, Sk)
+    seg_ops, seg_specs = _seg_operands(seg_q, seg_k, block_q, block_k,
+                                       q_grid_dim=2)
+    use_seg = bool(seg_ops)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [B,H,Sq,1]
@@ -300,17 +326,18 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, kb: (b, h, qi, 0)),
-            pl.BlockSpec((B, block_q), lambda b, h, qi, kb: (0, qi)),
-            pl.BlockSpec((B, block_k), lambda b, h, qi, kb: (0, kb)),
+            *seg_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, qi, kb: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
         scratch_shapes=[_vmem((block_q, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, *seg_ops)
 
     # dk/dv accumulate over q blocks, one pass per kv head group member then sum
+    seg_ops2, seg_specs2 = _seg_operands(seg_q, seg_k, block_q, block_k,
+                                         q_grid_dim=3)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           causal_offset=Sk - Sq, block_q=block_q,
@@ -325,8 +352,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ki, qb: (b, h, qb, 0)),
-            pl.BlockSpec((B, block_q), lambda b, h, ki, qb: (0, qb)),
-            pl.BlockSpec((B, block_k), lambda b, h, ki, qb: (0, ki)),
+            *seg_specs2,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qb: (b, h, ki, 0)),
@@ -339,7 +365,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
         scratch_shapes=[_vmem((block_k, D), jnp.float32),
                         _vmem((block_k, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, *seg_ops2)
 
     if group > 1:  # GQA: fold query-head groups back onto kv heads
         dk = dk.reshape(B, Hk, group, Sk, D).sum(axis=2)
