@@ -31,7 +31,8 @@ _LAZY_SUBMODULES = (
     "nn", "optimizer", "io", "jit", "distributed", "amp", "vision", "metric",
     "hapi", "device", "profiler", "static", "autograd", "framework", "linalg",
     "fft", "sparse", "distribution", "incubate", "text", "audio", "callbacks",
-    "kernels", "regularizer", "utils", "version",
+    "kernels", "regularizer", "utils", "version", "inference", "native",
+    "models",
 )
 
 

@@ -1,0 +1,128 @@
+"""Error-handling layer (PADDLE_ENFORCE parity).
+
+Parity target: ``paddle/fluid/platform/enforce.h`` (+ ``init.cc`` signal
+handlers) in the reference: typed error classes, ``PADDLE_ENFORCE_*`` check
+macros that attach operator/file context, and fatal-signal stack dumps. TPU
+rebuild: a Python exception hierarchy matching the reference's error codes,
+``enforce*`` check helpers that record the calling frame, and
+``faulthandler``-based native-crash dumps (the PJRT plugin is C++ — a
+segfault there should still leave a python stack).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import sys
+
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+           "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+           "UnimplementedError", "UnavailableError", "ResourceExhaustedError",
+           "PreconditionNotMetError", "ExecutionTimeoutError", "FatalError",
+           "enforce", "enforce_eq", "enforce_gt", "enforce_not_none",
+           "install_signal_handlers"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base check failure (ref: platform::EnforceNotMet). Carries the calling
+    frame so the message reads like the reference's [operator ... at file:line]
+    context block."""
+
+    error_code = "ENFORCE_NOT_MET"
+
+    def __init__(self, message: str, frame=None):
+        if frame is None:
+            f = sys._getframe(2) if sys._getframe(1).f_code.co_filename == \
+                __file__ else sys._getframe(1)
+            frame = (f.f_code.co_filename, f.f_lineno, f.f_code.co_name)
+        fname, lineno, func = frame
+        super().__init__(
+            f"{message}\n  [Hint: raised from {func} at {fname}:{lineno}] "
+            f"(error code: {self.error_code})")
+        self.frame = frame
+
+
+class InvalidArgumentError(EnforceNotMet):
+    error_code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    error_code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    error_code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    error_code = "ALREADY_EXISTS"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    error_code = "PERMISSION_DENIED"
+
+
+class UnimplementedError(EnforceNotMet):
+    error_code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    error_code = "UNAVAILABLE"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    error_code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    error_code = "PRECONDITION_NOT_MET"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    error_code = "EXECUTION_TIMEOUT"
+
+
+class FatalError(EnforceNotMet):
+    error_code = "FATAL"
+
+
+def enforce(condition, message: str = "enforce failed",
+            exc: type = EnforceNotMet):
+    """ref: PADDLE_ENFORCE(cond, msg)."""
+    if not condition:
+        raise exc(message)
+
+
+def enforce_eq(a, b, message: str = ""):
+    if a != b:
+        raise InvalidArgumentError(
+            f"expected {a!r} == {b!r}" + (f": {message}" if message else ""))
+
+
+def enforce_gt(a, b, message: str = ""):
+    if not a > b:
+        raise InvalidArgumentError(
+            f"expected {a!r} > {b!r}" + (f": {message}" if message else ""))
+
+
+def enforce_not_none(value, message: str = ""):
+    if value is None:
+        raise NotFoundError(message or "expected a value, got None")
+    return value
+
+
+_handlers_installed = False
+
+
+def install_signal_handlers():
+    """ref: paddle/fluid/platform/init.cc InitSignalHandler — dump the python
+    stack of every thread on SIGSEGV/SIGFPE/SIGABRT/SIGBUS (native crashes in
+    the C++ PJRT layer otherwise die silently)."""
+    global _handlers_installed
+    if not _handlers_installed:
+        faulthandler.enable(all_threads=True)
+        _handlers_installed = True
+
+
+# installed at import (matching the reference: the framework installs its
+# handler during paddle.base init)
+install_signal_handlers()

@@ -20,6 +20,7 @@ from .topology import (HybridCommunicateGroup, build_mesh,
                        set_hybrid_communicate_group)
 from . import checkpoint
 from . import fleet
+from . import rpc
 from . import sharding
 from .checkpoint import load_state_dict, save_state_dict
 from .context_parallel import sep_parallel_attention

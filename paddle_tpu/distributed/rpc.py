@@ -1,0 +1,190 @@
+"""``paddle.distributed.rpc`` parity.
+
+Parity target: ``python/paddle/distributed/rpc/`` in the reference (brpc-
+based ``init_rpc``/``rpc_sync``/``rpc_async``/``shutdown`` with named
+workers). TPU rebuild: the transport is the framework's own **native C++
+TCPStore** (``native/tcp_store.cc``) — requests/responses are pickled
+payloads exchanged through store keys, each worker runs a serving thread
+draining its ordered request sequence. Functions must be picklable by
+reference (module-level), matching the reference's constraint.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+class _RpcState:
+    def __init__(self):
+        self.store = None          # rank 0 additionally hosts the server
+        self.host = None
+        self.port = 0
+        self.name = None
+        self.rank = -1
+        self.world_size = 0
+        self.server_thread = None
+        self.stopping = False
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.tls = threading.local()
+
+
+_state = _RpcState()
+
+
+def _client():
+    """Per-thread store connection. A TCPStore client is one socket with a
+    strict request/response protocol — two threads sharing it (the serve
+    loop's blocking get vs a caller's set) would interleave frames and
+    deadlock, so every thread lazily opens its own connection."""
+    c = getattr(_state.tls, "client", None)
+    if c is None:
+        from ..native import TCPStore
+        c = TCPStore(_state.host, _state.port)
+        _state.tls.client = c
+    return c
+
+
+def _serve(state: _RpcState):
+    store = _client()  # this thread's own connection
+    seq = 0
+    while True:
+        raw = store.get(f"__rpc/{state.name}/req/{seq}")
+        try:
+            req = pickle.loads(raw)
+            if req.get("op") == "__shutdown__":
+                return
+            fn = req["fn"]
+            result = ("ok", fn(*req.get("args", ()), **req.get("kwargs", {})))
+        except Exception as e:  # noqa: BLE001 — errors travel to the caller
+            result = ("err", f"{type(e).__name__}: {e}")
+        store.set(f"__rpc/{state.name}/res/{seq}", pickle.dumps(result))
+        seq += 1
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Join the RPC group. Rank 0 hosts the store at ``master_endpoint``
+    (host:port; port 0 = auto on localhost for single-host tests)."""
+    from ..native import TCPStore
+    import os
+    if _state.store is not None:
+        raise RuntimeError("init_rpc already called; shutdown() first")
+    rank = int(rank if rank is not None
+               else os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = int(world_size if world_size is not None
+                     else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    endpoint = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                                 "127.0.0.1:0")
+    host, port = endpoint.rsplit(":", 1)
+    if rank == 0:
+        store = TCPStore(host, int(port), is_master=True)
+    else:
+        store = TCPStore(host, int(port))
+    _state.store = store
+    _state.host = host
+    _state.port = store.port
+    _state.tls = threading.local()
+    _state.tls.client = store  # main thread reuses the bootstrap connection
+    _state.name = name
+    _state.rank = rank
+    _state.world_size = world_size
+    _state.stopping = False
+    store.set(f"__rpc/worker/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, host, store.port)))
+    _state.server_thread = threading.Thread(
+        target=_serve, args=(_state,), daemon=True)
+    _state.server_thread.start()
+    # rendezvous: learn every worker's name
+    for r in range(world_size):
+        info: WorkerInfo = pickle.loads(store.get(f"__rpc/worker/{r}"))
+        _state.workers[info.name] = info
+    store.barrier("__rpc_init", world_size)
+
+
+def _check_ready():
+    if _state.store is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+
+
+def _send(to: str, fn, args, kwargs) -> int:
+    _check_ready()
+    if to not in _state.workers:
+        raise ValueError(f"unknown worker {to!r}; known: "
+                         f"{sorted(_state.workers)}")
+    c = _client()
+    seq = c.add(f"__rpc/{to}/seq", 1) - 1
+    payload = pickle.dumps({"fn": fn, "args": args, "kwargs": kwargs or {}})
+    c.set(f"__rpc/{to}/req/{seq}", payload)
+    return seq
+
+
+def _recv(to: str, seq: int):
+    status, value = pickle.loads(_client().get(f"__rpc/{to}/res/{seq}"))
+    if status == "err":
+        raise RuntimeError(f"rpc to {to!r} failed remotely: {value}")
+    return value
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = -1):
+    """Run ``fn(*args, **kwargs)`` on worker ``to`` and return its result."""
+    return _recv(to, _send(to, fn, args, kwargs))
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = -1) -> Future:
+    """Like rpc_sync but returns a Future (``.wait()``/``.result()``)."""
+    seq = _send(to, fn, args, kwargs)
+    fut: Future = Future()
+
+    def waiter():
+        try:
+            fut.set_result(_recv(to, seq))
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=waiter, daemon=True).start()
+    fut.wait = fut.result  # reference API name
+    return fut
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    _check_ready()
+    if name is None:
+        return _state.workers[_state.name]
+    return _state.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    _check_ready()
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def shutdown(graceful: bool = True) -> None:
+    """Stop serving and (on rank 0) the store. Barrier-synchronized."""
+    if _state.store is None:
+        return
+    if graceful:
+        _state.store.barrier("__rpc_shutdown", _state.world_size)
+    # poison my own server thread
+    seq = _state.store.add(f"__rpc/{_state.name}/seq", 1) - 1
+    _state.store.set(f"__rpc/{_state.name}/req/{seq}",
+                     pickle.dumps({"op": "__shutdown__"}))
+    _state.server_thread.join(timeout=10)
+    _state.store.close()
+    _state.store = None
+    _state.workers.clear()

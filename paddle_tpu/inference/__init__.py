@@ -1,0 +1,174 @@
+"""``paddle.inference`` parity: the deployment predictor API.
+
+Parity target: ``paddle/fluid/inference/api/analysis_predictor.cc`` +
+``paddle_infer`` Python surface in the reference (Config, create_predictor,
+Predictor with named input/output handles, zero-copy IO). TPU redesign
+(SURVEY §7 scope): the serving artifact is the StableHLO export written by
+``paddle.jit.save`` — the predictor loads it through ``jit.load`` and runs
+the compiled XLA executable; the reference's IR fusion passes and TensorRT
+subgraphs are XLA's job here, so Config's GPU/TRT/MKLDNN knobs are accepted
+and recorded but have no effect (documented honestly, queryable).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version() -> str:
+    from ..version import full_version
+    return f"paddle_tpu inference {full_version}"
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """ref: paddle_infer.Config — model path pair + device/opt toggles."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._path_prefix = prog_file
+        self._params_file = params_file
+        self._records: Dict[str, object] = {}
+
+    # -- the knobs the reference exposes (recorded, honest no-ops on TPU) ----
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._records["use_gpu"] = False  # no CUDA on this stack
+
+    def disable_gpu(self):
+        self._records["use_gpu"] = False
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._records["tensorrt"] = False  # XLA owns fusion/lowering
+
+    def enable_mkldnn(self):
+        self._records["mkldnn"] = False
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._records["ir_optim"] = bool(flag)
+
+    def enable_memory_optim(self):
+        self._records["memory_optim"] = True
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._records["cpu_threads"] = int(n)
+
+    def model_dir(self):
+        return self._path_prefix
+
+    def prog_file(self):
+        return (self._path_prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._path_prefix or "") + ".pdiparams"
+
+    def summary(self) -> str:
+        return f"Config(path={self._path_prefix}, records={self._records})"
+
+
+class Tensor:
+    """Named IO handle (ref: paddle_infer.Tensor zero-copy handles)."""
+
+    def __init__(self, name: str, slot: Dict):
+        self._name = name
+        self._slot = slot
+
+    def name(self) -> str:
+        return self._name
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._slot["value"] = np.ascontiguousarray(data)
+
+    def reshape(self, shape):
+        v = self._slot.get("value")
+        if v is not None:
+            self._slot["value"] = v.reshape(shape)
+        else:
+            self._slot["shape"] = list(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._slot["value"])
+
+    def shape(self) -> List[int]:
+        v = self._slot.get("value")
+        return list(v.shape) if v is not None else self._slot.get("shape", [])
+
+
+class Predictor:
+    """ref: paddle_infer.Predictor over the StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit.api import load as jit_load
+        if config._path_prefix is None:
+            raise ValueError("Config needs the model path prefix "
+                             "(the paddle.jit.save output)")
+        self._layer = jit_load(config._path_prefix)
+        import pickle
+        with open(config.prog_file(), "rb") as f:
+            meta = pickle.load(f)
+        self._input_specs = meta.get("input_specs", [])
+        self._input_names = [s[2] or f"x{i}"
+                             for i, s in enumerate(self._input_specs)]
+        self._inputs: Dict[str, Dict] = {n: {} for n in self._input_names}
+        self._outputs: List[Dict] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; inputs: "
+                           f"{self._input_names}")
+        return Tensor(name, self._inputs[name])
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, v in zip(self._input_names, inputs):
+                self._inputs[n]["value"] = np.asarray(v)
+        args = []
+        for n in self._input_names:
+            v = self._inputs[n].get("value")
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set; use "
+                                   f"get_input_handle(...).copy_from_cpu")
+            args.append(v)
+        outs = self._layer(*args)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        self._outputs = [{"value": np.asarray(o.numpy() if hasattr(o, "numpy")
+                                              else o)} for o in outs]
+        if inputs is not None:
+            return [o["value"] for o in self._outputs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return [f"out{i}" for i in range(len(self._outputs))] or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        idx = int(name.replace("out", "") or 0)
+        if not self._outputs:
+            raise RuntimeError("run() the predictor before reading outputs")
+        return Tensor(name, self._outputs[idx])
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -455,3 +455,108 @@ class TestMoE:
         gate = GShardGate(4, 2, capacity_factor=0.25)
         cap = gate.capacity(8)  # 8 tokens * 0.25 * 2 / 2 = 2
         assert cap == 2
+
+
+class TestInferencePredictor:
+    def test_save_then_predict(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu.jit import InputSpec, save
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        path = str(tmp_path / "model")
+        save(net, path, input_spec=[InputSpec([None, 8], "float32", "x")])
+
+        cfg = inference.Config(path)
+        cfg.enable_memory_optim()
+        pred = inference.create_predictor(cfg)
+        assert pred.get_input_names() == ["x"]
+        x = np.random.randn(3, 8).astype("float32")
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle("out0").copy_to_cpu()
+        expect = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, expect, atol=1e-5)
+
+    def test_run_list_api(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu.jit import InputSpec, save
+        net = nn.Linear(4, 2)
+        net.eval()
+        path = str(tmp_path / "m2")
+        save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+        pred = inference.create_predictor(inference.Config(path))
+        x = np.random.randn(2, 4).astype("float32")
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], net(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-5)
+
+
+def _rpc_double(x):
+    return x * 2
+
+
+def _rpc_raise():
+    raise ValueError("remote boom")
+
+
+class TestRPC:
+    def test_sync_async_and_errors(self):
+        from paddle_tpu.distributed import rpc
+        import multiprocessing as mp
+        from paddle_tpu.native import TCPStore
+        # reserve a port by binding a store briefly
+        probe = TCPStore(is_master=True)
+        port = probe.port
+        probe.close()
+        ep = f"127.0.0.1:{port}"
+
+        def child():
+            from paddle_tpu.distributed import rpc as r
+            r.init_rpc("worker1", rank=1, world_size=2, master_endpoint=ep)
+            r.shutdown()
+
+        p = mp.get_context("fork").Process(target=child)
+        p.start()
+        rpc.init_rpc("worker0", rank=0, world_size=2, master_endpoint=ep)
+        try:
+            assert rpc.rpc_sync("worker1", _rpc_double, args=(21,)) == 42
+            fut = rpc.rpc_async("worker1", _rpc_double, args=(5,))
+            assert fut.wait() == 10
+            # self-call works too
+            assert rpc.rpc_sync("worker0", _rpc_double, args=(1,)) == 2
+            with pytest.raises(RuntimeError, match="remote boom"):
+                rpc.rpc_sync("worker1", _rpc_raise)
+            infos = rpc.get_all_worker_infos()
+            assert [w.name for w in infos] == ["worker0", "worker1"]
+        finally:
+            rpc.shutdown()
+            p.join(timeout=30)
+        assert p.exitcode == 0
+
+
+class TestEnforce:
+    def test_error_types_and_context(self):
+        from paddle_tpu.core import enforce as E
+        with pytest.raises(E.EnforceNotMet, match="error code"):
+            E.enforce(False, "broken invariant")
+        with pytest.raises(E.InvalidArgumentError, match="expected 1"):
+            E.enforce_eq(1, 2)
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce_gt(1, 2)
+        with pytest.raises(E.NotFoundError):
+            E.enforce_not_none(None, "missing thing")
+        assert E.enforce_not_none(5) == 5
+        try:
+            E.enforce(False, "ctx check")
+        except E.EnforceNotMet as e:
+            assert "test_surface.py" in str(e)  # calling frame recorded
+
+    def test_signal_handlers_installed(self):
+        import faulthandler
+        from paddle_tpu.core import enforce as E
+        E.install_signal_handlers()
+        assert faulthandler.is_enabled()
