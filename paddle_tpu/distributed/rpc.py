@@ -64,6 +64,7 @@ def _serve(state: _RpcState):
     seq = 0
     while True:
         raw = store.get(f"__rpc/{state.name}/req/{seq}")
+        store.delete_key(f"__rpc/{state.name}/req/{seq}")  # bound store memory
         try:
             req = pickle.loads(raw)
             if req.get("op") == "__shutdown__":
@@ -135,7 +136,9 @@ def _send(to: str, fn, args, kwargs) -> int:
 
 
 def _recv(to: str, seq: int):
-    status, value = pickle.loads(_client().get(f"__rpc/{to}/res/{seq}"))
+    c = _client()
+    status, value = pickle.loads(c.get(f"__rpc/{to}/res/{seq}"))
+    c.delete_key(f"__rpc/{to}/res/{seq}")  # bound store memory
     if status == "err":
         raise RuntimeError(f"rpc to {to!r} failed remotely: {value}")
     return value
@@ -185,6 +188,10 @@ def shutdown(graceful: bool = True) -> None:
     _state.store.set(f"__rpc/{_state.name}/req/{seq}",
                      pickle.dumps({"op": "__shutdown__"}))
     _state.server_thread.join(timeout=10)
+    if graceful:
+        # rank 0 hosts the master server: it must not close until EVERY rank
+        # has finished its own poison/join traffic above
+        _state.store.barrier("__rpc_shutdown_done", _state.world_size)
     _state.store.close()
     _state.store = None
     _state.workers.clear()

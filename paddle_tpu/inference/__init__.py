@@ -123,10 +123,7 @@ class Predictor:
             raise ValueError("Config needs the model path prefix "
                              "(the paddle.jit.save output)")
         self._layer = jit_load(config._path_prefix)
-        import pickle
-        with open(config.prog_file(), "rb") as f:
-            meta = pickle.load(f)
-        self._input_specs = meta.get("input_specs", [])
+        self._input_specs = getattr(self._layer, "_input_specs", [])
         self._input_names = [s[2] or f"x{i}"
                              for i, s in enumerate(self._input_specs)]
         self._inputs: Dict[str, Dict] = {n: {} for n in self._input_names}

@@ -320,4 +320,8 @@ def load(path: str, **configs) -> TranslatedLayer:
         weights = pickle.load(f)
     exported = jexport.deserialize(meta["stablehlo"])
     params = [jnp.asarray(weights[n]) for n in meta["param_names"]]
-    return TranslatedLayer(exported, params, meta["param_names"])
+    tl = TranslatedLayer(exported, params, meta["param_names"])
+    # consumers (inference.Predictor) read these without re-unpickling the
+    # whole artifact (the stablehlo blob dominates the file)
+    tl._input_specs = meta.get("input_specs", [])
+    return tl

@@ -137,6 +137,11 @@ class TCPStore:
         n = self._L.tcp_store_get(self._client, key.encode(), buf, cap)
         if n < 0:
             raise RuntimeError("TCPStore.get failed")
+        if n > cap:  # value larger than the probe buffer: refetch full size
+            buf = ctypes.create_string_buffer(n)
+            n2 = self._L.tcp_store_get(self._client, key.encode(), buf, n)
+            if n2 != n:
+                raise RuntimeError("TCPStore.get failed on refetch")
         return buf.raw[:n]
 
     def add(self, key: str, amount: int = 1) -> int:

@@ -164,8 +164,9 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
         return forward_op("trapezoid",
                           lambda v, xv: jnp.trapezoid(v, xv, axis=axis),
                           [t, ensure_tensor(x)])
+    d = 1.0 if dx is None else dx
     return forward_op("trapezoid",
-                      lambda v: jnp.trapezoid(v, dx=dx or 1.0, axis=axis), [t])
+                      lambda v: jnp.trapezoid(v, dx=d, axis=axis), [t])
 
 
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
@@ -180,7 +181,7 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
         if xv is not None:
             d = xv[tuple(sl1)] - xv[tuple(sl2)]
         else:
-            d = dx or 1.0
+            d = 1.0 if dx is None else dx
         return jnp.cumsum(avg * d, axis=axis)
     if x is not None:
         return forward_op("cumulative_trapezoid", f, [t, ensure_tensor(x)])
@@ -252,19 +253,16 @@ def pdist(x, p=2.0, name=None):
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
+    """Host-side (non-differentiable counting op — one numpy pass yields
+    both histogram and edges)."""
     t = ensure_tensor(x)
-    w = None if weights is None else ensure_tensor(weights)
-
-    def f(v, wv=None):
-        return jnp.histogramdd(v, bins=bins, range=ranges, density=density,
-                               weights=wv)
-    args = [t] if w is None else [t, w]
-    hist, edges = forward_op("histogramdd", lambda *a: f(*a)[0], args,
-                             differentiable=False), None
-    import numpy as _np
-    edges_np = _np.histogramdd(_np.asarray(t._value), bins=bins, range=ranges)[1]
+    w = None if weights is None else np.asarray(ensure_tensor(weights)._value)
+    hist_np, edges_np = np.histogramdd(np.asarray(t._value), bins=bins,
+                                       range=ranges, density=density,
+                                       weights=w)
     from ..core.tensor import Tensor
-    return hist, [Tensor(jnp.asarray(e)) for e in edges_np]
+    return (Tensor(jnp.asarray(hist_np.astype(np.float32))),
+            [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges_np])
 
 
 def cartesian_prod(x, name=None):
@@ -289,24 +287,12 @@ def combinations(x, r=2, with_replacement=False, name=None):
     return forward_op("combinations", f, [t])
 
 
-# -- complex views -----------------------------------------------------------
+# -- complex views (single source of truth in ops/manipulation.py) -----------
 
-def view_as_complex(x, name=None):
-    """[..., 2] float -> complex (ref: paddle.as_complex)."""
-    return forward_op(
-        "view_as_complex",
-        lambda v: jax.lax.complex(v[..., 0], v[..., 1]), [ensure_tensor(x)])
+from .manipulation import as_complex, as_real  # noqa: E402
 
-
-def view_as_real(x, name=None):
-    return forward_op(
-        "view_as_real",
-        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
-        [ensure_tensor(x)])
-
-
-as_complex = view_as_complex
-as_real = view_as_real
+view_as_complex = as_complex
+view_as_real = as_real
 
 
 def polar(abs, angle, name=None):  # noqa: A002

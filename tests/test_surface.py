@@ -560,3 +560,52 @@ class TestEnforce:
         from paddle_tpu.core import enforce as E
         E.install_signal_handlers()
         assert faulthandler.is_enabled()
+
+
+def _rpc_big(n):
+    return np.zeros(n, np.uint8) + 7
+
+
+class TestReviewFixesRound2b:
+    def test_trapezoid_dx_zero(self):
+        y = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        assert float(paddle.trapezoid(y, dx=0.0)) == 0.0
+
+    def test_tcp_store_large_value(self):
+        from paddle_tpu.native import TCPStore
+        s = TCPStore(is_master=True)
+        try:
+            big = bytes(range(256)) * (8 * 1024)  # 2MB > 1MB probe buffer
+            s.set("big", big)
+            assert s.get("big") == big
+        finally:
+            s.close()
+
+    def test_rpc_large_payload_and_cleanup(self):
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.native import TCPStore
+        probe = TCPStore(is_master=True)
+        port = probe.port
+        probe.close()
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{port}")
+        try:
+            out = rpc.rpc_sync("solo", _rpc_big, args=(3 * 1024 * 1024,))
+            assert out.shape == (3 * 1024 * 1024,) and out[0] == 7
+            # req/res keys cleaned up after the exchange
+            assert not rpc._client().check("__rpc/solo/req/0")
+            assert not rpc._client().check("__rpc/solo/res/0")
+        finally:
+            rpc.shutdown()
+
+    def test_histogramdd_edges_consistent(self):
+        x = np.random.randn(100, 2).astype("float32")
+        hist, edges = paddle.histogramdd(paddle.to_tensor(x), bins=5)
+        ref_h, ref_e = np.histogramdd(x, bins=5)
+        np.testing.assert_allclose(hist.numpy(), ref_h, atol=1e-5)
+        for e, re_ in zip(edges, ref_e):
+            np.testing.assert_allclose(e.numpy(), re_, atol=1e-4)
+
+    def test_as_complex_single_source(self):
+        from paddle_tpu.ops import extras, manipulation
+        assert extras.view_as_complex is manipulation.as_complex
