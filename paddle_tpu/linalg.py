@@ -1,7 +1,8 @@
 """paddle.linalg namespace (parity: python/paddle/tensor/linalg.py public exports +
 python/paddle/linalg.py in the reference)."""
 
-from .ops.linalg import (bmm, cholesky, cholesky_solve, cond, corrcoef, cov, det,
+from .ops.linalg import (lu, lu_unpack, matrix_exp, ormqr,
+                         svd_lowrank, bmm, cholesky, cholesky_solve, cond, corrcoef, cov, det,
                          dist, eig, eigh, eigvals, eigvalsh, einsum,
                          householder_product, inv, lstsq, matmul, matrix_norm,
                          matrix_power, matrix_rank, multi_dot, mv, norm, pinv, qr,
@@ -14,5 +15,6 @@ __all__ = [
     "eig", "eigh", "eigvals", "eigvalsh", "einsum", "householder_product", "inv",
     "lstsq", "matmul", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
     "mv", "norm", "pinv", "qr", "slogdet", "solve", "svd", "svdvals", "t",
-    "triangular_solve", "vector_norm", "cross", "dot",
+    "triangular_solve", "vector_norm", "cross", "dot", "lu",
+    "lu_unpack", "matrix_exp", "ormqr", "svd_lowrank",
 ]

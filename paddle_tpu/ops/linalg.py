@@ -253,3 +253,94 @@ patch_methods([
     ("cholesky", cholesky), ("inv", inv), ("pinv", pinv), ("det", det),
     ("matrix_power", matrix_power),
 ])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (ref: paddle.linalg.lu — packed LU + pivots[+infos])."""
+    t = ensure_tensor(x)
+
+    def f(v):
+        lu_mat, piv, _ = jax.lax.linalg.lu(v)
+        # jax returns 0-based row-permutation indices; reference returns
+        # 1-based pivots (LAPACK convention)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    lu_mat, pivots = forward_op("lu", f, [t])
+    if get_infos:
+        from .creation import zeros
+        infos = zeros(list(t.shape[:-2]) or [1], "int32")
+        return lu_mat, pivots, infos
+    return lu_mat, pivots
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack packed LU + pivots into (P, L, U) (ref: paddle.linalg.lu_unpack)."""
+    lu_t, piv_t = ensure_tensor(x), ensure_tensor(y)
+    m, n = lu_t.shape[-2], lu_t.shape[-1]
+    k = min(m, n)
+
+    def f(lu_mat, piv):
+        eye_m = jnp.eye(m, dtype=lu_mat.dtype)
+        l = jnp.tril(lu_mat[..., :, :k], -1) + eye_m[..., :, :k]  # noqa: E741
+        u = jnp.triu(lu_mat[..., :k, :])
+        piv0 = piv.astype(jnp.int32) - 1  # back to 0-based
+
+        def perm_one(p):
+            perm = jnp.arange(m)
+            def body(i, perm):
+                a = perm[i]
+                b = perm[p[i]]
+                return perm.at[i].set(b).at[p[i]].set(a)
+            return jax.lax.fori_loop(0, p.shape[0], body, perm)
+        batch_shape = piv0.shape[:-1]
+        if batch_shape:
+            perm = jnp.reshape(
+                jax.vmap(perm_one)(piv0.reshape(-1, piv0.shape[-1])),
+                batch_shape + (m,))
+        else:
+            perm = perm_one(piv0)
+        p_mat = jax.nn.one_hot(perm, m, dtype=lu_mat.dtype)
+        p_mat = jnp.swapaxes(p_mat, -1, -2)
+        return p_mat, l, u
+    return forward_op("lu_unpack", f, [lu_t, piv_t])
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (ref: paddle.linalg.matrix_exp; jax.scipy expm)."""
+    from jax.scipy.linalg import expm
+    return forward_op("matrix_exp", expm, [ensure_tensor(x)])
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply by Q from a QR Householder factorization
+    (ref: paddle.linalg.ormqr): Q @ other / Q^T @ other / other @ Q."""
+    a, tt, c = ensure_tensor(x), ensure_tensor(tau), ensure_tensor(other)
+
+    def f(av, tv, cv):
+        q = jax.lax.linalg.householder_product(av, tv)
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ cv if left else cv @ q
+    return forward_op("ormqr", f, [a, tt, c])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (ref: paddle.linalg.svd_lowrank; Halko et al.
+    subspace iteration). Returns (U [m,q], S [q], V [n,q])."""
+    t = ensure_tensor(x)
+    m, n = t.shape[-2], t.shape[-1]
+    q = min(q, m, n)
+    from .random import _next_key
+    key = _next_key()
+
+    def f(v, mv=None):
+        a = v if mv is None else v - mv
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ ub, s, jnp.swapaxes(vt, -1, -2)
+    args = [t] if M is None else [t, ensure_tensor(M)]
+    return forward_op("svd_lowrank", f, args)

@@ -395,3 +395,51 @@ class TestExtras:
         y.backward()
         assert x.grad is not None
         assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestLinalgExtras:
+    def test_lu_and_unpack_reconstruct(self):
+        from paddle_tpu import linalg
+        a = np.random.randn(5, 5).astype("float32")
+        lu_mat, piv = linalg.lu(paddle.to_tensor(a))
+        p, l, u = linalg.lu_unpack(lu_mat, piv)
+        np.testing.assert_allclose(p.numpy() @ l.numpy() @ u.numpy(), a,
+                                   atol=1e-4)
+
+    def test_lu_get_infos(self):
+        from paddle_tpu import linalg
+        a = np.random.randn(3, 3).astype("float32")
+        _, _, infos = linalg.lu(paddle.to_tensor(a), get_infos=True)
+        assert (infos.numpy() == 0).all()
+
+    def test_matrix_exp(self):
+        from paddle_tpu import linalg
+        import scipy.linalg as sla
+        a = np.random.randn(4, 4).astype("float32") * 0.3
+        got = linalg.matrix_exp(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(got, sla.expm(a), atol=1e-4, rtol=1e-4)
+
+    def test_ormqr_matches_explicit_q(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu import linalg
+        a = np.random.randn(6, 4).astype("float32")
+        from jax._src.lax.linalg import geqrf
+        packed, tau = geqrf(jnp.asarray(a))
+        c = np.random.randn(6, 3).astype("float32")
+        got = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
+                           paddle.to_tensor(c)).numpy()
+        q = np.asarray(jax.lax.linalg.householder_product(packed, tau))
+        np.testing.assert_allclose(got, q @ c, atol=1e-4)
+        got_t = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
+                             paddle.to_tensor(c), transpose=True).numpy()
+        np.testing.assert_allclose(got_t, q.T @ c, atol=1e-4)
+
+    def test_svd_lowrank_approximates(self):
+        from paddle_tpu import linalg
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((20, 3)).astype("float32") @ \
+            rng.standard_normal((3, 15)).astype("float32")  # rank 3
+        u, s, v = linalg.svd_lowrank(paddle.to_tensor(base), q=5)
+        approx = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(approx, base, atol=1e-3, rtol=1e-3)
