@@ -311,15 +311,37 @@ def matrix_exp(x, name=None):
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):
-    """Multiply by Q from a QR Householder factorization
-    (ref: paddle.linalg.ormqr): Q @ other / Q^T @ other / other @ Q."""
+    """Multiply by the FULL m x m Q of a Householder QR factorization
+    (ref: paddle.linalg.ormqr / LAPACK ormqr): the k reflectors stored in
+    ``x``'s lower trapezoid are applied to ``other`` directly — the thin Q
+    from householder_product cannot represent full-Q products."""
     a, tt, c = ensure_tensor(x), ensure_tensor(tau), ensure_tensor(other)
+    if a.ndim != 2 or c.ndim != 2:
+        raise ValueError("ormqr: batched inputs are not supported; got "
+                         f"ndim {a.ndim}/{c.ndim}")
 
     def f(av, tv, cv):
-        q = jax.lax.linalg.householder_product(av, tv)
-        if transpose:
-            q = jnp.swapaxes(q, -1, -2)
-        return q @ cv if left else cv @ q
+        m = av.shape[0]
+        k = tv.shape[0]
+        rows = jnp.arange(m)
+
+        def reflect(i, mat):
+            col = jnp.take(av, i, axis=1)
+            v = jnp.where(rows > i, col, jnp.where(rows == i, 1.0, 0.0))
+            w = v @ mat                      # [n]
+            return mat - jnp.take(tv, i) * jnp.outer(v, w)
+
+        def apply_q(mat, trans):
+            # Q = H_0 H_1 ... H_{k-1}; Q @ C applies reflectors right-to-left
+            def body(j, mat):
+                i = j if trans else k - 1 - j
+                return reflect(i, mat)
+            return jax.lax.fori_loop(0, k, body, mat)
+
+        if left:
+            return apply_q(cv, transpose)
+        # C @ Q = (Q^T C^T)^T ; C @ Q^T = (Q C^T)^T
+        return apply_q(cv.T, not transpose).T
     return forward_op("ormqr", f, [a, tt, c])
 
 

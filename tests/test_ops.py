@@ -427,13 +427,25 @@ class TestLinalgExtras:
         from jax._src.lax.linalg import geqrf
         packed, tau = geqrf(jnp.asarray(a))
         c = np.random.randn(6, 3).astype("float32")
+        # oracle: full Q from reconstructing the factorization
+        r = np.triu(np.asarray(packed))[:4, :]
+        q_thin = np.asarray(jax.lax.linalg.householder_product(packed, tau))
+        np.testing.assert_allclose(q_thin @ r, a, atol=1e-4)  # sanity
         got = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
                            paddle.to_tensor(c)).numpy()
-        q = np.asarray(jax.lax.linalg.householder_product(packed, tau))
-        np.testing.assert_allclose(got, q @ c, atol=1e-4)
+        # thin-Q columns of full Q: (Q @ C) restricted check via Q^T relation
         got_t = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
                              paddle.to_tensor(c), transpose=True).numpy()
-        np.testing.assert_allclose(got_t, q.T @ c, atol=1e-4)
+        # Q^T @ (Q @ C) == C (orthogonality of the full Q)
+        back = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
+                            paddle.to_tensor(got), transpose=True).numpy()
+        np.testing.assert_allclose(back, c, atol=1e-4)
+        # first k rows of Q^T C equal thin-Q^T C
+        np.testing.assert_allclose(got_t[:4], q_thin.T @ c, atol=1e-4)
+        # right-multiplication consistency: (C^T Q)^T == Q^T C
+        got_r = linalg.ormqr(paddle.to_tensor(packed), paddle.to_tensor(tau),
+                             paddle.to_tensor(c.T), left=False).numpy()
+        np.testing.assert_allclose(got_r.T, got_t, atol=1e-4)
 
     def test_svd_lowrank_approximates(self):
         from paddle_tpu import linalg
