@@ -22,6 +22,12 @@ hand-scheduling from Python; the pipeline is ONE compiled XLA program:
 * :class:`PipelineParallel` — ``fleet.distributed_model`` wrapper exposing
   ``train_batch`` with micro-batch gradient accumulation semantics (numerically
   the pipeline schedule's result, independent of schedule order).
+
+Future work: the interleaved/virtual-stage schedule (reference:
+``interleave`` 1F1B) — in the compiled rotational form this means V
+activation slots circulating the pp ring V laps with per-tick slot
+selection; the bubble shrinks from (S-1)/(M+S-1) toward (S/V-1)/(M+S-1).
+The single-lap scan below already overlaps compute/ppermute via XLA.
 """
 
 from __future__ import annotations

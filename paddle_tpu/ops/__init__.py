@@ -16,12 +16,22 @@ _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
 
 
 def _export(module):
+    from ..core.dispatch import OP_REGISTRY, register_op
     names = []
     for k, v in vars(module).items():
         if k.startswith("_") or isinstance(v, _types.ModuleType) or k in _EXCLUDE:
             continue
+        if getattr(v, "__module__", "") == "typing":
+            continue  # leaked `from typing import ...` names are not ops
         globals()[k] = v
         names.append(k)
+        # complete the ops.yaml-equivalent schema registry (single source of
+        # truth for the surface: every public op is registered with its doc,
+        # whether factory-generated or hand-written)
+        if (callable(v) and not isinstance(v, type)
+                and getattr(v, "__module__", "") != "typing"
+                and k not in OP_REGISTRY):
+            register_op(k, v, doc=(v.__doc__ or "").strip())
     return names
 
 

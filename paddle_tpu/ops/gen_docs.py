@@ -1,0 +1,50 @@
+"""Generate the op-surface reference from the schema registry.
+
+The reference drives codegen (C++ API, grad nodes, bindings, docs) from
+``paddle/phi/api/yaml/ops.yaml``; here the registry IS the runtime op table
+(``core.dispatch.OP_REGISTRY``) and this generator derives the docs from it
+— one source of truth, no drift.
+
+    python -m paddle_tpu.ops.gen_docs [out_path]
+"""
+
+from __future__ import annotations
+
+import inspect
+import sys
+
+
+def generate(out_path: str = "docs/OPS.md") -> str:
+    import os
+
+    import paddle_tpu.ops  # populates the registry  # noqa: F401
+    from paddle_tpu.core.dispatch import OP_REGISTRY
+
+    lines = ["# Op surface reference",
+             "",
+             "Generated from `core.dispatch.OP_REGISTRY` (the ops.yaml-"
+             "equivalent single source of truth) by "
+             "`python -m paddle_tpu.ops.gen_docs`. Do not edit by hand.",
+             "",
+             f"{len(OP_REGISTRY)} registered ops.",
+             "",
+             "| op | signature | doc |",
+             "|---|---|---|"]
+    for name in sorted(OP_REGISTRY):
+        d = OP_REGISTRY[name]
+        try:
+            sig = str(inspect.signature(d.fn))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        doc = (d.doc or "").split("\n")[0].replace("|", "\\|")
+        lines.append(f"| `{name}` | `{sig}` | {doc} |")
+    text = "\n".join(lines) + "\n"
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(text)
+    return out_path
+
+
+if __name__ == "__main__":
+    path = generate(sys.argv[1] if len(sys.argv) > 1 else "docs/OPS.md")
+    print(f"wrote {path}")
