@@ -54,9 +54,17 @@ def init_parallel_env():
     import os
 
     if not _initialized and os.environ.get("PADDLE_TRAINERS_NUM", "1") not in ("", "1"):
-        # multi-host: the launcher sets the coordination env; jax.distributed
-        # wires every host's local devices into one global slice
-        jax.distributed.initialize()
+        # multi-host: consume the launcher's env contract (launch/main.py)
+        # explicitly — jax.distributed's own autodetect doesn't know the
+        # PADDLE_* names; the coordination service is the TCPStore equivalent
+        coord = os.environ.get("PADDLE_DIST_COORDINATOR")
+        kwargs = {}
+        if coord:
+            kwargs = dict(
+                coordinator_address=coord,
+                num_processes=int(os.environ["PADDLE_DIST_NUM_PROCESSES"]),
+                process_id=int(os.environ["PADDLE_DIST_PROCESS_ID"]))
+        jax.distributed.initialize(**kwargs)
     _initialized = True
     return None
 

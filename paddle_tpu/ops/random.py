@@ -23,11 +23,19 @@ from ._helpers import ensure_tensor, forward_op, patch_methods
 
 
 class Generator:
-    """Splittable-key RNG generator (``paddle.Generator`` parity)."""
+    """Splittable-key RNG generator (``paddle.Generator`` parity).
+
+    The key materializes on first use, NOT at construction: the module-level
+    default generator must not initialize the XLA backend at import time
+    (jax.distributed.initialize must run before any backend touch)."""
 
     def __init__(self, seed: int = 0):
-        self.key = jax.random.PRNGKey(seed)
+        self.key = None
         self._seed = seed
+
+    def _ensure(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(self._seed)
 
     def manual_seed(self, seed: int):
         self.key = jax.random.PRNGKey(seed)
@@ -38,10 +46,12 @@ class Generator:
         return self._seed
 
     def next_key(self):
+        self._ensure()
         self.key, sub = jax.random.split(self.key)
         return sub
 
     def get_state(self):
+        self._ensure()
         return to_tensor(self.key)
 
     def set_state(self, state):

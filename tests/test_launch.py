@@ -90,3 +90,41 @@ class TestLaunch:
         assert out.returncode == 0
         assert "hello from child" in \
             (tmp_path / "log" / "workerlog.0").read_text()
+
+
+class TestLaunchDistributedInit:
+    def test_two_process_collective(self, tmp_path):
+        """End to end: the launcher's env contract drives
+        init_parallel_env -> jax.distributed -> a real cross-process
+        collective on the multi-process CPU backend (the reference's
+        Gloo-on-localhost CI pattern, SURVEY §4)."""
+        script = _script(tmp_path, """
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            from paddle_tpu.distributed import init_parallel_env
+            init_parallel_env()
+            assert jax.process_count() == 2, jax.process_count()
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+            total = multihost_utils.process_allgather(
+                jnp.asarray([jax.process_index() + 1.0]))
+            assert float(total.sum()) == 3.0, total  # 1 + 2
+            print("COLLECTIVE_OK rank", jax.process_index())
+        """)
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)  # children must not grab the TPU
+        try:
+            rc = launch_procs(_args(tmp_path, script,
+                                    "--nproc_per_node", "2"))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        logs = [(tmp_path / "log" / f"workerlog.{r}").read_text()
+                for r in range(2)]
+        assert rc == 0, logs
+        for r in range(2):
+            assert "COLLECTIVE_OK" in logs[r], logs[r]
