@@ -15,12 +15,16 @@ from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
                    conv3d_transpose)
 from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,
                    cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
-                   hinge_embedding_loss, kl_div, l1_loss, log_loss,
-                   margin_ranking_loss, mse_loss, nll_loss, sigmoid_focal_loss,
-                   smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
+                   gaussian_nll_loss, hinge_embedding_loss, huber_loss, kl_div,
+                   l1_loss, log_loss, margin_ranking_loss, mse_loss,
+                   multi_label_soft_margin_loss, nll_loss, poisson_nll_loss,
+                   sigmoid_focal_loss, smooth_l1_loss, soft_margin_loss,
+                   softmax_with_cross_entropy, square_error_cost,
                    triplet_margin_loss)
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,
                    local_response_norm, rms_norm, spectral_norm)
+from .vision import (affine_grid, bilinear, feature_alpha_dropout, fold,
+                     grid_sample, temporal_shift)
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
                       avg_pool1d, avg_pool2d, avg_pool3d, lp_pool2d, max_pool1d,

@@ -341,3 +341,72 @@ def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
 
     return forward_op("dice_loss", impl, [ensure_tensor(input), ensure_tensor(label)])
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    """ref: paddle.nn.functional.huber_loss (quadratic within delta)."""
+    x, y = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+        return _reduce(out, reduction)
+    return forward_op("huber_loss", f, [x, y])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    """ref: soft_margin_loss — log(1 + exp(-y * x)) with y in {-1, 1}."""
+    x, y = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, b):
+        return _reduce(jnp.log1p(jnp.exp(-b * a)), reduction)
+    return forward_op("soft_margin_loss", f, [x, y])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    """ref: multi-label one-vs-all BCE-with-logits averaged over classes."""
+    x, y = ensure_tensor(input), ensure_tensor(label)
+    w = None if weight is None else ensure_tensor(weight)
+
+    def f(a, b, wv=None):
+        per = -(b * jax.nn.log_sigmoid(a) + (1 - b) * jax.nn.log_sigmoid(-a))
+        if wv is not None:
+            per = per * wv
+        return _reduce(per.mean(axis=-1), reduction)
+    args = [x, y] if w is None else [x, y, w]
+    return forward_op("multi_label_soft_margin_loss", f, args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    """ref: poisson_nll_loss (Stirling term when full=True)."""
+    x, y = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, b):
+        if log_input:
+            out = jnp.exp(a) - b * a
+        else:
+            out = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(b + epsilon) - b + \
+                0.5 * jnp.log(2 * jnp.pi * (b + epsilon))
+            out = out + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return forward_op("poisson_nll_loss", f, [x, y])
+
+
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean", name=None):
+    """ref: gaussian_nll_loss — 0.5*(log var + (x-y)^2/var) [+ const]."""
+    x, y, v = ensure_tensor(input), ensure_tensor(label), \
+        ensure_tensor(variance)
+
+    def f(a, b, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + jnp.square(a - b) / var)
+        if full:
+            out = out + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(out, reduction)
+    return forward_op("gaussian_nll_loss", f, [x, y, v])

@@ -1,0 +1,194 @@
+"""Vision-geometry functionals.
+
+Parity targets: ``python/paddle/nn/functional/vision.py`` in the reference
+(grid_sample, affine_grid, pixel_shuffle siblings) and
+``python/paddle/nn/functional/common.py`` (fold) — NCHW layout, jnp-backed,
+tape-differentiable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["grid_sample", "affine_grid", "fold", "temporal_shift",
+           "bilinear", "feature_alpha_dropout"]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x [N,C,H,W]`` at normalized ``grid [N,Ho,Wo,2]`` coordinates
+    in [-1, 1] (ref: F.grid_sample; bilinear/nearest, zeros/border/reflection
+    padding)."""
+    t, g = ensure_tensor(x), ensure_tensor(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear/nearest, "
+                         f"got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample padding_mode {padding_mode!r}")
+
+    def f(v, gv):
+        N, C, H, W = v.shape
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1) / 2 * (size - 1)
+            return ((coord + 1) * size - 1) / 2
+
+        ix = unnormalize(gv[..., 0], W)          # [N, Ho, Wo]
+        iy = unnormalize(gv[..., 1], H)
+
+        def reflect(c, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                c = jnp.abs(c) % jnp.maximum(span, 1)
+                return jnp.where(c > size - 1, span - c, c)
+            span = 2 * size
+            c = (jnp.abs(c + 0.5) % jnp.maximum(span, 1))
+            c = jnp.where(c > size - 0.5, span - c, c) - 0.5
+            return jnp.clip(c, 0, size - 1)
+
+        if padding_mode == "reflection":
+            ix = reflect(ix, W)
+            iy = reflect(iy, H)
+
+        def gather(yc, xc):
+            # integer coords [N,Ho,Wo] -> values [N,C,Ho,Wo] with padding
+            inb = (yc >= 0) & (yc < H) & (xc >= 0) & (xc < W)
+            ycc = jnp.clip(yc, 0, H - 1)
+            xcc = jnp.clip(xc, 0, W - 1)
+            n_idx = jnp.arange(N)[:, None, None]
+            vals = v[n_idx, :, ycc, xcc]          # [N, Ho, Wo, C]
+            vals = jnp.moveaxis(vals, -1, 1)      # [N, C, Ho, Wo]
+            if padding_mode == "zeros":
+                vals = vals * inb[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(iy).astype(jnp.int32),
+                          jnp.round(ix).astype(jnp.int32))
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        wx = (ix - x0)[:, None]
+        wy = (iy - y0)[:, None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+    return forward_op("grid_sample", f, [t, g])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid [N,H,W,2] from theta [N,2,3]
+    (ref: F.affine_grid)."""
+    th = ensure_tensor(theta)
+    N, H, W = int(out_shape[0]), int(out_shape[-2]), int(out_shape[-1])
+
+    def f(tv):
+        def axis(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            return (jnp.arange(size) * 2 + 1) / size - 1
+        ys = axis(H)
+        xs = axis(W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")      # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", tv, base)       # [N, H, W, 2]
+    return forward_op("affine_grid", f, [th])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (ref: F.fold): [N, C*kh*kw, L] -> [N, C, H, W], summing
+    overlapping patches — the exact adjoint of unfold."""
+    t = ensure_tensor(x)
+
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(v):
+        N = v.shape[0]
+        C = v.shape[1] // (kh * kw)
+        cols = v.reshape(N, C, kh, kw, nh, nw)
+        out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):       # static, small
+            for j in range(kw):
+                ys = i * dh + sh * jnp.arange(nh)
+                xs = j * dw + sw * jnp.arange(nw)
+                out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return forward_op("fold", f, [t])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (ref: F.temporal_shift): shift a channel fraction
+    one step along the segment (time) dim in each direction."""
+    t = ensure_tensor(x)
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift supports NCHW")
+
+    def f(v):
+        NT, C, H, W = v.shape
+        n = NT // seg_num
+        v5 = v.reshape(n, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, :c1]), v5[:, :-1, :c1]], axis=1)
+        bwd = jnp.concatenate(
+            [v5[:, 1:, c1:c2], jnp.zeros_like(v5[:, :1, c1:c2])], axis=1)
+        return jnp.concatenate([fwd, bwd, v5[:, :, c2:]],
+                               axis=2).reshape(NT, C, H, W)
+    return forward_op("temporal_shift", f, [t])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """ref: F.bilinear — out[n, o] = x1[n]^T W[o] x2[n] (+ bias)."""
+    a, b, w = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+    args = [a, b, w]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def f(av, bv, wv, biasv=None):
+        out = jnp.einsum("ni,oij,nj->no", av, wv, bv)
+        if biasv is not None:
+            out = out + biasv
+        return out
+    return forward_op("bilinear", f, args)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (ref: feature_alpha_dropout —
+    SELU-compatible noise on [N, C, ...] with per-channel masks)."""
+    t = ensure_tensor(x)
+    if not training or p == 0.0:
+        return t
+    if not 0 <= p < 1:
+        raise ValueError(f"feature_alpha_dropout p must be in [0,1), got {p}")
+    from ...ops.random import _next_key
+    key = _next_key()
+    alpha_p = -1.7580993408473766  # -scale*alpha of SELU
+
+    def f(v):
+        mask_shape = v.shape[:2] + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, mask_shape)
+        a = (1 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return forward_op("feature_alpha_dropout", f, [t])

@@ -369,3 +369,139 @@ class Lamb(Optimizer):
         u_norm = jnp.linalg.norm(update)
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         return value - lr * trust * update
+
+
+class Adadelta(Optimizer):
+    """ref: python/paddle/optimizer/adadelta.py (accumulated squared grads +
+    squared updates, rho-averaged)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _apply_one(self, p, value, grad, lr):
+        avg_sq_g = self._add_accumulator("avg_squared_grad", p,
+                                         dtype=value.dtype)
+        avg_sq_u = self._add_accumulator("avg_squared_update", p,
+                                         dtype=value.dtype)
+        g2 = self._rho * avg_sq_g._value + (1 - self._rho) * jnp.square(grad)
+        update = -jnp.sqrt((avg_sq_u._value + self._eps)
+                           / (g2 + self._eps)) * grad
+        u2 = self._rho * avg_sq_u._value + (1 - self._rho) * jnp.square(update)
+        avg_sq_g._value = g2
+        avg_sq_u._value = u2
+        return value + lr * update
+
+
+class Rprop(Optimizer):
+    """ref: python/paddle/optimizer/rprop.py (sign-based resilient prop)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _apply_one(self, p, value, grad, lr):
+        prev = self._add_accumulator("prev_grad", p, dtype=value.dtype)
+        step_sz = self._add_accumulator("learning_rate_step", p,
+                                        fill_value=float(self._lr_value()),
+                                        dtype=value.dtype)
+        sign = jnp.sign(grad * prev._value)
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        new_step = jnp.clip(step_sz._value * factor, self._lr_min, self._lr_max)
+        # on sign change the pending gradient is zeroed (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, grad)
+        step_sz._value = new_step
+        prev._value = g_eff
+        return value - jnp.sign(g_eff) * new_step
+
+
+class NAdam(Optimizer):
+    """ref: python/paddle/optimizer/nadam.py (Adam + Nesterov momentum
+    schedule mu_t = b1*(1 - 0.5*0.96^(t*psi)))."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _apply_one(self, p, value, grad, lr):
+        m = self._add_accumulator("momentum", p, dtype=value.dtype)
+        v = self._add_accumulator("moment2", p, dtype=value.dtype)
+        mu_prod = self._add_accumulator("mu_product", p, fill_value=1.0,
+                                        dtype=jnp.float32, shape=())
+        t = float(self._step_count)  # already incremented by step()
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod._value = mu_prod._value * mu_t
+        m._value = self._b1 * m._value + (1 - self._b1) * grad
+        v._value = self._b2 * v._value + (1 - self._b2) * jnp.square(grad)
+        mhat = (mu_t1 * m._value / (1 - mu_prod._value * mu_t1)
+                + (1 - mu_t) * grad / (1 - mu_prod._value))
+        vhat = v._value / (1 - self._b2 ** t)
+        return value - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+
+
+class RAdam(Optimizer):
+    """ref: python/paddle/optimizer/radam.py (rectified Adam: SGD-with-
+    momentum warmup until the variance rectification term is defined)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, value, grad, lr):
+        import math as _m
+        m = self._add_accumulator("moment1", p, dtype=value.dtype)
+        v = self._add_accumulator("moment2", p, dtype=value.dtype)
+        t = float(self._step_count)
+        m._value = self._b1 * m._value + (1 - self._b1) * grad
+        v._value = self._b2 * v._value + (1 - self._b2) * jnp.square(grad)
+        mhat = m._value / (1 - self._b1 ** t)
+        rho_inf = 2 / (1 - self._b2) - 1
+        rho_t = rho_inf - 2 * t * self._b2 ** t / (1 - self._b2 ** t)
+        if rho_t > 5.0:
+            r = _m.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                        / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v._value / (1 - self._b2 ** t))
+            return value - lr * r * mhat / (vhat + self._eps)
+        return value - lr * mhat
+
+
+class ASGD(Optimizer):
+    """ref: python/paddle/optimizer/asgd.py (averaged SGD: the d/y/ys
+    recursion over a window of n steps)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._n = max(1, int(batch_num))
+
+    def _apply_one(self, p, value, grad, lr):
+        d = self._add_accumulator("d", p, dtype=value.dtype)
+        ys = self._add_accumulator("ys", p,
+                                   shape=(self._n,) + tuple(value.shape),
+                                   dtype=value.dtype)
+        idx = (self._step_count - 1) % self._n
+        y_old = ys._value[idx]
+        d._value = d._value - y_old + grad
+        ys._value = ys._value.at[idx].set(grad)
+        m = min(self._step_count, self._n)
+        return value - lr * d._value / m

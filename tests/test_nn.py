@@ -552,3 +552,144 @@ class TestMLPTraining:
         assert last < first * 0.1, (first, last)
         acc = (np.argmax(m(t(X)).numpy(), 1) == y).mean()
         assert acc > 0.95
+
+
+class TestNewOptimizers:
+    """Adadelta/Rprop/NAdam/RAdam/ASGD descend a quadratic (convergence
+    oracle) and keep state_dict round-trips."""
+
+    @pytest.mark.parametrize("cls,kw,steps", [
+        ("Adadelta", dict(learning_rate=1.0), 400),  # tiny early steps by design
+        ("Rprop", dict(learning_rate=0.01), 60),
+        ("NAdam", dict(learning_rate=0.05), 60),
+        ("RAdam", dict(learning_rate=0.05), 60),
+        ("ASGD", dict(learning_rate=0.05, batch_num=4), 60),
+    ])
+    def test_descends_quadratic(self, cls, kw, steps):
+        import paddle_tpu.optimizer as optim
+        target = np.asarray([1.0, -2.0, 3.0], np.float32)
+        w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        from paddle_tpu.core.tensor import Parameter
+        w = Parameter(np.zeros(3, np.float32))
+        opt = getattr(optim, cls)(parameters=[w], **kw)
+        first = None
+        for _ in range(steps):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first or float(loss)
+        assert float(loss) < first * 0.2, (cls, first, float(loss))
+
+    def test_state_dict_roundtrip(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.core.tensor import Parameter
+        w = Parameter(np.ones(2, np.float32))
+        opt = optim.Adadelta(learning_rate=1.0, parameters=[w])
+        (w ** 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        w2 = Parameter(np.ones(2, np.float32))
+        opt2 = optim.Adadelta(learning_rate=1.0, parameters=[w2])
+        opt2.set_state_dict(sd)
+        (w2 ** 2).sum().backward()
+        opt2.step()  # must not crash and must use restored accumulators
+
+
+class TestFunctionalVisionOps:
+    def test_affine_grid_identity_and_sample(self):
+        import paddle_tpu.nn.functional as F
+        theta = np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], np.float32),
+                        (1, 1, 1))
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 4])
+        assert list(grid.shape) == [1, 4, 4, 2]
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+    def test_grid_sample_nearest_and_zeros_padding(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+        grid = paddle.to_tensor(np.asarray(
+            [[[[-3.0, -3.0], [0.0, 0.0]]]], np.float32))  # off-image + center
+        out = F.grid_sample(x, grid, mode="nearest").numpy()
+        assert out[0, 0, 0, 0] == 0.0   # zeros padding
+        assert out[0, 0, 0, 1] == 1.0
+
+    def test_grid_sample_grad(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.random.randn(1, 2, 4, 4).astype("float32"),
+                             stop_gradient=False)
+        theta = np.asarray([[[0.8, 0.1, 0.0], [0.0, 0.9, 0.1]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 4, 4])
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_fold_unfold_adjoint(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.random.randn(2, 3, 6, 6).astype("float32"))
+        cols = F.unfold(x, 2, strides=2)
+        back = F.fold(cols, (6, 6), 2, strides=2)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-5)
+        # overlapping: each pixel counted per covering patch
+        cols = F.unfold(paddle.to_tensor(np.ones((1, 1, 3, 3), np.float32)),
+                        2, strides=1)
+        summed = F.fold(cols, (3, 3), 2, strides=1).numpy()
+        assert summed[0, 0, 1, 1] == 4.0  # center covered by 4 patches
+
+    def test_temporal_shift_moves_channels(self):
+        import paddle_tpu.nn.functional as F
+        x = np.arange(2 * 4 * 4, dtype=np.float32).reshape(2 * 1, 4, 2, 2)
+        # seg_num=2, N=1: channel block 0 shifts forward in time
+        out = F.temporal_shift(paddle.to_tensor(x.reshape(2, 4, 2, 2)),
+                               seg_num=2, shift_ratio=0.25).numpy()
+        np.testing.assert_array_equal(out[0, 0], 0.0)      # t=0 fwd slot zero
+        np.testing.assert_array_equal(out[1, 0],
+                                      x.reshape(2, 4, 2, 2)[0, 0])
+
+    def test_bilinear(self):
+        import paddle_tpu.nn.functional as F
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        w = np.random.randn(2, 3, 5).astype("float32")
+        got = F.bilinear(paddle.to_tensor(a), paddle.to_tensor(b),
+                         paddle.to_tensor(w)).numpy()
+        expect = np.einsum("ni,oij,nj->no", a, w, b)
+        np.testing.assert_allclose(got, expect, atol=1e-4)
+
+    def test_new_losses(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.asarray([0.5, -1.0, 2.0], np.float32))
+        y = paddle.to_tensor(np.asarray([0.0, 0.0, 0.0], np.float32))
+        h = float(F.huber_loss(x, y, delta=1.0))
+        expect = np.mean([0.125, 0.5, 1.0 * (2.0 - 0.5)])
+        np.testing.assert_allclose(h, expect, atol=1e-6)
+        sm = float(F.soft_margin_loss(x, paddle.to_tensor(
+            np.asarray([1.0, -1.0, 1.0], np.float32))))
+        np.testing.assert_allclose(
+            sm, np.mean(np.log1p(np.exp(-np.asarray([1, -1, 1]) *
+                                        np.asarray([0.5, -1, 2])))), atol=1e-5)
+        g = float(F.gaussian_nll_loss(x, y, paddle.to_tensor(
+            np.ones(3, np.float32))))
+        np.testing.assert_allclose(
+            g, np.mean(0.5 * np.asarray([0.5, -1, 2]) ** 2), atol=1e-5)
+        p = F.poisson_nll_loss(x, paddle.to_tensor(
+            np.asarray([1.0, 2.0, 3.0], np.float32)))
+        assert np.isfinite(float(p))
+        ml = F.multi_label_soft_margin_loss(
+            paddle.to_tensor(np.random.randn(2, 4).astype("float32")),
+            paddle.to_tensor((np.random.rand(2, 4) > 0.5).astype("float32")))
+        assert np.isfinite(float(ml))
+
+    def test_feature_alpha_dropout(self):
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        x = paddle.to_tensor(np.ones((4, 8, 3, 3), np.float32))
+        out = F.feature_alpha_dropout(x, p=0.5, training=True).numpy()
+        # whole channels share a mask value
+        per_channel_std = out.std(axis=(2, 3))
+        np.testing.assert_allclose(per_channel_std, 0.0, atol=1e-6)
+        # eval mode = identity
+        np.testing.assert_array_equal(
+            F.feature_alpha_dropout(x, 0.5, training=False).numpy(),
+            x.numpy())
