@@ -360,7 +360,8 @@ def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
     x, y = ensure_tensor(input), ensure_tensor(label)
 
     def f(a, b):
-        return _reduce(jnp.log1p(jnp.exp(-b * a)), reduction)
+        # softplus form: log1p(exp(z)) overflows for moderate margins
+        return _reduce(jax.nn.softplus(-b * a), reduction)
     return forward_op("soft_margin_loss", f, [x, y])
 
 

@@ -177,10 +177,10 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     """Alpha dropout over whole channels (ref: feature_alpha_dropout —
     SELU-compatible noise on [N, C, ...] with per-channel masks)."""
     t = ensure_tensor(x)
-    if not training or p == 0.0:
-        return t
     if not 0 <= p < 1:
         raise ValueError(f"feature_alpha_dropout p must be in [0,1), got {p}")
+    if not training or p == 0.0:
+        return t
     from ...ops.random import _next_key
     key = _next_key()
     alpha_p = -1.7580993408473766  # -scale*alpha of SELU

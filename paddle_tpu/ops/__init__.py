@@ -28,9 +28,7 @@ def _export(module):
         # complete the ops.yaml-equivalent schema registry (single source of
         # truth for the surface: every public op is registered with its doc,
         # whether factory-generated or hand-written)
-        if (callable(v) and not isinstance(v, type)
-                and getattr(v, "__module__", "") != "typing"
-                and k not in OP_REGISTRY):
+        if callable(v) and not isinstance(v, type) and k not in OP_REGISTRY:
             register_op(k, v, doc=(v.__doc__ or "").strip())
     return names
 

@@ -14,6 +14,7 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Parameter, Tensor, _wrap_value, to_tensor
@@ -438,11 +439,17 @@ class NAdam(Optimizer):
         self._psi = momentum_decay
 
     def _apply_one(self, p, value, grad, lr):
+        # every time-dependent factor lives in a ()-shaped accumulator tensor
+        # so the step stays correct when traced ONCE under jit.to_static
+        # (reading self._step_count would bake a trace-time constant)
         m = self._add_accumulator("momentum", p, dtype=value.dtype)
         v = self._add_accumulator("moment2", p, dtype=value.dtype)
         mu_prod = self._add_accumulator("mu_product", p, fill_value=1.0,
                                         dtype=jnp.float32, shape=())
-        t = float(self._step_count)  # already incremented by step()
+        t_acc = self._add_accumulator("t", p, fill_value=0.0,
+                                      dtype=jnp.float32, shape=())
+        t_acc._value = t_acc._value + 1.0
+        t = t_acc._value
         mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
         mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
         mu_prod._value = mu_prod._value * mu_t
@@ -466,21 +473,27 @@ class RAdam(Optimizer):
         self._b1, self._b2, self._eps = beta1, beta2, epsilon
 
     def _apply_one(self, p, value, grad, lr):
-        import math as _m
+        # step counter as a traced accumulator (see NAdam note): the
+        # rectification branch is a jnp.where so a to_static-compiled step
+        # transitions from SGDM-warmup to rectified-Adam at the right time
         m = self._add_accumulator("moment1", p, dtype=value.dtype)
         v = self._add_accumulator("moment2", p, dtype=value.dtype)
-        t = float(self._step_count)
+        t_acc = self._add_accumulator("t", p, fill_value=0.0,
+                                      dtype=jnp.float32, shape=())
+        t_acc._value = t_acc._value + 1.0
+        t = t_acc._value
         m._value = self._b1 * m._value + (1 - self._b1) * grad
         v._value = self._b2 * v._value + (1 - self._b2) * jnp.square(grad)
         mhat = m._value / (1 - self._b1 ** t)
         rho_inf = 2 / (1 - self._b2) - 1
         rho_t = rho_inf - 2 * t * self._b2 ** t / (1 - self._b2 ** t)
-        if rho_t > 5.0:
-            r = _m.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
-                        / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
-            vhat = jnp.sqrt(v._value / (1 - self._b2 ** t))
-            return value - lr * r * mhat / (vhat + self._eps)
-        return value - lr * mhat
+        safe_rho = jnp.maximum(rho_t, 4.0 + 1e-3)
+        r = jnp.sqrt(((safe_rho - 4) * (safe_rho - 2) * rho_inf)
+                     / ((rho_inf - 4) * (rho_inf - 2) * safe_rho))
+        vhat = jnp.sqrt(v._value / (1 - self._b2 ** t))
+        rect = value - lr * r * mhat / (vhat + self._eps)
+        warm = value - lr * mhat
+        return jnp.where(rho_t > 5.0, rect, warm)
 
 
 class ASGD(Optimizer):
@@ -499,9 +512,14 @@ class ASGD(Optimizer):
         ys = self._add_accumulator("ys", p,
                                    shape=(self._n,) + tuple(value.shape),
                                    dtype=value.dtype)
-        idx = (self._step_count - 1) % self._n
-        y_old = ys._value[idx]
+        t_acc = self._add_accumulator("t", p, fill_value=0.0,
+                                      dtype=jnp.float32, shape=())
+        t_acc._value = t_acc._value + 1.0
+        # traced index: correct under a once-traced to_static step
+        idx = (t_acc._value.astype(jnp.int32) - 1) % self._n
+        y_old = jnp.take(ys._value, idx, axis=0)
         d._value = d._value - y_old + grad
-        ys._value = ys._value.at[idx].set(grad)
-        m = min(self._step_count, self._n)
+        ys._value = jax.lax.dynamic_update_index_in_dim(
+            ys._value, grad.astype(ys._value.dtype), idx, axis=0)
+        m = jnp.minimum(t_acc._value, float(self._n))
         return value - lr * d._value / m

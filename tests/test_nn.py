@@ -693,3 +693,51 @@ class TestFunctionalVisionOps:
         np.testing.assert_array_equal(
             F.feature_alpha_dropout(x, 0.5, training=False).numpy(),
             x.numpy())
+
+
+class TestOptimizerTraceCorrectness:
+    def test_nadam_radam_asgd_under_to_static(self):
+        """Step-dependent factors must be accumulator tensors, not baked
+        trace constants: a to_static-compiled step matches eager stepping."""
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu.jit import to_static
+
+        target = np.asarray([1.0, -2.0], np.float32)
+        for cls, kw in [("NAdam", dict(learning_rate=0.05)),
+                        ("RAdam", dict(learning_rate=0.05)),
+                        ("ASGD", dict(learning_rate=0.05, batch_num=3))]:
+            def run(compiled):
+                paddle.seed(0)
+                w = Parameter(np.zeros(2, np.float32))
+                opt = getattr(optim, cls)(parameters=[w], **kw)
+
+                def step():
+                    loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    return loss
+                fn = to_static(step) if compiled else step
+                return [float(fn()) for _ in range(12)]
+
+            eager = run(False)
+            jit = run(True)
+            np.testing.assert_allclose(jit, eager, rtol=2e-4, atol=2e-5,
+                                       err_msg=cls)
+
+    def test_soft_margin_large_logits_finite(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.asarray([90.0, -90.0], np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.asarray([-1.0, 1.0], np.float32))
+        loss = F.soft_margin_loss(x, y)
+        assert np.isfinite(float(loss)) and abs(float(loss) - 90.0) < 1e-3
+        loss.backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_feature_alpha_dropout_validates_in_eval(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.feature_alpha_dropout(x, p=1.5, training=False)
