@@ -5,12 +5,13 @@ performs these fusions on the standard layers, so the incubate names alias
 the standard implementations (documented equivalence, not stubs).
 """
 
+from . import functional  # noqa: F401
 from ...nn.layers.transformer import (MultiHeadAttention,
                                       TransformerEncoderLayer)
 from ...nn.layers.norm import RMSNorm
 
-__all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer",
-           "FusedRMSNorm"]
+__all__ = ["functional", "FusedMultiHeadAttention",
+           "FusedTransformerEncoderLayer", "FusedRMSNorm"]
 
 # XLA-fused equivalents of the reference's hand-fused CUDA layers
 FusedMultiHeadAttention = MultiHeadAttention

@@ -1,0 +1,138 @@
+"""incubate.nn.functional — fused-op names.
+
+Parity target: ``python/paddle/incubate/nn/functional/`` in the reference
+(fused_rotary_position_embedding, fused_rms_norm, fused_layer_norm,
+fused_multi_head_attention, swiglu, ...). On TPU these route to the Pallas
+kernels or to XLA-fused compositions — real implementations behind the
+reference's fused names, not stubs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
+           "fused_layer_norm", "fused_multi_head_attention", "swiglu",
+           "fused_linear", "fused_bias_dropout_residual_layer_norm"]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """ref: incubate fused_rope — applies RoPE to q/k (v passes through).
+    q/k: [B, S, H, D]; sin/cos default to tables built from rotary_emb_base."""
+    from ...kernels.rope import apply_rope, rope_cos_sin
+    qt = ensure_tensor(q)
+    B, S, H, D = qt.shape
+    if cos is None or sin is None:
+        cos_v, sin_v = rope_cos_sin(S, D, rotary_emb_base,
+                                    position_ids=position_ids)
+    else:
+        cos_v = ensure_tensor(cos)._value.reshape(S, D)
+        sin_v = ensure_tensor(sin)._value.reshape(S, D)
+
+    def rope_one(t):
+        return forward_op("fused_rope",
+                          lambda x: apply_rope(x, cos_v, sin_v), [t])
+    out_q = rope_one(qt)
+    out_k = rope_one(ensure_tensor(k)) if k is not None else None
+    out_v = ensure_tensor(v) if v is not None else None
+    return out_q, out_k, out_v
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """ref: incubate fused_rms_norm — the Pallas kernel."""
+    from ...kernels.rms_norm import rms_norm
+    t, w = ensure_tensor(x), ensure_tensor(norm_weight)
+    out = forward_op("fused_rms_norm",
+                     lambda v, wv: rms_norm(v, wv, epsilon), [t, w])
+    if norm_bias is not None:
+        out = out + ensure_tensor(norm_bias)
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    """ref: incubate fused_layer_norm — XLA fuses the composition."""
+    from ...nn import functional as F
+    return F.layer_norm(x, ensure_tensor(x).shape[-1:],
+                        weight=norm_weight, bias=norm_bias, epsilon=epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias=None, *,
+                               num_heads: int, causal: bool = False,
+                               linear_weight=None, linear_bias=None,
+                               dropout_rate=0.0, training=True, **kwargs):
+    """ref: incubate fused_multi_head_attention — fused qkv projection +
+    flash attention + output projection."""
+    from ...nn import functional as F
+    from ...ops.linalg import matmul
+    t = ensure_tensor(x)
+    B, S, E = t.shape
+    qkv = matmul(t, ensure_tensor(qkv_weight))        # [B, S, 3E]
+    if qkv_bias is not None:
+        qkv = qkv + ensure_tensor(qkv_bias)
+    D = E // num_heads
+
+    def split(i):
+        from ...ops.manipulation import reshape
+        part = qkv[:, :, i * E:(i + 1) * E]
+        return reshape(part, [B, S, num_heads, D])
+    q, k, v = split(0), split(1), split(2)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                         dropout_p=dropout_rate,
+                                         training=training)
+    from ...ops.manipulation import reshape
+    out = reshape(out, [B, S, E])
+    if linear_weight is not None:
+        out = matmul(out, ensure_tensor(linear_weight))
+        if linear_bias is not None:
+            out = out + ensure_tensor(linear_bias)
+    return out
+
+
+def swiglu(x, y=None, name=None):
+    """ref: incubate swiglu — silu(x) * y (y defaults to the second half
+    of x's last dim, matching the fused ffn convention)."""
+    t = ensure_tensor(x)
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jnp.asarray(jnp.multiply(b, jnp.asarray(
+                a * (1 / (1 + jnp.exp(-a))))))
+        return forward_op("swiglu", f, [t])
+    return forward_op(
+        "swiglu", lambda a, b: (a * (1 / (1 + jnp.exp(-a)))) * b,
+        [t, ensure_tensor(y)])
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref: incubate fused_linear (gemm+bias epilogue — XLA fuses it)."""
+    from ...nn import functional as F
+    w = ensure_tensor(weight)
+    if transpose_weight:
+        from ...ops.manipulation import transpose
+        w = transpose(w, [1, 0])
+    return F.linear(x, w, bias)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, **kwargs):
+    """ref: incubate fused_bias_dropout_residual_layer_norm."""
+    from ...nn import functional as F
+    t = ensure_tensor(x)
+    if bias is not None:
+        t = t + ensure_tensor(bias)
+    if dropout_rate:
+        t = F.dropout(t, dropout_rate, training=training)
+    t = t + ensure_tensor(residual)
+    return F.layer_norm(t, t.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)

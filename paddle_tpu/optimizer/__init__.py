@@ -1,10 +1,10 @@
 """paddle.optimizer namespace (parity: python/paddle/optimizer/__init__.py)."""
 
 from . import lr
-from .optimizer import (ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
-                        Lamb, Momentum, NAdam, Optimizer, RAdam, RMSProp,
-                        Rprop)
+from .optimizer import (ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax,
+                        AdamW, Lamb, Momentum, NAdam, Optimizer, RAdam,
+                        RMSProp, Rprop)
 
 __all__ = ["lr", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
            "RMSProp", "Lamb", "Optimizer", "Adadelta", "Rprop", "NAdam",
-           "RAdam", "ASGD"]
+           "RAdam", "ASGD", "LBFGS"]

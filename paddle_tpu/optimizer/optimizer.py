@@ -523,3 +523,102 @@ class ASGD(Optimizer):
             ys._value, grad.astype(ys._value.dtype), idx, axis=0)
         m = jnp.minimum(t_acc._value, float(self._n))
         return value - lr * d._value / m
+
+
+class LBFGS(Optimizer):
+    """ref: python/paddle/optimizer/lbfgs.py — limited-memory BFGS with the
+    closure API: ``step(closure)`` re-evaluates the loss (closure must call
+    ``backward()``). Two-loop recursion over a curvature history; step length
+    by backtracking Armijo line search (the reference's strong_wolfe is
+    approximated by backtracking — documented divergence)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = int(max_iter)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history = int(history_size)
+        self._line_search = line_search_fn  # None or "strong_wolfe"
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def _assign_flat(self, flat):
+        ofs = 0
+        for p in self._params():
+            n = int(np.prod(p._value.shape)) if p._value.shape else 1
+            p._value = flat[ofs:ofs + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            p._version += 1
+            ofs += n
+
+    def _gather(self):
+        ps = self._params()
+        flat_w = self._flat([p._value.astype(jnp.float32) for p in ps])
+        flat_g = self._flat([
+            (p.grad._value if p.grad is not None
+             else jnp.zeros_like(p._value)).astype(jnp.float32) for p in ps])
+        return flat_w, flat_g
+
+    def _direction(self, g):
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+                jnp.vdot(y_last, y_last), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that recomputes "
+                             "the loss and calls backward()")
+        loss = closure()
+        self._step_count += 1
+        w, g = self._gather()
+        if float(jnp.abs(g).max()) <= self._tol_grad:
+            return loss
+        for _ in range(self._max_iter):
+            d = self._direction(g)
+            lr = self._lr_value()
+            # backtracking Armijo
+            f0 = float(loss)
+            gtd = float(jnp.vdot(g, d))
+            t = lr
+            for _ls in range(10):
+                self._assign_flat(w + t * d)
+                self.clear_grad()
+                loss = closure()
+                if float(loss) <= f0 + 1e-4 * t * gtd:
+                    break
+                t *= 0.5
+            w_new, g_new = self._gather()
+            s, yv = w_new - w, g_new - g
+            if float(jnp.vdot(s, yv)) > 1e-10:
+                self._s.append(s)
+                self._y.append(yv)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.abs(g_new).max()) <= self._tol_grad or \
+                    float(jnp.abs(s).max()) <= self._tol_change:
+                w, g = w_new, g_new
+                break
+            w, g = w_new, g_new
+        return loss

@@ -609,3 +609,86 @@ class TestReviewFixesRound2b:
     def test_as_complex_single_source(self):
         from paddle_tpu.ops import extras, manipulation
         assert extras.view_as_complex is manipulation.as_complex
+
+
+class TestIncubateFusedFunctional:
+    def test_fused_rope_matches_kernel(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        from paddle_tpu.kernels.rope import apply_rope, rope_cos_sin
+        q = np.random.randn(1, 8, 2, 16).astype("float32")
+        k = np.random.randn(1, 8, 2, 16).astype("float32")
+        oq, ok, ov = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(k))
+        cos, sin = rope_cos_sin(8, 16)
+        np.testing.assert_allclose(oq.numpy(),
+                                   np.asarray(apply_rope(jnp.asarray(q),
+                                                         cos, sin)),
+                                   atol=1e-5)
+        assert ov is None
+
+    def test_fused_rms_norm(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        x = np.random.randn(4, 16).astype("float32")
+        w = np.random.rand(16).astype("float32")
+        got = IF.fused_rms_norm(paddle.to_tensor(x),
+                                paddle.to_tensor(w)).numpy()
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_swiglu(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        x = np.random.randn(3, 8).astype("float32")
+        got = IF.swiglu(paddle.to_tensor(x)).numpy()
+        a, b = x[:, :4], x[:, 4:]
+        ref = (a / (1 + np.exp(-a))) * b
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_fused_mha_runs_and_grads(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        E, H = 16, 4
+        x = paddle.to_tensor(np.random.randn(2, 8, E).astype("float32"),
+                             stop_gradient=False)
+        qkv_w = paddle.to_tensor(
+            np.random.randn(E, 3 * E).astype("float32") / 4,
+            stop_gradient=False)
+        out = IF.fused_multi_head_attention(x, qkv_w, num_heads=H,
+                                            causal=True, training=False)
+        assert list(out.shape) == [2, 8, E]
+        out.sum().backward()
+        assert x.grad is not None and qkv_w.grad is not None
+
+
+class TestLBFGS:
+    def test_converges_on_quadratic(self):
+        from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu.optimizer import LBFGS
+        target = np.asarray([1.0, -2.0, 3.0], np.float32)
+        w = Parameter(np.zeros(3, np.float32))
+        opt = LBFGS(learning_rate=1.0, max_iter=10, parameters=[w])
+
+        def closure():
+            opt.clear_grad()
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert float(loss) < 1e-6
+        np.testing.assert_allclose(w.numpy(), target, atol=1e-3)
+
+    def test_rosenbrock_descends(self):
+        from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu.optimizer import LBFGS
+        w = Parameter(np.asarray([-1.0, 1.0], np.float32))
+        opt = LBFGS(learning_rate=0.5, max_iter=30, parameters=[w])
+
+        def closure():
+            opt.clear_grad()
+            a, b = w[0], w[1]
+            loss = (1 - a) ** 2 + 100 * (b - a ** 2) ** 2
+            loss.backward()
+            return loss
+
+        first = float(closure())
+        loss = opt.step(closure)
+        assert float(loss) < first * 0.05
