@@ -205,10 +205,84 @@ def bench_resnet(batch=32, steps=8, image=224):
             "compile_s": compile_s, "loss": final}
 
 
+def bench_bert(batch=32, seq=128, steps=8):
+    """BERT-base fine-tune step via eager->to_static (BASELINE.md row)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import amp
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = BertConfig()  # base: L=12, H=768
+    net = BertForSequenceClassification(cfg, num_classes=2)
+    opt = AdamW(learning_rate=2e-5, parameters=net.parameters())
+    rng = np.random.default_rng(0)
+
+    @to_static
+    def train_step(ids, labels):
+        with amp.auto_cast():
+            loss, _ = net(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def mk(b, s):
+        return (paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                              (b, s)).astype("int64")),
+                paddle.to_tensor(rng.integers(0, 2, (b,)).astype("int64")))
+
+    xw, yw = mk(2, seq)
+    t0 = time.time()
+    float(train_step(xw, yw))  # eager state-discovery warmup (tiny batch)
+    warm_s = time.time() - t0
+    x, y = mk(batch, seq)
+    t0 = time.time()
+    float(train_step(x, y))    # compile at the timed size
+    compile_s = time.time() - t0
+    float(train_step(x, y))
+    t0 = time.time()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    final = float(loss)
+    per_step = (time.time() - t0) / steps
+    assert np.isfinite(final)
+    return {"examples_per_s": batch / per_step, "step_time_s": per_step,
+            "warmup_s": warm_s, "compile_s": compile_s}
+
+
+def bench_sdxl_attention(steps=10):
+    """SDXL-UNet-shape attention blocks through the Pallas kernel
+    (BASELINE.md row): the UNet's heavy self-attention at 64x64 latents
+    (S=4096, H=10, D=64) and 32x32 (S=1024, H=20, D=64), fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    out = {}
+    for name, (B, S, H, D) in {"sdxl_64x64": (2, 4096, 10, 64),
+                               "sdxl_32x32": (2, 1024, 20, 64)}.items():
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+                   for kk in ks)
+        f = jax.jit(jax.grad(lambda q, k, v: flash_attention(
+            q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        float(jnp.asarray(f(q, k, v)[0]).ravel()[0])
+        t0 = time.time()
+        for _ in range(steps):
+            g = f(q, k, v)
+        float(jnp.asarray(g[0]).ravel()[0])
+        out[name + "_ms"] = round((time.time() - t0) / steps * 1e3, 2)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--attn", action="store_true")
     ap.add_argument("--resnet", action="store_true")
+    ap.add_argument("--bert", action="store_true")
+    ap.add_argument("--sdxl", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
@@ -253,6 +327,17 @@ def main():
         print(json.dumps({"resnet50_images_per_s": round(rn["images_per_s"]),
                           "resnet50_step_s": round(rn["step_time_s"], 4),
                           "resnet50_compile_s": round(rn["compile_s"], 1)}),
+              file=sys.stderr)
+
+    if args.bert:
+        bt = bench_bert(steps=args.steps)
+        print(json.dumps({"bert_base_examples_per_s":
+                          round(bt["examples_per_s"]),
+                          "bert_step_s": round(bt["step_time_s"], 4)}),
+              file=sys.stderr)
+
+    if args.sdxl:
+        print(json.dumps(bench_sdxl_attention(steps=args.steps)),
               file=sys.stderr)
 
     # ONE JSON line on stdout (driver contract); north star = 50% MFU
