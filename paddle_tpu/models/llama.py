@@ -175,21 +175,30 @@ def _rms_norm(x, w, eps, use_kernels):
 
 
 def _rope(x, cos, sin, use_kernels):
-    if use_kernels:
+    if use_kernels and cos.ndim == 2:
         from ..kernels.rope import apply_rope
         return apply_rope(x, cos, sin)
-    # x: [B, S, H, D]; cos/sin: [S, D]
+    # x: [B, S, H, D]; cos/sin: [S, D] or [B, S, D] (per-row positions for
+    # packed sequences — the kernel path handles the shared-table case only)
     d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     rot = jnp.concatenate([-x2, x1], axis=-1)
-    c = cos[None, :, None, :].astype(x.dtype)
-    s = sin[None, :, None, :].astype(x.dtype)
+    expand = (lambda t: t[None, :, None, :]) if cos.ndim == 2 \
+        else (lambda t: t[:, :, None, :])
+    c = expand(cos).astype(x.dtype)
+    s = expand(sin).astype(x.dtype)
     return x * c + rot * s
 
 
-def _attention(q, k, v, cfg: LlamaConfig):
-    """Causal self-attention on [B, S, H(k), D]."""
+def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
+    """Causal self-attention on [B, S, H(k), D]; ``segment_ids [B, S]``
+    confines attention within packed sequences (varlen)."""
     if cfg.sep_axis is not None:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed-sequence masking under sep context parallelism is "
+                "not supported yet (the ring schedule assumes a plain causal "
+                "mask)")
         # context parallelism: seq stays sharded over the sep axis; ring or
         # Ulysses attention as an explicit shard_map region inside the
         # compiled program (composes with dp GSPMD; mp must be 1 here)
@@ -214,7 +223,8 @@ def _attention(q, k, v, cfg: LlamaConfig):
         return region(q, k, v)
     if cfg.use_kernels:
         from ..kernels.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               segment_ids=segment_ids)
     B, S, H, D = q.shape
     Hk = k.shape[2]
     if Hk != H:  # GQA: expand kv heads
@@ -224,14 +234,20 @@ def _attention(q, k, v, cfg: LlamaConfig):
     scale = 1.0 / math.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    s = jnp.where(mask[None, None], s, -1e30)
+    mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids)
+        mask = mask & (seg[:, None, :, None] == seg[:, None, None, :])
+    s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if segment_ids is not None:  # rows with no visible keys output 0
+        p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
     return o.astype(q.dtype)
 
 
-def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig):
+def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
+                  segment_ids=None):
     """One pre-norm decoder block on un-stacked layer params ``lp``."""
     B, S, E = x.shape
     H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
@@ -243,7 +259,7 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig):
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, Hk, D)
     q = _rope(q, cos, sin, cfg.use_fused_norm)
     k = _rope(k, cos, sin, cfg.use_fused_norm)
-    o = _attention(q, k, v, cfg).reshape(B, S, H * D)
+    o = _attention(q, k, v, cfg, segment_ids).reshape(B, S, H * D)
     x = x + o @ lp["wo"].astype(dt)
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
@@ -251,14 +267,33 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig):
     return x + g @ lp["w_down"].astype(dt)
 
 
-def forward(params: Dict, input_ids, cfg: LlamaConfig):
-    """``input_ids [B, S] -> logits [B, S, V]`` (single trace via lax.scan)."""
+def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
+            position_ids=None):
+    """``input_ids [B, S] -> logits [B, S, V]`` (single trace via lax.scan).
+
+    Packed-sequence (varlen) training: ``segment_ids [B, S]`` confines
+    attention within each packed sequence (routed to the flash kernel's
+    segment masking on TPU); ``position_ids [B, S]`` restarts RoPE positions
+    per sequence (defaults to 0..S-1 shared across rows).
+    """
     from ..kernels.rope import rope_cos_sin
     B, S = input_ids.shape
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.dtype)
-    cos, sin = rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
+    if position_ids is None:
+        cos, sin = rope_cos_sin(S, cfg.head_dim, cfg.rope_theta)
+    else:
+        pos = jnp.asarray(position_ids)
+        if pos.ndim == 1:
+            cos, sin = rope_cos_sin(S, cfg.head_dim, cfg.rope_theta,
+                                    position_ids=pos)
+        else:  # per-row positions -> [B, S, D] tables (jnp rope path)
+            import functools as _ft
+            mk = jax.vmap(_ft.partial(rope_cos_sin, S, cfg.head_dim,
+                                      cfg.rope_theta))
+            cos, sin = mk(position_ids=pos)
 
-    layer = partial(decoder_layer, cos=cos, sin=sin, cfg=cfg)
+    layer = partial(decoder_layer, cos=cos, sin=sin, cfg=cfg,
+                    segment_ids=segment_ids)
     if cfg.remat:
         layer = jax.checkpoint(layer)
 
@@ -272,9 +307,11 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig):
     return x @ head.astype(cfg.dtype)
 
 
-def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig):
+def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig,
+            segment_ids=None, position_ids=None):
     """Mean next-token cross-entropy (labels already shifted; -100 ignored)."""
-    logits = forward(params, input_ids, cfg).astype(jnp.float32)
+    logits = forward(params, input_ids, cfg, segment_ids,
+                     position_ids).astype(jnp.float32)
     V = logits.shape[-1]
     lse = jax.nn.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(
