@@ -23,11 +23,15 @@ hand-scheduling from Python; the pipeline is ONE compiled XLA program:
   ``train_batch`` with micro-batch gradient accumulation semantics (numerically
   the pipeline schedule's result, independent of schedule order).
 
-Future work: the interleaved/virtual-stage schedule (reference:
-``interleave`` 1F1B) — in the compiled rotational form this means V
-activation slots circulating the pp ring V laps with per-tick slot
-selection; the bubble shrinks from (S-1)/(M+S-1) toward (S/V-1)/(M+S-1).
-The single-lap scan below already overlaps compute/ppermute via XLA.
+Interleaved / virtual stages (reference: ``interleave`` 1F1B,
+``virtual_pp_degree``): ``circular_repeats=V`` runs the circular schedule —
+the ``S*V`` layer chunks are dealt round-robin (chunk ``c`` lives on device
+``c % S``, lap ``c // S``) and every activation traverses the ring ``V``
+laps, re-entering stage 0 through a hand-back buffer. Tick count drops from
+``M + S - 1`` stage-times to ``V*M + S - 1`` chunk-times (a chunk is ``1/V``
+of a stage), i.e. the bubble fraction shrinks from ``(S-1)/(M+S-1)`` to
+``((S-1)/V) / (M + (S-1)/V)`` — see :func:`pipeline_ticks` (asserted in
+tests/test_pipeline.py).
 """
 
 from __future__ import annotations
@@ -46,75 +50,169 @@ from ..nn.layer import Layer
 from .topology import get_hybrid_communicate_group
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-           "pipeline_scan"]
+           "pipeline_scan", "pipeline_ticks", "ring_schedule"]
 
 
 # ---------------------------------------------------------------------------
 # compiled rotational pipeline (the TPU-native schedule)
 # ---------------------------------------------------------------------------
 
+def pipeline_ticks(micro_batches: int, stages: int,
+                   circular_repeats: int = 1) -> int:
+    """Tick count of the compiled schedule: ``V*M + S - 1``.
+
+    One tick applies one CHUNK (``1/V`` of a stage), so in stage-time units
+    the schedule costs ``M + (S-1)/V`` — the interleaved bubble fraction is
+    ``((S-1)/V) / (M + (S-1)/V)`` vs the non-interleaved ``(S-1)/(M+S-1)``
+    (ref: Megatron interleaved 1F1B; upstream ``virtual_pp_degree``)."""
+    return circular_repeats * micro_batches + stages - 1
+
+
+def ring_schedule(stage_fn: Callable, params_local, xs, *, axis: str,
+                  num_stages: int, circular_repeats: int = 1):
+    """The rotational pipeline body, usable INSIDE an existing ``shard_map``
+    region (so callers can fuse vocab-parallel embedding / LM-head / loss into
+    the same compiled program — see ``models.llama.make_pp_train_step``).
+
+    Args:
+      stage_fn: ``(chunk_params, x) -> y`` with ``y.shape == x.shape``.
+      params_local: pytree whose leaves are this device's ``[V, ...]`` chunk
+        params (chunk ``c = v*S + s`` lives on device ``s``, lap ``v``).
+      xs: ``[M, b, ...]`` micro-batched stage-0 inputs (present on all ranks).
+      axis: the pp mesh axis name (must be a shard_map-bound axis).
+      circular_repeats: V — laps around the ring (interleaved schedule).
+
+    Returns ``[M, b, ...]`` last-chunk outputs, replicated over ``axis``.
+
+    Schedule: at tick ``t`` device ``s`` processes work item ``idx = t - s``
+    (micro-batch ``idx % M``, lap ``idx // M``) and hands its output to
+    ``s+1`` with ``lax.ppermute``. For ``V > 1`` the ring wraps around and
+    stage 0 parks activations returning from stage ``S-1`` in a ``[M, ...]``
+    buffer until their next lap starts (``M - S`` ticks later, so the
+    circular schedule needs ``M >= S``). Backward is ``jax.grad`` straight
+    through scan+ppermute — the transpose of a ppermute is the reverse
+    ppermute, and XLA schedules the 1F1B-like overlap.
+    """
+    S, V, M = num_stages, circular_repeats, xs.shape[0]
+    T = pipeline_ticks(M, S, V)
+    s = lax.axis_index(axis)
+    tree = jax.tree_util
+
+    if V == 1:
+        p_mine = tree.tree_map(lambda p: p[0], params_local)
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            ring = carry
+            m = jnp.clip(t - s, 0, M - 1)
+            x_feed = lax.dynamic_index_in_dim(xs, m, axis=0, keepdims=False)
+            x_in = jnp.where(s == 0, x_feed, ring)
+            y = stage_fn(p_mine, x_in)
+            return lax.ppermute(y, axis, perm), y
+
+        _, ys = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(T))
+    else:
+        if M < S:
+            raise ValueError(
+                f"circular schedule needs micro_batches >= stages "
+                f"(got M={M} < S={S}); the lap hand-back buffer is consumed "
+                f"M - S ticks after arrival")
+        perm = [(i, (i + 1) % S) for i in range(S)]  # ring incl. wrap-around
+
+        def tick(carry, t):
+            ring, park = carry
+            idx = t - s
+            m = jnp.mod(idx, M)
+            v = jnp.clip(idx // M, 0, V - 1)
+            # stage 0: park the activation that just arrived from stage S-1
+            # (lap output for micro-batch (t-S) % M; consumed M-S ticks later)
+            park = jnp.where(
+                s == 0,
+                lax.dynamic_update_index_in_dim(
+                    park, ring, jnp.mod(t - S, M), axis=0),
+                park)
+            x_fresh = lax.dynamic_index_in_dim(xs, m, axis=0, keepdims=False)
+            x_back = lax.dynamic_index_in_dim(park, m, axis=0, keepdims=False)
+            x_in = jnp.where(s == 0, jnp.where(v == 0, x_fresh, x_back), ring)
+            p_chunk = tree.tree_map(
+                lambda p: lax.dynamic_index_in_dim(p, v, axis=0,
+                                                   keepdims=False),
+                params_local)
+            y = stage_fn(p_chunk, x_in)
+            return (lax.ppermute(y, axis, perm), park), y
+
+        carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs))
+        _, ys = lax.scan(tick, carry0, jnp.arange(T))
+
+    # stage S-1 emitted the final-lap outputs at the last M ticks
+    outs = ys[T - M:]
+    outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis)
+
+
 def pipeline_scan(stage_fn: Callable, stage_params, xs, *, mesh: Mesh = None,
                   axis: str = "pp", remat: bool = False,
-                  batch_spec: Optional[P] = None):
+                  batch_spec: Optional[P] = None, circular_repeats: int = 1):
     """Run ``M`` micro-batches through ``S`` pipeline stages as one compiled
     shard_map program (GPipe/1F1B schedule; ref: pipeline_parallel.py
     ``forward_backward_pipeline`` — here the schedule is the scan and XLA owns
     the overlap).
 
     Args:
-      stage_fn: ``(params_one_stage, x) -> y`` with ``y.shape == x.shape``
+      stage_fn: ``(params_one_chunk, x) -> y`` with ``y.shape == x.shape``
         (homogeneous interior stages — the standard transformer-block case).
-      stage_params: pytree whose leaves are stacked per-stage ``[S, ...]``.
+      stage_params: pytree whose leaves are stacked per-chunk ``[S*V, ...]``
+        (``V = circular_repeats``; chunk ``c`` runs on device ``c % S``).
       xs: micro-batched input ``[M, B, ...]`` (fed to stage 0).
       mesh: defaults to the fleet hybrid mesh.
-      remat: checkpoint each stage application (activation recomputation).
+      remat: checkpoint each chunk application (activation recomputation).
       batch_spec: PartitionSpec for ``xs`` over the OTHER mesh axes (e.g.
         ``P(None, "dp")`` to keep the batch dim dp-sharded through the
         pipeline); defaults to replicated.
+      circular_repeats: V — interleaved/virtual-stage laps (upstream
+        ``virtual_pp_degree``); needs ``M >= S`` when ``V > 1``.
 
-    Returns ``[M, B, ...]`` outputs of the last stage, replicated over ``pp``.
+    Returns ``[M, B, ...]`` outputs of the last chunk, replicated over ``pp``.
     """
     mesh = mesh or get_hybrid_communicate_group().mesh
     bspec = batch_spec if batch_spec is not None else P()
     S = int(mesh.shape[axis])
-    M = xs.shape[0]
+    V = int(circular_repeats)
+    tree = jax.tree_util
+    leaves = tree.tree_leaves(stage_params)
+    if leaves and leaves[0].shape[0] != S * V:
+        raise ValueError(
+            f"stage_params leading dim {leaves[0].shape[0]} != "
+            f"num_stages*circular_repeats = {S}*{V}")
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
     if S == 1:
+        def apply_all(x):
+            def body(h, p):
+                return fn(p, h), None
+            h, _ = lax.scan(body, x, stage_params)
+            return h
+
         def scan1(carry, x):
-            return carry, stage_fn(jax.tree_util.tree_map(
-                lambda p: p[0], stage_params), x)
+            return carry, apply_all(x)
         _, ys = lax.scan(scan1, 0, xs)
         return ys
-    T = M + S - 1
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    in_axes_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    perm = [(i, i + 1) for i in range(S - 1)]
+    # [S*V, ...] -> [V, S, ...] so the chunk->device assignment c = v*S + s
+    # becomes a plain shard of dim 1 over the pp axis
+    stacked = tree.tree_map(
+        lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
+    in_spec = tree.tree_map(lambda _: P(None, axis), stacked)
 
     def body(params_local, xs_rep):
-        # params_local leaves: [1, ...] (my stage); xs_rep: [M, B, ...]
-        p_mine = jax.tree_util.tree_map(lambda p: p[0], params_local)
-        s = lax.axis_index(axis)
-        buf = jnp.zeros_like(xs_rep[0])
-
-        def tick(carry, t):
-            buf = carry
-            x_feed = lax.dynamic_index_in_dim(
-                xs_rep, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-            x_in = jnp.where(s == 0, x_feed, buf)
-            y = fn(p_mine, x_in)
-            nxt = lax.ppermute(y, axis, perm)
-            return nxt, y
-
-        _, ys = lax.scan(tick, buf, jnp.arange(T))
-        # stage S-1 produced valid outputs at ticks S-1 .. T-1
-        outs = ys[S - 1:]
-        outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
-        return lax.psum(outs, axis)
+        # params_local leaves: [V, 1, ...] (my chunks); xs_rep: [M, B, ...]
+        mine = tree.tree_map(lambda p: p[:, 0], params_local)
+        return ring_schedule(fn, mine, xs_rep, axis=axis, num_stages=S,
+                             circular_repeats=V)
 
     shmap = shard_map(
-        body, mesh=mesh, in_specs=(in_axes_spec, bspec), out_specs=bspec,
+        body, mesh=mesh, in_specs=(in_spec, bspec), out_specs=bspec,
         check_vma=False)
-    return shmap(stage_params, xs)
+    return shmap(stacked, xs)
 
 
 # ---------------------------------------------------------------------------
@@ -261,14 +359,91 @@ class _FnLayer(Layer):
 # fleet wrapper
 # ---------------------------------------------------------------------------
 
+def _param_sig(layer: Layer):
+    """Structural signature of a layer's trainable state (stack-compat key)."""
+    return (type(layer).__name__,
+            tuple((tuple(p._value.shape), str(p._value.dtype))
+                  for p in layer.parameters()))
+
+
+def _functional_apply(layers: Sequence[Layer], leaves, x_val):
+    """Apply eager ``layers`` as a pure function of ``leaves`` (their param
+    values, flattened in ``layer.parameters()`` order). Parameter values are
+    swapped in for the duration of the (trace-time) call — the dispatcher is
+    trace-safe, so under ``jax.jit``/``grad`` this emits the layer's program
+    with ``leaves`` as inputs (the PartialProgramLayer state-binding trick,
+    SURVEY §2.4, applied to the pipeline)."""
+    from ..core import autograd as _ag
+    from ..core.tensor import Tensor, _wrap_value
+
+    params = [p for l in layers for p in l.parameters()]
+    if len(params) != len(leaves):
+        raise ValueError(f"leaf count {len(leaves)} != param count {len(params)}")
+    old = [p._value for p in params]
+    try:
+        for p, v in zip(params, leaves):
+            p._value = v
+        with _ag.no_grad():   # outer jax.grad differentiates; skip the tape
+            h = _wrap_value(x_val, stop_gradient=True)
+            for l in layers:
+                h = l(h)
+        return h._value if isinstance(h, Tensor) else h
+    finally:
+        for p, v in zip(params, old):
+            p._value = v
+
+
+def _find_block_run(sigs, min_repeats: int):
+    """Find the longest contiguous run of a repeating layer-signature unit
+    (the transformer-block pattern). Returns ``(start, period, repeats)`` or
+    ``None``. A unit must own at least one parameter."""
+    n = len(sigs)
+    best = None
+    for start in range(n):
+        for period in range(1, (n - start) // max(min_repeats, 2) + 1):
+            unit = sigs[start:start + period]
+            if not any(s[1] for s in unit):
+                continue
+            r = 1
+            while (start + (r + 1) * period <= n and
+                   sigs[start + r * period:start + (r + 1) * period] == unit):
+                r += 1
+            if r >= min_repeats:
+                cov = r * period
+                if best is None or cov > best[3]:
+                    best = (start, period, r, cov)
+    return best[:3] if best else None
+
+
+_NO_RUN_REASON = (
+    "no stackable block run detected in the layer list; build the model as "
+    "[prologue..., N identical blocks, epilogue...] with N a multiple of "
+    "pp_degree*virtual_pp_degree")
+
+
 class PipelineParallel(Layer):
     """``fleet.distributed_model`` wrapper for pp (ref: PipelineParallel).
 
-    ``train_batch(data, optimizer, lr_scheduler)`` splits the batch into
-    ``accumulate_steps`` micro-batches and accumulates gradients — numerically
-    identical to the reference's 1F1B result (schedule order does not change
-    the sum). The compiled rotational schedule for jit/bench paths is
-    :func:`pipeline_scan`.
+    ``train_batch(data, optimizer, lr_scheduler)`` runs ONE compiled XLA
+    program for the whole pipelined step: the model's repeated-block run is
+    auto-detected from the layer list, its parameters are stacked
+    ``[S*V, bpc, ...]``, and :func:`pipeline_scan` executes the micro-batch
+    schedule in-program (loss and backward included — no per-micro-batch
+    Python loop, SURVEY §3.4). Layers before/after the block run (embedding /
+    head / loss — the heterogeneous first and last stages) run replicated
+    around the ring; on TPU that is the right trade: they are cheap relative
+    to the blocks, and GSPMD shards what it can (the dedicated LLaMA path,
+    ``models.llama.make_pp_train_step``, goes further and vocab-shards them
+    over the pp ranks).
+
+    When the layer list has no stackable block run (or a scaler is used),
+    ``train_batch`` falls back to eager micro-batch accumulation —
+    numerically identical to the reference's 1F1B result (schedule order
+    does not change the sum) — and warns once.
+
+    ``strategy.pipeline_configs`` knobs: ``accumulate_steps`` (micro-batch
+    count), ``micro_batch_size``, ``virtual_pp_degree`` (circular/interleaved
+    schedule — upstream interleave 1F1B), ``compiled`` (default True).
     """
 
     def __init__(self, layers, hcg=None, strategy=None):
@@ -282,14 +457,165 @@ class PipelineParallel(Layer):
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self.virtual_pp_degree = int(cfg.get("virtual_pp_degree", 1))
+        self._use_compiled = bool(cfg.get("compiled", True))
+        self._compiled_step = None     # (jit_fn, pro, unit, blocks, epi)
+        self._compile_attempted = False
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    # -- compiled whole-step path -------------------------------------------
+    def _try_build_compiled(self):
+        """Detect [prologue, N x block, epilogue]; build the one-program step.
+
+        Returns the step info dict, or a string explaining why the compiled
+        path is unavailable (the caller warns with it once)."""
+        self._compile_attempted = True
+        S = int(self._hcg.get_pipe_parallel_world_size())
+        V = self.virtual_pp_degree
+        M = self.accumulate_steps
+        if S < 2:
+            return "pp degree is 1 (nothing to pipeline)"
+        if V > 1 and M < S:
+            return (f"virtual_pp_degree={V} needs accumulate_steps >= "
+                    f"pp_degree (got {M} < {S}); raise accumulate_steps")
+        all_layers = self._layers._layers_list
+        if any(l.buffers(include_sublayers=True) for l in all_layers):
+            return ("the model registers stateful buffers (e.g. BatchNorm "
+                    "running stats), which cannot be updated from inside "
+                    "the compiled schedule")
+        run = _find_block_run([_param_sig(l) for l in all_layers],
+                              min_repeats=S * V)
+        if run is None:
+            return _NO_RUN_REASON
+        start, period, repeats = run
+        r_use = (repeats // (S * V)) * (S * V)
+        if r_use < S * V:
+            return _NO_RUN_REASON
+        pro = all_layers[:start]
+        blocks = [all_layers[start + i * period:start + (i + 1) * period]
+                  for i in range(r_use)]
+        epi = all_layers[start + r_use * period:]
+        unit = blocks[0]
+        mesh = self._hcg.mesh
+        remat = bool(self._layers._recompute_interval)
+        loss_layer = self._layers._loss_fn
+
+        def block_leaves(blk):
+            return [p._value for l in blk for p in l.parameters()]
+
+        n_leaf = len(block_leaves(unit))
+        if n_leaf == 0:
+            return _NO_RUN_REASON
+
+        def chunk_fn(chunk_leaves, x):
+            # chunk_leaves: tuple of [bpc, ...] — scan the chunk's blocks
+            def blk(h, one):
+                return _functional_apply(unit, list(one), h), None
+            h, _ = lax.scan(blk, x, chunk_leaves)
+            return h
+
+        def loss_val(o_val, y_val):
+            from ..core.tensor import Tensor, _wrap_value
+            out = loss_layer(_wrap_value(o_val, stop_gradient=True),
+                             _wrap_value(y_val, stop_gradient=True))
+            return out._value if isinstance(out, Tensor) else out
+
+        def step_fn(stacked, pro_leaves, epi_leaves, xs, ys):
+            # xs/ys: [M, mb, ...]
+            def lossf(stacked, pro_leaves, epi_leaves):
+                Mm, mb = xs.shape[0], xs.shape[1]
+                x = xs.reshape((Mm * mb,) + xs.shape[2:])
+                if pro:
+                    x = _functional_apply(pro, pro_leaves, x)
+                x = x.reshape((Mm, mb) + x.shape[1:])
+                out = pipeline_scan(chunk_fn, stacked, x, mesh=mesh,
+                                    axis="pp", remat=remat,
+                                    circular_repeats=V)
+                o = out.reshape((Mm * mb,) + out.shape[2:])
+                if epi:
+                    o = _functional_apply(epi, epi_leaves, o)
+                o = o.reshape((Mm, mb) + o.shape[1:])
+                losses = jax.vmap(loss_val)(o, ys)
+                return losses.mean()
+            return jax.value_and_grad(lossf, argnums=(0, 1, 2))(
+                stacked, pro_leaves, epi_leaves)
+
+        bpc = r_use // (S * V)
+
+        def stack_now():
+            per_block = [block_leaves(b) for b in blocks]
+            return tuple(
+                jnp.stack([pb[j] for pb in per_block]).reshape(
+                    (S * V, bpc) + per_block[0][j].shape)
+                for j in range(n_leaf))
+
+        info = {
+            "jit": jax.jit(step_fn), "pro": pro, "epi": epi,
+            "blocks": blocks, "unit": unit, "stack": stack_now,
+            "S": S, "V": V, "bpc": bpc, "n_leaf": n_leaf,
+        }
+        return info
+
+    def _train_batch_compiled(self, data, optimizer, lr_scheduler):
+        from ..core.tensor import Tensor, _wrap_value
+        info = self._compiled_step
+        inputs, labels = data
+        M = self.accumulate_steps
+        xv = inputs._value if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        yv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        B = xv.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by accumulate_steps {M}")
+        xs = xv.reshape((M, B // M) + xv.shape[1:])
+        ys = yv.reshape((M, B // M) + yv.shape[1:])
+        pro_leaves = [p._value for l in info["pro"] for p in l.parameters()]
+        epi_leaves = [p._value for l in info["epi"] for p in l.parameters()]
+        loss, (g_st, g_pro, g_epi) = info["jit"](
+            info["stack"](), pro_leaves, epi_leaves, xs, ys)
+
+        # scatter grads back onto the eager Parameters
+        blk_params = [p for b in info["blocks"] for l in b
+                      for p in l.parameters()]
+        n_leaf = info["n_leaf"]
+        for j in range(n_leaf):
+            flat = g_st[j].reshape((-1,) + g_st[j].shape[2:])  # [N_blocks,...]
+            for i in range(flat.shape[0]):
+                blk_params[i * n_leaf + j]._accumulate_grad(
+                    _wrap_value(flat[i]))
+        for p, g in zip((p for l in info["pro"] for p in l.parameters()),
+                        g_pro):
+            p._accumulate_grad(_wrap_value(g))
+        for p, g in zip((p for l in info["epi"] for p in l.parameters()),
+                        g_epi):
+            p._accumulate_grad(_wrap_value(g))
+
+        optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return _wrap_value(loss)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """One pipelined training step; returns the mean micro-batch loss."""
         if self._layers._loss_fn is None:
             raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        if scaler is None and self._use_compiled:
+            if not self._compile_attempted:
+                built = self._try_build_compiled()
+                if isinstance(built, str):
+                    if self._hcg.get_pipe_parallel_world_size() > 1:
+                        import warnings
+                        warnings.warn(
+                            f"PipelineParallel: falling back to eager "
+                            f"micro-batch accumulation (numerically "
+                            f"identical, but the schedule is not a single "
+                            f"compiled program): {built}", stacklevel=2)
+                else:
+                    self._compiled_step = built
+            if self._compiled_step is not None:
+                return self._train_batch_compiled(data, optimizer, lr_scheduler)
         inputs, labels = data
         M = self.accumulate_steps
         in_parts = _split_microbatches(inputs, M)

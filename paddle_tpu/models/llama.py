@@ -33,11 +33,13 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "param_specs",
-           "batch_spec", "make_train_step", "LlamaForCausalLM", "num_params"]
+           "batch_spec", "make_train_step", "LlamaForCausalLM", "num_params",
+           "make_pp_train_step", "to_pp_layout", "from_pp_layout",
+           "pp_param_specs"]
 
 
 @dataclasses.dataclass
@@ -325,6 +327,45 @@ def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig,
 # functional train step (AdamW, fp32 master weights)
 # ---------------------------------------------------------------------------
 
+def _adamw_init(params, opt_dtype=jnp.float32):
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, opt_dtype), params)
+    return {"m": zeros,
+            "v": jax.tree_util.tree_map(jnp.copy, zeros),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adamw_apply(params, grads, opt_state, *, lr, beta1, beta2, eps,
+                 weight_decay, opt_dtype):
+    """One AdamW update with fp32 moment arithmetic (multi_precision path)."""
+    step = opt_state["step"] + 1
+    t = step.astype(jnp.float32)
+    bc1 = 1.0 - beta1 ** t
+    bc2 = 1.0 - beta2 ** t
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32)
+        m = beta1 * m.astype(jnp.float32) + (1 - beta1) * g
+        v = beta2 * v.astype(jnp.float32) + (1 - beta2) * (g * g)
+        u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        pf = p.astype(jnp.float32)
+        if weight_decay:
+            u = u + weight_decay * pf
+        return ((pf - lr * u).astype(p.dtype),
+                m.astype(opt_dtype), v.astype(opt_dtype))
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(opt_state["m"])
+    flat_v = treedef.flatten_up_to(opt_state["v"])
+    new = [upd(p, g, m, v) for p, g, m, v
+           in zip(flat_p, flat_g, flat_m, flat_v)]
+    params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
+    m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
+    v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
+    return params, {"m": m, "v": v, "step": step}
+
+
 def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
                     eps=1e-8, weight_decay=0.0, opt_dtype=jnp.float32):
     """Returns ``(init_opt_state, train_step)`` pure functions.
@@ -337,40 +378,175 @@ def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
     """
 
     def init_opt_state(params):
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, opt_dtype), params)
-        return {"m": zeros,
-                "v": jax.tree_util.tree_map(jnp.copy, zeros),
-                "step": jnp.zeros((), jnp.int32)}
+        return _adamw_init(params, opt_dtype)
 
     def train_step(params, opt_state, input_ids, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, cfg)
-        step = opt_state["step"] + 1
-        t = step.astype(jnp.float32)
-        bc1 = 1.0 - beta1 ** t
-        bc2 = 1.0 - beta2 ** t
+        params, opt_state = _adamw_apply(
+            params, grads, opt_state, lr=lr, beta1=beta1, beta2=beta2,
+            eps=eps, weight_decay=weight_decay, opt_dtype=opt_dtype)
+        return params, opt_state, loss
 
-        def upd(p, g, m, v):
-            g = g.astype(jnp.float32)
-            m = beta1 * m.astype(jnp.float32) + (1 - beta1) * g
-            v = beta2 * v.astype(jnp.float32) + (1 - beta2) * (g * g)
-            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-            pf = p.astype(jnp.float32)
-            if weight_decay:
-                u = u + weight_decay * pf
-            return ((pf - lr * u).astype(p.dtype),
-                    m.astype(opt_dtype), v.astype(opt_dtype))
+    return init_opt_state, train_step
 
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(opt_state["m"])
-        flat_v = treedef.flatten_up_to(opt_state["v"])
-        new = [upd(p, g, m, v) for p, g, m, v
-               in zip(flat_p, flat_g, flat_m, flat_v)]
-        params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
-        m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
-        v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
-        return params, {"m": m, "v": v, "step": step}, loss
+
+# ---------------------------------------------------------------------------
+# pipelined train step: ids -> loss in ONE compiled program over the pp axis
+# ---------------------------------------------------------------------------
+
+def to_pp_layout(params: Dict, num_stages: int, circular_repeats: int = 1):
+    """Reshape the stacked ``[L, ...]`` layer params into pipeline layout
+    ``[V, S, bpc, ...]`` (chunk ``c = v*S + s`` on device ``s``, lap ``v``;
+    ``bpc`` blocks per chunk) so the chunk->device assignment is a plain
+    shard of dim 1 over the ``pp`` mesh axis."""
+    S, V = num_stages, circular_repeats
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda p: p.reshape((V, S, p.shape[0] // (S * V)) + p.shape[1:]),
+        params["layers"])
+    return out
+
+
+def from_pp_layout(params: Dict):
+    """Inverse of :func:`to_pp_layout` (back to stacked ``[L, ...]``)."""
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda p: p.reshape((-1,) + p.shape[3:]), params["layers"])
+    return out
+
+
+def pp_param_specs(cfg: LlamaConfig, pp_axis: str = "pp") -> Dict:
+    """PartitionSpecs for pp-layout params: blocks sharded over the pp axis,
+    embedding/LM-head VOCAB-sharded over the same axis (the heterogeneous
+    first/last stages are not pipeline-isolated on TPU — they are
+    tensor-parallel over the pp ranks, which turns the classic
+    embedding-stage imbalance into useful parallel work; ref:
+    pipeline_parallel.py first/last-stage special-casing)."""
+    layer_keys = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                  "ln_attn", "ln_mlp")
+    specs = {
+        "embed": P(pp_axis, None),
+        "layers": {k: P(None, pp_axis) for k in layer_keys},
+        "ln_f": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, pp_axis)
+    return specs
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, *, micro_batches: int,
+                       pp_axis: str = "pp", dp_axis: Optional[str] = "dp",
+                       circular_repeats: int = 1, lr: float = 3e-4,
+                       beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.0,
+                       opt_dtype=jnp.float32):
+    """Pipeline-parallel LLaMA training: the FULL step — vocab-parallel
+    embedding, the circular ring schedule over decoder blocks, final norm,
+    vocab-parallel LM head + cross-entropy, backward, AdamW — is one
+    compiled XLA program; no per-micro-batch Python loop exists anywhere
+    (SURVEY §3.4; ref: pipeline_parallel.py forward_backward_pipeline +
+    ParallelCrossEntropy).
+
+    Params must be in pp layout (:func:`to_pp_layout`); shard them with
+    :func:`pp_param_specs` so block weights live only on their stage.
+
+    Returns ``(init_opt_state, train_step)`` with
+    ``train_step(params, opt_state, ids [B, T], labels) ->
+    (params, opt_state, loss)``; ``B`` is split into ``micro_batches``.
+    """
+    from ..distributed.pipeline import ring_schedule
+    from ..kernels.rope import rope_cos_sin
+
+    S = int(mesh.shape[pp_axis])
+    V = int(circular_repeats)
+    M = int(micro_batches)
+    L, Vo = cfg.num_hidden_layers, cfg.vocab_size
+    if L % (S * V):
+        raise ValueError(f"num_hidden_layers {L} not divisible by "
+                         f"stages*circular_repeats = {S}*{V}")
+    if Vo % S:
+        raise ValueError(f"vocab_size {Vo} not divisible by pp degree {S}")
+    dpn = dp_axis if (dp_axis and dp_axis in mesh.axis_names) else None
+    tree = jax.tree_util
+
+    def body(embed_l, layers_l, ln_f, head_l, ids, labels):
+        # embed_l [Vo/S, E]; layers_l leaves [V, 1, bpc, ...];
+        # ids/labels [M, mb, T] (mb = local micro-batch after dp sharding)
+        s = lax.axis_index(pp_axis)
+        Vs = embed_l.shape[0]
+        off = s * Vs
+        Tq = ids.shape[-1]
+
+        # ---- vocab-parallel embedding over the pp axis ----
+        idx = ids - off
+        ok = (idx >= 0) & (idx < Vs)
+        e = jnp.take(embed_l, jnp.clip(idx, 0, Vs - 1), axis=0)
+        e = jnp.where(ok[..., None], e, 0)
+        x = lax.psum(e, pp_axis).astype(cfg.dtype)     # [M, mb, T, E]
+
+        cos, sin = rope_cos_sin(Tq, cfg.head_dim, cfg.rope_theta)
+
+        def chunk_fn(cp, h):
+            # cp leaves [bpc, ...]: apply the chunk's blocks sequentially
+            def blk(hh, lp):
+                return decoder_layer(lp, hh, cos, sin, cfg), None
+            h, _ = lax.scan(blk, h, cp)
+            return h
+
+        fn = jax.checkpoint(chunk_fn) if cfg.remat else chunk_fn
+        mine = tree.tree_map(lambda p: p[:, 0], layers_l)
+        outs = ring_schedule(fn, mine, x, axis=pp_axis, num_stages=S,
+                             circular_repeats=V)        # [M, mb, T, E]
+
+        # ---- final norm + vocab-parallel LM head + cross-entropy ----
+        h = _rms_norm(outs, ln_f, cfg.rms_norm_eps, cfg.use_fused_norm)
+        hd = embed_l.T if cfg.tie_word_embeddings else head_l  # [E, Vo/S]
+        z = (h @ hd.astype(cfg.dtype)).astype(jnp.float32)  # [M, mb, T, Vo/S]
+        lmax = lax.pmax(lax.stop_gradient(z).max(axis=-1), pp_axis)
+        lse = jnp.log(lax.psum(
+            jnp.exp(z - lmax[..., None]).sum(axis=-1), pp_axis)) + lmax
+        lidx = labels - off
+        inshard = (lidx >= 0) & (lidx < Vs)
+        tgt_l = jnp.take_along_axis(
+            z, jnp.clip(lidx, 0, Vs - 1)[..., None], axis=-1)[..., 0]
+        tgt = lax.psum(jnp.where(inshard, tgt_l, 0.0), pp_axis)
+        mask = labels >= 0
+        lsum = jnp.where(mask, lse - tgt, 0.0).sum()
+        cnt = mask.sum()
+        if dpn is not None:
+            lsum = lax.psum(lsum, dpn)
+            cnt = lax.psum(cnt, dpn)
+        return lsum / jnp.maximum(cnt, 1)
+
+    def pp_loss(params, ids_m, labels_m):
+        layers = params["layers"]
+        in_layer_spec = tree.tree_map(lambda p: P(None, pp_axis), layers)
+        bspec = P(None, dpn, None) if dpn else P(None, None, None)
+        head = None if cfg.tie_word_embeddings else params["lm_head"]
+        shmap = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(pp_axis, None), in_layer_spec, P(None),
+                      (P(None, pp_axis) if head is not None else P()),
+                      bspec, bspec),
+            out_specs=P(), check_vma=False)
+        if head is None:
+            head = jnp.zeros((), cfg.param_dtype)  # placeholder (unused)
+        return shmap(params["embed"], layers, params["ln_f"], head,
+                     ids_m, labels_m)
+
+    def init_opt_state(params):
+        return _adamw_init(params, opt_dtype)
+
+    def train_step(params, opt_state, input_ids, labels):
+        B = input_ids.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by micro_batches {M}")
+        ids_m = input_ids.reshape(M, B // M, -1)
+        lbl_m = labels.reshape(M, B // M, -1)
+        loss, grads = jax.value_and_grad(pp_loss)(params, ids_m, lbl_m)
+        params, opt_state = _adamw_apply(
+            params, grads, opt_state, lr=lr, beta1=beta1, beta2=beta2,
+            eps=eps, weight_decay=weight_decay, opt_dtype=opt_dtype)
+        return params, opt_state, loss
 
     return init_opt_state, train_step
 
