@@ -172,4 +172,12 @@ void shm_ring_close(void* handle) {
   delete r;
 }
 
+// A forked child inherits the parent's handle with owner=true; it must NOT
+// sem_destroy/shm_unlink a ring the parent is still draining (sem_destroy on
+// a semaphore another process waits on is UB). The child calls this right
+// after fork so its close/exit only unmaps.
+void shm_ring_disown(void* handle) {
+  static_cast<Ring*>(handle)->owner = false;
+}
+
 }  // extern "C"

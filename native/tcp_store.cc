@@ -64,6 +64,12 @@ bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// Caps on client-supplied lengths: a stray/hostile connection must not be
+// able to trigger an unbounded allocation (std::bad_alloc in a worker
+// thread would std::terminate the whole training process).
+constexpr uint32_t kMaxKeyLen = 1u << 16;        // 64 KiB keys
+constexpr uint64_t kMaxValLen = 1ull << 30;      // 1 GiB values
+
 void serve_client(Store* st, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -72,12 +78,14 @@ void serve_client(Store* st, int fd) {
     if (!read_full(fd, &op, 1)) break;
     uint32_t klen;
     if (!read_full(fd, &klen, 4)) break;
+    if (klen > kMaxKeyLen) break;  // drop the connection
     std::string key(klen, '\0');
     if (klen && !read_full(fd, key.data(), klen)) break;
     uint64_t arg;
     if (!read_full(fd, &arg, 8)) break;
 
     if (op == 1) {  // SET
+      if (arg > kMaxValLen) break;  // drop the connection
       std::vector<uint8_t> val(arg);
       if (arg && !read_full(fd, val.data(), arg)) break;
       {

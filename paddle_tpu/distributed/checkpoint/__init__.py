@@ -89,6 +89,14 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
 
     with open(os.path.join(path, f"data_{rank}.pkl"), "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    # Multi-host: each rank records its OWN shard index so the global
+    # metadata does not depend on the coordinator addressing every shard
+    # (upstream gathers per-rank metadata into one file; here load unions
+    # the per-rank records — no cross-host gather needed at save time).
+    rank_records = {name: e["shards"] for name, e in meta.items()
+                    if e.get("kind") == "array"}
+    with open(os.path.join(path, f"meta_{rank}.pkl"), "wb") as f:
+        pickle.dump(rank_records, f, protocol=pickle.HIGHEST_PROTOCOL)
     if rank == coordinator_rank:
         with open(os.path.join(path, _META), "wb") as f:
             pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -132,6 +140,24 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         if fname.startswith("data_") and fname.endswith(".pkl"):
             with open(os.path.join(path, fname), "rb") as f:
                 files[fname] = pickle.load(f)
+    # union per-rank shard records (multi-host saves: the coordinator's
+    # metadata only lists its own addressable shards)
+    for fname in sorted(os.listdir(path)):
+        if fname.startswith("meta_") and fname.endswith(".pkl"):
+            with open(os.path.join(path, fname), "rb") as f:
+                records = pickle.load(f)
+            for name, recs in records.items():
+                entry = meta.get(name)
+                if entry is None or entry.get("kind") != "array":
+                    continue
+                # dedup by shard index: a value replicated across hosts is
+                # taken from the first rank that recorded it
+                seen_idx = {r["index"] for r in entry["shards"]}
+                for r in recs:
+                    if r["index"] in seen_idx:
+                        continue
+                    entry["shards"].append(r)
+                    seen_idx.add(r["index"])
 
     flat = _flatten(state_dict)
     missing = [k for k in flat if k not in meta]

@@ -39,7 +39,8 @@ class DistributedStrategy:
 
     def __init__(self):
         self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-                               "sharding_degree": 1, "sep_degree": 1}
+                               "sharding_degree": 1, "sep_degree": 1,
+                               "ep_degree": 1}
         self.amp = False
         self.amp_configs = {}
         self.recompute = False
@@ -68,7 +69,7 @@ def init(role_maker=None, is_collective: bool = True,
     # upstream convention: degree <= 0 (usually -1) means "auto-infer"; only dp
     # is auto-filled from the remaining devices, other axes normalize to 1
     degrees = {k: max(int(cfg.get(f"{k}_degree", 1)), 1)
-               for k in ("dp", "mp", "pp", "sharding", "sep")}
+               for k in ("dp", "mp", "pp", "sharding", "sep", "ep")}
     dp_requested = int(cfg.get("dp_degree", 1))
     product = 1
     for v in degrees.values():
@@ -82,7 +83,7 @@ def init(role_maker=None, is_collective: bool = True,
             degrees["dp"] = n // non_dp  # dp fills the remaining devices
     hcg = HybridCommunicateGroup(
         dp=degrees["dp"], mp=degrees["mp"], pp=degrees["pp"],
-        sharding=degrees["sharding"], sep=degrees["sep"])
+        sharding=degrees["sharding"], sep=degrees["sep"], ep=degrees["ep"])
     set_hybrid_communicate_group(hcg)
     _fleet_state["initialized"] = True
     _fleet_state["strategy"] = strategy

@@ -9,11 +9,14 @@ alltoall dispatch, aux load-balancing losses). TPU redesign:
   ``dispatch [T,E,C]`` / ``combine [T,E,C]`` one-hot tensors contracted on
   the MXU. No scatter/gather kernels, fully differentiable, static shapes
   (XLA-friendly: token drop = capacity mask, no dynamic sizes).
-* Expert parallelism is a sharding: expert-stacked params carry
-  ``PartitionSpec(ep_axis, ...)`` and the dispatch einsum's contraction
-  makes GSPMD emit the all_to_all the reference writes by hand. Inside an
-  explicit ``shard_map`` region the layer emits ``lax.all_to_all``
-  directly (the global_scatter/global_gather pairing).
+* Expert parallelism is a sharding: identical experts are CONSOLIDATED at
+  construction into stacked ``[E, ...]`` Parameters sharded
+  ``PartitionSpec(ep_axis, ...)`` — each device stores only its ``E/ep``
+  experts — and applied with ``jax.vmap`` over the expert dim (one traced
+  program, no Python unroll). The dispatch einsum's contraction makes GSPMD
+  emit the all_to_all the reference writes by hand with
+  ``global_scatter``/``global_gather``.
+* Heterogeneous expert lists fall back to an unrolled replicated path.
 """
 
 from __future__ import annotations
@@ -132,6 +135,12 @@ class MoELayer(Layer):
     ``experts`` is a list of Layers applied expert-wise; ``gate`` a _GateBase
     (or dict config: {"type": "gshard"|"switch"|"naive", ...}). ``moe_group``
     selects the expert-parallel mesh axis (None = single-group/replicated).
+
+    When the experts are structurally identical (the standard case) their
+    weights are consolidated into stacked ``[E, ...]`` Parameters
+    (``expert_stack_<j>`` in the state dict) sharded over ``moe_group`` —
+    each device stores ``E/ep`` experts — and applied via ``jax.vmap``.
+    Heterogeneous experts fall back to an unrolled, replicated path.
     """
 
     def __init__(self, d_model: int, experts: Sequence[Layer],
@@ -140,8 +149,6 @@ class MoELayer(Layer):
         super().__init__()
         self.d_model = d_model
         self.num_experts = len(experts)
-        from ..nn.layers.container import LayerList
-        self.experts = LayerList(list(experts))
         if gate is None or isinstance(gate, dict):
             cfg = dict(gate or {})
             typ = cfg.pop("type", "gshard")
@@ -153,11 +160,76 @@ class MoELayer(Layer):
         self.moe_group = moe_group
         self.aux_loss: Optional[Tensor] = None
 
+        from .pipeline import _param_sig
+        sigs = [_param_sig(e) for e in experts]
+        if len(set(sigs)) == 1 and sigs[0][1] and len(experts) > 0:
+            # stacked-expert fast path: consolidate weights, keep the expert
+            # objects only as an unregistered template/API-compat list
+            object.__setattr__(self, "experts", list(experts))
+            object.__setattr__(self, "_template", experts[0])
+            stacked = []
+            per = [list(e.parameters()) for e in experts]
+            for j in range(len(per[0])):
+                p = Parameter(jnp.stack([ps[j]._value for ps in per]))
+                self.add_parameter(f"expert_stack_{j}", p)
+                stacked.append(p)
+            object.__setattr__(self, "_stacked", stacked)
+            object.__setattr__(self, "_ep_sharded", False)
+            self.shard_expert_weights()
+        else:
+            from ..nn.layers.container import LayerList
+            self.experts = LayerList(list(experts))
+            object.__setattr__(self, "_stacked", None)
+            object.__setattr__(self, "_template", None)
+
     def _ep_size(self) -> int:
-        if self.moe_group is None:
+        # does NOT install the default dp-only topology as a side effect:
+        # an MoELayer built before fleet.init must see ep=1 here and
+        # re-shard lazily once the real topology exists
+        from . import topology as _topo
+        if self.moe_group is None or _topo._hcg is None:
             return 1
-        mesh = get_hybrid_communicate_group().mesh
+        mesh = _topo._hcg.mesh
         return int(mesh.shape.get(self.moe_group, 1))
+
+    def shard_expert_weights(self, mesh=None):
+        """Place the stacked expert Parameters with ``P(ep_axis, ...)`` so
+        each device stores only its experts (the memory-scaling contract of
+        expert parallelism; ref: per-rank expert placement in moe_layer).
+        Called at construction and re-attempted lazily on forward, so a
+        layer built BEFORE ``fleet.init`` still gets sharded."""
+        ep = self._ep_size()
+        if self._stacked is None or self.moe_group is None or ep <= 1 \
+                or _axis_bound(self.moe_group):
+            return
+        if self.num_experts % ep:
+            raise ValueError(
+                f"num_experts {self.num_experts} not divisible by "
+                f"ep degree {ep} (axis {self.moe_group!r})")
+        mesh = mesh or get_hybrid_communicate_group().mesh
+        for p in self._stacked:
+            if isinstance(p._value, jax.core.Tracer):
+                return  # mid-trace: placement is the caller's business
+            sh = NamedSharding(
+                mesh, P(self.moe_group, *([None] * (p._value.ndim - 1))))
+            p._value = jax.device_put(p._value, sh)
+        object.__setattr__(self, "_ep_sharded", True)
+
+    # mode switches must reach the unregistered expert template/list
+    # (consolidation keeps them out of sublayers())
+    def train(self):
+        super().train()
+        if self._stacked is not None:
+            for e in self.experts:
+                e.train()
+        return self
+
+    def eval(self):
+        super().eval()
+        if self._stacked is not None:
+            for e in self.experts:
+                e.eval()
+        return self
 
     def forward(self, x):
         """x [B, S, M] (or [T, M]) -> same shape; stores ``self.aux_loss``."""
@@ -167,18 +239,13 @@ class MoELayer(Layer):
         T = int(np.prod(orig_shape[:-1]))
         cap = self.gate.capacity(T)
         gw = self.gate.weight
-        expert_params: List[List[Tensor]] = [
-            list(e.parameters()) for e in self.experts]
-        flat_eparams = [p for ps in expert_params for p in ps]
-        counts = [len(ps) for ps in expert_params]
         gate_obj = self.gate
-        experts = list(self.experts)
         ep_axis = self.moe_group
-        # EP distribution is a sharding: annotate the expert-stacked dispatch
-        # tensor over the ep axis and GSPMD inserts the all_to_all the
-        # reference's global_scatter/global_gather write by hand. (Inside an
-        # explicit shard_map region the annotation is a no-op and the layer
-        # computes replicated — the compiled-program path is the fast path.)
+        # EP distribution is a sharding: annotate the expert-stacked tensors
+        # over the ep axis and GSPMD inserts the all_to_all the reference's
+        # global_scatter/global_gather write by hand. (Inside an explicit
+        # shard_map region the annotation is a no-op and the layer computes
+        # with whatever the caller sharded.)
         constrain = (ep_axis is not None and not _axis_bound(ep_axis))
 
         def _ep_put(v):
@@ -191,27 +258,55 @@ class MoELayer(Layer):
                 return lax.with_sharding_constraint(v, sharding)
             return jax.device_put(v, sharding)
 
-        def run(xv, gwv, *eparams):
+        def _route(xv, gwv):
             tokens = xv.reshape(T, M)
             logits = tokens @ gwv.astype(tokens.dtype)
             combine, dispatch, aux = gate_obj._routing(
                 logits.astype(jnp.float32), cap)
-            combine = combine.astype(tokens.dtype)
-            dispatch = dispatch.astype(tokens.dtype)
-            # dispatch to expert queues: [E, C, M], expert dim ep-sharded
-            einp = _ep_put(jnp.einsum("tec,tm->ecm", dispatch, tokens))
-            # apply experts (unrolled; E is small and static)
-            outs = []
-            ofs = 0
-            for i, e in enumerate(experts):
-                ps = eparams[ofs:ofs + counts[i]]
-                ofs += counts[i]
-                outs.append(_apply_expert(e, ps, einp[i]))
-            eout = _ep_put(jnp.stack(outs))            # [E, C, M]
-            y = jnp.einsum("tec,ecm->tm", combine, eout)
-            return y.reshape(orig_shape), aux
+            return (tokens, combine.astype(tokens.dtype),
+                    dispatch.astype(tokens.dtype), aux)
 
-        out, aux = forward_op("moe_layer", run, [t, gw, *flat_eparams])
+        if self._stacked is not None:
+            if not getattr(self, "_ep_sharded", True) and self._ep_size() > 1:
+                self.shard_expert_weights()   # topology arrived after init
+            template = self._template
+
+            def run(xv, gwv, *stacked):
+                tokens, combine, dispatch, aux = _route(xv, gwv)
+                # dispatch to expert queues: [E, C, M], expert dim ep-sharded
+                einp = _ep_put(jnp.einsum("tec,tm->ecm", dispatch, tokens))
+
+                def one(leaves, inp):
+                    from .pipeline import _functional_apply
+                    return _functional_apply([template], list(leaves), inp)
+
+                eout = _ep_put(jax.vmap(one)(tuple(stacked), einp))
+                y = jnp.einsum("tec,ecm->tm", combine, eout)
+                return y.reshape(orig_shape), aux
+
+            out, aux = forward_op("moe_layer", run,
+                                  [t, gw, *self._stacked])
+        else:
+            expert_params: List[List[Tensor]] = [
+                list(e.parameters()) for e in self.experts]
+            flat_eparams = [p for ps in expert_params for p in ps]
+            counts = [len(ps) for ps in expert_params]
+            experts = list(self.experts)
+
+            def run(xv, gwv, *eparams):
+                tokens, combine, dispatch, aux = _route(xv, gwv)
+                einp = _ep_put(jnp.einsum("tec,tm->ecm", dispatch, tokens))
+                outs = []
+                ofs = 0
+                for i, e in enumerate(experts):
+                    ps = eparams[ofs:ofs + counts[i]]
+                    ofs += counts[i]
+                    outs.append(_apply_expert(e, ps, einp[i]))
+                eout = _ep_put(jnp.stack(outs))            # [E, C, M]
+                y = jnp.einsum("tec,ecm->tm", combine, eout)
+                return y.reshape(orig_shape), aux
+
+            out, aux = forward_op("moe_layer", run, [t, gw, *flat_eparams])
         self.aux_loss = aux
         return out
 

@@ -360,8 +360,18 @@ class _FnLayer(Layer):
 # ---------------------------------------------------------------------------
 
 def _param_sig(layer: Layer):
-    """Structural signature of a layer's trainable state (stack-compat key)."""
-    return (type(layer).__name__,
+    """Structural signature for stack-compatibility: class names of the whole
+    sublayer tree (parameterless layers matter — GELU vs ReLU), every param
+    shape/dtype, and simple scalar hyperparams (dropout p, eps, ...). Layers
+    must agree on ALL of this before their weights are stacked and run
+    through one shared program."""
+    def cfg(l):
+        return tuple(sorted(
+            (k, v) for k, v in vars(l).items()
+            if not k.startswith("_") and isinstance(v, (int, float, bool, str))
+        ))
+    tree = [layer] + layer.sublayers()
+    return (tuple((type(l).__name__, cfg(l)) for l in tree),
             tuple((tuple(p._value.shape), str(p._value.dtype))
                   for p in layer.parameters()))
 
@@ -559,6 +569,11 @@ class PipelineParallel(Layer):
         return info
 
     def _train_batch_compiled(self, data, optimizer, lr_scheduler):
+        # NOTE: each step re-stacks block params from the eager Parameters
+        # and scatters grads back — O(blocks * leaves) host work that keeps
+        # the eager optimizer/LR-scheduler semantics intact. The zero-
+        # overhead pipeline (stacked params as the source of truth, update
+        # in-program) is ``models.llama.make_pp_train_step``.
         from ..core.tensor import Tensor, _wrap_value
         info = self._compiled_step
         inputs, labels = data

@@ -21,8 +21,11 @@ from jax.sharding import Mesh
 __all__ = ["HybridCommunicateGroup", "ParallelAxis", "get_hybrid_communicate_group",
            "build_mesh", "set_hybrid_communicate_group"]
 
-# outermost -> innermost (mp innermost = nearest-neighbor ICI)
-_AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+# outermost -> innermost (mp innermost = nearest-neighbor ICI); ep sits
+# between sharding and sep: expert all_to_all is bulkier than mp collectives
+# but finer-grained than dp gradient reduction (ref: the moe group borrows
+# dp ranks in incubate/distributed/models/moe)
+_AXIS_ORDER = ("dp", "pp", "sharding", "ep", "sep", "mp")
 
 
 def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
@@ -77,9 +80,10 @@ class HybridCommunicateGroup:
     """
 
     def __init__(self, dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
-                 sep: int = 1, devices: Optional[Sequence] = None):
+                 sep: int = 1, ep: int = 1,
+                 devices: Optional[Sequence] = None):
         self.degrees = {"dp": dp, "mp": mp, "pp": pp, "sharding": sharding,
-                        "sep": sep}
+                        "sep": sep, "ep": ep}
         self.mesh = build_mesh(self.degrees, devices)
         self._axes = {a: ParallelAxis(self.mesh, a) for a in _AXIS_ORDER}
 
@@ -99,6 +103,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self) -> int:
         return self.degrees["sep"]
 
+    def get_expert_parallel_world_size(self) -> int:
+        return self.degrees["ep"]
+
     # -- axis ("group") handles --------------------------------------------
     def get_data_parallel_group(self) -> ParallelAxis:
         return self._axes["dp"]
@@ -114,6 +121,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> ParallelAxis:
         return self._axes["sep"]
+
+    def get_expert_parallel_group(self) -> ParallelAxis:
+        return self._axes["ep"]
 
     # Rank semantics (single-controller): inside a shard_map/pjit trace the rank
     # is the traced lax.axis_index; at the python level it is the coordinate of
@@ -153,6 +163,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_rank(self) -> int:
         return self._axis_rank("sep")
+
+    def get_expert_parallel_rank(self) -> int:
+        return self._axis_rank("ep")
 
     def get_stage_id(self) -> int:
         return self._axis_rank("pp")

@@ -20,6 +20,7 @@ import collections
 import itertools
 import multiprocessing as mp
 import queue as pyqueue
+import time
 import traceback
 from typing import Any, Callable, List, Optional
 
@@ -110,10 +111,18 @@ class _RingSource:
 
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
-                 worker_id, num_workers, seed, iterable, ring=None):
+                 worker_id, num_workers, seed, iterable, ring=None,
+                 all_rings=()):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
     np.random.seed(seed % (2 ** 31))
+    # forked children inherit owner=True ring handles; they must not destroy
+    # the parent's semaphores / shm at interpreter exit (ADVICE r2)
+    for r in all_rings:
+        try:
+            r.disown()
+        except Exception:
+            pass
     if ring is not None:
         import pickle
 
@@ -271,16 +280,19 @@ class DataLoader:
                 target=_worker_loop,
                 args=(self.dataset, iq, result_queue, self.collate_fn,
                       self.worker_init_fn, w, nw, base_seed + w,
-                      self._iterable, rings[w] if rings else None),
+                      self._iterable, rings[w] if rings else None,
+                      tuple(rings) if rings else ()),
                 daemon=True)
             p.start()
             index_queues.append(iq)
             workers.append(p)
         try:
             if self._iterable:
-                yield from self._mp_iterable(index_queues, result_src, nw)
+                yield from self._mp_iterable(index_queues, result_src, nw,
+                                             workers)
             else:
-                yield from self._mp_map(index_queues, result_src, nw)
+                yield from self._mp_map(index_queues, result_src, nw,
+                                        workers)
         finally:
             for iq in index_queues:
                 try:
@@ -295,16 +307,43 @@ class DataLoader:
                 for r in rings:
                     r.close()
 
-    def _get(self, result_queue):
-        timeout = self.timeout if self.timeout else None
-        try:
-            return result_queue.get(timeout=timeout)
-        except pyqueue.Empty:
-            raise RuntimeError(
-                f"DataLoader timed out after {self.timeout}s waiting for a "
-                f"worker batch") from None
+    def _get(self, result_queue, workers=()):
+        """Queue get with a liveness watchdog: wait in short slices and fail
+        fast with a descriptive error when a worker died (OOM-kill/segfault)
+        instead of blocking forever (the reference DataLoader's watchdog)."""
+        deadline = (None if not self.timeout
+                    else time.monotonic() + self.timeout)
+        while True:
+            slice_t = 1.0
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s waiting "
+                        f"for a worker batch")
+                slice_t = min(slice_t, left)
+            try:
+                return result_queue.get(timeout=slice_t)
+            except pyqueue.Empty:
+                dead = [(i, p.exitcode) for i, p in enumerate(workers)
+                        if not p.is_alive()]
+                if dead:
+                    # final drain: a worker may have enqueued its result (or
+                    # the real exception) just before exiting — surface that
+                    # instead of a misleading died-unexpectedly error
+                    try:
+                        return result_queue.get(timeout=0.2)
+                    except pyqueue.Empty:
+                        pass
+                    descr = ", ".join(f"worker {i} exit code {c}"
+                                      for i, c in dead)
+                    raise RuntimeError(
+                        f"DataLoader worker(s) died unexpectedly ({descr}) — "
+                        f"likely killed by OOM or a segfault in dataset "
+                        f"code; the remaining batch will never arrive"
+                    ) from None
 
-    def _mp_map(self, index_queues, result_queue, nw):
+    def _mp_map(self, index_queues, result_queue, nw, workers=()):
         batches = list(self.batch_sampler)
         depth = min(len(batches), self.prefetch_factor * nw)
         nxt = 0
@@ -314,7 +353,7 @@ class DataLoader:
         reorder = {}
         for want in range(len(batches)):
             while want not in reorder:
-                bidx, data = self._get(result_queue)
+                bidx, data = self._get(result_queue, workers)
                 if bidx == -1 or isinstance(data, _ExceptionWrapper):
                     if isinstance(data, _ExceptionWrapper):
                         data.reraise()
@@ -325,7 +364,7 @@ class DataLoader:
                 nxt += 1
             yield data
 
-    def _mp_iterable(self, index_queues, result_queue, nw):
+    def _mp_iterable(self, index_queues, result_queue, nw, workers=()):
         # request batches round-robin; a worker answering StopIteration is
         # retired, remaining workers drain their stream tails
         active = set(range(nw))
@@ -352,7 +391,7 @@ class DataLoader:
         done = set()
         while inflight:
             while inflight[0] not in reorder:
-                i, data = self._get(result_queue)
+                i, data = self._get(result_queue, workers)
                 if isinstance(data, _ExceptionWrapper):
                     data.reraise()
                 reorder[i] = data

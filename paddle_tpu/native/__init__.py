@@ -32,22 +32,33 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build_native(force: bool = False) -> str:
-    """Compile native/*.cc into one shared library (cached by mtime)."""
+    """Compile native/*.cc into one shared library (cached by source HASH —
+    an mtime check can be fooled by a stale artifact newer than edited
+    sources, e.g. after a checkout)."""
+    import hashlib
     srcs = [os.path.join(_REPO_NATIVE, f)
             for f in sorted(os.listdir(_REPO_NATIVE)) if f.endswith(".cc")]
     if not srcs:
         raise RuntimeError(f"no native sources found in {_REPO_NATIVE}")
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if not force and os.path.exists(_LIB_PATH):
-        lib_mtime = os.path.getmtime(_LIB_PATH)
-        if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
-            return _LIB_PATH
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()
+    stamp = os.path.join(_BUILD_DIR, "source.sha256")
+    if not force and os.path.exists(_LIB_PATH) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return _LIB_PATH
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            *srcs, "-o", _LIB_PATH, "-lrt"]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(
             f"native build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    with open(stamp, "w") as f:
+        f.write(digest)
     return _LIB_PATH
 
 
@@ -95,6 +106,7 @@ def lib() -> ctypes.CDLL:
             L.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                        ctypes.c_uint64, ctypes.c_int]
             L.shm_ring_close.argtypes = [ctypes.c_void_p]
+            L.shm_ring_disown.argtypes = [ctypes.c_void_p]
             _lib = L
     return _lib
 
@@ -218,6 +230,12 @@ class ShmRing:
         if n < 0:
             return None
         return buf.raw[:n]
+
+    def disown(self):
+        """Mark this handle non-owner (a forked child must not destroy the
+        semaphores / unlink shm the parent is still using)."""
+        if self._h:
+            self._L.shm_ring_disown(self._h)
 
     def close(self):
         if self._h:

@@ -1,0 +1,215 @@
+"""Expert parallelism tests (SURVEY §2.5 EP; ref:
+incubate/distributed/models/moe — MoELayer, gates, capacity/token drop,
+global_scatter/global_gather as GSPMD all_to_all).
+
+Oracles: parity vs the replicated layer, manual routing math, per-device
+shard-size accounting (the memory-scaling contract)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.moe import MoELayer, SwitchGate
+from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+
+
+@pytest.fixture
+def ep_mesh():
+    hcg = HybridCommunicateGroup(dp=2, ep=4)
+    set_hybrid_communicate_group(hcg)
+    yield hcg
+    set_hybrid_communicate_group(None)
+
+
+def _mk_experts(d, n, seed):
+    paddle.seed(seed)
+    return [nn.Sequential(nn.Linear(d, 2 * d), nn.GELU(), nn.Linear(2 * d, d))
+            for _ in range(n)]
+
+
+class TestExpertParallel:
+    def test_ep4_parity_vs_replicated(self, ep_mesh):
+        """ep-sharded expert weights compute the same function (sharding is
+        placement, not math — the GSPMD all_to_all is invisible numerics)."""
+        d = 8
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, d).astype("float32"))
+        moe_ep = MoELayer(d_model=d, experts=_mk_experts(d, 4, 3),
+                          gate={"type": "gshard", "capacity_factor": 4.0},
+                          moe_group="ep")
+        moe_rep = MoELayer(d_model=d, experts=_mk_experts(d, 4, 3),
+                           gate={"type": "gshard", "capacity_factor": 4.0},
+                           moe_group=None)
+        y_ep = moe_ep(x).numpy()
+        y_rep = moe_rep(x).numpy()
+        np.testing.assert_allclose(y_ep, y_rep, atol=1e-5)
+        np.testing.assert_allclose(float(moe_ep.aux_loss),
+                                   float(moe_rep.aux_loss), atol=1e-6)
+
+    def test_expert_weights_sharded_per_device(self, ep_mesh):
+        """Memory proof: each device stores E/ep of every expert weight
+        (mirror of TestZeroStage2Memory for the ep axis)."""
+        d = 8
+        moe = MoELayer(d_model=d, experts=_mk_experts(d, 4, 1),
+                       moe_group="ep")
+        assert moe._stacked is not None
+        d0 = jax.devices()[0]
+        for p in moe._stacked:
+            arr = p._value
+            dev_bytes = sum(
+                int(np.prod(s.data.shape)) * s.data.dtype.itemsize
+                for s in arr.addressable_shards if s.device == d0)
+            assert dev_bytes * 4 == arr.nbytes, p.name
+            assert "ep" in str(arr.sharding.spec)
+
+    def test_sharding_survives_training_step(self, ep_mesh):
+        d = 8
+        moe = MoELayer(d_model=d, experts=_mk_experts(d, 4, 2),
+                       moe_group="ep")
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=moe.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, d).astype("float32"))
+        losses = []
+        for _ in range(5):
+            y = moe(x)
+            loss = (y ** 2).mean() + 0.01 * moe.aux_loss
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        for p in moe._stacked:  # updates must not de-shard the experts
+            assert "ep" in str(p._value.sharding.spec)
+
+    def test_num_experts_not_divisible_raises(self, ep_mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            MoELayer(d_model=8, experts=_mk_experts(8, 6, 0), moe_group="ep")
+
+    def test_differing_activations_do_not_consolidate(self, ep_mesh):
+        """Same param shapes but different parameterless internals (GELU vs
+        ReLU) must NOT be stacked under one template (r3 review)."""
+        d = 8
+        paddle.seed(9)
+        experts = [nn.Sequential(nn.Linear(d, d), nn.GELU(), nn.Linear(d, d)),
+                   nn.Sequential(nn.Linear(d, d), nn.ReLU(), nn.Linear(d, d))]
+        moe = MoELayer(d_model=d, experts=experts, moe_group=None)
+        assert moe._stacked is None  # falls back to the faithful unroll
+
+    def test_eval_mode_reaches_consolidated_experts(self, ep_mesh):
+        """train()/eval() must propagate into the unregistered expert
+        template so Dropout etc. behave correctly (r3 review)."""
+        d = 8
+        paddle.seed(10)
+        experts = [nn.Sequential(nn.Linear(d, d), nn.Dropout(0.5))
+                   for _ in range(4)]
+        moe = MoELayer(d_model=d, experts=experts, moe_group="ep")
+        assert moe._stacked is not None
+        moe.eval()
+        assert all(not l.training for e in moe.experts
+                   for l in [e] + e.sublayers())
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(1, 4, d).astype("float32"))
+        y1, y2 = moe(x).numpy(), moe(x).numpy()
+        np.testing.assert_array_equal(y1, y2)  # dropout off => deterministic
+        moe.train()
+        assert all(l.training for e in moe.experts
+                   for l in [e] + e.sublayers())
+
+    def test_lazy_shard_after_fleet_init(self):
+        """An MoELayer built BEFORE the topology exists re-shards its expert
+        weights on first forward once the ep axis is available (r3 review)."""
+        d = 8
+        set_hybrid_communicate_group(None)
+        moe = MoELayer(d_model=d, experts=_mk_experts(d, 4, 11),
+                       moe_group="ep")
+        assert not moe._ep_sharded
+        try:
+            set_hybrid_communicate_group(HybridCommunicateGroup(dp=2, ep=4))
+            x = paddle.to_tensor(
+                np.random.RandomState(7).randn(1, 4, d).astype("float32"))
+            moe(x)
+            assert moe._ep_sharded
+            for p in moe._stacked:
+                assert "ep" in str(p._value.sharding.spec)
+        finally:
+            set_hybrid_communicate_group(None)
+
+    def test_heterogeneous_experts_fall_back(self, ep_mesh):
+        """Structurally different experts use the unrolled replicated path
+        and still train."""
+        d = 8
+        paddle.seed(5)
+        experts = [nn.Linear(d, d),
+                   nn.Sequential(nn.Linear(d, 4), nn.Tanh(), nn.Linear(4, d)),
+                   nn.Linear(d, d),
+                   nn.Sequential(nn.Linear(d, 4), nn.Tanh(), nn.Linear(4, d))]
+        moe = MoELayer(d_model=d, experts=experts, moe_group=None)
+        assert moe._stacked is None
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 4, d).astype("float32"))
+        y = moe(x)
+        (y ** 2).mean().backward()
+        grads = [p.grad for e in experts for p in e.parameters()]
+        assert all(g is not None for g in grads)
+
+
+class TestCapacityTokenDrop:
+    def test_overflow_tokens_dropped_to_zero(self):
+        """Numeric token-drop oracle: top-1 routing with capacity 1 — the
+        first token in the expert's queue is served, later ones emit 0
+        (ref: capacity + token dropping in the moe gates)."""
+        d = 4
+        paddle.seed(0)
+        expert0 = nn.Linear(d, d)
+        expert1 = nn.Linear(d, d)
+        moe = MoELayer(d_model=d, experts=[expert0, expert1],
+                       gate={"type": "switch", "capacity_factor": 0.6})
+        # force all 3 tokens onto expert 0
+        gw = np.zeros((d, 2), np.float32)
+        gw[:, 0] = 1.0
+        moe.gate.weight.set_value(gw)
+        T = 3
+        assert moe.gate.capacity(T) == 1  # ceil(3 * 0.6 * 1 / 2) = 1
+        x_np = np.random.RandomState(3).randn(1, T, d).astype("float32")
+        x_np = np.abs(x_np)  # keep logits for expert 0 strictly largest
+        y = moe(paddle.to_tensor(x_np)).numpy()[0]
+        # token 0 is served by expert 0 with renormalized gate 1.0
+        ref0 = expert0(paddle.to_tensor(x_np[0, :1])).numpy()[0]
+        np.testing.assert_allclose(y[0], ref0, atol=1e-5)
+        # tokens 1, 2 overflowed capacity -> dropped -> exact zeros
+        np.testing.assert_allclose(y[1], np.zeros(d), atol=0)
+        np.testing.assert_allclose(y[2], np.zeros(d), atol=0)
+
+    def test_large_capacity_keeps_everything(self):
+        d = 4
+        paddle.seed(1)
+        moe = MoELayer(d_model=d, experts=[nn.Linear(d, d) for _ in range(2)],
+                       gate={"type": "switch", "capacity_factor": 100.0})
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(1, 6, d).astype("float32"))
+        y = moe(x).numpy()[0]
+        assert not np.any(np.all(y == 0, axis=-1))  # nothing dropped
+
+    def test_stacked_matches_unrolled_path(self):
+        """The vmap fast path and the unrolled fallback are the same math."""
+        d = 8
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(2, 6, d).astype("float32"))
+        moe = MoELayer(d_model=d, experts=_mk_experts(d, 4, 7),
+                       gate={"type": "gshard", "capacity_factor": 4.0})
+        y_fast = moe(x).numpy()
+
+        moe2 = MoELayer(d_model=d, experts=_mk_experts(d, 4, 7),
+                        gate={"type": "gshard", "capacity_factor": 4.0})
+        # force the unrolled path: rebuild with per-expert registration
+        object.__setattr__(moe2, "_stacked", None)
+        from paddle_tpu.nn.layers.container import LayerList
+        moe2.experts = LayerList(list(moe2.experts))
+        y_slow = moe2(x).numpy()
+        np.testing.assert_allclose(y_fast, y_slow, atol=1e-5)

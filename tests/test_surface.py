@@ -430,8 +430,11 @@ class TestMoE:
         assert moe.aux_loss is not None and np.isfinite(float(moe.aux_loss))
         (y ** 2).mean().backward()
         assert moe.gate.weight.grad is not None
-        grads = [p.grad for e in experts for p in e.parameters()]
+        # identical experts are consolidated into stacked [E, ...] Parameters
+        assert moe._stacked is not None
+        grads = [p.grad for p in moe._stacked]
         assert all(g is not None for g in grads)
+        assert all(g.shape[0] == 4 for g in grads)
 
     def test_top1_switch_with_huge_capacity_matches_dense_expert(self):
         """With capacity >= tokens and top-1 routing, each token's output is
