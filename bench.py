@@ -1,18 +1,20 @@
 """Benchmark harness (BASELINE.md protocol).
 
-Default run: steady-state LLaMA train-step throughput on the current backend
-(the real TPU chip under the driver), printing ONE JSON line:
+Default run: EVERY bench point, one JSON line each on stdout (machine-
+readable for the driver), the headline LAST:
 
     {"metric": "llama_train_mfu", "value": <pct>, "unit": "%", "vs_baseline": r}
 
-``vs_baseline`` is measured MFU / the 50% north-star MFU from BASELINE.json.
-Secondary detail (tokens/sec, step time, config, hardware) goes to stderr and
-should be copied into BASELINE.md rows.
+The headline is the HONEST LLaMA-ratio config (I=5504, L=12 — LLaMA-7B
+shape ratios at 738M scale); ``vs_baseline`` = measured MFU / the 50%
+north-star from BASELINE.json. Secondary rows (wide-FFN variant, flash
+attention vs XLA SDPA, ResNet-50, BERT-base, SDXL attention) carry
+``vs_baseline`` relative to their round-2 recorded values so the driver can
+track regressions. Detail (tokens/sec, step time, config, hardware) goes to
+stderr and is copied into BASELINE.md rows.
 
-Flags:
-  --attn     also microbench Pallas flash attention vs the jnp SDPA reference
-  --size S   small|base|large model preset (default: auto by backend)
-  --steps N  timed steps (default 10)
+Flags restrict the run to single sections (--llama, --wide, --attn,
+--resnet, --bert, --sdxl); default = all, each section failure-isolated.
 """
 
 from __future__ import annotations
@@ -46,24 +48,28 @@ def _peak_tflops(dev) -> float:
     return 197.0  # conservative default; note in stderr
 
 
-def _presets(backend: str):
+def _presets(backend: str, wide: bool = False):
+    """(cfg, batch, seq). ``wide=False`` (the HEADLINE): LLaMA-7B shape
+    ratios (I/E=2.6875, i.e. I=5504, L=12) at 738M params. ``wide=True``
+    (secondary): the benchmark-friendly 4x-wide SwiGLU FFN (I=8192, L=8) —
+    this chip's sustained matmul throughput is strongly K/N-width dependent
+    (K=N=1024 caps at ~22 TF/s, wide contractions at ~85-171 of 197 peak),
+    recorded to show the width effect, NOT as the headline."""
     from paddle_tpu.models.llama import LlamaConfig
     if backend != "tpu":
         # CPU smoke config — numbers are not meaningful, just keep the
         # harness runnable anywhere
         return LlamaConfig(vocab_size=1024, hidden_size=128,
-                           intermediate_size=384, num_hidden_layers=2,
+                           intermediate_size=512 if wide else 384,
+                           num_hidden_layers=2,
                            num_attention_heads=4, num_key_value_heads=4,
                            use_kernels=False, remat=False), 2, 256
-    # Config chosen from the on-chip sweep: this chip's sustained matmul
-    # throughput is strongly K/N-width dependent (K=N=1024 caps at ~22 TF/s,
-    # K=N=2048 at ~42, wide contractions at ~85-171 of 197 peak), so the
-    # bench model uses a 4x-wide SwiGLU FFN (I=8192) — 53.9% MFU vs 49.8%
-    # for the LLaMA-ratio I=5504/L=12 variant, both fitting fp32 Adam in HBM.
     import jax.numpy as jnp
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=8192,
-        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+        vocab_size=32000, hidden_size=2048,
+        intermediate_size=8192 if wide else 5504,
+        num_hidden_layers=8 if wide else 12,
+        num_attention_heads=16, num_key_value_heads=16,
         max_position_embeddings=2048, use_kernels=True, remat=True,
         dtype=jnp.bfloat16, param_dtype=jnp.float32)
     return cfg, 8, 2048
@@ -116,8 +122,36 @@ def bench_train(cfg, batch, seq, steps, lr=1e-4):
             "loss": final}
 
 
+def _loop_timed(grad_fn, q, k, v, iters):
+    """Time fwd+bwd of ``grad_fn`` with the iteration loop INSIDE one
+    compiled program (a lax.fori_loop with a scalar dependency chain), so the
+    axon tunnel's ~10ms per-dispatch overhead amortizes to nothing. Returns
+    seconds per iteration."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(q, k, v):
+        def body(i, carry):
+            # serialize iterations WITHOUT promoting q's dtype (bf16 + f32
+            # scalar would silently time an f32 kernel)
+            qq = q + (carry * 1e-24).astype(q.dtype)
+            g = grad_fn(qq, k, v)
+            return g[0].ravel()[0].astype(jnp.float32)
+        return lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    f = jax.jit(run)
+    float(f(q, k, v))                 # compile + warm
+    t0 = time.time()
+    out = float(f(q, k, v))
+    per = (time.time() - t0) / iters
+    assert np.isfinite(out)
+    return per
+
+
 def bench_attention(seq=2048, batch=4, heads=16, head_dim=64, steps=10):
-    """Pallas flash attention vs jnp SDPA reference, fwd+bwd, causal."""
+    """Pallas flash attention vs jnp SDPA reference, fwd+bwd, causal
+    (iteration loop compiled in-graph — see _loop_timed)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.kernels.flash_attention import flash_attention
@@ -136,20 +170,12 @@ def bench_attention(seq=2048, batch=4, heads=16, head_dim=64, steps=10):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
-    def _drain(out):  # device->host read (see bench_train timing note)
-        return float(jnp.asarray(out[0]).ravel()[0])
-
     results = {}
     for name, fn in (("flash", lambda q, k, v: flash_attention(q, k, v, causal=True)),
                      ("ref", ref)):
-        f = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(
-            jnp.float32).sum(), argnums=(0, 1, 2)))
-        _drain(f(q, k, v))
-        t0 = time.time()
-        for _ in range(steps):
-            out = f(q, k, v)
-        _drain(out)
-        results[name] = (time.time() - t0) / steps
+        g = jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+                     argnums=(0, 1, 2))
+        results[name] = _loop_timed(g, q, k, v, max(steps, 10))
     return results
 
 
@@ -266,45 +292,46 @@ def bench_sdxl_attention(steps=10):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
                    for kk in ks)
-        f = jax.jit(jax.grad(lambda q, k, v: flash_attention(
-            q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-        float(jnp.asarray(f(q, k, v)[0]).ravel()[0])
-        t0 = time.time()
-        for _ in range(steps):
-            g = f(q, k, v)
-        float(jnp.asarray(g[0]).ravel()[0])
-        out[name + "_ms"] = round((time.time() - t0) / steps * 1e3, 2)
+        g = jax.grad(lambda q, k, v: flash_attention(q, k, v).astype(
+            jnp.float32).sum(), argnums=(0, 1, 2))
+        out[name + "_ms"] = round(
+            _loop_timed(g, q, k, v, max(steps, 10)) * 1e3, 2)
     return out
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--attn", action="store_true")
-    ap.add_argument("--resnet", action="store_true")
-    ap.add_argument("--bert", action="store_true")
-    ap.add_argument("--sdxl", action="store_true")
-    ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--batch", type=int, default=None)
-    ap.add_argument("--seq", type=int, default=None)
-    args = ap.parse_args()
+# recorded values — regression anchors for vs_baseline on the secondary
+# rows (BASELINE.md; the headline's anchor is the 50% north star). The two
+# kernel microbenches are anchored at round 3 because the timing methodology
+# changed there (in-graph fori_loop instead of dispatch pipelining — the
+# axon tunnel's ~10ms/dispatch overhead polluted the round-2 numbers).
+_R2_ANCHORS = {
+    "llama_wide_train_mfu": 55.1,     # % (round 2)
+    "flash_attn_speedup": 1.0,        # the XLA-composed SDPA itself is the
+    # baseline; measured 1.0-1.75x across runs (the REF side's executable
+    # varies run to run — XLA compile-time autotuning), flash side stable
+    "resnet50_throughput": 964.0,     # img/s (round 2)
+    "bert_base_throughput": 605.0,    # ex/s (round 2)
+    "sdxl_attn_64x64": 10.5,          # ms, lower is better (round 3, bf16)
+}
 
-    import jax
-    backend = jax.default_backend()
-    dev = jax.devices()[0]
-    peak = _peak_tflops(dev)
 
+def _emit(metric, value, unit, vs_baseline):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit,
+                      "vs_baseline": round(vs_baseline, 3)}))
+    sys.stdout.flush()
+
+
+def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
     from paddle_tpu.models.llama import num_params
-    cfg, batch, seq = _presets(backend)
-    batch = args.batch or batch
-    seq = args.seq or seq
-
-    r = bench_train(cfg, batch, seq, args.steps)
+    cfg, batch, seq = _presets(backend, wide=wide)
+    batch = batch_arg or batch
+    seq = seq_arg or seq
+    r = bench_train(cfg, batch, seq, steps)
     flops = _train_flops_per_step(cfg, batch, seq)
     tflops_s = flops / r["step_time_s"] / 1e12
     mfu = 100.0 * tflops_s / peak
-
     detail = {
-        "backend": backend, "device_kind": getattr(dev, "device_kind", "?"),
+        "preset": "llama_wide" if wide else "llama_ratio",
         "params": num_params(cfg), "batch": batch, "seq": seq,
         "step_time_s": round(r["step_time_s"], 4),
         "compile_s": round(r["compile_s"], 1),
@@ -314,35 +341,119 @@ def main():
         "loss": round(r["loss"], 3),
     }
     print(json.dumps(detail), file=sys.stderr)
+    return mfu
 
-    if args.attn:
-        a = bench_attention(steps=args.steps)
-        print(json.dumps({"attn_flash_s": round(a["flash"], 4),
-                          "attn_ref_s": round(a["ref"], 4),
-                          "flash_speedup": round(a["ref"] / a["flash"], 2)}),
-              file=sys.stderr)
 
-    if args.resnet:
-        rn = bench_resnet(steps=args.steps)
-        print(json.dumps({"resnet50_images_per_s": round(rn["images_per_s"]),
-                          "resnet50_step_s": round(rn["step_time_s"], 4),
-                          "resnet50_compile_s": round(rn["compile_s"], 1)}),
-              file=sys.stderr)
+def main():
+    ap = argparse.ArgumentParser()
+    for sec in ("llama", "wide", "attn", "resnet", "bert", "sdxl"):
+        ap.add_argument(f"--{sec}", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    args = ap.parse_args()
+    chosen = [s for s in ("llama", "wide", "attn", "resnet", "bert", "sdxl")
+              if getattr(args, s)]
+    run_all = not chosen
 
-    if args.bert:
-        bt = bench_bert(steps=args.steps)
-        print(json.dumps({"bert_base_examples_per_s":
-                          round(bt["examples_per_s"]),
-                          "bert_step_s": round(bt["step_time_s"], 4)}),
-              file=sys.stderr)
+    def want(s):
+        return run_all or s in chosen
 
-    if args.sdxl:
-        print(json.dumps(bench_sdxl_attention(steps=args.steps)),
-              file=sys.stderr)
+    import jax
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev)
+    print(json.dumps({"backend": backend,
+                      "device_kind": getattr(dev, "device_kind", "?")}),
+          file=sys.stderr)
 
-    # ONE JSON line on stdout (driver contract); north star = 50% MFU
-    print(json.dumps({"metric": "llama_train_mfu", "value": round(mfu, 2),
-                      "unit": "%", "vs_baseline": round(mfu / 50.0, 3)}))
+    import os
+    t_start = time.time()
+    budget = float(os.environ.get("BENCH_BUDGET_S", "540"))
+
+    def section(name, fn, budget_exempt=False):
+        """Failure isolation + time budget: one broken or slow section must
+        not hide the rest (or starve the headline). Returns fn()'s value or
+        None on failure/skip."""
+        if not budget_exempt and time.time() - t_start > budget:
+            print(json.dumps({"section": name,
+                              "skipped": f"budget {budget}s exhausted"}),
+                  file=sys.stderr)
+            return None
+        try:
+            return fn()
+        except Exception as e:
+            print(json.dumps({"section": name, "error": f"{type(e).__name__}:"
+                              f" {str(e)[:300]}"}), file=sys.stderr)
+            return None
+
+    # the HEADLINE runs FIRST (it must exist even if the driver kills a slow
+    # secondary section; budget-exempt) and is re-emitted as the final line
+    # (the driver parses the last metric line)
+    headline = None
+    if want("llama"):
+        headline = section(
+            "llama",
+            lambda: _llama_point(backend, peak, args.steps, wide=False,
+                                 batch_arg=args.batch, seq_arg=args.seq),
+            budget_exempt=True)
+        # a failed headline must still be the last metric line (value 0),
+        # never silently replaced by whatever secondary ran last
+        _emit("llama_train_mfu",
+              round(headline, 2) if headline is not None else 0.0, "%",
+              (headline / 50.0) if headline is not None else 0.0)
+
+    if want("wide"):
+        def _wide():
+            mfu = _llama_point(backend, peak, args.steps, wide=True)
+            _emit("llama_wide_train_mfu", round(mfu, 2), "%",
+                  mfu / _R2_ANCHORS["llama_wide_train_mfu"])
+        section("wide", _wide)
+    if want("attn"):
+        def _attn():
+            a = bench_attention(steps=args.steps)
+            sp = a["ref"] / a["flash"]
+            print(json.dumps({"attn_flash_s": round(a["flash"], 4),
+                              "attn_ref_s": round(a["ref"], 4)}),
+                  file=sys.stderr)
+            _emit("flash_attn_speedup", round(sp, 2), "x",
+                  sp / _R2_ANCHORS["flash_attn_speedup"])
+        section("attn", _attn)
+    if want("sdxl"):
+        def _sdxl():
+            s = bench_sdxl_attention(steps=args.steps)
+            print(json.dumps(s), file=sys.stderr)
+            v = s["sdxl_64x64_ms"]
+            _emit("sdxl_attn_64x64", v, "ms",
+                  _R2_ANCHORS["sdxl_attn_64x64"] / v)  # lower is better
+        section("sdxl", _sdxl)
+    if want("resnet"):
+        def _resnet():
+            rn = bench_resnet(steps=args.steps)
+            print(json.dumps({"resnet50_step_s": round(rn["step_time_s"], 4),
+                              "resnet50_compile_s": round(rn["compile_s"], 1),
+                              "loss": round(rn["loss"], 3)}), file=sys.stderr)
+            v = rn["images_per_s"]
+            _emit("resnet50_throughput", round(v), "img/s",
+                  v / _R2_ANCHORS["resnet50_throughput"])
+        section("resnet", _resnet)
+    if want("bert"):
+        def _bert():
+            bt = bench_bert(steps=args.steps)
+            print(json.dumps({"bert_step_s": round(bt["step_time_s"], 4),
+                              "bert_compile_s": round(bt["compile_s"], 1)}),
+                  file=sys.stderr)
+            v = bt["examples_per_s"]
+            _emit("bert_base_throughput", round(v), "ex/s",
+                  v / _R2_ANCHORS["bert_base_throughput"])
+        section("bert", _bert)
+
+    # re-emit the headline LAST: honest LLaMA-ratio config vs the 50% MFU
+    # north star (the driver parses the final metric line)
+    if want("llama"):
+        _emit("llama_train_mfu",
+              round(headline, 2) if headline is not None else 0.0, "%",
+              (headline / 50.0) if headline is not None else 0.0)
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ from .core.place import (CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
 from .core.tensor import Parameter, Tensor, to_tensor
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
 from .core.dispatch import OP_REGISTRY
+from .core import enforce  # typed-error layer (PADDLE_ENFORCE parity)
 from .ops import *  # noqa: F401,F403 — the tensor op surface
 from .ops import __all__ as _ops_all
 from .ops import seed  # override any collision: paddle.seed is the RNG seed

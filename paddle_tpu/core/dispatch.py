@@ -80,7 +80,11 @@ def forward_op(name: str, fn: Callable, args: Sequence[Any],
                 diff_idx.append(i)
 
     if not diff_idx:
-        out_vals = fn(*vals, **kwargs)
+        try:
+            out_vals = fn(*vals, **kwargs)
+        except Exception as e:  # typed error with op + shapes + user frame
+            from .enforce import translate_op_error
+            raise translate_op_error(e, name, vals) from e
         _maybe_check_nan(name, out_vals)
         return _wrap_outputs(out_vals, None)
 
@@ -90,7 +94,11 @@ def forward_op(name: str, fn: Callable, args: Sequence[Any],
             full[i] = v
         return fn(*full, **kwargs)
 
-    out_vals, vjp_fn = jax.vjp(diff_fn, *(vals[i] for i in diff_idx))
+    try:
+        out_vals, vjp_fn = jax.vjp(diff_fn, *(vals[i] for i in diff_idx))
+    except Exception as e:  # typed error with op + shapes + user frame
+        from .enforce import translate_op_error
+        raise translate_op_error(e, name, vals) from e
     _maybe_check_nan(name, out_vals)
 
     multi = isinstance(out_vals, (tuple, list))

@@ -19,7 +19,7 @@ __all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
            "UnimplementedError", "UnavailableError", "ResourceExhaustedError",
            "PreconditionNotMetError", "ExecutionTimeoutError", "FatalError",
            "enforce", "enforce_eq", "enforce_gt", "enforce_not_none",
-           "install_signal_handlers"]
+           "install_signal_handlers", "translate_op_error", "user_frame"]
 
 
 class EnforceNotMet(RuntimeError):
@@ -108,6 +108,92 @@ def enforce_not_none(value, message: str = ""):
     if value is None:
         raise NotFoundError(message or "expected a value, got None")
     return value
+
+
+# ---------------------------------------------------------------------------
+# dispatcher-raised error translation (the PADDLE_ENFORCE user experience:
+# op name + argument shapes/dtypes + the USER's stack frame, with jax/XLA
+# internals trimmed, and actionable hints for the common failure classes)
+# ---------------------------------------------------------------------------
+
+_INTERNAL_MARKERS = ("/paddle_tpu/", "/jax/", "/jaxlib/", "/jax_", "<frozen")
+
+
+def user_frame():
+    """(filename, lineno, funcname) of the innermost stack frame OUTSIDE
+    this framework and jax — the line of user code that triggered the op
+    (ref: the python-side of the fused C++/Python traceback)."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not any(m in fn for m in _INTERNAL_MARKERS):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+def _describe(v) -> str:
+    shape = getattr(v, "shape", None)
+    if shape is None:
+        return repr(v)[:40]
+    dtype = getattr(v, "dtype", "?")
+    return f"{dtype}{list(shape)}"
+
+
+def translate_op_error(e: BaseException, op: str, vals=()) -> "EnforceNotMet":
+    """Map a raw jax/XLA exception from operator ``op`` to a typed framework
+    error carrying the op name, input signatures, the user stack frame, and
+    a hint when the failure class is recognized (OOM, shape mismatch, dtype
+    mismatch, donation, NaN). The original exception is preserved as
+    ``__cause__`` (raise ... from e at the call site)."""
+    if isinstance(e, EnforceNotMet):
+        return e
+    import re as _re
+    text = str(e)
+    low = text.lower()
+    cls, hint = InvalidArgumentError, ""
+    # typed-exception classes first: their message text must not reroute
+    # them through the substring heuristics
+    if isinstance(e, NotImplementedError):
+        cls = UnimplementedError
+    elif isinstance(e, MemoryError):
+        cls = ResourceExhaustedError
+    elif "resource_exhausted" in low or "out of memory" in low or \
+            "ran out of memory" in low:
+        cls = ResourceExhaustedError
+        hint = ("the program does not fit in device memory — lower the "
+                "batch size, enable activation recomputation "
+                "(recompute/remat), store optimizer moments in bfloat16, "
+                "or shard parameters (ZeRO/mp) across more devices")
+    elif "donat" in low:
+        cls = InvalidArgumentError
+        hint = ("a donated buffer was reused — don't read arrays passed "
+                "with donate_argnums after the call, or drop the donation")
+    elif "incompatible shapes" in low or "shapes must be equal" in low or \
+            "dimension" in low and ("mismatch" in low or "must" in low) or \
+            "rank" in low and "must" in low or "got shape" in low or \
+            "size" in low and "reshape" in low:
+        cls = InvalidArgumentError
+        hint = "check the input shapes listed above"
+    elif "dtype" in low or "must be a" in low and "type" in low:
+        cls = InvalidArgumentError
+        hint = "check the input dtypes listed above"
+    elif isinstance(e, FloatingPointError) or \
+            _re.search(r"\bnan\b|\binf\b|non-finite", low):
+        cls = FatalError
+        hint = ("enable FLAGS_check_nan_inf to pinpoint the first operator "
+                "producing non-finite values")
+
+    sig = ", ".join(_describe(v) for v in vals) if vals else "-"
+    first = text.strip().splitlines()[0][:400] if text.strip() else \
+        type(e).__name__
+    uf = user_frame()
+    at = f"\n  [user code: {uf[0]}:{uf[1]} in {uf[2]}]" if uf else ""
+    hint_s = f"\n  [Hint: {hint}]" if hint else ""
+    err = cls(
+        f"operator `{op}` failed: {first}\n  inputs: ({sig}){at}{hint_s}",
+        frame=uf or ("<unknown>", 0, "?"))
+    return err
 
 
 _handlers_installed = False

@@ -62,6 +62,9 @@ class LlamaConfig:
     dtype: Any = jnp.float32         # activation/compute dtype
     param_dtype: Any = jnp.float32   # storage dtype
     remat: bool = False              # jax.checkpoint each decoder layer
+    remat_policy: Optional[str] = None  # None = full remat; "dots" saves MXU
+    # outputs and recomputes only elementwise (less recompute FLOPs, more
+    # HBM); "nothing" saves nothing (alias of full remat, explicit)
     sep_axis: Optional[str] = None   # context-parallel mesh axis (e.g. "sep")
     cp_impl: str = "ring"            # "ring" | "ulysses" attention over sep
 
@@ -167,6 +170,31 @@ def shard_params(params, mesh: Mesh, cfg: LlamaConfig, mp_axis="mp",
 # forward
 # ---------------------------------------------------------------------------
 
+def _remat_policy(name: Optional[str]):
+    """Map a config string to a jax.checkpoint policy (SURVEY §6: the remat
+    policy sweep is a first-class MFU knob — full remat recomputes the whole
+    block including its matmuls; "dots" keeps MXU outputs in HBM and only
+    recomputes the cheap elementwise tail)."""
+    if name is None or name == "nothing":
+        return None
+    import jax.ad_checkpoint as adc
+    policies = {
+        "dots": adc.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": adc.checkpoint_policies.dots_saveable,
+        # save the attention block's outputs ([B,S,E]-sized — cheap in HBM)
+        # so backward never re-runs the flash kernel forward; the FFN (whose
+        # [B,S,I] intermediates dominate activation memory) still remats
+        "save_attn": adc.checkpoint_policies.save_only_these_names(
+            "attn_out"),
+        "save_qkv_attn": adc.checkpoint_policies.save_only_these_names(
+            "attn_out", "qkv"),
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat_policy {name!r}; "
+                         f"options: {sorted(policies)} or None")
+    return policies[name]
+
+
 def _rms_norm(x, w, eps, use_kernels):
     if use_kernels:
         from ..kernels.rms_norm import rms_norm as fused
@@ -255,13 +283,16 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
     H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     dt = cfg.dtype
 
+    from jax.ad_checkpoint import checkpoint_name
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
     q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, D)
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, Hk, D)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, Hk, D)
-    q = _rope(q, cos, sin, cfg.use_fused_norm)
-    k = _rope(k, cos, sin, cfg.use_fused_norm)
+    q = checkpoint_name(_rope(q, cos, sin, cfg.use_fused_norm), "qkv")
+    k = checkpoint_name(_rope(k, cos, sin, cfg.use_fused_norm), "qkv")
+    v = checkpoint_name(v, "qkv")
     o = _attention(q, k, v, cfg, segment_ids).reshape(B, S, H * D)
+    o = checkpoint_name(o, "attn_out")
     x = x + o @ lp["wo"].astype(dt)
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
@@ -297,7 +328,7 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
     layer = partial(decoder_layer, cos=cos, sin=sin, cfg=cfg,
                     segment_ids=segment_ids)
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        layer = jax.checkpoint(layer, policy=_remat_policy(cfg.remat_policy))
 
     def scan_body(h, lp):
         return layer(lp, h), None

@@ -210,7 +210,12 @@ def test_nan_check_flag():
     paddle.set_flags({"FLAGS_check_nan_inf": True})
     try:
         x = paddle.to_tensor([-1.0], stop_gradient=False)
-        with pytest.raises(FloatingPointError):
+        # jax_debug_nans raises FloatingPointError; the dispatcher wraps it
+        # in the typed FatalError carrying the op name + nan-hunt hint
+        # (r3 enforce layer), chaining the original as __cause__
+        with pytest.raises(
+                (FloatingPointError, paddle.enforce.FatalError)) as ei:
             paddle.log(x)
+        assert "log" in str(ei.value)
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})

@@ -1,0 +1,142 @@
+"""Error-quality tests (SURVEY §2.1 platform misc; ref: PADDLE_ENFORCE +
+the fused C++/Python traceback). The contract: failures raised through the
+dispatcher are TYPED, name the operator, list input shapes/dtypes, point at
+the USER's code line (jax internals trimmed), and carry an actionable hint
+for the recognized failure classes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.enforce import (EnforceNotMet, FatalError,
+                                     InvalidArgumentError,
+                                     ResourceExhaustedError,
+                                     UnimplementedError, enforce, enforce_eq,
+                                     enforce_gt, enforce_not_none,
+                                     translate_op_error)
+
+
+def _t(shape, dtype="float32"):
+    return paddle.to_tensor(np.ones(shape, dtype))
+
+
+class TestDispatcherErrors:
+    """Failure modes through real ops (each asserts type AND content)."""
+
+    def test_matmul_shape_mismatch(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            paddle.matmul(_t((2, 3)), _t((4, 5)))
+        msg = str(ei.value)
+        assert "matmul" in msg
+        assert "float32[2, 3]" in msg and "float32[4, 5]" in msg
+        assert "test_enforce.py" in msg          # the USER frame, not jax's
+
+    def test_add_incompatible_shapes(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            _t((2, 3)) + _t((7, 5))
+        assert "[2, 3]" in str(ei.value) and "[7, 5]" in str(ei.value)
+
+    def test_reshape_wrong_size(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            paddle.reshape(_t((2, 3)), [4, 4])
+        msg = str(ei.value)
+        assert "reshape" in msg and "[2, 3]" in msg
+
+    def test_concat_rank_mismatch(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            paddle.concat([_t((2, 3)), _t((2, 3, 4))])
+        assert "concat" in str(ei.value)
+
+    def test_cross_entropy_bad_label_rank(self):
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(EnforceNotMet):
+            F.cross_entropy(_t((4, 10)), _t((4, 2, 2), "int64"))
+
+    def test_conv_channel_mismatch(self):
+        import paddle_tpu.nn as nn
+        conv = nn.Conv2D(3, 8, 3)
+        with pytest.raises(EnforceNotMet) as ei:
+            conv(_t((1, 5, 16, 16)))            # 5 channels into in=3
+        assert "test_enforce.py" in str(ei.value)
+
+    def test_split_bad_sections(self):
+        with pytest.raises(EnforceNotMet):
+            paddle.split(_t((6, 2)), [4, 4], axis=0)
+
+    def test_original_exception_preserved_as_cause(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            paddle.matmul(_t((2, 3)), _t((4, 5)))
+        assert ei.value.__cause__ is not None   # raw jax error chained
+
+
+class TestTranslation:
+    """Unit-level translation of failure classes we cannot cheaply trigger
+    on the test backend (OOM, donation)."""
+
+    def test_oom_translates_to_resource_exhausted_with_hint(self):
+        e = RuntimeError(
+            "RESOURCE_EXHAUSTED: XLA:TPU compile permanent error. Ran out "
+            "of memory in memory space hbm. Used 21.02G of 15.75G hbm.")
+        err = translate_op_error(e, "llama_loss", [np.zeros((8, 2048))])
+        assert isinstance(err, ResourceExhaustedError)
+        msg = str(err)
+        assert "llama_loss" in msg
+        assert "recompute" in msg or "remat" in msg      # actionable hint
+        assert "batch size" in msg
+
+    def test_donation_hint(self):
+        e = RuntimeError("Donation is not implemented for this buffer; "
+                         "donated buffer was reused")
+        err = translate_op_error(e, "train_step", [])
+        assert "donate" in str(err)
+
+    def test_nan_maps_to_fatal_with_flag_hint(self):
+        e = FloatingPointError("invalid value (nan) encountered in matmul")
+        err = translate_op_error(e, "matmul", [])
+        assert isinstance(err, FatalError)
+        assert "FLAGS_check_nan_inf" in str(err)
+
+    def test_not_implemented_maps_to_unimplemented(self):
+        err = translate_op_error(NotImplementedError("no such kernel"),
+                                 "sparse_mm", [])
+        assert isinstance(err, UnimplementedError)
+        assert err.error_code == "UNIMPLEMENTED"
+
+    def test_already_typed_error_passes_through(self):
+        orig = InvalidArgumentError("x must be positive")
+        assert translate_op_error(orig, "op", []) is orig
+
+    def test_dtype_mismatch_hint(self):
+        e = TypeError("lax.add requires arguments to have the same dtypes, "
+                      "got float32, int32")
+        err = translate_op_error(e, "add", [])
+        assert "dtype" in str(err)
+
+
+class TestEnforceHelpers:
+    def test_enforce_raises_with_frame(self):
+        with pytest.raises(EnforceNotMet) as ei:
+            enforce(1 == 2, "degrees must multiply to world size")
+        msg = str(ei.value)
+        assert "degrees must multiply" in msg
+        assert "test_enforce.py" in msg
+
+    def test_enforce_eq_message(self):
+        with pytest.raises(InvalidArgumentError) as ei:
+            enforce_eq(3, 4, "stage count")
+        assert "3" in str(ei.value) and "4" in str(ei.value)
+        assert "stage count" in str(ei.value)
+
+    def test_enforce_gt(self):
+        with pytest.raises(InvalidArgumentError):
+            enforce_gt(1, 2)
+
+    def test_enforce_not_none(self):
+        from paddle_tpu.core.enforce import NotFoundError
+        with pytest.raises(NotFoundError):
+            enforce_not_none(None, "param 'weight' missing from state dict")
+
+    def test_error_codes_hierarchy(self):
+        assert issubclass(ResourceExhaustedError, EnforceNotMet)
+        assert issubclass(InvalidArgumentError, RuntimeError)
+        assert paddle.enforce.InvalidArgumentError is InvalidArgumentError
