@@ -405,7 +405,8 @@ def main():
 
     if want("wide"):
         def _wide():
-            mfu = _llama_point(backend, peak, args.steps, wide=True)
+            mfu = _llama_point(backend, peak, args.steps, wide=True,
+                               batch_arg=args.batch, seq_arg=args.seq)
             _emit("llama_wide_train_mfu", round(mfu, 2), "%",
                   mfu / _R2_ANCHORS["llama_wide_train_mfu"])
         section("wide", _wide)

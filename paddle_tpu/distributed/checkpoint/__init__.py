@@ -87,19 +87,26 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         meta[name] = entry
         payload[name] = shards
 
-    with open(os.path.join(path, f"data_{rank}.pkl"), "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    # All files are written tmp+rename (atomic on POSIX): an elastic restart
+    # can SIGKILL a rank mid-save, and the resume contract depends on every
+    # *.pkl in the directory being either the old or the new version — never
+    # torn (concurrent readers during the same round see the same guarantee).
+    def _atomic_dump(obj, fname):
+        tmp = os.path.join(path, f".{fname}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, os.path.join(path, fname))
+
+    _atomic_dump(payload, f"data_{rank}.pkl")
     # Multi-host: each rank records its OWN shard index so the global
     # metadata does not depend on the coordinator addressing every shard
     # (upstream gathers per-rank metadata into one file; here load unions
     # the per-rank records — no cross-host gather needed at save time).
     rank_records = {name: e["shards"] for name, e in meta.items()
                     if e.get("kind") == "array"}
-    with open(os.path.join(path, f"meta_{rank}.pkl"), "wb") as f:
-        pickle.dump(rank_records, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _atomic_dump(rank_records, f"meta_{rank}.pkl")
     if rank == coordinator_rank:
-        with open(os.path.join(path, _META), "wb") as f:
-            pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _atomic_dump(meta, _META)
 
 
 def _assemble(entry: Dict, files: Dict[str, Dict], name: str) -> np.ndarray:

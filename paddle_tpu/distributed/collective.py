@@ -53,6 +53,11 @@ def init_parallel_env():
     global _initialized
     import os
 
+    # elastic liveness: stamp heartbeats into the launcher's TCPStore so a
+    # hung (not just crashed) worker is detected (distributed/elastic.py)
+    if os.environ.get("PADDLE_ELASTIC_STORE"):
+        from .elastic import start_heartbeat
+        start_heartbeat()
     if not _initialized and os.environ.get("PADDLE_TRAINERS_NUM", "1") not in ("", "1"):
         # multi-host: consume the launcher's env contract (launch/main.py)
         # explicitly — jax.distributed's own autodetect doesn't know the

@@ -1,0 +1,120 @@
+"""Elastic training: liveness heartbeats + failure detection.
+
+Parity target: ``python/paddle/distributed/fleet/elastic/manager.py`` in the
+reference (etcd-backed node heartbeats, watchdog that detects dead/hung
+trainers, job-level restart). TPU redesign: the launcher hosts the native
+C++ :class:`~paddle_tpu.native.TCPStore` (the rendezvous KV) and every
+worker runs a daemon thread stamping ``hb/<job>/<rank>`` with a timestamp;
+the launcher's watch loop declares a rank HUNG when its stamp goes stale for
+``--elastic_timeout`` seconds — catching workers that are alive-but-frozen
+(deadlock, stuck collective, swap storm), which exit-code watching alone
+cannot see. Detection triggers the same kill-all + restart-round path as a
+crash; the restart gets a FRESH rendezvous (new coordinator port) and the
+training script resumes from its own (distributed) checkpoint.
+
+Worker side is automatic: ``init_parallel_env`` (and thus ``fleet.init``)
+calls :func:`start_heartbeat` when the launcher exported
+``PADDLE_ELASTIC_STORE``; scripts that skip those can call it directly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["start_heartbeat", "stop_heartbeat", "HeartbeatMonitor"]
+
+_worker = {"thread": None, "stop": None}
+
+
+def start_heartbeat(store_addr: Optional[str] = None,
+                    rank: Optional[int] = None,
+                    interval: Optional[float] = None):
+    """Begin stamping liveness into the launcher's TCPStore (idempotent).
+
+    A daemon thread SETs ``hb/<job>/<rank>`` = wall-clock every ``interval``
+    seconds. A truly hung process (stop signal, native deadlock holding the
+    GIL, OOM freeze) stops stamping, which is exactly the signal the
+    launcher's monitor consumes."""
+    addr = store_addr or os.environ.get("PADDLE_ELASTIC_STORE")
+    if not addr or _worker["thread"] is not None:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None \
+        else int(rank)
+    interval = interval if interval is not None else float(
+        os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "5.0"))
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    host, port = addr.rsplit(":", 1)
+
+    try:
+        from ..native import TCPStore
+        store = TCPStore(host, int(port))
+    except Exception as e:
+        # liveness is a nicety — its unavailability must never abort
+        # training (the launcher degrades to exit-code watching)
+        import warnings
+        warnings.warn(f"elastic heartbeat disabled: cannot reach the "
+                      f"launcher store at {addr} ({e})")
+        return None
+    key = f"hb/{job}/{rank}"
+    stop = threading.Event()
+
+    def beat():
+        while not stop.is_set():
+            try:
+                store.set(key, f"{time.time():.3f}")
+            except Exception:
+                pass  # the store may be gone during teardown — never crash
+            stop.wait(interval)
+
+    t = threading.Thread(target=beat, daemon=True, name="elastic-heartbeat")
+    t.start()
+    _worker["thread"], _worker["stop"] = t, stop
+    return t
+
+
+def stop_heartbeat():
+    if _worker["stop"] is not None:
+        _worker["stop"].set()
+        _worker["thread"] = None
+        _worker["stop"] = None
+
+
+class HeartbeatMonitor:
+    """Launcher side: host the store, read stamps, report stale ranks."""
+
+    def __init__(self, job_id: str = "default"):
+        from ..native import TCPStore
+        self.store = TCPStore(is_master=True)
+        self.addr = f"127.0.0.1:{self.store.port}"
+        self.job = job_id
+
+    def last_beat(self, rank: int) -> Optional[float]:
+        key = f"hb/{self.job}/{rank}"
+        if not self.store.check(key):
+            return None   # never beat — script may not use the framework
+        try:
+            return float(self.store.get(key))
+        except Exception:
+            return None
+
+    def hung_ranks(self, ranks, ttl: float):
+        """Ranks whose LAST stamp is older than ``ttl`` seconds. Ranks that
+        never stamped are not reported (no false positives for scripts that
+        don't init the framework)."""
+        now = time.time()
+        out = []
+        for r in ranks:
+            t = self.last_beat(r)
+            if t is not None and now - t > ttl:
+                out.append(r)
+        return out
+
+    def clear(self, world_size: int):
+        for r in range(world_size):
+            self.store.delete_key(f"hb/{self.job}/{r}")
+
+    def close(self):
+        self.store.close()

@@ -52,7 +52,20 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--job_id", default="default")
     p.add_argument("--max_restart", "--elastic_level", type=int, default=0,
                    dest="max_restart",
-                   help="elastic: restart the job this many times on failure")
+                   help="elastic level: 0 = fail fast (no restarts); N > 0 "
+                        "= restart the whole job up to N times on a crash "
+                        "OR a hung worker (see --elastic_timeout); each "
+                        "round gets a fresh rendezvous and the script is "
+                        "expected to resume from its own checkpoints")
+    p.add_argument("--elastic_timeout", type=float, default=60.0,
+                   help="seconds without a worker heartbeat before the rank "
+                        "is declared HUNG and the job restarts. Active only "
+                        "when --max_restart/--elastic_level > 0; 0 disables "
+                        "liveness detection. Workers stamp heartbeats "
+                        "automatically from init_parallel_env/fleet.init. "
+                        "Note: a native call holding the GIL longer than "
+                        "the timeout starves the stamping thread — size the "
+                        "timeout above your longest compile")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -65,10 +78,13 @@ class _Proc:
         self.log_path = log_path
 
 
-def _spawn(args, restart_round: int) -> List[_Proc]:
+def _spawn(args, restart_round: int,
+           elastic_store: Optional[str] = None) -> List[_Proc]:
     os.makedirs(args.log_dir, exist_ok=True)
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
+    # fresh rendezvous every round: a restarted job must not collide with
+    # stale state from the previous coordinator (SURVEY §5 elastic)
     master = args.master or f"127.0.0.1:{_free_port()}"
     procs = []
     for local_rank in range(nproc):
@@ -85,6 +101,8 @@ def _spawn(args, restart_round: int) -> List[_Proc]:
             "PADDLE_RESTART_ROUND": str(restart_round),
             "PADDLE_JOB_ID": args.job_id,
         })
+        if elastic_store:
+            env["PADDLE_ELASTIC_STORE"] = elastic_store
         if args.devices is not None:
             env["TPU_VISIBLE_DEVICES"] = args.devices
         if world > 1 and nproc > 1:
@@ -102,10 +120,36 @@ def _spawn(args, restart_round: int) -> List[_Proc]:
     return procs
 
 
-def _watch(procs: List[_Proc]) -> int:
+HUNG_RC = 98  # job rc when a rank was killed for missing heartbeats
+
+
+def _kill_all(procs: List[_Proc], grace: float = 10.0,
+              force_first: Optional[List[int]] = None):
+    force_first = force_first or []
+    for q in procs:
+        if q.popen.poll() is None:
+            # a STOPPED/hung process won't act on SIGTERM — SIGKILL it
+            if q.rank in force_first:
+                q.popen.kill()
+            else:
+                q.popen.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    for q in procs:
+        timeout = max(0.1, deadline - time.time())
+        try:
+            q.popen.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            q.popen.kill()
+
+
+def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
     """Wait for all children; on any nonzero exit kill the rest (the
-    reference's kill-all-on-one-failure policy). Returns the job rc."""
+    reference's kill-all-on-one-failure policy). With a heartbeat
+    ``monitor``, a rank whose liveness stamp goes stale for ``ttl`` seconds
+    is declared HUNG — killed with the rest, job rc = HUNG_RC (a hung
+    worker never produces an exit code on its own). Returns the job rc."""
     try:
+        last_hb_check = 0.0
         while True:
             alive = 0
             for p in procs:
@@ -113,22 +157,24 @@ def _watch(procs: List[_Proc]) -> int:
                 if rc is None:
                     alive += 1
                 elif rc != 0:
-                    for q in procs:
-                        if q.popen.poll() is None:
-                            q.popen.send_signal(signal.SIGTERM)
-                    deadline = time.time() + 10
-                    for q in procs:
-                        timeout = max(0.1, deadline - time.time())
-                        try:
-                            q.popen.wait(timeout=timeout)
-                        except subprocess.TimeoutExpired:
-                            q.popen.kill()
+                    _kill_all(procs)
                     print(f"rank {p.rank} exited with {rc} "
                           f"(log: {p.log_path}); peers terminated",
                           file=sys.stderr)
                     return rc
             if alive == 0:
                 return 0
+            if monitor is not None and ttl > 0 and \
+                    time.time() - last_hb_check > min(1.0, ttl / 3):
+                last_hb_check = time.time()
+                live = [p.rank for p in procs if p.popen.poll() is None]
+                hung = monitor.hung_ranks(live, ttl)
+                if hung:
+                    print(f"elastic: rank(s) {hung} missed heartbeats for "
+                          f"> {ttl}s — declaring hung, terminating the job",
+                          file=sys.stderr)
+                    _kill_all(procs, grace=3.0, force_first=hung)
+                    return HUNG_RC
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:
@@ -139,18 +185,39 @@ def _watch(procs: List[_Proc]) -> int:
 
 def launch_procs(args) -> int:
     """Run the job with elastic restarts (checkpoint-resume contract: the
-    script must resume from its own checkpoints; the launcher only supplies
-    a fresh rendezvous — SURVEY §5 failure-detection stance)."""
+    script must resume from its own checkpoints; the launcher supplies a
+    fresh rendezvous each round and the heartbeat-based hung-worker
+    detection — SURVEY §5 failure-detection stance)."""
     rounds = args.max_restart + 1
+    # liveness detection only at elastic levels > 0: without restarts a
+    # hung-kill would just turn a stall into a failure with no recovery
+    ttl = float(getattr(args, "elastic_timeout", 0.0) or 0.0) \
+        if args.max_restart > 0 else 0.0
+    monitor = None
+    if ttl > 0:
+        try:
+            from ..elastic import HeartbeatMonitor
+            monitor = HeartbeatMonitor(args.job_id)
+        except Exception as e:  # native lib unavailable: degrade gracefully
+            print(f"elastic: heartbeat monitor unavailable ({e}); "
+                  f"exit-code watching only", file=sys.stderr)
+    world = args.nnodes * args.nproc_per_node
     rc = 1
-    for attempt in range(rounds):
-        procs = _spawn(args, attempt)
-        rc = _watch(procs)
-        if rc == 0 or rc == 130:
-            return rc
-        if attempt < rounds - 1:
-            print(f"elastic: restarting job (attempt {attempt + 2}/{rounds})",
-                  file=sys.stderr)
+    try:
+        for attempt in range(rounds):
+            if monitor is not None:
+                monitor.clear(world)   # stale stamps from the last round
+            procs = _spawn(args, attempt,
+                           elastic_store=monitor.addr if monitor else None)
+            rc = _watch(procs, monitor=monitor, ttl=ttl)
+            if rc == 0 or rc == 130:
+                return rc
+            if attempt < rounds - 1:
+                print(f"elastic: restarting job "
+                      f"(attempt {attempt + 2}/{rounds})", file=sys.stderr)
+    finally:
+        if monitor is not None:
+            monitor.close()
     return rc
 
 

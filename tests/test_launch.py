@@ -92,6 +92,101 @@ class TestLaunch:
             (tmp_path / "log" / "workerlog.0").read_text()
 
 
+class TestElasticDetection:
+    def test_heartbeat_monitor_unit(self):
+        """Worker stamps -> monitor sees it; stale stamp -> hung."""
+        import time
+        from paddle_tpu.distributed import elastic
+        mon = elastic.HeartbeatMonitor("jobX")
+        try:
+            assert mon.hung_ranks([0, 1], ttl=0.2) == []  # never beat: quiet
+            os.environ["PADDLE_JOB_ID"] = "jobX"
+            t = elastic.start_heartbeat(store_addr=mon.addr, rank=0,
+                                        interval=0.1)
+            assert t is not None
+            time.sleep(0.4)
+            assert mon.last_beat(0) is not None
+            assert mon.hung_ranks([0], ttl=5.0) == []
+            elastic.stop_heartbeat()
+            time.sleep(0.8)
+            assert mon.hung_ranks([0], ttl=0.5) == [0]   # stamp went stale
+            mon.clear(2)
+            assert mon.last_beat(0) is None
+        finally:
+            elastic.stop_heartbeat()
+            os.environ.pop("PADDLE_JOB_ID", None)
+            mon.close()
+
+    def test_hung_worker_detected_job_restarts_and_resumes(self, tmp_path):
+        """The SURVEY §5 elastic contract end to end: rank 1 FREEZES (not
+        crashes) mid-training; the launcher's heartbeat watchdog declares it
+        hung, kills the job, restarts with a fresh rendezvous, and the
+        script resumes from the distributed checkpoint and finishes."""
+        import numpy as np
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        script = _script(tmp_path, f"""
+            import os, sys, signal, time
+            sys.path.insert(0, "/root/repo")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            rnd = int(os.environ["PADDLE_RESTART_ROUND"])
+            from paddle_tpu.distributed.elastic import start_heartbeat
+            start_heartbeat(interval=0.25)
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                           save_state_dict)
+            ck = {str(ckpt_dir)!r}
+            state = {{"w": paddle.to_tensor(np.zeros((3, 1), np.float32)),
+                      "step": paddle.to_tensor(np.zeros((), np.float32))}}
+            if os.path.exists(os.path.join(ck, "metadata.pkl")):
+                load_state_dict(state, ck)
+                open(os.path.join(ck, "resumed.%d" % rank), "w").write(
+                    str(float(state["step"])))
+            start = int(float(state["step"]))
+            rng = np.random.RandomState(0)
+            X = paddle.to_tensor(rng.randn(32, 3).astype("float32"))
+            y = X.matmul(paddle.to_tensor(
+                np.array([[1.5], [-2.0], [0.5]], np.float32)))
+            wt = paddle.Parameter(state["w"].numpy())
+            for step in range(start, 8):
+                loss = ((X.matmul(wt) - y) ** 2).mean()
+                loss.backward()
+                wt.set_value(wt.numpy() - 0.1 * wt.grad.numpy())
+                wt.clear_grad()
+                if rank == 0:
+                    save_state_dict(
+                        {{"w": paddle.to_tensor(wt.numpy()),
+                          "step": paddle.to_tensor(np.float32(step + 1))}},
+                        ck)
+                if rnd == 0 and rank == 1 and step == 3:
+                    os.kill(os.getpid(), signal.SIGSTOP)   # freeze == hung
+                time.sleep(0.05)
+            final = float(((X.matmul(wt) - y) ** 2).mean())
+            open(os.path.join(ck, "final.%d" % rank), "w").write(str(final))
+        """)
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)
+        os.environ["PADDLE_HEARTBEAT_INTERVAL"] = "0.25"
+        try:
+            rc = launch_procs(_args(tmp_path, script, "--nproc_per_node", "2",
+                                    "--max_restart", "2",
+                                    "--elastic_timeout", "2.5"))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        logs = [(tmp_path / "log" / f"workerlog.{r}").read_text()
+                for r in range(2)]
+        assert rc == 0, logs
+        # the frozen rank resumed from a mid-training checkpoint on round 1
+        assert (ckpt_dir / "resumed.1").exists(), logs
+        assert float((ckpt_dir / "resumed.1").read_text()) >= 3
+        # training CONTINUED: the resumed run finished and converged
+        final = float((ckpt_dir / "final.1").read_text())
+        assert np.isfinite(final) and final < 0.5, final
+
+
 class TestLaunchDistributedInit:
     def test_two_process_collective(self, tmp_path):
         """End to end: the launcher's env contract drives

