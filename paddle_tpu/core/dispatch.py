@@ -32,20 +32,26 @@ __all__ = ["forward_op", "register_op", "OP_REGISTRY", "OpDef"]
 
 @dataclass
 class OpDef:
-    """Schema entry for one op (the ops.yaml-equivalent single source of truth)."""
+    """Schema entry for one op (the ops.yaml-equivalent single source of truth).
+
+    ``category`` drives the auto-generated OpTest sweep
+    (tests/test_op_sweep.py): "unary"/"binary" elementwise ops get numpy-
+    oracle + finite-difference-gradient + dtype coverage synthesized from
+    the schema alone (SURVEY §4's per-op OpTest lesson)."""
     name: str
     fn: Callable
     doc: str = ""
     n_outputs: int = 1
     differentiable: bool = True
+    category: str = ""
 
 
 OP_REGISTRY: Dict[str, OpDef] = {}
 
 
 def register_op(name: str, fn: Callable, doc: str = "", n_outputs: int = 1,
-                differentiable: bool = True) -> OpDef:
-    d = OpDef(name, fn, doc, n_outputs, differentiable)
+                differentiable: bool = True, category: str = "") -> OpDef:
+    d = OpDef(name, fn, doc, n_outputs, differentiable, category)
     OP_REGISTRY[name] = d
     return d
 

@@ -1,0 +1,63 @@
+"""``paddle.geometric`` parity: graph message-passing primitives.
+
+Parity target: ``python/paddle/geometric/`` in the reference (segment
+reductions, send/recv message passing over edge indices). TPU lowering:
+``jax.ops.segment_*`` — a sorted-scatter XLA reduction, no atomics needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..ops._helpers import ensure_tensor, forward_op
+from ..ops.extended import (_SEGMENT_POOLS as _POOLS, segment_max,
+                            segment_mean, segment_min, segment_sum)
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather source-node features along edges and reduce at destinations
+    (ref: paddle.geometric.send_u_recv)."""
+    t = ensure_tensor(x)
+    s = ensure_tensor(src_index)
+    d = ensure_tensor(dst_index)
+    pool = _POOLS[reduce_op]
+    n_out = int(out_size) if out_size is not None else int(t.shape[0])
+
+    def impl(xv, sv, dv):
+        msgs = xv[sv.astype(jnp.int32)]
+        return pool(msgs, dv.astype(jnp.int32), n_out)
+
+    return forward_op("send_u_recv", impl, [t, s, d])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Node features combined with edge features, then reduced at the
+    destinations (ref: paddle.geometric.send_ue_recv)."""
+    t = ensure_tensor(x)
+    e = ensure_tensor(y)
+    s = ensure_tensor(src_index)
+    d = ensure_tensor(dst_index)
+    pool = _POOLS[reduce_op]
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+    n_out = int(out_size) if out_size is not None else int(t.shape[0])
+
+    def impl(xv, ev, sv, dv):
+        msgs = comb(xv[sv.astype(jnp.int32)], ev)
+        return pool(msgs, dv.astype(jnp.int32), n_out)
+
+    return forward_op("send_ue_recv", impl, [t, e, s, d])
+
+
+register_op("send_u_recv", lambda x, s, d: x,
+            "Edge gather + destination segment reduction.")
+register_op("send_ue_recv", lambda x, e, s, d: x,
+            "Node(+edge) messages reduced at destinations.")

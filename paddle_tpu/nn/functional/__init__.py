@@ -29,3 +29,20 @@ from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_poo
                       adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
                       avg_pool1d, avg_pool2d, avg_pool3d, lp_pool2d, max_pool1d,
                       max_pool2d, max_pool3d)
+
+# Register the functional surface in the op schema registry: upstream these
+# ARE ops.yaml kernels (conv2d, softmax, cross_entropy, ... all dispatch to
+# phi kernels), so the single source of truth must list them (docs/OPS.md).
+def _register_functional():
+    import types as _t
+
+    from ...core.dispatch import OP_REGISTRY, register_op
+    for _k, _v in list(globals().items()):
+        if _k.startswith("_") or isinstance(_v, (_t.ModuleType, type)):
+            continue
+        if not callable(_v) or _k in OP_REGISTRY:
+            continue
+        register_op(_k, _v, doc=(_v.__doc__ or "").strip().split("\n")[0])
+
+
+_register_functional()

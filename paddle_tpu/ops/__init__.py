@@ -7,7 +7,8 @@ these modules populate it and patch methods onto Tensor (mirroring how the refer
 
 import types as _types
 
-from . import creation, extras, linalg, logic, manipulation, math, random, search
+from . import (creation, extended, extras, linalg, logic, manipulation, math,
+               random, search)
 
 _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
             "register_op", "patch_methods", "unary_factory", "binary_factory",
@@ -35,6 +36,10 @@ def _export(module):
 
 __all__ = sorted(set(
     _export(creation) + _export(math) + _export(manipulation) + _export(linalg)
-    + _export(logic) + _export(search) + _export(random) + _export(extras)))
+    + _export(logic) + _export(search) + _export(random) + _export(extras)
+    + _export(extended)))
+# the inplace generator reads the assembled surface above — import it last
+from . import inplace  # noqa: E402
+__all__ = sorted(set(__all__ + _export(inplace)))
 from .random import Generator, default_generator  # noqa: E402
 from .creation import to_tensor  # noqa: E402

@@ -35,7 +35,7 @@ def axes_arg(axis):
 
 
 def unary_factory(name: str, jfn: Callable, doc: str = ""):
-    register_op(name, jfn, doc)
+    register_op(name, jfn, doc, category="unary")
 
     def op(x, name=None):
         return forward_op(op.__name__, jfn, [ensure_tensor(x)])
@@ -47,7 +47,7 @@ def unary_factory(name: str, jfn: Callable, doc: str = ""):
 
 
 def binary_factory(name: str, jfn: Callable, doc: str = ""):
-    register_op(name, jfn, doc)
+    register_op(name, jfn, doc, category="binary")
 
     def op(x, y, name=None):
         return forward_op(op.__name__, jfn, [ensure_tensor(x), ensure_tensor(y)])

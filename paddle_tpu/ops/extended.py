@@ -1,0 +1,427 @@
+"""Extended tensor-op surface (round-3 breadth push toward the reference's
+~2,000-function tensor API — SURVEY §2.3).
+
+Parity targets: ``python/paddle/tensor/{math,manipulation,search,stat}.py``
+in the reference. All jnp/XLA-backed, registered in OP_REGISTRY so the
+schema sweep (tests/test_op_sweep.py) and docs/OPS.md cover them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._helpers import (Tensor, axes_arg, binary_factory, ensure_tensor,
+                       forward_op, register_op, unary_factory)
+
+__all__ = [
+    "slice_scatter", "polygamma", "logaddexp2", "frexp", "sgn",
+    "nanquantile", "as_strided", "unfold_axis", "atleast_1d", "atleast_2d",
+    "atleast_3d", "fix", "fmod", "msort", "rank", "reverse", "binomial",
+    "standard_gamma", "cummin", "logcumsumexp", "isposinf", "isneginf",
+    "isreal", "iscomplex", "index_sample", "strided_slice", "increment",
+    "gammainc", "gammaincc", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]
+
+
+# ---------------------------------------------------------------------------
+# elementwise additions (factory-registered: picked up by the schema sweep)
+# ---------------------------------------------------------------------------
+
+fix = unary_factory("fix", jnp.trunc, "Round toward zero (alias of trunc).")
+sgn = unary_factory(
+    "sgn", lambda x: (jnp.sign(x) if not jnp.iscomplexobj(x)
+                      else jnp.where(x == 0, 0, x / jnp.abs(x))),
+    "Sign; for complex inputs x/|x| (ref: paddle.sgn).")
+isposinf = unary_factory("isposinf", jnp.isposinf, "x == +inf elementwise.")
+isneginf = unary_factory("isneginf", jnp.isneginf, "x == -inf elementwise.")
+isreal = unary_factory("isreal", jnp.isreal, "True where imaginary part 0.")
+iscomplex = unary_factory(
+    "iscomplex", lambda x: jnp.full(x.shape, jnp.iscomplexobj(x)),
+    "True where the dtype is complex (ref: paddle.is_complex semantics).")
+logaddexp2 = binary_factory("logaddexp2", jnp.logaddexp2,
+                            "log2(2**x + 2**y), overflow-safe.")
+fmod = binary_factory("fmod", jnp.fmod,
+                      "C-style remainder (sign follows the dividend).")
+gammainc = binary_factory(
+    "gammainc", lambda a, x: jax.scipy.special.gammainc(a, x),
+    "Regularized lower incomplete gamma P(a, x).")
+gammaincc = binary_factory(
+    "gammaincc", lambda a, x: jax.scipy.special.gammaincc(a, x),
+    "Regularized upper incomplete gamma Q(a, x).")
+
+
+def polygamma(x, n: int = 1, name=None):
+    """n-th derivative of digamma (ref: paddle.polygamma)."""
+    t = ensure_tensor(x)
+    return forward_op("polygamma",
+                      lambda v: jax.scipy.special.polygamma(n, v), [t])
+
+
+register_op("polygamma", lambda v: jax.scipy.special.polygamma(1, v),
+            "n-th polygamma function (n static).")
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = m * 2**e, 0.5 <= |m| < 1."""
+    t = ensure_tensor(x)
+    return forward_op("frexp", lambda v: tuple(jnp.frexp(v)), [t])
+
+
+register_op("frexp", lambda v: tuple(jnp.frexp(v)),
+            "Decompose into mantissa and exponent.", n_outputs=2,
+            differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    """Write ``value`` into the slice of ``x`` selected by axes/starts/ends
+    (ref: paddle.slice_scatter)."""
+    t = ensure_tensor(x)
+    v = ensure_tensor(value)
+    axes = [int(a) for a in axes]
+    strides = [1] * len(axes) if strides is None else [int(s) for s in strides]
+
+    def impl(xv, vv):
+        idx = [slice(None)] * xv.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = slice(int(s), int(e), st)
+        return xv.at[tuple(idx)].set(vv.astype(xv.dtype))
+
+    return forward_op("slice_scatter", impl, [t, v])
+
+
+register_op("slice_scatter", lambda x, v: x,
+            "Scatter a value tensor into a strided slice.")
+
+
+def as_strided(x, shape, stride, offset: int = 0, name=None):
+    """Strided view as a gather (ref: paddle.as_strided; on TPU a copy —
+    XLA has no aliasing views across programs)."""
+    t = ensure_tensor(x)
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def impl(v):
+        flat = v.reshape(-1)
+        if not shape:
+            return flat[offset]
+        grids = np.ix_(*[np.arange(n) for n in shape])
+        lin = np.broadcast_to(
+            offset + sum(g * s for g, s in zip(grids, stride)), tuple(shape))
+        return flat[jnp.asarray(lin, jnp.int32)]
+
+    return forward_op("as_strided", impl, [t])
+
+
+register_op("as_strided", lambda x: x,
+            "Strided re-indexing of the underlying buffer (gather copy).")
+
+
+def unfold_axis(x, axis: int, size: int, step: int, name=None):
+    """Sliding windows over one axis: shape[axis] -> (n_windows, size) as
+    the LAST dim. This is ``Tensor.unfold``'s semantics — the TOP-LEVEL
+    ``paddle.nn.functional.unfold`` is the unrelated im2col op and keeps its
+    name (nn/functional/common.py)."""
+    t = ensure_tensor(x)
+    axis = int(axis)
+
+    def impl(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        win = jnp.arange(size)
+        idx = starts[:, None] + win[None, :]          # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return forward_op("unfold_axis", impl, [t])
+
+
+register_op("unfold_axis", lambda x: x,
+            "Sliding-window view over one axis (Tensor.unfold).")
+
+# method surface: x.unfold(axis, size, step) — the Tensor METHOD is the
+# sliding window; the module-level `unfold` name stays with im2col
+from ._helpers import patch_methods  # noqa: E402
+
+patch_methods([("unfold", unfold_axis)])
+
+
+def atleast_1d(*xs, name=None):
+    outs = [forward_op("atleast_1d", jnp.atleast_1d, [ensure_tensor(x)])
+            for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = [forward_op("atleast_2d", jnp.atleast_2d, [ensure_tensor(x)])
+            for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = [forward_op("atleast_3d", jnp.atleast_3d, [ensure_tensor(x)])
+            for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+register_op("atleast_1d", jnp.atleast_1d, "Promote to >= 1-D.")
+register_op("atleast_2d", jnp.atleast_2d, "Promote to >= 2-D.")
+register_op("atleast_3d", jnp.atleast_3d, "Promote to >= 3-D.")
+
+
+def msort(x, name=None):
+    """Sort along the FIRST axis (ref: paddle.msort)."""
+    return forward_op("msort", lambda v: jnp.sort(v, axis=0),
+                      [ensure_tensor(x)])
+
+
+register_op("msort", lambda v: jnp.sort(v, axis=0), "Sort along axis 0.")
+
+
+def rank(x, name=None):
+    """Number of dimensions, as a 0-D int32 tensor (ref: paddle.rank)."""
+    t = ensure_tensor(x)
+    return forward_op("rank", lambda v: jnp.asarray(v.ndim, jnp.int32), [t],
+                      differentiable=False)
+
+
+register_op("rank", lambda v: jnp.asarray(v.ndim, jnp.int32),
+            "ndim as a tensor.", differentiable=False)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (legacy paddle.reverse)."""
+    ax = axes_arg(axis)
+    ax = (ax,) if isinstance(ax, int) else ax
+    return forward_op("reverse", lambda v: jnp.flip(v, axis=ax),
+                      [ensure_tensor(x)])
+
+
+register_op("reverse", lambda v: jnp.flip(v, axis=0), "Flip along axes.")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """ref: paddle.strided_slice."""
+    t = ensure_tensor(x)
+    axes = [int(a) for a in axes]
+
+    def impl(v):
+        idx = [slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+
+    return forward_op("strided_slice", impl, [t])
+
+
+register_op("strided_slice", lambda v: v, "Multi-axis strided slice.")
+
+
+def index_sample(x, index):
+    """Per-row gather: out[i, j] = x[i, index[i, j]] (ref:
+    paddle.index_sample)."""
+    t = ensure_tensor(x)
+    i = ensure_tensor(index)
+    return forward_op(
+        "index_sample",
+        lambda v, ix: jnp.take_along_axis(v, ix.astype(jnp.int32), axis=1),
+        [t, i])
+
+
+register_op("index_sample",
+            lambda v, ix: jnp.take_along_axis(v, ix.astype(jnp.int32), 1),
+            "Batched per-row gather.")
+
+
+def increment(x, value: float = 1.0, name=None):
+    """In-place add of a scalar (ref: paddle.increment)."""
+    t = ensure_tensor(x)
+    out = forward_op("increment", lambda v: v + np.asarray(value, v.dtype),
+                     [t])
+    t._rebind(out)
+    return t
+
+
+register_op("increment", lambda v: v + 1, "x += value (in place).")
+
+
+# ---------------------------------------------------------------------------
+# reductions / scans / stats
+# ---------------------------------------------------------------------------
+
+def cummin(x, axis: Optional[int] = None, dtype="int64", name=None):
+    """(values, indices) running minimum (ref: paddle.cummin)."""
+    t = ensure_tensor(x)
+
+    def impl(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis) % vv.ndim
+        n = vv.shape[ax]
+        ar = jnp.broadcast_to(
+            jnp.arange(n).reshape([-1 if i == ax else 1
+                                   for i in range(vv.ndim)]), vv.shape)
+
+        def comb(a, b):  # the argmin monoid (ties keep the earlier index)
+            av, ai = a
+            bv, bi = b
+            bwins = (bv < av) | ((bv == av) & (bi < ai))
+            return jnp.where(bwins, bv, av), jnp.where(bwins, bi, ai)
+
+        vals, idx = lax.associative_scan(comb, (vv, ar), axis=ax)
+        return vals, idx.astype(jnp.int64 if dtype == "int64" else jnp.int32)
+
+    return forward_op("cummin", impl, [t])
+
+
+register_op("cummin", lambda v: lax.associative_scan(jnp.minimum, v, axis=0),
+            "Running minimum with indices.", n_outputs=2)
+
+
+def logcumsumexp(x, axis: Optional[int] = None, name=None):
+    t = ensure_tensor(x)
+
+    def impl(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        return lax.cumlogsumexp(vv, axis=ax)
+
+    return forward_op("logcumsumexp", impl, [t])
+
+
+register_op("logcumsumexp", lambda v: lax.cumlogsumexp(v, axis=0),
+            "Numerically stable log(cumsum(exp(x))).")
+
+
+def nanquantile(x, q, axis=None, keepdim: bool = False, name=None):
+    t = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return forward_op(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=ax,
+                                  keepdims=keepdim),
+        [t])
+
+
+register_op("nanquantile", lambda v: jnp.nanquantile(v, 0.5),
+            "Quantile ignoring NaNs.")
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+
+def _next_key():
+    from .random import _next_key as nk
+    return nk()
+
+
+def binomial(count, prob, name=None):
+    """Sample Binomial(count, prob) elementwise (ref: paddle.binomial)."""
+    c = ensure_tensor(count)
+    p = ensure_tensor(prob)
+    key = _next_key()
+
+    def impl(cv, pv):
+        shape = jnp.broadcast_shapes(cv.shape, pv.shape)
+        return jax.random.binomial(
+            key, cv.astype(jnp.float32), pv.astype(jnp.float32),
+            shape=shape).astype(jnp.int64)
+
+    return forward_op("binomial", impl, [c, p], differentiable=False)
+
+
+register_op("binomial", lambda c, p: c * 0,
+            "Binomial sampling.", differentiable=False)
+
+
+def standard_gamma(alpha, name=None):
+    """Sample Gamma(alpha, 1) (ref: paddle.standard_gamma)."""
+    a = ensure_tensor(alpha)
+    key = _next_key()
+    return forward_op(
+        "standard_gamma",
+        lambda av: jax.random.gamma(key, av.astype(jnp.float32)),
+        [a], differentiable=False)
+
+
+register_op("standard_gamma", lambda a: a,
+            "Gamma(alpha, 1) sampling.", differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# fft completions (hermitian 2-D/N-D)
+# ---------------------------------------------------------------------------
+
+def _fft_member(name, jfn):
+    def op(x, *a, name=None, **k):
+        return forward_op(name, lambda v: jfn(v), [ensure_tensor(x)])
+    op.__name__ = name
+    register_op(name, jfn, f"{name} (hermitian FFT family).")
+    return op
+
+
+# factorization (torch.fft semantics): the input is one-sided Hermitian in
+# the LAST dim only — full C->C transforms over the other dims, then the
+# Hermitian C->R transform last (mirror of irfftn's structure)
+hfft2 = _fft_member(
+    "hfft2", lambda v: jnp.fft.hfft(jnp.fft.fft(v, axis=-2), axis=-1))
+ihfft2 = _fft_member(
+    "ihfft2", lambda v: jnp.fft.ifft(jnp.fft.ihfft(v, axis=-1), axis=-2))
+hfftn = _fft_member(
+    "hfftn", lambda v: jnp.fft.hfft(
+        jnp.fft.fftn(v, axes=tuple(range(v.ndim - 1))), axis=-1))
+ihfftn = _fft_member(
+    "ihfftn", lambda v: jnp.fft.ifftn(
+        jnp.fft.ihfft(v, axis=-1), axes=tuple(range(v.ndim - 1))))
+
+
+# ---------------------------------------------------------------------------
+# geometric segment ops (ref: paddle.geometric.segment_*)
+# ---------------------------------------------------------------------------
+
+# the shared reduction table — geometric's send/recv ops reuse these exact
+# lambdas (single definition for the empty-segment guard etc.)
+_SEGMENT_POOLS = {
+    "sum": lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n),
+    "mean": lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n) /
+    jnp.maximum(jax.ops.segment_sum(jnp.ones(s.shape, jnp.float32), s,
+                                    num_segments=n), 1.0).reshape(
+        (-1,) + (1,) * (d.ndim - 1)),
+    "max": lambda d, s, n: jax.ops.segment_max(d, s, num_segments=n),
+    "min": lambda d, s, n: jax.ops.segment_min(d, s, num_segments=n),
+}
+
+
+def _segment(name, pool):
+    jfn = _SEGMENT_POOLS[pool]
+
+    def op(data, segment_ids, name=None):
+        d = ensure_tensor(data)
+        s = ensure_tensor(segment_ids)
+
+        def impl(dv, sv):
+            num = int(np.asarray(jax.device_get(sv)).max()) + 1 \
+                if sv.size else 0
+            return jfn(dv, sv.astype(jnp.int32), num)
+
+        return forward_op(name, impl, [d, s])
+    op.__name__ = name
+    register_op(name, lambda d, s: d, f"{name}: per-segment reduction.")
+    return op
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_max = _segment("segment_max", "max")
+segment_min = _segment("segment_min", "min")

@@ -17,7 +17,14 @@ import sys
 def generate(out_path: str = "docs/OPS.md") -> str:
     import os
 
-    import paddle_tpu.ops  # populates the registry  # noqa: F401
+    # populate the registry: the tensor surface plus every domain that
+    # registers kernels (upstream: one ops.yaml covers them all)
+    import paddle_tpu.ops  # noqa: F401
+    import paddle_tpu.nn.functional  # noqa: F401
+    import paddle_tpu.sparse  # noqa: F401
+    import paddle_tpu.signal  # noqa: F401
+    import paddle_tpu.geometric  # noqa: F401
+    import paddle_tpu.vision.ops  # noqa: F401
     from paddle_tpu.core.dispatch import OP_REGISTRY
 
     lines = ["# Op surface reference",

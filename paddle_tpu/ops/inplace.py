@@ -1,0 +1,135 @@
+"""In-place op variants (``add_``, ``tanh_``, ...).
+
+Parity target: the ``*_`` inplace API family of ``python/paddle/tensor/*``
+(generated upstream by the inplace pass over ops.yaml). TPU redesign: jax
+arrays are immutable, so "in place" means compute-out-of-place then REBIND
+the Tensor's buffer (``Tensor._rebind`` — bumps the inplace version counter
+and keeps the autograd graph flowing through the new value; the same
+semantics the reference's inplace grad nodes provide, minus the buffer
+aliasing XLA would not allow across programs anyway).
+
+Every variant is registered in OP_REGISTRY (docs/OPS.md) pointing at the
+base op's kernel fn.
+"""
+
+from __future__ import annotations
+
+from ..core.dispatch import OP_REGISTRY, register_op
+
+__all__ = []  # populated below
+
+
+def _make(base_name: str, base_fn):
+    def op(x, *args, **kwargs):
+        out = base_fn(x, *args, **kwargs)
+        x._rebind(out)
+        return x
+
+    op.__name__ = base_name + "_"
+    op.__qualname__ = op.__name__
+    op.__doc__ = (f"In-place variant of ``{base_name}`` (rebinds the "
+                  f"tensor's buffer; ref: paddle.Tensor.{base_name}_).")
+    base = OP_REGISTRY.get(base_name)
+    register_op(base_name + "_",
+                base.fn if base else (lambda v: v),
+                f"In-place variant of {base_name}.",
+                differentiable=base.differentiable if base else True)
+    return op
+
+
+# base-op names whose paddle API includes an inplace twin; only generated
+# when the base exists here (asserted below so drift is loud)
+_INPLACE_BASES = [
+    "acos", "acosh", "asin", "asinh", "atan", "atanh", "atan2",
+    "cos", "cosh", "sin", "sinh", "tan", "tanh",
+    "erf", "erfinv", "exp", "expm1", "log", "log10", "log1p", "log2",
+    "logit", "sigmoid", "square", "trunc", "frac", "digamma", "lgamma",
+    "gammaln", "i0", "nan_to_num", "copysign", "hypot", "ldexp", "lerp",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "greater_equal", "greater_than", "less_equal", "less_than", "not_equal",
+    "remainder", "mod", "floor_divide",
+    "tril", "triu", "masked_fill", "index_fill", "index_put", "index_add",
+    "put_along_axis", "renorm",
+]
+
+
+def _populate():
+    import paddle_tpu.ops as _ops
+
+    made = {}
+    missing = []
+    for base in _INPLACE_BASES:
+        fn = getattr(_ops, base, None)
+        if fn is None:
+            missing.append(base)
+            continue
+        made[base + "_"] = _make(base, fn)
+    if missing:
+        raise ImportError(
+            f"inplace generation: base ops missing from the surface: "
+            f"{missing} (add them or drop from _INPLACE_BASES)")
+    return made
+
+
+_generated = _populate()
+globals().update(_generated)
+__all__ = sorted(_generated)
+
+
+def _fill(x, value):
+    import jax.numpy as jnp
+    from ._helpers import ensure_tensor, forward_op
+    t = ensure_tensor(x)
+    out = forward_op("fill", lambda v: jnp.full_like(v, value), [t])
+    t._rebind(out)
+    return t
+
+
+def fill_(x, value, name=None):
+    """Set every element to ``value`` (ref: paddle.Tensor.fill_)."""
+    return _fill(x, value)
+
+
+def zero_(x, name=None):
+    """Set every element to 0 (ref: paddle.Tensor.zero_)."""
+    return _fill(x, 0)
+
+
+def fill_diagonal_(x, value, offset: int = 0, wrap: bool = False, name=None):
+    """Write ``value`` onto the (offset) diagonal; ``wrap`` repeats the
+    diagonal down tall matrices, numpy-style (ref: Tensor.fill_diagonal_)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from ._helpers import ensure_tensor, forward_op
+    t = ensure_tensor(x)
+
+    def impl(v):
+        H, W = v.shape[-2], v.shape[-1]
+        r0, c0 = (0, offset) if offset >= 0 else (-offset, 0)
+        n = max(0, min(H - r0, W - c0))
+        rows = np.arange(n) + r0
+        cols = np.arange(n) + c0
+        if wrap and offset == 0 and H > W:
+            # numpy wrap semantics: restart the diagonal every W+1 rows
+            rows, cols = [], []
+            start = 0
+            while start < H:
+                m = min(W, H - start)
+                rows.append(np.arange(m) + start)
+                cols.append(np.arange(m))
+                start += W + 1
+            rows = np.concatenate(rows)
+            cols = np.concatenate(cols)
+        return v.at[..., jnp.asarray(rows), jnp.asarray(cols)].set(value)
+
+    out = forward_op("fill_diagonal", impl, [t])
+    t._rebind(out)
+    return t
+
+
+register_op("fill", lambda v: v * 0, "Fill with a scalar (in place).")
+register_op("zero_", lambda v: v * 0, "Zero the tensor (in place).")
+register_op("fill_diagonal", lambda v: v, "Write the diagonal (in place).")
+__all__ += ["fill_", "zero_", "fill_diagonal_"]

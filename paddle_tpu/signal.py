@@ -1,0 +1,115 @@
+"""``paddle.signal`` parity: STFT / inverse STFT.
+
+Parity target: ``python/paddle/signal.py`` in the reference (stft/istft over
+the frame + fft ops). TPU lowering: framing is a static gather, the FFT is
+XLA's native rfft/fft — one fused program, no Python loop over frames.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import register_op
+from .ops._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = ["stft", "istft"]
+
+
+def _prep_window(window, win_length: int, n_fft: int):
+    if window is None:
+        w = jnp.ones((win_length,), jnp.float32)
+    else:
+        w = ensure_tensor(window)._value.astype(jnp.float32)
+        if w.shape[0] != win_length:
+            raise ValueError(f"window length {w.shape[0]} != win_length "
+                             f"{win_length}")
+    pad = n_fft - win_length
+    if pad:
+        w = jnp.pad(w, (pad // 2, pad - pad // 2))
+    return w
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform -> ``[..., n_freq, n_frames]`` complex
+    (ref: paddle.signal.stft)."""
+    t = ensure_tensor(x)
+    hop = int(hop_length) if hop_length else n_fft // 4
+    wl = int(win_length) if win_length else n_fft
+    w = _prep_window(window, wl, n_fft)
+
+    def impl(v):
+        one_d = v.ndim == 1
+        vv = v[None] if one_d else v.reshape(-1, v.shape[-1])
+        if center:
+            vv = jnp.pad(vv, ((0, 0), (n_fft // 2, n_fft // 2)),
+                         mode=pad_mode)
+        T = vv.shape[-1]
+        n_frames = 1 + (T - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop +
+               jnp.arange(n_fft)[None, :])
+        frames = vv[:, idx] * w[None, None, :]        # [B, F, n_fft]
+        sp = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            sp = sp / jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        sp = jnp.swapaxes(sp, -1, -2)                  # [B, freq, frames]
+        if one_d:
+            return sp[0]
+        return sp.reshape(v.shape[:-1] + sp.shape[-2:])
+
+    return forward_op("stft", impl, [t])
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT by windowed overlap-add with window-square
+    normalization (ref: paddle.signal.istft)."""
+    t = ensure_tensor(x)
+    hop = int(hop_length) if hop_length else n_fft // 4
+    wl = int(win_length) if win_length else n_fft
+    w = _prep_window(window, wl, n_fft)
+
+    def impl(sp):
+        one_batch = sp.ndim == 2
+        s = sp[None] if one_batch else sp.reshape((-1,) + sp.shape[-2:])
+        s = jnp.swapaxes(s, -1, -2)                    # [B, frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(s, axis=-1)
+        if not return_complex:
+            frames = frames.real if jnp.iscomplexobj(frames) else frames
+        frames = frames * w[None, None, :]
+        B, F = frames.shape[0], frames.shape[1]
+        T = n_fft + hop * (F - 1)
+        starts = jnp.arange(F) * hop
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros((B, T), frames.dtype)
+        out = out.at[:, idx].add(frames.reshape(B, -1))
+        # window-square envelope for COLA normalization
+        env = jnp.zeros((T,), jnp.float32).at[idx].add(
+            jnp.tile(w * w, (F,)))
+        out = out / jnp.maximum(env, 1e-11)[None, :]
+        if center:
+            out = out[:, n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        if one_batch:
+            return out[0]
+        return out.reshape(sp.shape[:-2] + out.shape[-1:])
+
+    return forward_op("istft", impl, [t])
+
+
+register_op("stft", lambda v: v, "Short-time Fourier transform.")
+register_op("istft", lambda v: v, "Inverse STFT (windowed overlap-add).")

@@ -22,7 +22,11 @@ from ..ops._helpers import ensure_tensor, forward_op
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
            "sparse_csr_tensor", "is_same_shape", "add", "subtract",
-           "multiply", "matmul", "masked_matmul", "relu", "coalesce"]
+           "multiply", "divide", "matmul", "masked_matmul", "mv", "addmm",
+           "relu", "coalesce", "sin", "tan", "asin", "atan", "sinh", "tanh",
+           "asinh", "atanh", "sqrt", "square", "log1p", "abs", "expm1",
+           "deg2rad", "rad2deg", "neg", "pow", "cast", "sum", "transpose",
+           "reshape", "nn"]
 
 
 class SparseCooTensor:
@@ -249,7 +253,251 @@ def relu(x: SparseCooTensor, name=None) -> SparseCooTensor:
     return SparseCooTensor(x.indices_, F.relu(x.values_), x.shape)
 
 
-class nn:  # namespace parity: paddle.sparse.nn.ReLU
+# ---------------------------------------------------------------------------
+# zero-preserving unary surface (ref: python/paddle/sparse/unary.py — the
+# reference restricts sparse elementwise ops to exactly the f(0)=0 family,
+# so applying the kernel to the VALUES array is exact, [nnz]-sized work)
+# ---------------------------------------------------------------------------
+
+def _values_map(x, name, jfn):
+    if isinstance(x, SparseCsrTensor):
+        vals = forward_op(name, jfn, [x.values_])
+        return SparseCsrTensor(x.crows_, x.cols_, vals, x.shape)
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"sparse.{name.split('_', 1)[1]} expects a sparse "
+                        f"tensor")
+    vals = forward_op(name, jfn, [x.values_])
+    return SparseCooTensor(x.indices_, vals, x.shape, x._coalesced)
+
+
+def _sparse_unary(name, jfn, doc=""):
+    from ..core.dispatch import register_op as _reg
+    opname = f"sparse_{name}"
+    _reg(opname, jfn, doc or f"sparse.{name}: zero-preserving elementwise "
+         f"{name} on the values array.")
+
+    def op(x, name=None):
+        return _values_map(x, opname, jfn)
+    op.__name__ = f"sparse_{name}"
+    return op
+
+
+import jax as _jax  # noqa: E402
+
+sin = _sparse_unary("sin", jnp.sin)
+tan = _sparse_unary("tan", jnp.tan)
+asin = _sparse_unary("asin", jnp.arcsin)
+atan = _sparse_unary("atan", jnp.arctan)
+sinh = _sparse_unary("sinh", jnp.sinh)
+tanh = _sparse_unary("tanh", jnp.tanh)
+asinh = _sparse_unary("asinh", jnp.arcsinh)
+atanh = _sparse_unary("atanh", jnp.arctanh)
+sqrt = _sparse_unary("sqrt", jnp.sqrt)
+square = _sparse_unary("square", jnp.square)
+log1p = _sparse_unary("log1p", jnp.log1p)
+abs = _sparse_unary("abs", jnp.abs)  # noqa: A001
+expm1 = _sparse_unary("expm1", jnp.expm1)
+deg2rad = _sparse_unary("deg2rad", jnp.deg2rad)
+rad2deg = _sparse_unary("rad2deg", jnp.rad2deg)
+neg = _sparse_unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    """Elementwise power on the values (factor > 0 keeps zeros at zero)."""
+    return _values_map(x, "sparse_pow",
+                       lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref: paddle.sparse.cast — retype indices and/or values."""
+    from ..core.dtype import canonical_dtype
+    vals = x.values_ if value_dtype is None else \
+        x.values_.astype(canonical_dtype(value_dtype))
+    if isinstance(x, SparseCsrTensor):
+        crows = x.crows_ if index_dtype is None else \
+            x.crows_.astype(canonical_dtype(index_dtype))
+        cols = x.cols_ if index_dtype is None else \
+            x.cols_.astype(canonical_dtype(index_dtype))
+        return SparseCsrTensor(crows, cols, vals, x.shape)
+    idx = x.indices_ if index_dtype is None else \
+        x.indices_.astype(canonical_dtype(index_dtype))
+    return SparseCooTensor(idx, vals, x.shape, x._coalesced)
+
+
+def divide(x, y, name=None):
+    """Elementwise divide — requires IDENTICAL sparsity patterns (upstream
+    restriction: outside the intersection the result would be 0/0)."""
+    if not isinstance(x, SparseCooTensor) or not isinstance(y, SparseCooTensor):
+        raise TypeError("sparse.divide expects SparseCooTensor operands")
+    xc, yc = x.coalesce(), y.coalesce()
+    if xc.shape != yc.shape or not np.array_equal(
+            np.asarray(xc.indices_.numpy()), np.asarray(yc.indices_.numpy())):
+        raise ValueError(
+            "sparse.divide requires operands with the same sparsity "
+            "pattern (0/0 is undefined outside the intersection)")
+    vals = forward_op("sparse_divide", lambda a, b: a / b,
+                      [xc.values_, yc.values_])
+    return SparseCooTensor(xc.indices_, vals, xc.shape, coalesced=True)
+
+
+def mv(x, vec, name=None):
+    """2-D sparse @ 1-D dense -> dense [m] (ref: paddle.sparse.mv): gather
+    the vector at the column indices, scale by values, segment-sum by row —
+    [nnz]-sized work, no densification."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor) or x.ndim != 2:
+        raise TypeError("sparse.mv expects a 2-D sparse tensor")
+    v = ensure_tensor(vec)
+    m = x.shape[0]
+
+    def f(idx, vals, vv):
+        contrib = vals * vv[idx[1]]
+        return _jax.ops.segment_sum(contrib, idx[0], num_segments=m)
+
+    return forward_op("sparse_mv", f, [x.indices_, x.values_, v])
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta * input + alpha * (x @ y) (ref: paddle.sparse.addmm)."""
+    prod = matmul(x, y)
+    return ensure_tensor(input) * beta + prod * alpha
+
+
+def sum(x, axis=None, dtype=None, keepdim: bool = False, name=None):  # noqa: A001
+    """ref: paddle.sparse.sum. Full reduction works on values only
+    ([nnz]-sized); axis reductions lower through dense (documented)."""
+    if axis is None:
+        out = forward_op("sparse_sum", lambda v: jnp.sum(v), [x.values_])
+        return out.astype(dtype) if dtype else out
+    d = x.to_dense()
+    from ..ops import math as _m
+    return _m.sum(d, axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+def transpose(x, perm, name=None):
+    """Permute a COO tensor's dims: an index-row permutation, O(nnz)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    perm = [int(p) for p in perm]
+
+    def f(idx):
+        return jnp.stack([idx[p] for p in perm])
+
+    idx = forward_op("sparse_transpose", f, [x.indices_],
+                     differentiable=False)
+    return SparseCooTensor(idx, x.values_, [x.shape[p] for p in perm])
+
+
+def reshape(x, shape, name=None):
+    """COO reshape via linear-index recomputation, O(nnz)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    old = x.shape
+    total = int(np.prod(old))
+    shape = [int(s) if s != -1 else -1 for s in shape]
+    if -1 in shape:
+        rest = int(np.prod([s for s in shape if s != -1]))
+        shape = [s if s != -1 else total // rest for s in shape]
+
+    def f(idx):
+        lin = jnp.zeros(idx.shape[1], jnp.int32)
+        mul = 1
+        for d in range(len(old) - 1, -1, -1):
+            lin = lin + idx[d].astype(jnp.int32) * mul
+            mul *= old[d]
+        out = []
+        for s in reversed(shape):
+            out.append(lin % s)
+            lin = lin // s
+        return jnp.stack(list(reversed(out))).astype(jnp.int32)
+
+    idx = forward_op("sparse_reshape", f, [x.indices_], differentiable=False)
+    return SparseCooTensor(idx, x.values_, shape)
+
+
+# registry entries for the structural ops (the unary family registers in
+# _sparse_unary)
+from ..core.dispatch import register_op as _register_op  # noqa: E402
+for _n, _f, _d in [
+    ("sparse_pow", lambda v: v, "values power (zero-preserving)"),
+    ("sparse_cast", lambda v: v, "retype indices/values"),
+    ("sparse_divide", lambda a, b: a / b, "elementwise divide"),
+    ("sparse_mv", lambda i, v, x: v, "sparse matrix-vector product"),
+    ("sparse_addmm", lambda a, b: a, "beta*input + alpha*(x@y)"),
+    ("sparse_sum", lambda v: jnp.sum(v), "sum of values"),
+    ("sparse_transpose", lambda i: i, "dim permutation on indices"),
+    ("sparse_reshape", lambda i: i, "linear-index reshape"),
+    ("sparse_matmul", lambda a, b: a @ b, "sparse @ dense on the MXU"),
+    ("sparse_masked_matmul", lambda a, b: a @ b, "sddmm sampling"),
+    ("sparse_add", lambda a, b: a + b, "elementwise add"),
+    ("sparse_subtract", lambda a, b: a - b, "elementwise subtract"),
+    ("sparse_multiply", lambda a, b: a * b, "elementwise multiply"),
+    ("sparse_relu", lambda v: jnp.maximum(v, 0), "relu on values"),
+    ("sparse_coalesce", lambda v: v, "merge duplicate coordinates"),
+]:
+    _register_op(_n, _f, f"sparse.{_n.split('_', 1)[1]}: {_d}")
+
+
+def _sparse_softmax(x, axis: int = -1, name=None):
+    """Row-wise softmax over the stored values (ref:
+    paddle.sparse.nn.functional.softmax; only the last axis of a 2-D
+    pattern is supported, matching the reference's CSR kernel)."""
+    if isinstance(x, SparseCsrTensor):
+        coo = x.to_sparse_coo()
+        back = "csr"
+    else:
+        coo, back = x, "coo"
+    if coo.ndim != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax supports 2-D patterns over the "
+                         "last axis")
+    m = coo.shape[0]
+
+    def f(idx, vals):
+        row = idx[0]
+        vmax = _jax.ops.segment_max(vals, row, num_segments=m)
+        e = jnp.exp(vals - vmax[row])
+        den = _jax.ops.segment_sum(e, row, num_segments=m)
+        return e / den[row]
+
+    vals = forward_op("sparse_softmax", f, [coo.indices_, coo.values_])
+    out = SparseCooTensor(coo.indices_, vals, coo.shape, coo._coalesced)
+    return out.to_sparse_csr() if back == "csr" else out
+
+
+_register_op("sparse_softmax", lambda i, v: v,
+             "sparse.nn.functional.softmax: row-wise over stored values")
+
+
+class nn:  # namespace parity: paddle.sparse.nn.ReLU / functional
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class Softmax:
+        def __init__(self, axis: int = -1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return _sparse_softmax(x, self.axis)
+
+    class functional:
+        relu = staticmethod(lambda x, name=None: relu(x))
+        softmax = staticmethod(_sparse_softmax)
+
+        @staticmethod
+        def relu6(x, name=None):
+            return _values_map(x, "sparse_relu6",
+                               lambda v: jnp.clip(v, 0, 6))
+
+        @staticmethod
+        def leaky_relu(x, negative_slope: float = 0.01, name=None):
+            return _values_map(
+                x, "sparse_leaky_relu",
+                lambda v: jnp.where(v >= 0, v, v * negative_slope))
+
+
+_register_op("sparse_relu6", lambda v: jnp.clip(v, 0, 6),
+             "sparse.nn.functional.relu6 on values")
+_register_op("sparse_leaky_relu", lambda v: jnp.where(v >= 0, v, v * 0.01),
+             "sparse.nn.functional.leaky_relu on values")

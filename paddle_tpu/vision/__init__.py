@@ -7,7 +7,7 @@ subprocesses (which must never touch the PJRT client); models build on the
 framework's nn layers.
 """
 
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import (LeNet, ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, vgg11, vgg13, vgg16, vgg19, VGG)  # noqa: F401
 

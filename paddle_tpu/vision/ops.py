@@ -1,0 +1,231 @@
+"""``paddle.vision.ops`` parity: detection primitives.
+
+Parity target: ``python/paddle/vision/ops.py`` in the reference (nms,
+roi_align, roi_pool, box_coder — the PaddleDetection post-processing
+kernels). TPU lowering notes: roi_align/roi_pool are vectorized bilinear /
+max gathers (one XLA program, static shapes given static output_size); nms
+is greedy suppression over a precomputed IoU matrix — O(N^2) on device,
+which beats serializing N kernel launches for the N found in practice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import register_op
+from ..ops._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder"]
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU of two [N,4]/[M,4] xyxy box sets -> [N, M]."""
+    a = ensure_tensor(boxes1)
+    b = ensure_tensor(boxes2)
+
+    def impl(x, y):
+        area1 = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+        area2 = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+        lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+        rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+
+    return forward_op("box_iou", impl, [a, b])
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None,
+        name=None):
+    """Greedy non-maximum suppression; returns kept indices into ``boxes``
+    ordered by descending score (ref: paddle.vision.ops.nms). With
+    ``category_idxs``/``categories``, suppression is per-category
+    (batched-nms offset trick). Eager-only output shape (data dependent)."""
+    b = ensure_tensor(boxes)
+    n = int(b.shape[0])
+    if scores is None:
+        sv = jnp.arange(n, 0, -1, dtype=jnp.float32)  # keep input order
+    else:
+        sv = ensure_tensor(scores)._value.astype(jnp.float32)
+    bv = b._value.astype(jnp.float32)
+    if category_idxs is not None:
+        # shift each category into a disjoint coordinate range so cross-
+        # category boxes never overlap (the standard batched-nms trick)
+        cv = ensure_tensor(category_idxs)._value.astype(jnp.float32)
+        span = jnp.max(bv) - jnp.min(bv) + 1.0
+        bv = bv + (cv * span)[:, None]
+
+    order = jnp.argsort(-sv)
+    bs = bv[order]
+    iou = np.asarray(box_iou(Tensor(bs), Tensor(bs))._value)
+
+    keep_sorted = np.ones(n, bool)
+    for i in range(n):          # greedy suppression (host; N is post-top-k)
+        if not keep_sorted[i]:
+            continue
+        keep_sorted[i + 1:] &= ~(iou[i, i + 1:] > iou_threshold)
+    kept = np.asarray(order)[keep_sorted]
+    if top_k is not None:
+        kept = kept[:top_k]
+    from ..core.tensor import to_tensor
+    return to_tensor(kept.astype(np.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """RoIAlign: average of bilinear samples per output bin (ref:
+    paddle.vision.ops.roi_align; boxes [R, 4] xyxy in input coords,
+    ``boxes_num`` [B] rois per image)."""
+    xt = ensure_tensor(x)
+    bt = ensure_tensor(boxes)
+    bn = np.asarray(ensure_tensor(boxes_num).numpy()).astype(np.int64)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def impl(xv, bv):
+        off = 0.5 if aligned else 0.0
+        x1 = bv[:, 0] * spatial_scale - off
+        y1 = bv[:, 1] * spatial_scale - off
+        x2 = bv[:, 2] * spatial_scale - off
+        y2 = bv[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: [R, ph*sr] x [R, pw*sr]
+        gy = (y1[:, None] + (jnp.arange(ph * sr) + 0.5)[None, :] *
+              (bin_h / sr)[:, None])
+        gx = (x1[:, None] + (jnp.arange(pw * sr) + 0.5)[None, :] *
+              (bin_w / sr)[:, None])
+        H, W = xv.shape[2], xv.shape[3]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [Py], xx [Px] -> [C, Py, Px]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            y0, x0, y1i, x1i = (a.astype(jnp.int32)
+                                for a in (y0, x0, y1i, x1i))
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                    + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                    + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                    + v11 * wy[None, :, None] * wx[None, None, :])
+
+        def one_roi(bi, yy, xx):
+            samp = bilinear(xv[bi], yy, xx)         # [C, ph*sr, pw*sr]
+            C = samp.shape[0]
+            samp = samp.reshape(C, ph, sr, pw, sr)
+            return samp.mean(axis=(2, 4))           # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_idx), gy, gx)
+
+    return forward_op("roi_align", impl, [xt, bt])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """RoIPool: max over quantized bins (ref: paddle.vision.ops.roi_pool)."""
+    xt = ensure_tensor(x)
+    bt = ensure_tensor(boxes)
+    bn = np.asarray(ensure_tensor(boxes_num).numpy()).astype(np.int64)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
+
+    def impl(xv, bv):
+        H, W = xv.shape[2], xv.shape[3]
+        x1 = jnp.round(bv[:, 0] * spatial_scale)
+        y1 = jnp.round(bv[:, 1] * spatial_scale)
+        x2 = jnp.round(bv[:, 2] * spatial_scale)
+        y2 = jnp.round(bv[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+        # dense sampling grid (oversample then segment-max per bin keeps
+        # shapes static; grid of H/W points covers every integer cell)
+        def one_roi(bi, xx1, yy1, ww, hh):
+            gy = jnp.clip(yy1 + (jnp.arange(ph * 4) + 0.0) * hh / (ph * 4),
+                          0, H - 1).astype(jnp.int32)
+            gx = jnp.clip(xx1 + (jnp.arange(pw * 4) + 0.0) * ww / (pw * 4),
+                          0, W - 1).astype(jnp.int32)
+            patch = xv[bi][:, gy][:, :, gx]          # [C, ph*4, pw*4]
+            C = patch.shape[0]
+            patch = patch.reshape(C, ph, 4, pw, 4)
+            return patch.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.asarray(batch_idx), x1, y1, rw, rh)
+
+    return forward_op("roi_pool", impl, [xt, bt])
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None):
+    """Encode/decode boxes against priors (ref: paddle.vision.ops.box_coder,
+    SSD/R-CNN box regression transform)."""
+    p = ensure_tensor(prior_box)
+    v = None if prior_box_var is None else ensure_tensor(prior_box_var)
+    t = ensure_tensor(target_box)
+    norm = 0.0 if box_normalized else 1.0
+
+    def centers(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        cx = b[..., 0] + 0.5 * w
+        cy = b[..., 1] + 0.5 * h
+        return cx, cy, w, h
+
+    if code_type == "encode_center_size":
+        def impl(pv, tv, *var):
+            pcx, pcy, pw, ph_ = centers(pv)
+            tcx, tcy, tw, th = centers(tv[:, None, :]
+                                       if tv.ndim == 2 else tv)
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph_,
+                             jnp.log(tw / pw), jnp.log(th / ph_)], axis=-1)
+            if var:
+                out = out / var[0]
+            return out
+        args = [p, t] + ([v] if v is not None else [])
+        return forward_op("box_coder", impl, args)
+
+    def impl(pv, tv, *var):   # decode_center_size
+        pcx, pcy, pw, ph_ = centers(pv)
+        d = tv * var[0] if var else tv
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph_ + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                          ocx + 0.5 * ow - norm, ocy + 0.5 * oh - norm],
+                         axis=-1)
+
+    args = [p, t] + ([v] if v is not None else [])
+    return forward_op("box_coder", impl, args)
+
+
+for _n, _f, _d in [
+    ("box_iou", lambda a, b: a, "pairwise IoU matrix"),
+    ("nms", lambda b: b, "greedy non-maximum suppression"),
+    ("roi_align", lambda x, b: x, "RoIAlign bilinear pooling"),
+    ("roi_pool", lambda x, b: x, "RoIPool max pooling"),
+    ("box_coder", lambda p, t: t, "SSD/R-CNN box regression transform"),
+]:
+    register_op(_n, _f, f"vision.ops.{_n}: {_d}")

@@ -1,0 +1,277 @@
+"""Value-level tests for the round-3 op-surface additions: extended tensor
+ops, the inplace family, sparse kernels, signal (stft/istft), geometric
+segment/message ops, and vision detection ops. Oracles are numpy/torch
+(torch-cpu is in the image and matches paddle's semantics for these)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestExtended:
+    def test_slice_scatter(self):
+        x = np.zeros((4, 6), np.float32)
+        v = np.ones((4, 2), np.float32) * 7
+        out = paddle.slice_scatter(t(x), t(v), axes=[1], starts=[2],
+                                   ends=[4]).numpy()
+        ref = x.copy()
+        ref[:, 2:4] = 7
+        np.testing.assert_array_equal(out, ref)
+
+    def test_as_strided(self):
+        x = np.arange(12, dtype=np.float32)
+        out = paddle.as_strided(t(x), shape=[3, 4], stride=[4, 1]).numpy()
+        np.testing.assert_array_equal(out, x.reshape(3, 4))
+        # overlapping windows (stride < size)
+        out2 = paddle.as_strided(t(x), shape=[5, 4], stride=[2, 1]).numpy()
+        ref2 = np.lib.stride_tricks.as_strided(
+            x, (5, 4), (2 * 4, 4)).copy()
+        np.testing.assert_array_equal(out2, ref2)
+
+    def test_unfold(self):
+        x = np.arange(10, dtype=np.float32)
+        out = t(x).unfold(axis=0, size=4, step=2).numpy()
+        ref = torch.tensor(x).unfold(0, 4, 2).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_cummin_matches_torch(self):
+        a = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        vals, idx = paddle.cummin(t(a), axis=1)
+        tv, ti = torch.tensor(a).cummin(dim=1)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+
+    def test_logcumsumexp(self):
+        a = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        out = paddle.logcumsumexp(t(a), axis=1).numpy()
+        ref = torch.logcumsumexp(torch.tensor(a), dim=1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_index_sample(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([[0, 2], [1, 3], [3, 0]], np.int64)
+        out = paddle.index_sample(t(x), t(idx)).numpy()
+        np.testing.assert_array_equal(out, np.take_along_axis(x, idx, 1))
+
+    def test_frexp(self):
+        a = np.array([0.5, 3.0, -6.0, 0.25], np.float32)
+        m, e = paddle.frexp(t(a))
+        rm, re = np.frexp(a)
+        np.testing.assert_allclose(m.numpy(), rm, atol=1e-6)
+        np.testing.assert_array_equal(e.numpy(), re)
+
+    def test_hermitian_fft_against_torch(self):
+        rng = np.random.RandomState(2)
+        x = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype(np.complex64)
+        from paddle_tpu import fft as _  # noqa: F401 (namespace exists)
+        out = paddle.hfft2(t(x)).numpy()
+        ref = torch.fft.hfft2(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        y = rng.randn(4, 8).astype(np.float32)
+        out_i = paddle.ihfft2(t(y)).numpy()
+        ref_i = torch.fft.ihfft2(torch.tensor(y)).numpy()
+        np.testing.assert_allclose(out_i, ref_i, rtol=1e-4, atol=1e-5)
+
+    def test_binomial_standard_gamma_stats(self):
+        paddle.seed(0)
+        s = paddle.binomial(t(np.full((20000,), 10, np.int64)),
+                            t(np.full((20000,), 0.3, np.float32))).numpy()
+        assert abs(s.mean() - 3.0) < 0.1
+        g = paddle.standard_gamma(t(np.full((20000,), 4.0,
+                                            np.float32))).numpy()
+        assert abs(g.mean() - 4.0) < 0.15   # E[Gamma(a,1)] = a
+
+
+class TestInplace:
+    def test_inplace_updates_and_grads_flow(self):
+        a = np.array([0.2, 0.4, 0.6], np.float32)
+        x = t(a.copy(), sg=False)
+        y = x.multiply(t(np.float32(1.0)))  # graph node
+        before = id(x)
+        out = paddle.tanh_(x)
+        assert out is x and id(x) == before     # same python object
+        np.testing.assert_allclose(x.numpy(), np.tanh(a), atol=1e-6)
+
+    def test_inplace_version_bumps(self):
+        x = t(np.ones(3, np.float32))
+        v0 = x.inplace_version
+        paddle.log1p_(x)
+        assert x.inplace_version > v0
+
+    def test_fill_zero_diagonal(self):
+        x = t(np.ones((3, 3), np.float32))
+        paddle.zero_(x)
+        np.testing.assert_array_equal(x.numpy(), np.zeros((3, 3)))
+        paddle.fill_(x, 2.5)
+        np.testing.assert_array_equal(x.numpy(), np.full((3, 3), 2.5))
+        paddle.fill_diagonal_(x, -1.0)
+        assert np.all(np.diag(x.numpy()) == -1.0)
+
+    def test_surface_breadth(self):
+        import paddle_tpu.ops.inplace as ip
+        assert len(ip.__all__) >= 55  # the paddle *_ family is present
+
+
+class TestSparseSurface:
+    def _coo(self, dense):
+        idx = np.stack(np.nonzero(dense)).astype(np.int32)
+        vals = dense[tuple(idx)]
+        from paddle_tpu import sparse as sp
+        return sp.sparse_coo_tensor(idx, vals, dense.shape), dense
+
+    def test_unary_values_exact(self):
+        from paddle_tpu import sparse as sp
+        d = np.zeros((4, 5), np.float32)
+        d[0, 1], d[2, 3], d[3, 0] = 0.5, -0.25, 0.75
+        x, dense = self._coo(d)
+        for name, ref in [("sin", np.sin), ("tanh", np.tanh),
+                          ("sqrt", None), ("square", np.square),
+                          ("expm1", np.expm1), ("abs", np.abs)]:
+            if ref is None:
+                continue
+            out = getattr(sp, name)(x).to_dense().numpy()
+            np.testing.assert_allclose(out, ref(dense), atol=1e-6,
+                                       err_msg=name)
+
+    def test_mv_matches_dense(self):
+        from paddle_tpu import sparse as sp
+        d = np.zeros((4, 6), np.float32)
+        d[0, 1], d[1, 4], d[3, 2] = 2.0, -1.0, 0.5
+        x, dense = self._coo(d)
+        v = np.random.RandomState(3).randn(6).astype(np.float32)
+        out = sp.mv(x, t(v)).numpy()
+        np.testing.assert_allclose(out, dense @ v, atol=1e-5)
+
+    def test_softmax_rows(self):
+        from paddle_tpu import sparse as sp
+        d = np.zeros((3, 5), np.float32)
+        d[0, 1], d[0, 3], d[2, 2] = 1.0, 2.0, 5.0
+        x, dense = self._coo(d)
+        out = sp.nn.functional.softmax(x).to_dense().numpy()
+        # row 0: softmax over the two stored values
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(out[0, [1, 3]], e / e.sum(), atol=1e-6)
+        np.testing.assert_allclose(out[2, 2], 1.0, atol=1e-6)
+
+    def test_transpose_reshape_roundtrip(self):
+        from paddle_tpu import sparse as sp
+        d = np.zeros((3, 4), np.float32)
+        d[1, 2], d[2, 0] = 3.0, -1.0
+        x, dense = self._coo(d)
+        np.testing.assert_allclose(
+            sp.transpose(x, [1, 0]).to_dense().numpy(), dense.T, atol=0)
+        np.testing.assert_allclose(
+            sp.reshape(x, [4, 3]).to_dense().numpy(),
+            dense.reshape(4, 3), atol=0)
+
+    def test_addmm(self):
+        from paddle_tpu import sparse as sp
+        d = np.zeros((3, 4), np.float32)
+        d[0, 0], d[2, 3] = 1.0, 2.0
+        x, dense = self._coo(d)
+        y = np.random.RandomState(4).randn(4, 2).astype(np.float32)
+        inp = np.random.RandomState(5).randn(3, 2).astype(np.float32)
+        out = sp.addmm(t(inp), x, t(y), beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(out, 0.5 * inp + 2.0 * (dense @ y),
+                                   rtol=1e-5)
+
+
+class TestSignal:
+    def test_stft_matches_torch(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 512).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        out = paddle.signal.stft(t(x), n_fft=128, hop_length=64,
+                                 window=t(win)).numpy()
+        ref = torch.stft(torch.tensor(x), n_fft=128, hop_length=64,
+                         window=torch.tensor(win), center=True,
+                         pad_mode="reflect", return_complex=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1024).astype(np.float32)
+        win = np.hanning(256).astype(np.float32)
+        sp = paddle.signal.stft(t(x), n_fft=256, hop_length=64,
+                                window=t(win))
+        back = paddle.signal.istft(sp, n_fft=256, hop_length=64,
+                                   window=t(win), length=1024).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        seg = np.array([0, 0, 1, 1], np.int32)
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(t(data), t(seg)).numpy(),
+            [[4., 6.], [12., 14.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(t(data), t(seg)).numpy(),
+            [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(t(data), t(seg)).numpy(),
+            [[3., 4.], [7., 8.]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(t(data), t(seg)).numpy(),
+            [[1., 2.], [5., 6.]])
+
+    def test_send_u_recv(self):
+        x = np.array([[1.], [2.], [4.]], np.float32)
+        src = np.array([0, 1, 2], np.int64)
+        dst = np.array([1, 2, 2], np.int64)
+        out = paddle.geometric.send_u_recv(t(x), t(src), t(dst),
+                                           reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[0.], [1.], [6.]])
+
+
+class TestVisionOps:
+    def test_nms_matches_torchvision_semantics(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                          [21, 21, 29, 29]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+        kept = paddle.vision.ops.nms(t(boxes), iou_threshold=0.5,
+                                     scores=t(scores)).numpy()
+        # 3 overlaps 2 (suppressed), 1 overlaps 0 (suppressed)
+        np.testing.assert_array_equal(sorted(kept), [0, 3])
+
+    def test_box_iou(self):
+        b1 = np.array([[0, 0, 2, 2]], np.float32)
+        b2 = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32)
+        iou = paddle.vision.ops.box_iou(t(b1), t(b2)).numpy()
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0], atol=1e-6)
+
+    def test_roi_align_matches_torchvision(self):
+        tv = pytest.importorskip("torchvision")
+        rng = np.random.RandomState(8)
+        x = rng.randn(1, 3, 16, 16).astype(np.float32)
+        boxes = np.array([[2., 2., 10., 10.], [0., 0., 15., 15.]],
+                         np.float32)
+        out = paddle.vision.ops.roi_align(
+            t(x), t(boxes), t(np.array([2], np.int32)), output_size=4,
+            spatial_scale=1.0, sampling_ratio=2, aligned=True).numpy()
+        ref = tv.ops.roi_align(
+            torch.tensor(x), [torch.tensor(boxes)], output_size=4,
+            spatial_scale=1.0, sampling_ratio=2, aligned=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 20.]],
+                          np.float32)
+        targets = np.array([[1., 1., 9., 11.], [4., 6., 16., 18.]],
+                           np.float32)
+        enc = paddle.vision.ops.box_coder(
+            t(priors), None, t(targets), code_type="encode_center_size")
+        dec = paddle.vision.ops.box_coder(
+            t(priors), None, enc, code_type="decode_center_size").numpy()
+        # encode produces the [target, prior, 4] matrix; the i-th target
+        # decoded against the i-th prior is the roundtrip identity
+        np.testing.assert_allclose(dec[np.arange(2), np.arange(2)], targets,
+                                   atol=1e-4)

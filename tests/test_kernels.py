@@ -250,3 +250,40 @@ class TestVarlenFlashAttention:
                                   block_q=8, block_k=8)
         np.testing.assert_allclose(np.asarray(out_none), np.asarray(out_seg),
                                    atol=1e-5)
+
+
+class TestQuantMatmul:
+    """Weight-only int8 matmul kernel (ref: weight_only_linear)."""
+
+    def test_matches_dequantized_reference(self):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.quant_matmul import (quantize_weights,
+                                                     weight_only_matmul)
+        rng = np.random.RandomState(0)
+        w = (rng.randn(256, 512) * 0.05).astype(np.float32)
+        x = rng.randn(4, 64, 256).astype(np.float32)
+        wq, s = quantize_weights(w)
+        assert wq.dtype == jnp.int8 and s.shape == (512,)
+        out = np.asarray(weight_only_matmul(jnp.asarray(x), wq, s),
+                         np.float32)
+        ref = x.reshape(-1, 256) @ (np.asarray(wq, np.float32)
+                                    * np.asarray(s)[None, :])
+        np.testing.assert_allclose(out.reshape(-1, 512), ref,
+                                   rtol=2e-2, atol=2e-2)  # bf16 MXU acc
+        # quantization noise vs the ORIGINAL weights stays ~1%
+        full = x.reshape(-1, 256) @ w
+        rel = np.abs(out.reshape(-1, 512) - full).max() / np.abs(full).max()
+        assert rel < 0.05, rel
+
+    def test_unblockable_shape_falls_back(self):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.quant_matmul import (quantize_weights,
+                                                     weight_only_matmul)
+        rng = np.random.RandomState(1)
+        w = (rng.randn(100, 36) * 0.1).astype(np.float32)  # not tileable
+        x = rng.randn(5, 100).astype(np.float32)
+        wq, s = quantize_weights(w)
+        out = np.asarray(weight_only_matmul(jnp.asarray(x), wq, s),
+                         np.float32)
+        ref = x @ (np.asarray(wq, np.float32) * np.asarray(s)[None, :])
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)

@@ -1,0 +1,187 @@
+"""Schema-driven OpTest sweep (SURVEY §4: the reference's OpTest pattern —
+numpy oracle + numeric gradient check + dtype sweep PER OP — generated here
+from OP_REGISTRY instead of hand-written per-op classes).
+
+Every registered op tagged "unary"/"binary" by its factory gets:
+  * fp32 forward vs the numpy oracle of the same (aliased) name,
+  * autodiff gradient vs central finite differences,
+  * a bfloat16 run (dtype support + finiteness) where the math allows.
+Ops with no numpy counterpart still get the run + gradient check.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # populate the registry  # noqa: F401
+from paddle_tpu.core.dispatch import OP_REGISTRY
+
+# safe input domains: (low, high) keeping the op real, finite, and away
+# from non-differentiable kinks; default (-2, 2)
+DOMAINS = {
+    "log": (0.3, 3.0), "log2": (0.3, 3.0), "log10": (0.3, 3.0),
+    "log1p": (-0.6, 3.0), "sqrt": (0.1, 4.0), "rsqrt": (0.1, 4.0),
+    "asin": (-0.9, 0.9), "acos": (-0.9, 0.9), "atanh": (-0.9, 0.9),
+    "acosh": (1.1, 3.0), "erfinv": (-0.9, 0.9), "logit": (0.1, 0.9),
+    "lgamma": (0.2, 3.0), "gammaln": (0.2, 3.0), "digamma": (0.2, 3.0),
+    "polygamma": (0.2, 3.0), "tan": (-1.2, 1.2), "gamma": (0.2, 3.0),
+    "reciprocal": (0.3, 3.0), "divide": (0.3, 3.0), "rdiv": (0.3, 3.0),
+    "floor_divide": (0.5, 4.0), "remainder": (0.5, 4.0), "mod": (0.5, 4.0),
+    "fmod": (0.5, 4.0), "pow": (0.3, 2.0), "float_power": (0.3, 2.0),
+    "gammainc": (0.3, 3.0), "gammaincc": (0.3, 3.0),
+    "i0": (-2.0, 2.0), "i0e": (-2.0, 2.0), "i1": (-2.0, 2.0),
+    "i1e": (-2.0, 2.0), "cumprod": (0.3, 1.5), "prod": (0.3, 1.5),
+    "elementwise_pow": (0.3, 2.0),
+}
+
+# integer-domain ops: sampled as int32, no gradient or bf16 legs
+INT_OPS = {"bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+           "gcd", "lcm"}
+
+# paddle name -> numpy callable (when names differ or live elsewhere)
+ORACLES = {
+    "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+    "asinh": np.arcsinh, "acosh": np.arccosh, "atanh": np.arctanh,
+    "atan2": np.arctan2, "rsqrt": lambda v: 1 / np.sqrt(v),
+    "reciprocal": lambda v: 1 / v, "neg": np.negative,
+    "lgamma": lambda v: np.vectorize(__import__("math").lgamma)(v),
+    "gammaln": lambda v: np.vectorize(__import__("math").lgamma)(v),
+    "pow": np.power, "mod": np.mod, "remainder": np.mod,
+    "elementwise_pow": np.power,
+    "logical_not": np.logical_not, "logical_and": np.logical_and,
+    "logical_or": np.logical_or, "logical_xor": np.logical_xor,
+    "not_equal": np.not_equal, "equal": np.equal,
+    "greater_than": np.greater, "greater_equal": np.greater_equal,
+    "less_than": np.less, "less_equal": np.less_equal,
+    "maximum": np.maximum, "minimum": np.minimum, "fmax": np.fmax,
+    "fmin": np.fmin, "multiply": np.multiply, "add": np.add,
+    "subtract": np.subtract, "divide": np.divide,
+    "floor_divide": np.floor_divide, "fmod": np.fmod,
+    "logaddexp": np.logaddexp, "logaddexp2": np.logaddexp2,
+    "hypot": np.hypot, "copysign": np.copysign, "nextafter": np.nextafter,
+    "heaviside": np.heaviside, "ldexp": lambda a, b: np.ldexp(a, b.astype(int)),
+    "square": np.square, "sign": np.sign, "sgn": np.sign,
+    "abs": np.abs, "exp": np.exp, "expm1": np.expm1,
+    "trunc": np.trunc, "fix": np.fix, "frac": lambda v: v - np.trunc(v),
+    "deg2rad": np.deg2rad, "rad2deg": np.rad2deg,
+    "erf": None, "erfinv": None,  # no numpy counterpart — run-only
+}
+
+# ops whose sampled-arg semantics don't fit the generic harness
+SKIP = {
+    "ldexp",        # int second operand — covered in test_ops.py
+    "heaviside",    # kink at 0 breaks the finite-difference check
+    "nextafter",    # not meaningfully differentiable
+    "iscomplex",    # depends on dtype, not values
+    "bitwise_left_shift", "bitwise_right_shift",  # int-only, in test_ops.py
+}
+
+
+def _ops_with(category):
+    return sorted(n for n, d in OP_REGISTRY.items()
+                  if d.category == category and n not in SKIP
+                  and not n.endswith("_"))
+
+
+def _sample(name, shape=(3, 4), seed=0):
+    rng = np.random.RandomState(seed + (sum(map(ord, name)) % 1000))
+    if name in INT_OPS:
+        return rng.randint(1, 16, shape).astype(np.int32)
+    lo, hi = DOMAINS.get(name, (-2.0, 2.0))
+    return (rng.uniform(lo, hi, shape)).astype(np.float32)
+
+
+def _is_float(arr):
+    return np.issubdtype(np.asarray(arr).dtype, np.floating)
+
+
+def _oracle(name):
+    if name in ORACLES:
+        return ORACLES[name]
+    return getattr(np, name, None)
+
+
+@pytest.mark.parametrize("name", _ops_with("unary"))
+def test_unary_sweep(name):
+    d = OP_REGISTRY[name]
+    x = _sample(name)
+    out = np.asarray(d.fn(jnp.asarray(x)))
+    assert np.all(np.isfinite(np.asarray(out, np.float32))), \
+        f"{name}: non-finite output inside its declared domain"
+
+    ref = _oracle(name)
+    if ref is not None:
+        expect = np.asarray(ref(x))
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   np.asarray(expect, np.float64),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+    if d.differentiable and _is_float(out) and name not in INT_OPS:
+        g = jax.grad(lambda v: d.fn(v).astype(jnp.float32).sum())(
+            jnp.asarray(x))
+        eps = 1e-3
+        for (i, j) in [(0, 0), (1, 2), (2, 3)]:
+            xp, xm = x.copy(), x.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            num = (np.asarray(d.fn(jnp.asarray(xp)), np.float64).sum()
+                   - np.asarray(d.fn(jnp.asarray(xm)), np.float64).sum()) \
+                / (2 * eps)
+            np.testing.assert_allclose(
+                float(g[i, j]), num, rtol=2e-2, atol=2e-3,
+                err_msg=f"{name}: grad mismatch at [{i},{j}]")
+
+    # bf16 dtype sweep: must execute and stay finite
+    if name not in INT_OPS:
+        ob = d.fn(jnp.asarray(x, jnp.bfloat16))
+        assert np.all(np.isfinite(np.asarray(ob, np.float32))), \
+            f"{name}: non-finite under bfloat16"
+
+
+@pytest.mark.parametrize("name", _ops_with("binary"))
+def test_binary_sweep(name):
+    d = OP_REGISTRY[name]
+    x = _sample(name, seed=1)
+    y = _sample(name, seed=2)
+    out = np.asarray(d.fn(jnp.asarray(x), jnp.asarray(y)))
+    assert np.all(np.isfinite(np.asarray(out, np.float32))), name
+
+    ref = _oracle(name)
+    if ref is not None:
+        expect = np.asarray(ref(x, y))
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   np.asarray(expect, np.float64),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+    if d.differentiable and _is_float(out) and name not in INT_OPS:
+        g = jax.grad(
+            lambda a, b: d.fn(a, b).astype(jnp.float32).sum(),
+            argnums=(0, 1))(jnp.asarray(x), jnp.asarray(y))
+        eps = 1e-3
+        for argn, arr in ((0, x), (1, y)):
+            xp, xm = arr.copy(), arr.copy()
+            xp[1, 1] += eps
+            xm[1, 1] -= eps
+            args_p = (xp, y) if argn == 0 else (x, xp)
+            args_m = (xm, y) if argn == 0 else (x, xm)
+            num = (np.asarray(d.fn(*map(jnp.asarray, args_p)),
+                              np.float64).sum()
+                   - np.asarray(d.fn(*map(jnp.asarray, args_m)),
+                                np.float64).sum()) / (2 * eps)
+            np.testing.assert_allclose(
+                float(g[argn][1, 1]), num, rtol=2e-2, atol=2e-3,
+                err_msg=f"{name}: grad mismatch wrt arg {argn}")
+
+    if name not in INT_OPS:
+        ob = d.fn(jnp.asarray(x, jnp.bfloat16), jnp.asarray(y, jnp.bfloat16))
+        assert np.all(np.isfinite(np.asarray(ob, np.float32))), name
+
+
+def test_sweep_covers_the_factory_surface():
+    """The registry must be driving a real sweep (regression guard on the
+    category tagging)."""
+    u, b = _ops_with("unary"), _ops_with("binary")
+    assert len(u) >= 55, len(u)
+    assert len(b) >= 30, len(b)
