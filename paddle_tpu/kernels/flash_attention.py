@@ -40,6 +40,32 @@ def _interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 
+def _seg_overlap(sq_ref, sk_ref):
+    """Whether this [block_q, block_k] tile can contain ANY same-segment
+    pair: the segment-id RANGES of the two tiles must intersect. Sound for
+    arbitrary segment ids (range test is conservative); for the packed
+    layout (ids non-decreasing along the sequence — the varlen contract)
+    it is exact, and skipping the disjoint tiles makes the kernel's work
+    scale with the number of same-segment blocks rather than S^2 — the
+    splash/sparse-causal structure of the reference's varlen kernels."""
+    sq = sq_ref[0, :, 0]
+    sk = sk_ref[0, :, 0]
+    return (jnp.min(sq) <= jnp.max(sk)) & (jnp.min(sk) <= jnp.max(sq))
+
+
+def _gate(pred_static, sq_ref, sk_ref, use_seg, run):
+    """Combine the causal block gate (None = always run) with the segment
+    block-skip predicate and execute ``run`` under it."""
+    pred = pred_static
+    if use_seg:
+        ov = _seg_overlap(sq_ref, sk_ref)
+        pred = ov if pred is None else jnp.logical_and(pred, ov)
+    if pred is None:
+        run()
+    else:
+        pl.when(pred)(run)
+
+
 def _fwd_kernel(*refs, scale, causal, causal_offset, block_q,
                 block_k, num_kv_blocks, use_seg):
     if use_seg:
@@ -90,13 +116,12 @@ def _fwd_kernel(*refs, scale, causal, causal_offset, block_q,
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         m_ref[:, 0] = m_cur
 
-    if causal:
-        # skip blocks strictly above the (bottom-right-aligned) diagonal
-        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
-        def _():
-            run()
-    else:
-        run()
+    # causal: skip blocks strictly above the (bottom-right-aligned)
+    # diagonal; varlen: additionally skip tiles with no same-segment pair
+    _gate(k_start <= q_start + block_q - 1 + causal_offset if causal
+          else None,
+          sq_ref if use_seg else None, sk_ref if use_seg else None,
+          use_seg, run)
 
     @pl.when(kb == num_kv_blocks - 1)
     def _finalize():
@@ -223,12 +248,10 @@ def _bwd_dq_kernel(*refs, scale, causal, causal_offset,
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
-        def _():
-            run()
-    else:
-        run()
+    _gate(k_start <= q_start + block_q - 1 + causal_offset if causal
+          else None,
+          sq_ref if use_seg else None, sk_ref if use_seg else None,
+          use_seg, run)
 
     @pl.when(kb == num_kv_blocks - 1)
     def _fin():
@@ -283,12 +306,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, causal_offset, block_q, block_k,
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(k_start <= q_start + block_q - 1 + causal_offset)
-        def _():
-            run()
-    else:
-        run()
+    _gate(k_start <= q_start + block_q - 1 + causal_offset if causal
+          else None,
+          sq_ref if use_seg else None, sk_ref if use_seg else None,
+          use_seg, run)
 
     @pl.when(qb == num_q_blocks - 1)
     def _fin():

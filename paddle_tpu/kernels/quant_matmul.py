@@ -83,7 +83,7 @@ def weight_only_matmul(x, w_q, scale, *, block_m: Optional[int] = None,
             w_q.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)[None, :])
         return out.astype(out_dtype).reshape(*lead, N)
 
-    if pltpu is None and not _interpret():
+    if pltpu is None:
         return xla_fallback()        # no VMEM scratch without pallas.tpu
     if M % bm or N % bn or K % bk:
         return xla_fallback()        # shape not blockable

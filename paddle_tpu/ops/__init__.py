@@ -13,7 +13,7 @@ from . import (creation, extended, extras, linalg, logic, manipulation, math,
 _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
             "register_op", "patch_methods", "unary_factory", "binary_factory",
             "axes_arg", "canonical_dtype", "get_default_dtype", "get_jax_device",
-            "Generator", "default_generator"}
+            "Generator", "default_generator", "OP_REGISTRY"}
 
 
 def _export(module):

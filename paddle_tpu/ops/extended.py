@@ -363,27 +363,44 @@ register_op("standard_gamma", lambda a: a,
 # fft completions (hermitian 2-D/N-D)
 # ---------------------------------------------------------------------------
 
-def _fft_member(name, jfn):
-    def op(x, *a, name=None, **k):
-        return forward_op(name, lambda v: jfn(v), [ensure_tensor(x)])
+# factorization (torch.fft semantics): the input is one-sided Hermitian in
+# the LAST transform dim only — full C->C transforms over the other dims,
+# then the Hermitian C->R transform last (mirror of irfftn's structure)
+
+def _hfft_nd(v, s, axes, norm, inverse: bool):
+    axes = tuple(range(-len(s), 0)) if (axes is None and s is not None) \
+        else (axes if axes is not None else tuple(range(v.ndim)))
+    axes = tuple(a % v.ndim for a in axes)
+    other, last = axes[:-1], axes[-1]
+    s_other = None if s is None else tuple(s[:-1])
+    n_last = None if s is None else s[-1]
+    if inverse:
+        u = jnp.fft.ihfft(v, n=n_last, axis=last, norm=norm)
+        return jnp.fft.ifftn(u, s=s_other, axes=other, norm=norm) \
+            if other else u
+    u = jnp.fft.fftn(v, s=s_other, axes=other, norm=norm) if other else v
+    return jnp.fft.hfft(u, n=n_last, axis=last, norm=norm)
+
+
+def _fft_member(name, default_axes, inverse):
+    def op(x, s=None, axes=None, norm=None, name=None):
+        ax = axes if axes is not None else default_axes
+        return forward_op(
+            name, lambda v: _hfft_nd(v, s, ax, norm, inverse),
+            [ensure_tensor(x)])
     op.__name__ = name
-    register_op(name, jfn, f"{name} (hermitian FFT family).")
+    op.__doc__ = (f"{name}: Hermitian FFT family (torch.fft semantics); "
+                  f"honors s/axes/norm.")
+    register_op(name, lambda v: _hfft_nd(v, None, default_axes, None,
+                                         inverse),
+                f"{name} (hermitian FFT family).")
     return op
 
 
-# factorization (torch.fft semantics): the input is one-sided Hermitian in
-# the LAST dim only — full C->C transforms over the other dims, then the
-# Hermitian C->R transform last (mirror of irfftn's structure)
-hfft2 = _fft_member(
-    "hfft2", lambda v: jnp.fft.hfft(jnp.fft.fft(v, axis=-2), axis=-1))
-ihfft2 = _fft_member(
-    "ihfft2", lambda v: jnp.fft.ifft(jnp.fft.ihfft(v, axis=-1), axis=-2))
-hfftn = _fft_member(
-    "hfftn", lambda v: jnp.fft.hfft(
-        jnp.fft.fftn(v, axes=tuple(range(v.ndim - 1))), axis=-1))
-ihfftn = _fft_member(
-    "ihfftn", lambda v: jnp.fft.ifftn(
-        jnp.fft.ihfft(v, axis=-1), axes=tuple(range(v.ndim - 1))))
+hfft2 = _fft_member("hfft2", (-2, -1), inverse=False)
+ihfft2 = _fft_member("ihfft2", (-2, -1), inverse=True)
+hfftn = _fft_member("hfftn", None, inverse=False)
+ihfftn = _fft_member("ihfftn", None, inverse=True)
 
 
 # ---------------------------------------------------------------------------

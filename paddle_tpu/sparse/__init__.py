@@ -390,7 +390,9 @@ def transpose(x, perm, name=None):
 
 
 def reshape(x, shape, name=None):
-    """COO reshape via linear-index recomputation, O(nnz)."""
+    """COO reshape via linear-index recomputation, O(nnz). The index math
+    runs on the HOST in int64 — logical element counts routinely exceed
+    2**31 for sparse shapes, which would overflow the device's int32."""
     if isinstance(x, SparseCsrTensor):
         x = x.to_sparse_coo()
     old = x.shape
@@ -400,20 +402,10 @@ def reshape(x, shape, name=None):
         rest = int(np.prod([s for s in shape if s != -1]))
         shape = [s if s != -1 else total // rest for s in shape]
 
-    def f(idx):
-        lin = jnp.zeros(idx.shape[1], jnp.int32)
-        mul = 1
-        for d in range(len(old) - 1, -1, -1):
-            lin = lin + idx[d].astype(jnp.int32) * mul
-            mul *= old[d]
-        out = []
-        for s in reversed(shape):
-            out.append(lin % s)
-            lin = lin // s
-        return jnp.stack(list(reversed(out))).astype(jnp.int32)
-
-    idx = forward_op("sparse_reshape", f, [x.indices_], differentiable=False)
-    return SparseCooTensor(idx, x.values_, shape)
+    idx_np = np.asarray(x.indices_.numpy()).astype(np.int64)
+    lin = np.ravel_multi_index(tuple(idx_np), tuple(old))
+    new_idx = np.stack(np.unravel_index(lin, tuple(shape))).astype(np.int32)
+    return SparseCooTensor(to_tensor(new_idx), x.values_, shape)
 
 
 # registry entries for the structural ops (the unary family registers in

@@ -89,7 +89,19 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
-    sr = sampling_ratio if sampling_ratio > 0 else 2
+    if sampling_ratio > 0:
+        sr = sampling_ratio
+    else:
+        # adaptive (-1): the reference uses ceil(roi_size/output_size)
+        # samples per bin PER RoI; static shapes need one count per call,
+        # so use the ceil for the LARGEST RoI (over-sampling smaller RoIs
+        # only refines their average)
+        bnp = np.asarray(bt.numpy(), np.float32)
+        max_h = float(np.max(bnp[:, 3] - bnp[:, 1])) * spatial_scale \
+            if len(bnp) else 1.0
+        max_w = float(np.max(bnp[:, 2] - bnp[:, 0])) * spatial_scale \
+            if len(bnp) else 1.0
+        sr = int(max(1, min(8, np.ceil(max(max_h / ph, max_w / pw)))))
     batch_idx = np.repeat(np.arange(len(bn)), bn).astype(np.int32)
 
     def impl(xv, bv):
@@ -208,6 +220,12 @@ def box_coder(prior_box, prior_box_var, target_box,
 
     def impl(pv, tv, *var):   # decode_center_size
         pcx, pcy, pw, ph_ = centers(pv)
+        if tv.ndim == 3:
+            # priors broadcast along `axis` of the [N, M, 4] deltas
+            # (ref: box_coder's axis attr; axis=0 -> prior per column)
+            expand = (lambda a: a[None, :]) if axis == 0 \
+                else (lambda a: a[:, None])
+            pcx, pcy, pw, ph_ = (expand(a) for a in (pcx, pcy, pw, ph_))
         d = tv * var[0] if var else tv
         ocx = d[..., 0] * pw + pcx
         ocy = d[..., 1] * ph_ + pcy

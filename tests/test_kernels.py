@@ -287,3 +287,50 @@ class TestQuantMatmul:
                          np.float32)
         ref = x @ (np.asarray(wq, np.float32) * np.asarray(s)[None, :])
         np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+class TestVarlenBlockSkip:
+    """r3: segment-disjoint tiles are SKIPPED (splash-style sparsity).
+    The skip predicate is range-based, so it must stay CORRECT for
+    arbitrary (even unsorted) segment ids and block-unaligned boundaries."""
+
+    def _run(self, seg_row, S=256, B=2, H=2, D=32):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+                   for kk in ks)
+        seg = jnp.asarray(np.tile(seg_row, (B, 1)))
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg)
+
+        # oracle: jnp masked softmax
+        import math
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / math.sqrt(D)
+        m = jnp.tril(jnp.ones((S, S), bool))[None, None] & \
+            (seg[:, None, :, None] == seg[:, None, None, :])
+        s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(m.any(-1, keepdims=True), p, 0.0)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_block_unaligned_segments(self):
+        # boundaries at 100/190: never aligned with the 128 test blocks
+        row = np.zeros(256, np.int32)
+        row[100:190] = 1
+        row[190:] = 2
+        self._run(row)
+
+    def test_unsorted_segment_ids_stay_correct(self):
+        # interleaved pattern defeats the range skip (ranges always
+        # overlap) — the kernel must fall back to masking, not mis-skip
+        row = (np.arange(256) % 3).astype(np.int32)
+        self._run(row)
+
+    def test_many_tiny_segments(self):
+        row = np.repeat(np.arange(32), 8).astype(np.int32)
+        self._run(row)
