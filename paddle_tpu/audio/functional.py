@@ -131,7 +131,9 @@ def get_window(window: str, win_length: int, fftbins: bool = True):
 
 
 def frame(x, frame_length: int, hop_length: int, axis: int = -1):
-    """Slide a window over the last axis -> [..., n_frames, frame_length]."""
+    """Slide a window over the last axis. Output follows the reference's
+    (librosa) convention: ``axis=-1`` -> [..., frame_length, n_frames];
+    ``axis=0`` -> [n_frames, frame_length, ...]."""
     t = ensure_tensor(x)
 
     def f(v):
@@ -139,7 +141,10 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1):
         n_frames = 1 + (n - frame_length) // hop_length
         idx = (jnp.arange(n_frames)[:, None] * hop_length +
                jnp.arange(frame_length)[None, :])
-        return v[..., idx]
+        out = v[..., idx]                      # [..., n_frames, frame_length]
+        if axis == -1:
+            return jnp.swapaxes(out, -1, -2)   # [..., frame_length, n_frames]
+        return jnp.moveaxis(out, -2, 0)        # [n_frames, ..., frame_length]
     return forward_op("audio_frame", f, [t])
 
 
