@@ -131,20 +131,24 @@ def get_window(window: str, win_length: int, fftbins: bool = True):
 
 
 def frame(x, frame_length: int, hop_length: int, axis: int = -1):
-    """Slide a window over the last axis. Output follows the reference's
-    (librosa) convention: ``axis=-1`` -> [..., frame_length, n_frames];
-    ``axis=0`` -> [n_frames, frame_length, ...]."""
+    """Slide a window over the time axis. Output follows the reference's
+    (librosa) convention: ``axis=-1`` (time-last input) ->
+    [..., frame_length, n_frames]; ``axis=0`` (time-FIRST input) ->
+    [n_frames, frame_length, ...]."""
     t = ensure_tensor(x)
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be -1 (time-last) or 0 "
+                         "(time-first)")
 
     def f(v):
-        n = v.shape[-1]
+        n = v.shape[-1] if axis == -1 else v.shape[0]
         n_frames = 1 + (n - frame_length) // hop_length
         idx = (jnp.arange(n_frames)[:, None] * hop_length +
                jnp.arange(frame_length)[None, :])
-        out = v[..., idx]                      # [..., n_frames, frame_length]
         if axis == -1:
+            out = v[..., idx]                  # [..., n_frames, frame_length]
             return jnp.swapaxes(out, -1, -2)   # [..., frame_length, n_frames]
-        return jnp.moveaxis(out, -2, 0)        # [n_frames, ..., frame_length]
+        return v[idx]                          # [n_frames, frame_length, ...]
     return forward_op("audio_frame", f, [t])
 
 

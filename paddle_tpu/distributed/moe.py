@@ -36,12 +36,54 @@ from ..ops._helpers import ensure_tensor, forward_op
 from .collective import _axis_bound
 from .topology import get_hybrid_communicate_group
 
-__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer"]
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "MoELayer",
+           "gshard_routing"]
 
 
 # ---------------------------------------------------------------------------
 # gates
 # ---------------------------------------------------------------------------
+
+def gshard_routing(logits, top_k: int, capacity: int):
+    """GShard dense routing math on raw values: ``logits [T, E]`` ->
+    ``(combine [T,E,C], dispatch [T,E,C], aux_loss)``. Pure function —
+    shared by the eager :class:`MoELayer` gates and the functional
+    LLaMA-MoE path (models/llama.py)."""
+    T, E = logits.shape
+    cap = capacity
+    probs = jax.nn.softmax(logits, axis=-1)                # [T, E]
+
+    topv, topi = lax.top_k(probs, top_k)                   # [T, K]
+    # position of each token in its expert's queue, per k-choice:
+    # order by k first (all 1st choices before 2nd choices), then token
+    combine = jnp.zeros((T, E, cap), probs.dtype)
+    prev_counts = jnp.zeros((E,), jnp.int32)
+    for k in range(top_k):
+        e_k = topi[:, k]                                    # [T]
+        onehot = jax.nn.one_hot(e_k, E, dtype=jnp.int32)    # [T, E]
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - 1) + prev_counts[None]
+        prev_counts = prev_counts + onehot.sum(0)
+        my_pos = jnp.take_along_axis(
+            pos_in_e, e_k[:, None], axis=1)[:, 0]           # [T]
+        keep = my_pos < cap
+        gate_k = jnp.where(keep, topv[:, k], 0.0)
+        oh_cap = jax.nn.one_hot(jnp.where(keep, my_pos, cap), cap + 1,
+                                dtype=probs.dtype)[:, :cap]  # [T, C]
+        combine = combine + gate_k[:, None, None] * \
+            onehot.astype(probs.dtype)[:, :, None] * oh_cap[:, None, :]
+
+    # renormalize kept gates (GShard: gates sum to 1 over kept choices)
+    denom = jnp.maximum(combine.sum(axis=(1, 2)), 1e-9)
+    combine = combine / denom[:, None, None]
+    dispatch = (combine > 0).astype(probs.dtype)
+
+    # aux load-balancing loss (Switch/GShard): E * sum_e f_e * p_e
+    me = probs.mean(axis=0)                                 # [E]
+    top1 = jax.nn.one_hot(topi[:, 0], E, dtype=probs.dtype)
+    ce = top1.mean(axis=0)
+    aux = (me * ce).sum() * E
+    return combine, dispatch, aux
+
 
 class _GateBase(Layer):
     """Router: tokens [T, M] -> (combine [T,E,C], dispatch [T,E,C], aux)."""
@@ -63,43 +105,7 @@ class _GateBase(Layer):
             / self.num_experts)))
 
     def _routing(self, logits, cap: int):
-        """GShard dense routing math on raw values; returns
-        (combine [T,E,C], dispatch [T,E,C], aux_loss)."""
-        T, E = logits.shape
-        probs = jax.nn.softmax(logits, axis=-1)                # [T, E]
-
-        topv, topi = lax.top_k(probs, self.top_k)              # [T, K]
-        # position of each token in its expert's queue, per k-choice:
-        # order by k first (all 1st choices before 2nd choices), then token
-        combine = jnp.zeros((T, E, cap), probs.dtype)
-        dispatch_total = jnp.zeros((T,), probs.dtype)
-        prev_counts = jnp.zeros((E,), jnp.int32)
-        for k in range(self.top_k):
-            e_k = topi[:, k]                                    # [T]
-            onehot = jax.nn.one_hot(e_k, E, dtype=jnp.int32)    # [T, E]
-            pos_in_e = (jnp.cumsum(onehot, axis=0) - 1) + prev_counts[None]
-            prev_counts = prev_counts + onehot.sum(0)
-            my_pos = jnp.take_along_axis(
-                pos_in_e, e_k[:, None], axis=1)[:, 0]           # [T]
-            keep = my_pos < cap
-            gate_k = jnp.where(keep, topv[:, k], 0.0)
-            oh_cap = jax.nn.one_hot(jnp.where(keep, my_pos, cap), cap + 1,
-                                    dtype=probs.dtype)[:, :cap]  # [T, C]
-            combine = combine + gate_k[:, None, None] * \
-                onehot.astype(probs.dtype)[:, :, None] * oh_cap[:, None, :]
-            dispatch_total = dispatch_total + gate_k
-
-        # renormalize kept gates (GShard: gates sum to 1 over kept choices)
-        denom = jnp.maximum(combine.sum(axis=(1, 2)), 1e-9)
-        combine = combine / denom[:, None, None]
-        dispatch = (combine > 0).astype(probs.dtype)
-
-        # aux load-balancing loss (Switch/GShard): E * sum_e f_e * p_e
-        me = probs.mean(axis=0)                                 # [E]
-        top1 = jax.nn.one_hot(topi[:, 0], E, dtype=probs.dtype)
-        ce = top1.mean(axis=0)
-        aux = (me * ce).sum() * E
-        return combine, dispatch, aux
+        return gshard_routing(logits, self.top_k, cap)
 
 
 class NaiveGate(_GateBase):

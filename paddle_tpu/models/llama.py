@@ -67,6 +67,15 @@ class LlamaConfig:
     # HBM); "nothing" saves nothing (alias of full remat, explicit)
     sep_axis: Optional[str] = None   # context-parallel mesh axis (e.g. "sep")
     cp_impl: str = "ring"            # "ring" | "ulysses" attention over sep
+    # MoE (LLaMA-MoE / Mixtral-style; ref: PaddleNLP MoE models over
+    # incubate/distributed/models/moe): > 0 replaces every dense SwiGLU FFN
+    # with moe_num_experts GShard-routed experts. Expert weights carry a
+    # leading [E] dim sharded over `ep_axis` in param_specs.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    ep_axis: Optional[str] = None    # expert-parallel mesh axis (e.g. "ep")
 
     @property
     def head_dim(self) -> int:
@@ -81,7 +90,12 @@ def num_params(cfg: LlamaConfig) -> int:
     E, I, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
                   cfg.num_hidden_layers)
     kvd = cfg.kv_heads * cfg.head_dim
-    per_layer = E * E + 2 * E * kvd + E * E + 3 * E * I + 2 * E
+    ffn = 3 * E * I
+    gate = 0
+    if cfg.moe_num_experts:
+        ffn = cfg.moe_num_experts * 3 * E * I
+        gate = E * cfg.moe_num_experts
+    per_layer = E * E + 2 * E * kvd + E * E + ffn + gate + 2 * E
     n = V * E + L * per_layer + E
     if not cfg.tie_word_embeddings:
         n += E * V
@@ -105,6 +119,9 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
         return (jax.random.normal(k, shape, jnp.float32) /
                 math.sqrt(fan_in)).astype(pd)
 
+    Ex = cfg.moe_num_experts
+    ffn_shape = ((L, Ex, E, I) if Ex else (L, E, I))
+    ffn_dshape = ((L, Ex, I, E) if Ex else (L, I, E))
     params = {
         "embed": dense(ks[0], (V, E), E),
         "layers": {
@@ -112,14 +129,16 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
             "wk": dense(ks[2], (L, E, Hk * D), E),
             "wv": dense(ks[3], (L, E, Hk * D), E),
             "wo": dense(ks[4], (L, H * D, E), H * D),
-            "w_gate": dense(ks[5], (L, E, I), E),
-            "w_up": dense(ks[6], (L, E, I), E),
-            "w_down": dense(ks[7], (L, I, E), I),
+            "w_gate": dense(ks[5], ffn_shape, E),
+            "w_up": dense(ks[6], ffn_shape, E),
+            "w_down": dense(ks[7], ffn_dshape, I),
             "ln_attn": jnp.ones((L, E), pd),
             "ln_mlp": jnp.ones((L, E), pd),
         },
         "ln_f": jnp.ones((E,), pd),
     }
+    if Ex:
+        params["layers"]["moe_gate"] = dense(ks[9], (L, E, Ex), E)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(ks[8], (E, V), E)
     return params
@@ -134,6 +153,15 @@ def param_specs(cfg: LlamaConfig, mp_axis: Optional[str] = "mp",
     the ``sharding`` axis — ref: GroupShardedStage3, here just a layout).
     """
     mp, fs = mp_axis, fsdp_axis
+    ep = cfg.ep_axis
+    if cfg.moe_num_experts:
+        # experts sharded over ep (E/ep per device); the FFN contraction
+        # dims may still carry mp/fs on top (composable hybrid layout)
+        ffn_in = P(None, ep, fs, mp)
+        ffn_out = P(None, ep, mp, fs)
+    else:
+        ffn_in = P(None, fs, mp)
+        ffn_out = P(None, mp, fs)
     specs = {
         "embed": P(mp, fs),                  # vocab-sharded (VocabParallelEmbedding)
         "layers": {
@@ -141,14 +169,16 @@ def param_specs(cfg: LlamaConfig, mp_axis: Optional[str] = "mp",
             "wk": P(None, fs, mp),
             "wv": P(None, fs, mp),
             "wo": P(None, mp, fs),           # row-parallel
-            "w_gate": P(None, fs, mp),
-            "w_up": P(None, fs, mp),
-            "w_down": P(None, mp, fs),
+            "w_gate": ffn_in,
+            "w_up": ffn_in,
+            "w_down": ffn_out,
             "ln_attn": P(None, None),
             "ln_mlp": P(None, None),
         },
         "ln_f": P(None),
     }
+    if cfg.moe_num_experts:
+        specs["layers"]["moe_gate"] = P(None, None, None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(fs, mp)         # vocab-sharded logits
     return specs
@@ -276,9 +306,41 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
     return o.astype(q.dtype)
 
 
+def _moe_ffn(lp: Dict, h, cfg: LlamaConfig):
+    """GShard-routed SwiGLU experts on ``h [B, S, E]`` -> (out, aux_loss).
+
+    Expert weights carry a leading [E_experts] dim (sharded over
+    ``cfg.ep_axis`` by :func:`param_specs`); the dispatch/combine einsums
+    are the dense GShard formulation, so GSPMD inserts the all_to_all the
+    reference writes by hand (ref: PaddleNLP MoE decoder over
+    incubate/distributed/models/moe)."""
+    from ..distributed.moe import gshard_routing
+    B, S, M = h.shape
+    T = B * S
+    Ex = cfg.moe_num_experts
+    cap = max(1, math.ceil(T * cfg.moe_capacity_factor * cfg.moe_top_k / Ex))
+    h2 = h.reshape(T, M)
+    # router in fp32: bf16 logits make near-tied top-k selections noisy
+    # (the reference's gates also project in fp32); [T,M]x[M,E] is cheap
+    logits = h2.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
+    combine, dispatch, aux = gshard_routing(logits, cfg.moe_top_k, cap)
+    einp = jnp.einsum("tec,tm->ecm", dispatch.astype(h2.dtype), h2)
+
+    def one_expert(wg, wu, wd, xe):
+        g = jax.nn.silu(xe @ wg.astype(xe.dtype)) * (xe @ wu.astype(xe.dtype))
+        return g @ wd.astype(xe.dtype)
+
+    eout = jax.vmap(one_expert)(lp["w_gate"], lp["w_up"], lp["w_down"], einp)
+    y = jnp.einsum("tec,ecm->tm", combine.astype(h2.dtype), eout)
+    return y.reshape(B, S, M), aux
+
+
 def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
                   segment_ids=None):
-    """One pre-norm decoder block on un-stacked layer params ``lp``."""
+    """One pre-norm decoder block on un-stacked layer params ``lp``.
+
+    Dense configs return the block output; MoE configs
+    (``cfg.moe_num_experts > 0``) return ``(output, aux_loss)``."""
     B, S, E = x.shape
     H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -296,18 +358,24 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
     x = x + o @ lp["wo"].astype(dt)
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    if cfg.moe_num_experts:
+        y, aux = _moe_ffn(lp, h, cfg)
+        return x + y, aux
     g = jax.nn.silu(h @ lp["w_gate"].astype(dt)) * (h @ lp["w_up"].astype(dt))
     return x + g @ lp["w_down"].astype(dt)
 
 
 def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
-            position_ids=None):
+            position_ids=None, return_aux: bool = False):
     """``input_ids [B, S] -> logits [B, S, V]`` (single trace via lax.scan).
 
     Packed-sequence (varlen) training: ``segment_ids [B, S]`` confines
     attention within each packed sequence (routed to the flash kernel's
     segment masking on TPU); ``position_ids [B, S]`` restarts RoPE positions
     per sequence (defaults to 0..S-1 shared across rows).
+
+    MoE configs with ``return_aux=True`` return ``(logits, aux_loss)``
+    (mean load-balancing loss over the layers).
     """
     from ..kernels.rope import rope_cos_sin
     B, S = input_ids.shape
@@ -330,28 +398,42 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
     if cfg.remat:
         layer = jax.checkpoint(layer, policy=_remat_policy(cfg.remat_policy))
 
-    def scan_body(h, lp):
-        return layer(lp, h), None
+    if cfg.moe_num_experts:
+        def scan_body(h, lp):
+            h, aux = layer(lp, h)
+            return h, aux
+    else:
+        def scan_body(h, lp):
+            return layer(lp, h), None
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, auxes = lax.scan(scan_body, x, params["layers"])
     x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_fused_norm)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
-    return x @ head.astype(cfg.dtype)
+    logits = x @ head.astype(cfg.dtype)
+    if return_aux:  # dense configs report aux 0.0 — callers get a 2-tuple
+        aux = jnp.mean(auxes) if cfg.moe_num_experts else jnp.float32(0.0)
+        return logits, aux
+    return logits
 
 
 def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig,
             segment_ids=None, position_ids=None):
-    """Mean next-token cross-entropy (labels already shifted; -100 ignored)."""
-    logits = forward(params, input_ids, cfg, segment_ids,
-                     position_ids).astype(jnp.float32)
+    """Mean next-token cross-entropy (labels already shifted; -100 ignored).
+    MoE configs add ``cfg.moe_aux_weight *`` the load-balancing loss."""
+    logits, aux = forward(params, input_ids, cfg, segment_ids,
+                          position_ids, return_aux=True)
+    logits = logits.astype(jnp.float32)
     V = logits.shape[-1]
     lse = jax.nn.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(
         logits, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
     mask = labels >= 0
     per_tok = jnp.where(mask, lse - tgt, 0.0)
-    return per_tok.sum() / jnp.maximum(mask.sum(), 1)
+    ce = per_tok.sum() / jnp.maximum(mask.sum(), 1)
+    if cfg.moe_num_experts:
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +578,11 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, *, micro_batches: int,
                          f"stages*circular_repeats = {S}*{V}")
     if Vo % S:
         raise ValueError(f"vocab_size {Vo} not divisible by pp degree {S}")
+    if cfg.moe_num_experts:
+        raise NotImplementedError(
+            "make_pp_train_step does not yet thread the MoE aux loss "
+            "through the ring schedule; use the dp/ep GSPMD path "
+            "(make_train_step with cfg.ep_axis) for MoE configs")
     dpn = dp_axis if (dp_axis and dp_axis in mesh.axis_names) else None
     tree = jax.tree_util
 
