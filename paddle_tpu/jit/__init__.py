@@ -3,6 +3,7 @@
 from .api import (InputSpec, StaticFunction, TranslatedLayer, enable_to_static,
                   ignore_module, load, not_to_static, save, to_static)
 from .control_flow import cond, fori_loop, scan, while_loop
+from . import dy2static
 
 __all__ = ["InputSpec", "StaticFunction", "TranslatedLayer", "enable_to_static",
            "ignore_module", "load", "not_to_static", "save", "to_static",

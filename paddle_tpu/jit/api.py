@@ -82,13 +82,16 @@ class StaticFunction:
     compiled program cache keyed by (tree structure, shapes, dtypes, training flags).
     """
 
-    def __init__(self, function, input_spec=None, donate_states=False, layer=None):
+    def __init__(self, function, input_spec=None, donate_states=False,
+                 layer=None, ast_target=None):
         self._fn = function
         self._input_spec = input_spec
         self._donate = donate_states
         self._layer = layer
         self._programs = {}
         self._warmed_up = False
+        self._ast_fn = None       # dy2static-transformed fallback (lazy)
+        self._ast_target = ast_target  # what to transform (Layer.forward)
 
     @property
     def _train_flags(self):
@@ -121,18 +124,54 @@ class StaticFunction:
         key = self._sig(args, kwargs)
         prog = self._programs.get(key)
         if prog is None:
+            fn = self._ast_fn or self._fn
             try:
-                prog = CompiledProgram(self._fn, args, kwargs,
+                prog = CompiledProgram(fn, args, kwargs,
                                        donate_states=self._donate,
                                        layer=self._layer)
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
-                raise RuntimeError(
-                    "to_static: data-dependent Python control flow (if/while on a "
-                    "tensor value) cannot be traced. Use paddle_tpu.jit.cond / "
-                    "while_loop / scan, or fall back to eager mode.\n"
-                    f"original error: {e}") from None
+                # dy2static fallback (the reference's transformer tier):
+                # rewrite tensor-dependent if/while to lax control flow
+                # and retrace once
+                if self._ast_fn is None:
+                    import functools
+                    import inspect
+
+                    from .dy2static import ast_transform
+                    target = self._ast_target or self._fn
+                    try:
+                        if inspect.ismethod(target):
+                            # Layer case: transform the underlying forward
+                            # and re-bind its instance
+                            tf = ast_transform(target.__func__)
+                            cand = functools.partial(tf, target.__self__)
+                        else:
+                            cand = ast_transform(target)
+                        prog = CompiledProgram(cand, args, kwargs,
+                                               donate_states=self._donate,
+                                               layer=self._layer)
+                    except Exception as e2:
+                        raise RuntimeError(
+                            "to_static: data-dependent Python control flow "
+                            "(if/while on a tensor value) cannot be traced, "
+                            "and the dy2static AST rewrite could not lower "
+                            "it (branches with return/break/continue or "
+                            "object mutation are out of its scope). Use "
+                            "paddle_tpu.jit.cond / while_loop / scan "
+                            "explicitly, or fall back to eager mode.\n"
+                            f"trace error: {e}\n"
+                            f"dy2static: {e2}") from None
+                    # only adopt the transformed fn once it COMPILED — a
+                    # broken transform must not poison later calls
+                    self._ast_fn = cand
+                else:
+                    raise RuntimeError(
+                        "to_static: data-dependent Python control flow "
+                        "remains after the dy2static rewrite. Use "
+                        "paddle_tpu.jit.cond / while_loop / scan.\n"
+                        f"original error: {e}") from None
             self._programs[key] = prog
         return prog(args, kwargs)
 
@@ -169,7 +208,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             layer = fn
             orig_forward = layer.forward
             sf = StaticFunction(lambda *a, **k: orig_forward(*a, **k),
-                                input_spec, donate_states, layer=layer)
+                                input_spec, donate_states, layer=layer,
+                                ast_target=orig_forward)
             layer.forward = sf
             layer._static_function = sf
             layer._orig_forward = orig_forward

@@ -1,0 +1,258 @@
+"""dy2static AST tier (SURVEY §2.4; ref: jit/dy2static transformers):
+tensor-dependent Python if/while inside to_static lowers to lax control
+flow automatically, engaged as a trace-failure fallback."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ast_transform
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestAstTransform:
+    def test_if_else_on_tensor(self):
+        def f(x):
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y + 1.0
+
+        g = ast_transform(f)
+        xp = t([1.0, 2.0])
+        xn = t([-1.0, -2.0])
+        np.testing.assert_allclose(g(xp).numpy(), [3.0, 5.0])
+        np.testing.assert_allclose(g(xn).numpy(), [-1.0, -2.0])
+
+    def test_elif_chain(self):
+        def f(x):
+            if x.mean() > 1:
+                y = x * 10.0
+            elif x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x * 0.0
+            return y
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([2.0])).numpy(), [20.0])
+        np.testing.assert_allclose(g(t([0.5])).numpy(), [1.0])
+        np.testing.assert_allclose(g(t([-3.0])).numpy(), [0.0])
+
+    def test_while_on_tensor(self):
+        def f(x):
+            s = x * 0.0 + 1.0
+            while s.sum() < 100.0:
+                s = s * 2.0
+            return s
+
+        g = ast_transform(f)
+        out = float(g(t([1.0])).numpy()[0])
+        assert out == 128.0  # first power of 2 with sum >= 100
+
+    def test_python_bool_keeps_python_semantics(self):
+        def f(x, flag):
+            if flag:                   # plain python predicate
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([1.0]), True).numpy(), [2.0])
+        np.testing.assert_allclose(g(t([1.0]), False).numpy(), [0.0])
+
+    def test_closure_and_nested_if(self):
+        scale = 3.0
+
+        def f(x):
+            if x.mean() > 0:
+                if x.mean() > 10:
+                    y = x * scale * 2.0
+                else:
+                    y = x * scale
+            else:
+                y = x
+            return y
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([1.0])).numpy(), [3.0])
+        np.testing.assert_allclose(g(t([20.0])).numpy(), [120.0])
+        np.testing.assert_allclose(g(t([-1.0])).numpy(), [-1.0])
+
+    def test_gradients_flow_through_rewritten_if(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y.sum()
+
+        g = ast_transform(f)
+        x = t([1.0, 1.0], sg=False)
+        g(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x2 = t([-1.0, -1.0], sg=False)
+        g(x2).backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [3.0, 3.0])
+
+    def test_branch_with_return_left_untouched(self):
+        def f(x):
+            if x.mean() > 0:       # early return: out of rewrite scope
+                return x * 2.0
+            return x
+
+        g = ast_transform(f)       # transform succeeds (node untouched)...
+        out = g(t([1.0]))          # ...and still works EAGERLY
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+
+class TestToStaticFallback:
+    def test_tensor_if_compiles_via_fallback(self):
+        calls = {"n": 0}
+
+        @to_static
+        def step(x):
+            calls["n"] += 1
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x - 5.0
+            return y.sum()
+
+        xp = t([1.0, 3.0])
+        a = float(step(xp))      # warmup (eager)
+        b = float(step(xp))      # compile: trace fails -> dy2static retry
+        c = float(step(xp))      # cached program
+        assert a == b == c == 8.0
+        xn = t([-1.0, -3.0])
+        assert float(step(xn)) == -14.0   # both branches live in ONE program
+        assert step._ast_fn is not None   # the fallback actually engaged
+        # warmup + failed trace + transformed trace; NOT re-run per call
+        assert calls["n"] <= 4
+
+    def test_tensor_while_compiles(self):
+        @to_static
+        def grow(x):
+            s = x * 0.0 + 1.0
+            while s.sum() < 10.0:
+                s = s + 1.0
+            return s
+
+        x = t([0.0])
+        float(grow(x).numpy()[0])                 # warmup
+        out = float(grow(x).numpy()[0])           # compiled via fallback
+        assert out == 10.0
+
+    def test_unsupported_gets_actionable_error(self):
+        @to_static
+        def bad(x):
+            if x.mean() > 0:
+                return x * 2.0      # early return: not rewritable
+            return x
+
+        x = t([1.0])
+        bad(x)                      # warmup ok (eager)
+        with pytest.raises(RuntimeError, match="dy2static"):
+            bad(x)
+
+
+class TestReviewRegressions:
+    def test_branch_local_temporary(self):
+        """A temp assigned-then-read inside the branch must not become a
+        required call-site input (r3 review)."""
+        def f(x):
+            if x.mean() > 0:
+                tmp = x * 2.0
+                y = tmp + 1.0
+            else:
+                y = x
+            return y
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([1.0])).numpy(), [3.0])
+        np.testing.assert_allclose(g(t([-1.0])).numpy(), [-1.0])
+
+    def test_while_body_temporary(self):
+        def f(x):
+            s = x * 0.0
+            while s.sum() < 3.0:
+                step = x * 0.0 + 1.0
+                s = s + step
+            return s
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([0.0])).numpy(), [3.0])
+
+    def test_mutating_call_left_untouched(self):
+        """cache.append in a branch: lax.cond would run it for BOTH
+        branches at trace time — the rewrite must refuse (r3 review)."""
+        cache = []
+
+        def f(x):
+            if x.mean() > 0:
+                cache.append(1)
+                y = x * 2.0
+            else:
+                y = x
+            return y
+
+        g = ast_transform(f)        # if left as plain python
+        g(t([1.0]))
+        g(t([1.0]))
+        assert cache == [1, 1]      # ran exactly per taken branch (eager)
+
+    def test_failed_transform_does_not_poison(self):
+        @to_static
+        def bad(x):
+            if x.mean() > 0:
+                return x * 2.0      # unsupported: early return
+            return x
+
+        x = t([1.0])
+        bad(x)                                          # warmup
+        with pytest.raises(RuntimeError, match="dy2static"):
+            bad(x)
+        with pytest.raises(RuntimeError, match="dy2static"):
+            bad(x)                  # SAME actionable error, not a raw crash
+
+    def test_layer_forward_fallback(self):
+        import paddle_tpu.nn as nn
+
+        class Gated(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 100.0:
+                    y = h * 0.0
+                else:
+                    y = h
+                return y.sum()
+
+        net = to_static(Gated())
+        x = t(np.ones((2, 4), np.float32))
+        a = float(net(x))           # warmup
+        b = float(net(x))           # compiled via the Layer-forward rewrite
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+        assert net._static_function._ast_fn is not None
+
+    def test_one_sided_assignment_with_prebound_value(self):
+        """`y = ...; if p: y = ...` — the else path must pass the incoming
+        value through."""
+        def f(x):
+            y = x * 1.0
+            if x.mean() > 0:
+                y = x * 5.0
+            return y
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([2.0])).numpy(), [10.0])
+        np.testing.assert_allclose(g(t([-2.0])).numpy(), [-2.0])
