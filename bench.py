@@ -369,7 +369,10 @@ def main():
 
     import os
     t_start = time.time()
-    budget = float(os.environ.get("BENCH_BUDGET_S", "540"))
+    # the self-imposed budget must expire BEFORE any plausible external
+    # timeout so the final headline re-emit always runs (sections are
+    # skipped, never the closing line); raise via BENCH_BUDGET_S
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
 
     def section(name, fn, budget_exempt=False):
         """Failure isolation + time budget: one broken or slow section must

@@ -55,24 +55,48 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, ins: Tuple):
 
 def convert_while(cond_fn: Callable, body_fn: Callable,
                   loop_vars: Tuple) -> Tuple:
-    """Tensor condition -> lax.while_loop (forward-only); python condition
-    -> plain while."""
+    """Tensor condition -> lax.while_loop (forward-only; REFUSES when a
+    loop var wants gradients — silent zero-grad is worse than the loud
+    error pointing at jit.scan); python condition -> plain while."""
+    from ..core import autograd
     from ..core.tensor import Tensor
     first = cond_fn(*loop_vars)
     if isinstance(first, Tensor):
+        if autograd.is_grad_enabled() and any(
+                isinstance(v, Tensor) and not v.stop_gradient
+                for v in loop_vars):
+            raise Dy2StaticError(
+                "tensor-dependent `while` lowers to lax.while_loop, which "
+                "is forward-only — gradients through the loop would be "
+                "silently zero. Rewrite the loop with paddle_tpu.jit.scan "
+                "(differentiable), or mark the loop vars stop_gradient")
         from .control_flow import while_loop
         res = while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)),
                          list(loop_vars))
         return tuple(res)
+    # python predicate: reuse the probe evaluation — an impure condition
+    # must run exactly once per iteration check
     vs = tuple(loop_vars)
-    while cond_fn(*vs):
+    res = first
+    while res:
         vs = tuple(body_fn(*vs))
+        res = cond_fn(*vs)
     return vs
 
 
 # ---------------------------------------------------------------------------
 # static analysis
 # ---------------------------------------------------------------------------
+
+
+def _param_names(args: ast.arguments) -> set:
+    names = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
 
 def _assigned_names(stmts) -> set:
     """Plain local names bound by the statements (nested defs excluded)."""
@@ -111,13 +135,7 @@ def _loaded_names(node) -> set:
             self.generic_visit(n)
 
         def visit_FunctionDef(self, n):
-            own = {a.arg for a in (n.args.posonlyargs + n.args.args
-                                   + n.args.kwonlyargs)}
-            if n.args.vararg:
-                own.add(n.args.vararg.arg)
-            if n.args.kwarg:
-                own.add(n.args.kwarg.arg)
-            own |= _assigned_names(n.body)
+            own = _param_names(n.args) | _assigned_names(n.body)
             inner = _loaded_names(ast.Module(body=list(n.body),
                                              type_ignores=[]))
             names.update(inner - own)
@@ -182,6 +200,22 @@ def _has_object_store(stmts) -> bool:
     return v.found
 
 
+def _definitely_bound(stmts) -> set:
+    """Names guaranteed bound after the statements run on EVERY path — a
+    name assigned only inside one if-branch or a possibly-zero-iteration
+    loop is NOT definite (reading it later may raise in eager python, so
+    the rewrite must not turn it into an unconditional call-site load)."""
+    out = set()
+    for s in stmts:
+        if isinstance(s, ast.If):
+            out |= (_definitely_bound(s.body) & _definitely_bound(s.orelse))
+        elif isinstance(s, (ast.While, ast.For, ast.Try)):
+            pass                      # may run zero times / partially
+        else:
+            out |= _assigned_names([s])
+    return out
+
+
 def _free_reads(stmts, pre_bound=()) -> set:
     """Names READ before being written, walking statements in order — a
     branch-local temporary (``t = ...; y = t + 1``) is not a free read and
@@ -202,9 +236,7 @@ def _free_reads(stmts, pre_bound=()) -> set:
                 free.add(s.target.id)
             bound |= _assigned_names([s])
         elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            own = {a.arg for a in (s.args.posonlyargs + s.args.args
-                                   + s.args.kwonlyargs)}
-            own |= _assigned_names(s.body)
+            own = _param_names(s.args) | _assigned_names(s.body)
             free.update((_loaded_names(ast.Module(body=list(s.body),
                                                   type_ignores=[])) - own)
                         - bound)
@@ -251,46 +283,62 @@ class _ControlFlowTransformer:
         self.n = 0
 
     def transform_function(self, fdef):
-        params = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
-                                  + fdef.args.kwonlyargs)}
-        if fdef.args.vararg:
-            params.add(fdef.args.vararg.arg)
-        if fdef.args.kwarg:
-            params.add(fdef.args.kwarg.arg)
-        fdef.body = self._block(fdef.body, set(params))
+        fdef.body = self._block(fdef.body, _param_names(fdef.args))
         return fdef
 
-    def _block(self, stmts, bound):
+    def _block(self, stmts, bound, rest=frozenset()):
+        """``bound`` tracks names DEFINITELY bound on every path — a
+        conditional assignment must not license an unconditional call-site
+        load further down."""
         out = []
-        for s in stmts:
+        for i, s in enumerate(stmts):
+            # names the REST of the function may read: the tail of this
+            # block plus whatever the enclosing blocks read after us
+            tail_reads = _free_reads(stmts[i + 1:]) | set(rest)
             if isinstance(s, ast.If):
-                out.extend(self._if(s, bound))
+                new, defb = self._if(s, bound, tail_reads)
+                out.extend(new)
+                bound |= defb
             elif isinstance(s, ast.While):
-                out.extend(self._while(s, bound))
+                new, defb = self._while(s, bound, tail_reads)
+                out.extend(new)
+                bound |= defb
             elif isinstance(s, (ast.For, ast.With)):
-                s.body = self._block(s.body, set(bound))
+                # loop bodies re-read their own names across iterations —
+                # count the whole statement's loads as "later reads"
+                sub_rest = tail_reads | _loaded_names(s)
+                s.body = self._block(s.body, set(bound), sub_rest)
                 if getattr(s, "orelse", None):
-                    s.orelse = self._block(s.orelse, set(bound))
+                    s.orelse = self._block(s.orelse, set(bound), sub_rest)
                 out.append(s)
+                bound |= _definitely_bound([s])
             else:
                 out.append(s)
-            bound |= _assigned_names([s])
+                bound |= _assigned_names([s])
         return out
 
     # -- if/elif/else -------------------------------------------------------
-    def _if(self, node: ast.If, bound):
-        node.body = self._block(node.body, set(bound))
-        node.orelse = self._block(node.orelse, set(bound))
+    def _if(self, node: ast.If, bound, rest=frozenset()):
+        node.body = self._block(node.body, set(bound), rest)
+        node.orelse = self._block(node.orelse, set(bound), rest)
         branches = node.body + node.orelse
         if _has_jump(branches) or _has_object_store(branches):
-            return [node]
+            return [node], _definitely_bound([node])
         a_t = _assigned_names(node.body) & self.locals
         a_f = _assigned_names(node.orelse) & self.locals
         # outputs: assigned on both paths, or assigned on one path with a
         # pre-bound value flowing through the other
         outs = sorted((a_t & a_f) | ((a_t | a_f) & bound))
         if not outs:
-            return [node]
+            return [node], _definitely_bound([node])
+        # a one-sided NEW name (no pre-bound value, not assigned on the
+        # other path) becomes branch-local in the rewrite. That is fine for
+        # genuine temporaries, but if anything LATER reads the name the
+        # rewrite would silently drop a live binding — leave the if
+        # untouched instead (python-bool branches keep exact eager
+        # semantics, tensor predicates fail loudly at trace)
+        if ((a_t | a_f) - set(outs)) & set(rest):
+            return [node], _definitely_bound([node])
         reads = (_free_reads(node.body) | _free_reads(node.orelse)
                  | _loaded_names(node.test))
         ins = sorted(((reads | set(outs)) & self.locals & bound))
@@ -319,20 +367,26 @@ class _ControlFlowTransformer:
                       ast.Name(id=f_name, ctx=ast.Load()),
                       _names_tuple(ins, ast.Load)],
                 keywords=[]))
-        return [mk_branch(t_name, node.body),
-                mk_branch(f_name, node.orelse), call]
+        # the call site assigns every out unconditionally
+        return ([mk_branch(t_name, node.body),
+                 mk_branch(f_name, node.orelse), call], set(outs))
 
     # -- while --------------------------------------------------------------
-    def _while(self, node: ast.While, bound):
-        node.body = self._block(node.body, set(bound))
+    def _while(self, node: ast.While, bound, rest=frozenset()):
+        node.body = self._block(node.body, set(bound),
+                                set(rest) | _loaded_names(node))
         if node.orelse or _has_jump(node.body) or \
                 _has_object_store(node.body):
-            return [node]
+            return [node], set()
         # carry = mutated names with a pre-loop value (lax.while_loop needs
         # an initial carry; body temporaries stay local to the body fn)
-        loop = sorted(_assigned_names(node.body) & self.locals & bound)
+        assigned = _assigned_names(node.body) & self.locals
+        loop = sorted(assigned & bound)
         if not loop:
-            return [node]
+            return [node], set()
+        # a body-new name read later would be dropped by the rewrite
+        if (assigned - set(loop)) & set(rest):
+            return [node], set()
         i = self.n
         self.n += 1
         args = ast.arguments(
@@ -357,7 +411,7 @@ class _ControlFlowTransformer:
                       ast.Name(id=f"__pt_body_{i}", ctx=ast.Load()),
                       _names_tuple(loop, ast.Load)],
                 keywords=[]))
-        return [cond_def, body_def, call]
+        return [cond_def, body_def, call], set(loop)
 
 
 def ast_transform(fn: Callable) -> Callable:
@@ -375,13 +429,7 @@ def ast_transform(fn: Callable) -> Callable:
         raise Dy2StaticError("dy2static: expected a function definition")
     fdef.decorator_list = []
 
-    params = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
-                              + fdef.args.kwonlyargs)}
-    if fdef.args.vararg:
-        params.add(fdef.args.vararg.arg)
-    if fdef.args.kwarg:
-        params.add(fdef.args.kwarg.arg)
-    local_names = params | _assigned_names(fdef.body)
+    local_names = _param_names(fdef.args) | _assigned_names(fdef.body)
 
     new_fdef = _ControlFlowTransformer(local_names).transform_function(fdef)
     ast.fix_missing_locations(new_fdef)

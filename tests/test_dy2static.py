@@ -256,3 +256,58 @@ class TestReviewRegressions:
         g = ast_transform(f)
         np.testing.assert_allclose(g(t([2.0])).numpy(), [10.0])
         np.testing.assert_allclose(g(t([-2.0])).numpy(), [-2.0])
+
+    def test_one_sided_new_name_read_later_left_untouched(self):
+        """A python-bool branch binding a NEW name read later must keep
+        exact eager semantics (r3 review: no silent drop)."""
+        def f(x, flag):
+            y = x * 1.0
+            if flag:
+                y = x * 5.0
+                z = x + 1.0
+            else:
+                y = x - 1.0
+            if flag:
+                out = y + z
+            else:
+                out = y
+            return out
+
+        g = ast_transform(f)
+        np.testing.assert_allclose(g(t([2.0]), True).numpy(), [13.0])
+        np.testing.assert_allclose(g(t([2.0]), False).numpy(), [1.0])
+
+    def test_impure_python_while_condition_runs_once_per_check(self):
+        """The dispatch probe must not consume an extra condition
+        evaluation (r3 review)."""
+        evals = []
+
+        def f(x):
+            s = x * 0.0
+            while (evals.append(1) or len(evals)) <= 3:
+                s = s + 1.0
+            return s
+
+        g = ast_transform(f)
+        out = g(t([0.0]))
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        assert len(evals) == 4      # 3 true checks + the final false one
+
+    def test_tensor_while_under_grad_refuses_loudly(self):
+        """Forward-only while must not silently zero gradients
+        (r3 review)."""
+        from paddle_tpu.jit.dy2static import Dy2StaticError
+
+        def f(x):
+            s = x * 1.0
+            while s.sum() < 4.0:
+                s = s * 2.0
+            return s.sum()
+
+        g = ast_transform(f)
+        xg = t([1.0], sg=False)
+        with pytest.raises(Dy2StaticError, match="scan"):
+            g(xg)
+        # without gradients it runs fine
+        out = g(t([1.0]))
+        assert float(out.numpy()) == 4.0
