@@ -1,0 +1,82 @@
+"""Serving-side autoregressive decoding — the Predictor tier of generate().
+
+Parity target: the reference ecosystem serves LLM generation through its
+inference engine (Paddle Inference + PaddleNLP's generation heads; SURVEY
+§2.6). Here the serving artifact is the model's parameter pytree plus its
+config; the decode engine is :mod:`paddle_tpu.models.generation` (one
+compiled program for batch generation, a donated-cache streaming session for
+token-at-a-time serving).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["GenerationConfig", "GenerationPredictor"]
+
+
+class GenerationConfig:
+    """Sampling knobs (ref: PaddleNLP GenerationConfig)."""
+
+    def __init__(self, max_new_tokens: int = 64, temperature: float = 0.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = pad_token_id
+
+
+class GenerationPredictor:
+    """Batch + streaming decode service over a causal-LM param pytree.
+
+    ``predictor.generate(ids)`` — whole batch, one compiled program.
+    ``predictor.stream(ids)`` — yields one token list per step (greedy),
+    using the donated-cache :class:`~paddle_tpu.models.generation.DecodeSession`.
+    """
+
+    def __init__(self, params, model_config, gen_config: GenerationConfig):
+        self._params = params
+        self._cfg = model_config
+        self._gen = gen_config
+
+    def generate(self, input_ids, prompt_lens=None, seed: int = 0):
+        import jax
+        from ..models.generation import generate
+        g = self._gen
+        out = generate(self._params, np.asarray(input_ids), self._cfg,
+                       max_new_tokens=g.max_new_tokens,
+                       prompt_lens=prompt_lens, temperature=g.temperature,
+                       top_k=g.top_k, top_p=g.top_p,
+                       eos_token_id=g.eos_token_id,
+                       pad_token_id=g.pad_token_id,
+                       key=jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+    def stream(self, input_ids, prompt_lens=None):
+        """Greedy token-at-a-time generator (serving loop): yields a [B]
+        numpy array per decode step, stopping at max_new_tokens (rows past
+        eos emit pad)."""
+        import jax.numpy as jnp
+        from ..models.generation import DecodeSession
+        ids = np.asarray(input_ids)
+        B, S = ids.shape
+        g = self._gen
+        sess = DecodeSession(self._params, self._cfg,
+                             capacity=S + g.max_new_tokens)
+        logits = sess.prefill(jnp.asarray(ids), prompt_lens)
+        done = np.zeros((B,), bool)
+        for t in range(g.max_new_tokens):
+            tok = np.asarray(jnp.argmax(logits, -1)).astype(ids.dtype)
+            tok = np.where(done, g.pad_token_id, tok)
+            yield tok
+            if g.eos_token_id is not None:
+                done |= tok == g.eos_token_id
+                if done.all():
+                    return
+            if t < g.max_new_tokens - 1:
+                logits = sess.step(jnp.asarray(tok))

@@ -1,0 +1,369 @@
+"""Autoregressive generation with a KV cache — TPU decode done the XLA way.
+
+Capability target: the reference ecosystem's ``generate()`` surface
+(PaddleNLP ``generation_utils.py`` — greedy / sampling with top-k/top-p,
+eos handling, ragged prompt batches; SURVEY §2.6 ecosystem row).
+
+TPU redesign, not a translation:
+
+* **One compiled program.** Prefill + the whole decode loop run inside a
+  single ``jax.jit`` — the decode loop is a ``lax.scan`` over token steps, so
+  there is no per-token Python dispatch (the reference's per-token Python
+  loop is exactly the pattern SURVEY §3.1 warns against on TPU).
+* **Static cache layout.** The KV cache is a stacked ``[L, B, C, Hk, D]``
+  pytree with a *static* capacity ``C = prompt_len + max_new_tokens``; every
+  decode step writes at a uniform scalar index via
+  ``lax.dynamic_update_slice`` — no dynamic shapes anywhere, so XLA keeps the
+  whole loop on-device and updates the cache in place (buffer reuse inside
+  the program; the streaming API additionally donates the cache across
+  dispatches).
+* **Left-aligned ragged batches.** Ragged prompts are left-padded
+  internally: every row's last prompt token then sits at the same index, the
+  prefill's final-position logits are a plain ``h[:, -1]`` slice, and decode
+  writes land at one scalar index for all rows (a right-padded layout would
+  need per-row scatter indices).
+* **Streaming tier.** :class:`DecodeSession` exposes prefill/step as two
+  jitted functions with the cache DONATED between dispatches, for callers
+  that need a token at a time (``inference.Predictor`` wiring, speculative
+  clients). Same kernels, same cache layout.
+
+MoE caveat: GShard routing capacity is evaluated per forward call, so a
+decode step routes B tokens in isolation while a full no-cache forward
+routes B*S jointly — when capacity DROPS occur the two paths can diverge
+(both are "correct" MoE inference; drops are a training-throughput knob).
+Exact greedy parity with the full-forward oracle therefore holds when no
+tokens are dropped, which is the regime inference runs in (per-step load
+of B tokens over E experts rarely exceeds capacity).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import LlamaConfig, _moe_ffn, _rms_norm, _rope
+
+__all__ = ["init_cache", "prefill", "decode_step", "make_generate_fn",
+           "generate", "DecodeSession"]
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: LlamaConfig, batch: int, capacity: int,
+               dtype=None) -> Dict:
+    """Stacked KV cache ``{"k","v": [L, B, C, Hk, D]}`` (static capacity)."""
+    dt = dtype if dtype is not None else cfg.dtype
+    shape = (cfg.num_hidden_layers, batch, capacity, cfg.kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_layer(lp: Dict, x, ck, cv, cos, sin, kv_mask, write_idx,
+                  cfg: LlamaConfig):
+    """One decoder block attending against the cache.
+
+    ``x [B, T, E]`` (T = prompt length for prefill, 1 for decode);
+    ``ck/cv [B, C, Hk, D]`` this layer's cache; ``kv_mask [B, T, C]`` True
+    where query t may attend key position j; ``write_idx`` scalar — the new
+    K/V rows are written at cache positions [write_idx, write_idx+T).
+    Returns ``(y, ck, cv)``. MoE configs also apply the routed FFN (aux loss
+    is irrelevant at inference and dropped).
+    """
+    B, T, E = x.shape
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = _rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, T, H, D)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, T, Hk, D)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, T, Hk, D)
+    q = _rope(q, cos, sin, False)
+    k = _rope(k, cos, sin, False)
+
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, write_idx, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, write_idx, 0, 0))
+
+    kk, vv = ck, cv
+    if Hk != H:                       # GQA: expand kv heads for the einsum
+        rep = H // Hk
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bthd,bjhd->bhtj", q.astype(jnp.float32),
+                   kk.astype(jnp.float32)) * scale
+    s = jnp.where(kv_mask[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhtj,bjhd->bthd", p.astype(vv.dtype), vv)
+    x = x + o.reshape(B, T, H * D).astype(dt) @ lp["wo"].astype(dt)
+
+    h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    if cfg.moe_num_experts:
+        y, _ = _moe_ffn(lp, h, cfg)
+        return x + y, ck, cv
+    g = jax.nn.silu(h @ lp["w_gate"].astype(dt)) * (h @ lp["w_up"].astype(dt))
+    return x + g @ lp["w_down"].astype(dt), ck, cv
+
+
+def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
+                kv_mask, write_idx):
+    """Embed ``ids [B, T]``, run all layers against the cache (lax.scan over
+    the stacked [L, ...] params+cache), return (last-position logits [B, V],
+    new cache)."""
+    x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        h, ck, cv = _cached_layer(lp, h, ck, cv, cos, sin, kv_mask,
+                                  write_idx, cfg)
+        return h, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"],
+                                     cache["v"]))
+    x = _rms_norm(x[:, -1:], params["ln_f"], cfg.rms_norm_eps,
+                  cfg.use_fused_norm)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    logits = (x @ head.astype(cfg.dtype))[:, 0]
+    return logits.astype(jnp.float32), {"k": ck, "v": cv}
+
+
+def _row_tables(cfg: LlamaConfig, pos):
+    """Per-row RoPE tables for positions ``pos [B, T]`` -> cos/sin [B,T,D]."""
+    from ..kernels.rope import rope_cos_sin
+    T = pos.shape[1]
+    mk = jax.vmap(functools.partial(rope_cos_sin, T, cfg.head_dim,
+                                    cfg.rope_theta))
+    return mk(position_ids=pos)
+
+
+def left_align(ids, prompt_lens, pad_token_id: int = 0):
+    """Right-padded rows -> left-padded (row b's tokens end at index S-1)."""
+    B, S = ids.shape
+    shift = (S - prompt_lens)[:, None]
+    src = (jnp.arange(S)[None, :] - shift) % S
+    out = jnp.take_along_axis(ids, src, axis=1)
+    return jnp.where(jnp.arange(S)[None, :] >= shift, out, pad_token_id)
+
+
+def prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens, cache: Dict,
+            left_padded: bool = False):
+    """Run the prompt through the model, filling cache positions [0, S).
+
+    ``ids [B, S]`` is RIGHT-padded ragged (the public convention) unless
+    ``left_padded=True``; rows are left-aligned internally so every row's
+    last prompt token sits at index S-1 (see module docstring). Returns
+    (next-token logits [B, V], cache).
+    """
+    if not left_padded:
+        ids = left_align(ids, prompt_lens)
+    B, S = ids.shape
+    C = cache["k"].shape[2]
+    shift = S - prompt_lens                                  # [B] pad amount
+    valid = jnp.arange(S)[None, :] >= shift[:, None]         # [B, S]
+    pos = jnp.maximum(jnp.arange(S)[None, :] - shift[:, None], 0)
+    cos, sin = _row_tables(cfg, pos)
+    causal = jnp.arange(C)[None, :] <= jnp.arange(S)[:, None]  # [S, C]
+    valid_k = jnp.pad(valid, ((0, 0), (0, C - S)))             # [B, C]
+    kv_mask = causal[None] & valid_k[:, None, :]
+    return _fwd_cached(params, cfg, ids, cache, cos, sin, kv_mask, 0)
+
+
+def decode_step(params: Dict, cfg: LlamaConfig, token, t, prompt_lens,
+                prompt_pad, cache: Dict):
+    """One decode step: ``token [B]`` at step ``t`` (0-based), writing cache
+    position ``S + t`` (``prompt_pad = S`` the left-padded prompt length).
+    Returns (logits [B, V], cache)."""
+    C = cache["k"].shape[2]
+    pos = (prompt_lens + t)[:, None]                         # [B, 1]
+    cos, sin = _row_tables(cfg, pos)
+    j = jnp.arange(C)[None, :]
+    valid_prompt = (j >= (prompt_pad - prompt_lens)[:, None]) & (j < prompt_pad)
+    appended = (j >= prompt_pad) & (j <= prompt_pad + t)
+    kv_mask = (valid_prompt | appended)[:, None, :]          # [B, 1, C]
+    return _fwd_cached(params, cfg, token[:, None], cache, cos, sin,
+                       kv_mask, prompt_pad + t)
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+def _sample(logits, key, temperature: float, top_k: Optional[int],
+            top_p: Optional[float]):
+    """Greedy when ``temperature == 0``; else temperature/top-k/top-p
+    sampling (static config -> a fixed compiled program per setting)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (the token
+        # that crosses the threshold stays in)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# generate: prefill + scan decode in ONE compiled program
+# ---------------------------------------------------------------------------
+
+def make_generate_fn(cfg: LlamaConfig, *, max_new_tokens: int,
+                     temperature: float = 0.0, top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     eos_token_id: Optional[int] = None,
+                     pad_token_id: int = 0):
+    """Build ``gen(params, ids [B,S], prompt_lens [B], key) -> tokens
+    [B, max_new_tokens]`` — jit it once, every call is one device program.
+
+    ``ids`` may be right-padded; rows are left-aligned internally (see module
+    docstring). Rows finish at ``eos_token_id`` and emit ``pad_token_id``
+    thereafter.
+    """
+
+    def gen(params, ids, prompt_lens, key):
+        B, S = ids.shape
+        C = S + max_new_tokens
+        ids_l = left_align(ids, prompt_lens, pad_token_id)
+
+        cache = init_cache(cfg, B, C)
+        logits, cache = prefill(params, cfg, ids_l, prompt_lens, cache,
+                                left_padded=True)
+
+        # first token comes from the prefill logits; subsequent tokens from
+        # decode steps 0..max_new-2 (eos itself is emitted, pad thereafter)
+        key, sub = jax.random.split(key)
+        tok0 = _sample(logits, sub, temperature, top_k, top_p)
+        done0 = (jnp.zeros((B,), bool) if eos_token_id is None
+                 else tok0 == eos_token_id)
+
+        def body(carry, t):
+            tok, cache, done, key = carry
+            logits, cache = decode_step(params, cfg, tok, t, prompt_lens,
+                                        jnp.int32(S), cache)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, temperature, top_k, top_p)
+            nxt = jnp.where(done, pad_token_id, nxt)
+            ndone = done if eos_token_id is None else \
+                done | (nxt == eos_token_id)
+            return (nxt.astype(ids.dtype), cache, ndone, key), \
+                nxt.astype(ids.dtype)
+
+        if max_new_tokens > 1:
+            carry = (tok0.astype(ids.dtype), cache, done0, key)
+            _, rest = lax.scan(body, carry,
+                               jnp.arange(max_new_tokens - 1))
+            out = jnp.concatenate([tok0[:, None].astype(ids.dtype),
+                                   rest.T], axis=1)
+        else:
+            out = tok0[:, None].astype(ids.dtype)
+        return out
+
+    return gen
+
+
+def generate(params: Dict, ids, cfg: LlamaConfig, *, max_new_tokens: int,
+             prompt_lens=None, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+             key: Optional[jax.Array] = None):
+    """Convenience wrapper: jit-cached by (cfg, sampling knobs, shapes)."""
+    ids = jnp.asarray(ids)
+    B, S = ids.shape
+    if prompt_lens is None:
+        prompt_lens = jnp.full((B,), S, jnp.int32)
+    else:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    fn = _jitted_gen(cfg, max_new_tokens, temperature, top_k, top_p,
+                     eos_token_id, pad_token_id)
+    return fn(params, ids, prompt_lens, key)
+
+
+_GEN_CACHE: Dict = {}
+
+
+def _jitted_gen(cfg: LlamaConfig, max_new_tokens, temperature, top_k, top_p,
+                eos_token_id, pad_token_id):
+    # LlamaConfig is a plain (unhashable) dataclass; key the jit cache by its
+    # full repr + the sampling knobs. jax.jit's own cache handles shapes.
+    key = (repr(cfg), max_new_tokens, temperature, top_k, top_p,
+           eos_token_id, pad_token_id)
+    if key not in _GEN_CACHE:
+        fn = make_generate_fn(
+            cfg, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id)
+        _GEN_CACHE[key] = jax.jit(fn)
+    return _GEN_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# streaming decode (cache donated across dispatches)
+# ---------------------------------------------------------------------------
+
+class DecodeSession:
+    """Token-at-a-time decoding for streaming callers (Predictor wiring).
+
+    Two jitted programs — prefill and step — with the cache DONATED on every
+    dispatch, so XLA updates it in place instead of allocating a fresh
+    [L, B, C, Hk, D] buffer per token.
+
+        sess = DecodeSession(params, cfg, capacity=512)
+        logits = sess.prefill(ids, prompt_lens)   # fills the cache
+        for _ in range(n):
+            tok = logits.argmax(-1)
+            logits = sess.step(tok)
+    """
+
+    def __init__(self, params: Dict, cfg: LlamaConfig, capacity: int):
+        self.params, self.cfg, self.capacity = params, cfg, capacity
+        self._cache = None
+        self._t = 0
+
+        def _prefill(params, ids, plens, cache):
+            return prefill(params, cfg, ids, plens, cache)
+
+        def _step(params, tok, t, plens, ppad, cache):
+            return decode_step(params, cfg, tok, t, plens, ppad, cache)
+
+        self._jpre = jax.jit(_prefill, donate_argnums=(3,))
+        self._jstep = jax.jit(_step, donate_argnums=(5,))
+
+    def prefill(self, ids, prompt_lens=None):
+        ids = jnp.asarray(ids)
+        B, S = ids.shape
+        if S > self.capacity:
+            raise ValueError(f"prompt {S} exceeds capacity {self.capacity}")
+        self._plens = (jnp.full((B,), S, jnp.int32) if prompt_lens is None
+                       else jnp.asarray(prompt_lens, jnp.int32))
+        self._ppad = jnp.int32(S)
+        self._t = 0
+        cache = init_cache(self.cfg, B, self.capacity)
+        logits, self._cache = self._jpre(self.params, ids, self._plens, cache)
+        return logits
+
+    def step(self, token):
+        if self._cache is None:
+            raise RuntimeError("call prefill() first")
+        if int(self._ppad) + self._t >= self.capacity:
+            raise RuntimeError(f"capacity {self.capacity} exhausted")
+        logits, self._cache = self._jstep(
+            self.params, jnp.asarray(token), jnp.int32(self._t),
+            self._plens, self._ppad, self._cache)
+        self._t += 1
+        return logits

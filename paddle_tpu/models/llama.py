@@ -721,5 +721,25 @@ class LlamaForCausalLM:
                 return forward_op("llama_loss", f,
                                   [input_ids, labels, *self._flat_params])
 
+            def generate(self, input_ids, *, max_new_tokens: int,
+                         prompt_lens=None, temperature: float = 0.0,
+                         top_k=None, top_p=None, eos_token_id=None,
+                         pad_token_id: int = 0, seed: int = 0):
+                """KV-cache autoregressive decoding (greedy when
+                ``temperature == 0``, else top-k/top-p sampling); prefill +
+                the whole decode loop compile to ONE device program — see
+                :mod:`paddle_tpu.models.generation`."""
+                from .generation import generate as _gen
+                ids = getattr(input_ids, "_value", input_ids)
+                out = _gen(self.params_pytree(), ids, self.config,
+                           max_new_tokens=max_new_tokens,
+                           prompt_lens=getattr(prompt_lens, "_value",
+                                               prompt_lens),
+                           temperature=temperature, top_k=top_k, top_p=top_p,
+                           eos_token_id=eos_token_id,
+                           pad_token_id=pad_token_id,
+                           key=jax.random.PRNGKey(seed))
+                return Tensor(out)
+
         _Llama.__name__ = "LlamaForCausalLM"
         return _Llama(config, key)
