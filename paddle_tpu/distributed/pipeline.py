@@ -69,7 +69,8 @@ def pipeline_ticks(micro_batches: int, stages: int,
 
 
 def ring_schedule(stage_fn: Callable, params_local, xs, *, axis: str,
-                  num_stages: int, circular_repeats: int = 1):
+                  num_stages: int, circular_repeats: int = 1,
+                  with_aux: bool = False):
     """The rotational pipeline body, usable INSIDE an existing ``shard_map``
     region (so callers can fuse vocab-parallel embedding / LM-head / loss into
     the same compiled program — see ``models.llama.make_pp_train_step``).
@@ -92,25 +93,41 @@ def ring_schedule(stage_fn: Callable, params_local, xs, *, axis: str,
     circular schedule needs ``M >= S``). Backward is ``jax.grad`` straight
     through scan+ppermute — the transpose of a ppermute is the reverse
     ppermute, and XLA schedules the 1F1B-like overlap.
+
+    ``with_aux=True``: ``stage_fn`` returns ``(y, aux_scalar)`` (MoE
+    load-balancing loss); aux from bubble ticks (warmup/cooldown garbage
+    inputs) is MASKED OUT, real-work aux is summed over ticks and psum'd
+    over the ring — the return becomes ``(outs, aux_total)`` where
+    ``aux_total = sum over every (chunk, micro-batch) application``.
     """
     S, V, M = num_stages, circular_repeats, xs.shape[0]
     T = pipeline_ticks(M, S, V)
     s = lax.axis_index(axis)
     tree = jax.tree_util
 
+    def run_stage(p, x_in, t):
+        """Apply the stage; mask bubble-tick aux (idx outside [0, V*M))."""
+        if not with_aux:
+            return stage_fn(p, x_in), jnp.float32(0.0)
+        y, aux = stage_fn(p, x_in)
+        idx = t - s
+        valid = (idx >= 0) & (idx < V * M)
+        return y, jnp.where(valid, aux.astype(jnp.float32), 0.0)
+
     if V == 1:
         p_mine = tree.tree_map(lambda p: p[0], params_local)
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
-            ring = carry
+            ring, aux_acc = carry
             m = jnp.clip(t - s, 0, M - 1)
             x_feed = lax.dynamic_index_in_dim(xs, m, axis=0, keepdims=False)
             x_in = jnp.where(s == 0, x_feed, ring)
-            y = stage_fn(p_mine, x_in)
-            return lax.ppermute(y, axis, perm), y
+            y, aux = run_stage(p_mine, x_in, t)
+            return (lax.ppermute(y, axis, perm), aux_acc + aux), y
 
-        _, ys = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(T))
+        (_, aux_acc), ys = lax.scan(
+            tick, (jnp.zeros_like(xs[0]), jnp.float32(0.0)), jnp.arange(T))
     else:
         if M < S:
             raise ValueError(
@@ -120,7 +137,7 @@ def ring_schedule(stage_fn: Callable, params_local, xs, *, axis: str,
         perm = [(i, (i + 1) % S) for i in range(S)]  # ring incl. wrap-around
 
         def tick(carry, t):
-            ring, park = carry
+            ring, park, aux_acc = carry
             idx = t - s
             m = jnp.mod(idx, M)
             v = jnp.clip(idx // M, 0, V - 1)
@@ -138,16 +155,20 @@ def ring_schedule(stage_fn: Callable, params_local, xs, *, axis: str,
                 lambda p: lax.dynamic_index_in_dim(p, v, axis=0,
                                                    keepdims=False),
                 params_local)
-            y = stage_fn(p_chunk, x_in)
-            return (lax.ppermute(y, axis, perm), park), y
+            y, aux = run_stage(p_chunk, x_in, t)
+            return (lax.ppermute(y, axis, perm), park, aux_acc + aux), y
 
-        carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs))
-        _, ys = lax.scan(tick, carry0, jnp.arange(T))
+        carry0 = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs),
+                  jnp.float32(0.0))
+        (_, _, aux_acc), ys = lax.scan(tick, carry0, jnp.arange(T))
 
     # stage S-1 emitted the final-lap outputs at the last M ticks
     outs = ys[T - M:]
     outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
-    return lax.psum(outs, axis)
+    outs = lax.psum(outs, axis)
+    if with_aux:
+        return outs, lax.psum(aux_acc, axis)
+    return outs
 
 
 def pipeline_scan(stage_fn: Callable, stage_params, xs, *, mesh: Mesh = None,

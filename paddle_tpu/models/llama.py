@@ -528,13 +528,19 @@ def from_pp_layout(params: Dict):
     return out
 
 
-def pp_param_specs(cfg: LlamaConfig, pp_axis: str = "pp") -> Dict:
+def pp_param_specs(cfg: LlamaConfig, pp_axis: str = "pp",
+                   ep_axis: Optional[str] = None) -> Dict:
     """PartitionSpecs for pp-layout params: blocks sharded over the pp axis,
     embedding/LM-head VOCAB-sharded over the same axis (the heterogeneous
     first/last stages are not pipeline-isolated on TPU — they are
     tensor-parallel over the pp ranks, which turns the classic
     embedding-stage imbalance into useful parallel work; ref:
-    pipeline_parallel.py first/last-stage special-casing)."""
+    pipeline_parallel.py first/last-stage special-casing).
+
+    MoE configs: expert weights are ``[V, S, bpc, E, ...]`` — the expert dim
+    additionally shards over ``ep_axis`` (defaults to ``cfg.ep_axis``), the
+    pp x ep submesh composition (ref: the reference's large-MoE configs run
+    pp+ep together)."""
     layer_keys = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                   "ln_attn", "ln_mlp")
     specs = {
@@ -542,6 +548,11 @@ def pp_param_specs(cfg: LlamaConfig, pp_axis: str = "pp") -> Dict:
         "layers": {k: P(None, pp_axis) for k in layer_keys},
         "ln_f": P(None),
     }
+    if cfg.moe_num_experts:
+        ep = ep_axis if ep_axis is not None else cfg.ep_axis
+        for k in ("w_gate", "w_up", "w_down"):
+            specs["layers"][k] = P(None, pp_axis, None, ep)
+        specs["layers"]["moe_gate"] = P(None, pp_axis)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, pp_axis)
     return specs
@@ -578,11 +589,13 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, *, micro_batches: int,
                          f"stages*circular_repeats = {S}*{V}")
     if Vo % S:
         raise ValueError(f"vocab_size {Vo} not divisible by pp degree {S}")
-    if cfg.moe_num_experts:
-        raise NotImplementedError(
-            "make_pp_train_step does not yet thread the MoE aux loss "
-            "through the ring schedule; use the dp/ep GSPMD path "
-            "(make_train_step with cfg.ep_axis) for MoE configs")
+    moe = bool(cfg.moe_num_experts)
+    # pp x ep composition: the pp ring runs MANUAL (shard_map over pp/dp);
+    # the expert dim stays an AUTO axis — GSPMD shards the GShard dispatch/
+    # combine einsums over `ep` INSIDE the manual region (sharding
+    # constraints on the expert leaves; measured fwd+bwd working jax 0.9)
+    ep = cfg.ep_axis if (moe and cfg.ep_axis and
+                         cfg.ep_axis in mesh.axis_names) else None
     dpn = dp_axis if (dp_axis and dp_axis in mesh.axis_names) else None
     tree = jax.tree_util
 
@@ -606,14 +619,24 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, *, micro_batches: int,
         def chunk_fn(cp, h):
             # cp leaves [bpc, ...]: apply the chunk's blocks sequentially
             def blk(hh, lp):
+                if moe:
+                    if ep is not None:  # expert dim: GSPMD auto axis
+                        lp = dict(lp)
+                        for kk in ("w_gate", "w_up", "w_down"):
+                            lp[kk] = lax.with_sharding_constraint(
+                                lp[kk], P(ep, None, None))
+                    return decoder_layer(lp, hh, cos, sin, cfg)
                 return decoder_layer(lp, hh, cos, sin, cfg), None
-            h, _ = lax.scan(blk, h, cp)
+            h, auxes = lax.scan(blk, h, cp)
+            if moe:  # chunk aux = sum over its bpc layers
+                return h, jnp.sum(auxes)
             return h
 
         fn = jax.checkpoint(chunk_fn) if cfg.remat else chunk_fn
         mine = tree.tree_map(lambda p: p[:, 0], layers_l)
-        outs = ring_schedule(fn, mine, x, axis=pp_axis, num_stages=S,
-                             circular_repeats=V)        # [M, mb, T, E]
+        res = ring_schedule(fn, mine, x, axis=pp_axis, num_stages=S,
+                            circular_repeats=V, with_aux=moe)
+        outs, aux_total = res if moe else (res, None)   # outs [M, mb, T, E]
 
         # ---- final norm + vocab-parallel LM head + cross-entropy ----
         h = _rms_norm(outs, ln_f, cfg.rms_norm_eps, cfg.use_fused_norm)
@@ -633,19 +656,34 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh, *, micro_batches: int,
         if dpn is not None:
             lsum = lax.psum(lsum, dpn)
             cnt = lax.psum(cnt, dpn)
-        return lsum / jnp.maximum(cnt, 1)
+        loss = lsum / jnp.maximum(cnt, 1)
+        if moe:
+            # serial-equivalent normalization: micro-batched serial loss is
+            # mean over M of (ce_m + w * mean_l aux_{l,m}); aux_total sums
+            # every (layer, micro-batch) application -> divide by L*M
+            aux_mean = aux_total / (L * M)
+            if dpn is not None:
+                aux_mean = lax.pmean(aux_mean, dpn)
+            loss = loss + cfg.moe_aux_weight * aux_mean
+        return loss
 
     def pp_loss(params, ids_m, labels_m):
         layers = params["layers"]
         in_layer_spec = tree.tree_map(lambda p: P(None, pp_axis), layers)
         bspec = P(None, dpn, None) if dpn else P(None, None, None)
         head = None if cfg.tie_word_embeddings else params["lm_head"]
+        extra = {}
+        if ep is not None:
+            # manual axes = the ring + dp; `ep` stays auto so GSPMD shards
+            # the expert einsums inside the manual region
+            extra["axis_names"] = frozenset(
+                {pp_axis} | ({dpn} if dpn else set()))
         shmap = shard_map(
             body, mesh=mesh,
             in_specs=(P(pp_axis, None), in_layer_spec, P(None),
                       (P(None, pp_axis) if head is not None else P()),
                       bspec, bspec),
-            out_specs=P(), check_vma=False)
+            out_specs=P(), check_vma=False, **extra)
         if head is None:
             head = jnp.zeros((), cfg.param_dtype)  # placeholder (unused)
         return shmap(params["embed"], layers, params["ln_f"], head,

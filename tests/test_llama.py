@@ -362,10 +362,85 @@ class TestLlamaMoE:
         for k in ("w_gate", "w_up", "w_down"):      # sharding survives
             assert "ep" in str(p2["layers"][k].sharding.spec), k
 
-    def test_pp_rejects_moe(self):
-        from paddle_tpu.distributed.topology import build_mesh
+    def test_pp_moe_parity_vs_serial(self):
+        """MoE x pipeline (pp x ep submesh): the compiled ring schedule with
+        GShard experts inside (ep as a GSPMD auto axis, aux loss threaded
+        through the schedule with bubble masking) matches a serial
+        micro-batched oracle — loss AND the AdamW update (r3 VERDICT #5;
+        ref: the reference's large-MoE pp+ep configs)."""
+        from jax.sharding import Mesh, NamedSharding
         from paddle_tpu.models import llama
-        mesh = build_mesh({"dp": 2, "pp": 4}, jax.devices()[:8])
-        cfg = self._cfg(num_hidden_layers=4, vocab_size=128)
-        with pytest.raises(NotImplementedError, match="aux"):
-            llama.make_pp_train_step(cfg, mesh, micro_batches=4)
+        from paddle_tpu.models.llama import _adamw_apply, _adamw_init
+
+        cfg = self._cfg(num_hidden_layers=4, vocab_size=128,
+                        moe_num_experts=4, moe_top_k=2, ep_axis="ep")
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        B, S, MB = 4, 16, 2
+        ids = jnp.asarray(rng.integers(0, 128, (B, S)), jnp.int32)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "ep"))
+        ppp = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            llama.to_pp_layout(params, 2),
+            llama.pp_param_specs(cfg, "pp", "ep"))
+        init_opt, step = llama.make_pp_train_step(
+            cfg, mesh, micro_batches=MB, dp_axis=None, lr=1e-2)
+        p1, _, loss_pp = jax.jit(step)(ppp, init_opt(ppp), ids, ids)
+
+        def serial_loss(params):
+            tot_l, tot_c, auxes = 0.0, 0, []
+            for m in range(MB):
+                i_m = ids[m * (B // MB):(m + 1) * (B // MB)]
+                logits, aux = llama.forward(params, i_m, cfg,
+                                            return_aux=True)
+                logits = logits.astype(jnp.float32)
+                lse = jax.nn.logsumexp(logits, -1)
+                tgt = jnp.take_along_axis(
+                    logits, i_m[..., None], -1)[..., 0]
+                tot_l = tot_l + (lse - tgt).sum()
+                tot_c = tot_c + i_m.size
+                auxes.append(aux)
+            return (tot_l / tot_c
+                    + cfg.moe_aux_weight * jnp.mean(jnp.asarray(auxes)))
+
+        loss_s, g_s = jax.value_and_grad(serial_loss)(params)
+        assert abs(float(loss_s) - float(loss_pp)) < 2e-5
+        p_s, _ = _adamw_apply(params, g_s, _adamw_init(params), lr=1e-2,
+                              beta1=0.9, beta2=0.95, eps=1e-8,
+                              weight_decay=0.0, opt_dtype=jnp.float32)
+        # Adam's rsqrt amplifies float-reassociation noise in the grads
+        # (~1e-7) into ~1e-4 param deltas at lr=1e-2; a real routing/aux bug
+        # shows up at 1e-2+ (verified by perturbing the aux weight)
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()),
+            llama.from_pp_layout(jax.device_get(p1)), p_s)
+        assert max(jax.tree_util.tree_leaves(diffs)) < 1e-3
+
+    def test_pp_moe_hybrid_dp_pp_ep_trains(self):
+        """dp x pp(interleaved V=2) x ep MoE: loss decreases over steps and
+        expert weights stay ep-sharded (dryrun family F shape)."""
+        from jax.sharding import Mesh, NamedSharding
+        from paddle_tpu.models import llama
+
+        cfg = self._cfg(num_hidden_layers=4, vocab_size=128,
+                        moe_num_experts=4, moe_top_k=2, ep_axis="ep")
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("dp", "pp", "ep"))
+        ppp = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            llama.to_pp_layout(params, 2, circular_repeats=2),
+            llama.pp_param_specs(cfg, "pp", "ep"))
+        init_opt, step = llama.make_pp_train_step(
+            cfg, mesh, micro_batches=4, dp_axis="dp", circular_repeats=2,
+            lr=1e-2)
+        rng = np.random.default_rng(1)
+        ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        p, o, loss = jstep(ppp, init_opt(ppp), ids, ids)
+        l0 = float(loss)
+        for _ in range(4):
+            p, o, loss = jstep(p, o, ids, ids)
+        assert float(loss) < l0
+        for k in ("w_gate", "w_up", "w_down"):
+            assert "ep" in str(p["layers"][k].sharding.spec), k
