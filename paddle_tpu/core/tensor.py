@@ -41,6 +41,11 @@ class _TraceHook:
 
 _trace_hook = _TraceHook
 
+# SOT materialization-event hook (jit/sot.py): when set, tensor->Python
+# conversions (__bool__/__int__/__float__/__index__/item) route through it —
+# the graph-break points of the bytecode tier. None = zero-overhead off.
+_materialize_hook = None
+
 
 class Tensor:
     __slots__ = ("_raw", "stop_gradient", "grad", "name", "persistable",
@@ -128,6 +133,8 @@ class Tensor:
     __array__ = numpy
 
     def item(self):
+        if _materialize_hook is not None:
+            return _materialize_hook("item", self)
         return self._value.item()
 
     def tolist(self):
@@ -319,15 +326,23 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        if _materialize_hook is not None:
+            return _materialize_hook("bool", self)
         return bool(self._value)
 
     def __int__(self):
+        if _materialize_hook is not None:
+            return _materialize_hook("int", self)
         return int(self._value)
 
     def __float__(self):
+        if _materialize_hook is not None:
+            return _materialize_hook("float", self)
         return float(self._value)
 
     def __index__(self):
+        if _materialize_hook is not None:
+            return _materialize_hook("int", self)
         return int(self._value)
 
     def __format__(self, spec):

