@@ -32,6 +32,26 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def _load_slowlist():
+    path = os.path.join(os.path.dirname(__file__), "slowlist.txt")
+    try:
+        with open(path) as f:
+            return {ln.strip() for ln in f
+                    if ln.strip() and not ln.startswith("#")}
+    except OSError:
+        return set()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark measured-slow tests (tests/slowlist.txt) so the default run
+    (pytest.ini addopts = -m "not slow") is a fast green signal; explicit
+    @pytest.mark.slow still works for new tests (SURVEY §4 CI discipline)."""
+    slow = _load_slowlist()
+    for item in items:
+        if item.nodeid in slow:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     import paddle_tpu as paddle

@@ -362,6 +362,7 @@ class TestLlamaMoE:
         for k in ("w_gate", "w_up", "w_down"):      # sharding survives
             assert "ep" in str(p2["layers"][k].sharding.spec), k
 
+    @pytest.mark.slow
     def test_pp_moe_parity_vs_serial(self):
         """MoE x pipeline (pp x ep submesh): the compiled ring schedule with
         GShard experts inside (ep as a GSPMD auto axis, aux loss threaded
@@ -416,6 +417,7 @@ class TestLlamaMoE:
             llama.from_pp_layout(jax.device_get(p1)), p_s)
         assert max(jax.tree_util.tree_leaves(diffs)) < 1e-3
 
+    @pytest.mark.slow
     def test_pp_moe_hybrid_dp_pp_ep_trains(self):
         """dp x pp(interleaved V=2) x ep MoE: loss decreases over steps and
         expert weights stay ep-sharded (dryrun family F shape)."""
