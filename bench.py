@@ -137,7 +137,11 @@ def _loop_timed(grad_fn, q, k, v, iters):
             # scalar would silently time an f32 kernel)
             qq = q + (carry * 1e-24).astype(q.dtype)
             g = grad_fn(qq, k, v)
-            return g[0].ravel()[0].astype(jnp.float32)
+            gs = g if isinstance(g, (tuple, list)) else (g,)
+            # consume one element of EVERY grad: a dead grad output gets
+            # DCE'd by XLA and its backward matmuls silently vanish from
+            # the measurement (weight grads are half the bwd FLOPs)
+            return sum(gg.ravel()[0].astype(jnp.float32) for gg in gs)
         return lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
     f = jax.jit(run)
@@ -299,6 +303,181 @@ def bench_sdxl_attention(steps=10):
     return out
 
 
+def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
+    """The memory-tuned LLaMA-ratio point (secondary; the headline keeps the
+    reference-parity numerics): remat_policy="save_flash" (flash residuals +
+    qkv saved — backward never re-runs the fwd attention kernel or the qkv
+    matmuls), token-chunked CE, bf16 Adam-moment STORAGE and bf16 grad
+    STORAGE (fp32 moment arithmetic; the weight grads are produced by bf16
+    backward matmuls anyway). Each trade is a storage-precision knob, and
+    they buy the HBM headroom the faster remat schedule needs. Measured
+    r4: 56.4% vs the honest default's 52.9%."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama
+
+    cfg, b, s = _presets(backend, wide=False)
+    batch, seq = batch or b, seq or s
+    if backend == "tpu":
+        cfg = dataclasses.replace(cfg, remat_policy="save_flash",
+                                  ce_chunks=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, step_fn = llama.make_train_step(
+        cfg, lr=1e-4, opt_dtype=jnp.bfloat16, grad_dtype=jnp.bfloat16)
+    opt = init_opt(params)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    params, opt, loss = jstep(params, opt, ids, ids)
+    float(loss)
+    for _ in range(2):
+        params, opt, loss = jstep(params, opt, ids, ids)
+    float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt, loss = jstep(params, opt, ids, ids)
+    final = float(loss)
+    per_step = (time.time() - t0) / steps
+    assert np.isfinite(final)
+    flops = _train_flops_per_step(cfg, batch, seq)
+    return 100.0 * flops / per_step / 1e12 / peak, per_step
+
+
+def bench_roofline(backend, steps=10):
+    """Phase-isolated timing of the HEADLINE config's train step (r3 VERDICT
+    #3): each term measured as its own in-graph loop (same _loop_timed
+    protocol), so the decomposition can be compared against the observed
+    step time and the MFU gap attributed. Emits one JSON object to stderr;
+    numbers land in BASELINE.md's roofline table."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.models import llama
+
+    cfg, B, S = _presets(backend, wide=False)
+    E, I, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    H, D = cfg.num_attention_heads, cfg.head_dim
+    T = B * S
+    k = jax.random.PRNGKey(0)
+    out = {}
+
+    def timed(name, grad_fn, *arrs, iters=None):
+        it = iters or max(steps, 10)
+        per = _loop_timed(grad_fn, *arrs, iters=it)
+        out[name + "_ms"] = round(per * 1e3, 3)
+        return per
+
+    def g3(f):
+        # loss = |out|^2, NOT sum(out): a linear functional lets XLA's
+        # algebraic simplifier collapse trailing matmuls to matvecs (sum(A@B)
+        # = A @ (B@1)) — measured 227 "TF/s" (> peak) before this fix
+        def loss(a, b, c):
+            o = f(a, b, c).astype(jnp.float32)
+            return jnp.vdot(o, o)
+        return jax.grad(loss, argnums=(0, 1, 2))
+
+    # ---- attention (flash kernel, causal), fwd+bwd, ONE layer -------------
+    q = jax.random.normal(k, (B, S, H, D), jnp.bfloat16)
+    timed("attn_layer", g3(lambda q, kk, v: flash_attention(
+        q, kk, v, causal=True)), q, q, q)
+
+    # ---- FFN (SwiGLU), fwd+bwd, ONE layer ---------------------------------
+    h = jax.random.normal(k, (T, E), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.fold_in(k, 1), (E, 2 * I),
+                           jnp.bfloat16)           # gate+up fused [E, 2I]
+    wd = jax.random.normal(jax.random.fold_in(k, 2), (I, E), jnp.bfloat16)
+
+    def ffn(h, wg, wd):
+        gu = h @ wg                                # one [E,2I] matmul
+        gate = jax.nn.silu(gu[:, :I]) * gu[:, I:]
+        return gate @ wd
+    timed("ffn_layer", g3(ffn), h, wg, wd)
+
+    # ---- QKV+O projections, fwd+bwd, ONE layer ----------------------------
+    wqkv = jax.random.normal(jax.random.fold_in(k, 3), (E, 3 * E),
+                             jnp.bfloat16)
+    wo = jax.random.normal(jax.random.fold_in(k, 4), (E, E), jnp.bfloat16)
+
+    def qkvo(h, wqkv, wo):
+        y = h @ wqkv
+        return (y[:, :E] + y[:, E:2 * E] + y[:, 2 * E:]) @ wo
+    timed("qkvo_layer", g3(qkvo), h, wqkv, wo)
+
+    # ---- fwd-only flavors (= the remat recompute cost per layer) ----------
+    def fwd_loop(f, *arrs):
+        def run(*a):
+            def body(i, carry):
+                a0 = a[0] + (carry * 1e-24).astype(a[0].dtype)
+                r = f(a0, *a[1:]).astype(jnp.float32)
+                return jnp.vdot(r, r)   # consume the FULL output (no DCE)
+            return lax.fori_loop(0, max(steps, 10), body, jnp.float32(0.0))
+        fjit = jax.jit(run)
+        float(fjit(*arrs))
+        t0 = time.time()
+        float(fjit(*arrs))
+        return (time.time() - t0) / max(steps, 10)
+
+    out["attn_layer_fwd_ms"] = round(fwd_loop(
+        lambda q, kk, v: flash_attention(q, kk, v, causal=True),
+        q, q, q) * 1e3, 3)
+    out["ffn_layer_fwd_ms"] = round(fwd_loop(ffn, h, wg, wd) * 1e3, 3)
+    out["qkvo_layer_fwd_ms"] = round(fwd_loop(qkvo, h, wqkv, wo) * 1e3, 3)
+
+    # ---- embedding + LM head + CE, fwd+bwd --------------------------------
+    emb = jax.random.normal(k, (V, E), jnp.float32)
+    ids = jax.random.randint(k, (B, S), 0, V)
+
+    def embed_ce(emb, hd, _):
+        x = jnp.take(emb, ids, axis=0).astype(jnp.bfloat16)
+        logits = (x @ hd.astype(jnp.bfloat16)).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, ids[..., None], axis=-1)[..., 0]
+        return (lse - tgt).mean()[None]
+    hd = jax.random.normal(k, (E, V), jnp.float32)
+    timed("embed_ce", g3(embed_ce), emb, hd, emb)
+
+    # ---- optimizer (AdamW fp32, donated state) ----------------------------
+    params = llama.init_params(cfg, k)
+    from paddle_tpu.models.llama import _adamw_apply, _adamw_init
+    opt0 = _adamw_init(params)
+    grads = jax.device_put(jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 1e-6, p.dtype), params))
+
+    def adam_step(params, opt, grads):   # grads as an ARG (a captured-const
+        # closure embeds 2.95GB into the executable and skews the timing)
+        return _adamw_apply(params, grads, opt, lr=1e-4, beta1=0.9,
+                            beta2=0.95, eps=1e-8, weight_decay=0.0,
+                            opt_dtype=jnp.float32)
+    jadam = jax.jit(adam_step, donate_argnums=(0, 1))
+    p, o = jadam(params, opt0, grads)
+    jax.block_until_ready(p)
+    t0 = time.time()
+    for _ in range(max(steps, 10)):
+        p, o = jadam(p, o, grads)
+    float(p["ln_f"][0])
+    out["adam_full_ms"] = round(
+        (time.time() - t0) / max(steps, 10) * 1e3, 3)
+
+    # ---- model: account -----------------------------------------------
+    acct = {
+        "attn_bwd_x_L": out["attn_layer_ms"] * L,
+        "ffn_bwd_x_L": out["ffn_layer_ms"] * L,
+        "qkvo_bwd_x_L": out["qkvo_layer_ms"] * L,
+        "remat_recompute_x_L": (out["attn_layer_fwd_ms"]
+                                + out["ffn_layer_fwd_ms"]
+                                + out["qkvo_layer_fwd_ms"]) * L,
+        "embed_ce": out["embed_ce_ms"],
+        "adam": out["adam_full_ms"],
+    }
+    acct["sum_ms"] = round(sum(acct.values()), 1)
+    out["account"] = {kk: round(vv, 1) for kk, vv in acct.items()}
+    return out
+
+
 def bench_decode(backend, prompt=128, new_tokens=128, batches=(1, 8)):
     """KV-cache decode throughput on the flagship config (BASELINE.md decode
     row): prefill + the whole greedy decode loop is ONE compiled program
@@ -390,7 +569,8 @@ def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
 
 def main():
     ap = argparse.ArgumentParser()
-    _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode")
+    _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode",
+                 "tuned", "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
@@ -446,9 +626,9 @@ def main():
     except OSError:
         _warm = False
     _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
-                  "sdxl": 25.0, "decode": 45.0} if _warm else
+                  "sdxl": 25.0, "decode": 45.0, "tuned": 35.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
-                  "sdxl": 45.0, "decode": 90.0})
+                  "sdxl": 45.0, "decode": 90.0, "tuned": 60.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -538,6 +718,18 @@ def main():
             _emit("sdxl_attn_64x64", v, "ms",
                   _R2_ANCHORS["sdxl_attn_64x64"] / v)  # lower is better
         section("sdxl", _sdxl)
+    if "roofline" in chosen:   # explicit-only: a diagnostic, not a metric
+        def _roof():
+            r = bench_roofline(backend, steps=args.steps)
+            print(json.dumps(r), file=sys.stderr)
+        section("roofline", _roof, budget_exempt=True)
+    if want("tuned"):
+        def _tuned():
+            m, st = bench_tuned(backend, peak, steps=args.steps)
+            print(json.dumps({"tuned_step_s": round(st, 4),
+                              "tuned_mfu": round(m, 2)}), file=sys.stderr)
+            _emit("llama_train_mfu_tuned", round(m, 2), "%", m / 50.0)
+        section("tuned", _tuned)
     if want("decode"):
         def _decode():
             d = bench_decode(backend)

@@ -406,6 +406,14 @@ def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
     out, lse = _fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k)
+    # checkpoint-policy names: save_only_these_names("flash_out","flash_lse")
+    # keeps the kernel's residuals across remat so backward never re-runs
+    # the fwd kernel (the dominant recompute term in the full-remat LLaMA
+    # step — see BASELINE.md roofline); memory cost is o (bf16) + lse (f32
+    # [B,H,S]) per layer, far below the "dots" policies' [B,S,I] saves
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (out, lse), (q, k, v, seg_q, seg_k, out, lse)
 
 

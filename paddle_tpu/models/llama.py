@@ -76,6 +76,11 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     ep_axis: Optional[str] = None    # expert-parallel mesh axis (e.g. "ep")
+    ce_chunks: int = 1               # >1: token-chunked cross-entropy — the
+    # fp32 [T, V] logits (2.1GB at the bench config) never materialize;
+    # each chunk's logits are recomputed in backward (jax.checkpoint), which
+    # frees the HBM that lets remat_policy="save_flash" fit at fp32 Adam
+    # (measured roofline, BASELINE.md)
 
     @property
     def head_dim(self) -> int:
@@ -213,11 +218,25 @@ def _remat_policy(name: Optional[str]):
         "dots_saveable": adc.checkpoint_policies.dots_saveable,
         # save the attention block's outputs ([B,S,E]-sized — cheap in HBM)
         # so backward never re-runs the flash kernel forward; the FFN (whose
-        # [B,S,I] intermediates dominate activation memory) still remats
+        # [B,S,I] intermediates dominate activation memory) still remats.
+        # NOTE (measured, v5e): "attn_out" alone does NOT stop the flash
+        # fwd re-run — the kernel's bwd needs its lse residual too, which
+        # only "save_flash" keeps (names emitted inside the kernel's vjp).
         "save_attn": adc.checkpoint_policies.save_only_these_names(
             "attn_out"),
         "save_qkv_attn": adc.checkpoint_policies.save_only_these_names(
-            "attn_out", "qkv"),
+            "attn_out", "qk", "v_proj"),
+        # the winning family on the headline config: save the flash kernel's
+        # (out, lse) residuals + post-rope q/k (+v), so backward feeds the
+        # bwd kernels directly and recompute covers only norms + matmuls
+        "save_flash": adc.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "qk", "v_proj"),
+        # v is ONE cheap matmul to recompute but 0.77GB to keep (12 layers,
+        # bench shapes) — dropping it is what fits fp32-Adam in HBM
+        "save_flash_qk": adc.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "qk"),
+        "save_flash_only": adc.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"),
     }
     if name not in policies:
         raise ValueError(f"unknown remat_policy {name!r}; "
@@ -350,9 +369,9 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
     q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, D)
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, Hk, D)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, Hk, D)
-    q = checkpoint_name(_rope(q, cos, sin, cfg.use_fused_norm), "qkv")
-    k = checkpoint_name(_rope(k, cos, sin, cfg.use_fused_norm), "qkv")
-    v = checkpoint_name(v, "qkv")
+    q = checkpoint_name(_rope(q, cos, sin, cfg.use_fused_norm), "qk")
+    k = checkpoint_name(_rope(k, cos, sin, cfg.use_fused_norm), "qk")
+    v = checkpoint_name(v, "v_proj")
     o = _attention(q, k, v, cfg, segment_ids).reshape(B, S, H * D)
     o = checkpoint_name(o, "attn_out")
     x = x + o @ lp["wo"].astype(dt)
@@ -366,7 +385,8 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
 
 
 def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
-            position_ids=None, return_aux: bool = False):
+            position_ids=None, return_aux: bool = False,
+            return_hidden: bool = False):
     """``input_ids [B, S] -> logits [B, S, V]`` (single trace via lax.scan).
 
     Packed-sequence (varlen) training: ``segment_ids [B, S]`` confines
@@ -408,6 +428,8 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
 
     x, auxes = lax.scan(scan_body, x, params["layers"])
     x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    if return_hidden:   # chunked-CE path computes the head itself
+        return x
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
     logits = x @ head.astype(cfg.dtype)
@@ -420,7 +442,43 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
 def loss_fn(params: Dict, input_ids, labels, cfg: LlamaConfig,
             segment_ids=None, position_ids=None):
     """Mean next-token cross-entropy (labels already shifted; -100 ignored).
-    MoE configs add ``cfg.moe_aux_weight *`` the load-balancing loss."""
+    MoE configs add ``cfg.moe_aux_weight *`` the load-balancing loss.
+
+    ``cfg.ce_chunks > 1`` computes the CE blockwise over token chunks (a
+    lax.scan with per-chunk checkpoint): the full fp32 ``[T, V]`` logits and
+    their cotangent never live in HBM at once — the memory headroom this
+    frees is what lets ``remat_policy="save_flash"`` fit the bench config
+    with fp32 Adam moments (see BASELINE.md roofline)."""
+    if cfg.ce_chunks > 1 and not cfg.moe_num_experts:
+        hidden = forward(params, input_ids, cfg, segment_ids, position_ids,
+                         return_hidden=True)
+        head = (params["embed"].T if cfg.tie_word_embeddings
+                else params["lm_head"])
+        B, S, E = hidden.shape
+        T = B * S
+        C = cfg.ce_chunks
+        if T % C:
+            raise ValueError(f"tokens {T} not divisible by ce_chunks {C}")
+        h2 = hidden.reshape(C, T // C, E)
+        lbl = labels.reshape(C, T // C)
+
+        @jax.checkpoint
+        def chunk(hc, lc):
+            logits = (hc @ head.astype(cfg.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(
+                logits, jnp.maximum(lc, 0)[..., None], axis=-1)[..., 0]
+            m = lc >= 0
+            return (jnp.where(m, lse - tgt, 0.0).sum(),
+                    m.sum())
+
+        def body(carry, xs):
+            s, n = chunk(*xs)
+            return (carry[0] + s, carry[1] + n), None
+
+        (tot, cnt), _ = lax.scan(body, (jnp.float32(0.0), jnp.int32(0)),
+                                 (h2, lbl))
+        return tot / jnp.maximum(cnt, 1)
     logits, aux = forward(params, input_ids, cfg, segment_ids,
                           position_ids, return_aux=True)
     logits = logits.astype(jnp.float32)
@@ -480,7 +538,8 @@ def _adamw_apply(params, grads, opt_state, *, lr, beta1, beta2, eps,
 
 
 def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
-                    eps=1e-8, weight_decay=0.0, opt_dtype=jnp.float32):
+                    eps=1e-8, weight_decay=0.0, opt_dtype=jnp.float32,
+                    grad_dtype=None):
     """Returns ``(init_opt_state, train_step)`` pure functions.
 
     ``train_step(params, opt_state, input_ids, labels) ->
@@ -488,6 +547,12 @@ def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
     (the reference's multi_precision optimizer path); ``opt_dtype`` sets the
     m/v STORAGE dtype (bf16 halves optimizer HBM for memory-bound configs —
     a documented quality trade, not the default).
+
+    ``grad_dtype=bf16`` stores the grad TREE bf16: the weight grads are
+    already produced by bf16-activation backward matmuls and only cast up
+    at the boundary, so this adds a single extra rounding while XLA fuses
+    the downcast into the producers — the fp32 grad tree (2.95GB at the
+    bench config) never materializes. Moment arithmetic stays fp32.
     """
 
     def init_opt_state(params):
@@ -495,6 +560,9 @@ def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
 
     def train_step(params, opt_state, input_ids, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, cfg)
+        if grad_dtype is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(grad_dtype), grads)
         params, opt_state = _adamw_apply(
             params, grads, opt_state, lr=lr, beta1=beta1, beta2=beta2,
             eps=eps, weight_decay=weight_decay, opt_dtype=opt_dtype)

@@ -446,3 +446,61 @@ class TestLlamaMoE:
         assert float(loss) < l0
         for k in ("w_gate", "w_up", "w_down"):
             assert "ep" in str(p["layers"][k].sharding.spec), k
+
+
+class TestMfuKnobs:
+    """Round-4 MFU levers (BASELINE.md roofline): numerics stay exact."""
+
+    def test_chunked_ce_matches_dense(self):
+        import dataclasses
+        cfg = tiny_cfg()
+        cfgc = dataclasses.replace(cfg, ce_chunks=4)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        lbl = ids.at[0, :3].set(-100)   # ignore_index through the chunks
+        l1, g1 = jax.value_and_grad(llama.loss_fn)(params, ids, lbl, cfg)
+        l2, g2 = jax.value_and_grad(llama.loss_fn)(params, ids, lbl, cfgc)
+        np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5), g1, g2)
+
+    def test_chunked_ce_indivisible_raises(self):
+        import dataclasses
+        cfg = dataclasses.replace(tiny_cfg(), ce_chunks=7)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.zeros((2, 16), jnp.int32)
+        with pytest.raises(ValueError, match="ce_chunks"):
+            llama.loss_fn(params, ids, ids, cfg)
+
+    def test_grad_dtype_bf16_trains(self):
+        cfg = tiny_cfg()
+        params = llama.init_params(cfg, jax.random.PRNGKey(2))
+        init_opt, step = llama.make_train_step(cfg, lr=1e-2,
+                                               grad_dtype=jnp.bfloat16)
+        opt = init_opt(params)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+        jstep = jax.jit(step)
+        losses = []
+        for _ in range(8):
+            params, opt, loss = jstep(params, opt, ids, ids)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_save_flash_remat_policy_parity(self):
+        """save_flash/save_flash_qk remat: gradients match full remat."""
+        import dataclasses
+        cfg = tiny_cfg(use_kernels=True)      # interpret-mode kernels on CPU
+        params = llama.init_params(cfg, jax.random.PRNGKey(4))
+        ids = jnp.arange(16).reshape(1, 16) % cfg.vocab_size
+        g_ref = jax.grad(llama.loss_fn)(
+            params, ids, ids, dataclasses.replace(cfg, remat=True))
+        for pol in ("save_flash", "save_flash_qk", "save_flash_only"):
+            g = jax.grad(llama.loss_fn)(
+                params, ids, ids,
+                dataclasses.replace(cfg, remat=True, remat_policy=pol))
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-5), g_ref, g)
