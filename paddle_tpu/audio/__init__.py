@@ -11,3 +11,40 @@ from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
+
+
+def spectrogram(x, n_fft: int = 512, hop_length=None, win_length=None,
+                window: str = "hann", power: float = 2.0, center: bool = True,
+                pad_mode: str = "reflect"):
+    """Functional spectrogram (wraps features.Spectrogram; ref:
+    paddle.audio.features)."""
+    from .features import Spectrogram
+    return Spectrogram(n_fft=n_fft, hop_length=hop_length,
+                       win_length=win_length, window=window, power=power,
+                       center=center, pad_mode=pad_mode)(x)
+
+
+def melspectrogram(x, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                   n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                   **kw):
+    """Functional mel spectrogram (wraps features.MelSpectrogram)."""
+    from .features import MelSpectrogram
+    return MelSpectrogram(sr=sr, n_fft=n_fft, hop_length=hop_length,
+                          n_mels=n_mels, f_min=f_min, f_max=f_max, **kw)(x)
+
+
+def mfcc(x, sr: int = 22050, n_mfcc: int = 40, **kw):
+    """Functional MFCC (wraps features.MFCC)."""
+    from .features import MFCC
+    return MFCC(sr=sr, n_mfcc=n_mfcc, **kw)(x)
+
+
+def _register_feature_ops():
+    from ..core.dispatch import register_op
+    for _n, _f in (("spectrogram", spectrogram),
+                   ("melspectrogram", melspectrogram), ("mfcc", mfcc)):
+        register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                    differentiable=False, category="audio", public=_f)
+
+
+_register_feature_ops()

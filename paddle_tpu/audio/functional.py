@@ -176,3 +176,17 @@ def stft_magnitude(x, n_fft: int = 512, hop_length: Optional[int] = None,
         mag = jnp.abs(spec) ** power
         return jnp.swapaxes(mag, -1, -2)             # [..., bins, frames]
     return forward_op("stft_magnitude", f, [ensure_tensor(x)])
+
+
+# -- schema registration (r4 breadth; ops.yaml-equivalent bookkeeping) ------
+def _register_audio_ops():
+    from ..core.dispatch import OP_REGISTRY, register_op
+    for _n in __all__:
+        _f = globals().get(_n)
+        if callable(_f) and _n not in OP_REGISTRY:  # ops/windows owns
+            # get_window; don't shadow it with the audio re-export
+            register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        differentiable=False, category="audio", public=_f)
+
+
+_register_audio_ops()

@@ -37,21 +37,35 @@ class OpDef:
     ``category`` drives the auto-generated OpTest sweep
     (tests/test_op_sweep.py): "unary"/"binary" elementwise ops get numpy-
     oracle + finite-difference-gradient + dtype coverage synthesized from
-    the schema alone (SURVEY §4's per-op OpTest lesson)."""
+    the schema alone (SURVEY §4's per-op OpTest lesson).
+
+    ``oracle``/``sweep`` extend the sweep to COMPOSITE ops (r3 VERDICT #6):
+    ``sweep`` is a callable ``(rng) -> [(args, kwargs), ...]`` producing
+    public-API example calls; ``oracle`` is the numpy reference
+    ``(*np_args, **kwargs) -> np result`` checked against each call. Specs
+    live in ``ops/sweep_specs.py`` (attached to the registry post-import so
+    op modules stay lean); coverage is reported in docs/OPS.md."""
     name: str
     fn: Callable
     doc: str = ""
     n_outputs: int = 1
     differentiable: bool = True
     category: str = ""
+    oracle: Optional[Callable] = None
+    sweep: Optional[Callable] = None
+    public: Optional[Callable] = None   # public wrapper (sweep entry point)
 
 
 OP_REGISTRY: Dict[str, OpDef] = {}
 
 
 def register_op(name: str, fn: Callable, doc: str = "", n_outputs: int = 1,
-                differentiable: bool = True, category: str = "") -> OpDef:
-    d = OpDef(name, fn, doc, n_outputs, differentiable, category)
+                differentiable: bool = True, category: str = "",
+                oracle: Optional[Callable] = None,
+                sweep: Optional[Callable] = None,
+                public: Optional[Callable] = None) -> OpDef:
+    d = OpDef(name, fn, doc, n_outputs, differentiable, category,
+              oracle, sweep, public)
     OP_REGISTRY[name] = d
     return d
 

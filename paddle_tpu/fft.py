@@ -92,3 +92,15 @@ def ifftshift(x, axes=None, name=None):
     return forward_op("ifftshift",
                       lambda v: jnp.fft.ifftshift(v, axes=axes_arg(axes)),
                       [ensure_tensor(x)])
+
+
+# -- schema registration (ops.yaml-equivalent bookkeeping; r4 breadth) ------
+from .core.dispatch import register_op as _reg_op  # noqa: E402
+
+for _n in ("fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"):
+    _f = globals().get(_n)
+    if _f is not None:
+        _reg_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                category="fft", public=_f)

@@ -136,3 +136,16 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     t = t + ensure_tensor(residual)
     return F.layer_norm(t, t.shape[-1:], weight=ln_scale, bias=ln_bias,
                         epsilon=ln_epsilon)
+
+
+# -- schema registration (r4: fused names join docs/OPS.md) ------------------
+def _register_fused():
+    from ...core.dispatch import register_op
+    for _n in __all__:
+        _f = globals().get(_n)
+        if callable(_f):
+            register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        category="fused", public=_f)
+
+
+_register_fused()

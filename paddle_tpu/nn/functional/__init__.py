@@ -8,11 +8,13 @@ from .activation import (celu, elu, gelu, glu, gumbel_softmax, hardshrink,
 from .attention import (attention_probs, flash_attention,
                         scaled_dot_product_attention, sequence_mask)
 from .common import (alpha_dropout, channel_shuffle, cosine_similarity, dropout,
+                     pairwise_distance, softmax2d,
                      dropout2d, dropout3d, embedding, interpolate, label_smooth,
                      linear, normalize, one_hot, pad, pixel_shuffle, pixel_unshuffle,
                      unfold, upsample, zeropad2d)
 from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
-                   conv3d_transpose)
+                   conv3d_transpose, conv_transpose1d, conv_transpose2d,
+                   conv_transpose3d)
 from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,
                    cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
                    gaussian_nll_loss, hinge_embedding_loss, huber_loss, kl_div,
@@ -27,7 +29,8 @@ from .vision import (affine_grid, bilinear, feature_alpha_dropout, fold,
                      grid_sample, temporal_shift)
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
-                      avg_pool1d, avg_pool2d, avg_pool3d, lp_pool2d, max_pool1d,
+                      avg_pool1d, avg_pool2d, avg_pool3d, fractional_max_pool2d,
+                      fractional_max_pool3d, lp_pool1d, lp_pool2d, max_pool1d,
                       max_pool2d, max_pool3d)
 
 # Register the functional surface in the op schema registry: upstream these
@@ -42,7 +45,8 @@ def _register_functional():
             continue
         if not callable(_v) or _k in OP_REGISTRY:
             continue
-        register_op(_k, _v, doc=(_v.__doc__ or "").strip().split("\n")[0])
+        register_op(_k, _v, doc=(_v.__doc__ or "").strip().split("\n")[0],
+                    public=_v)
 
 
 _register_functional()

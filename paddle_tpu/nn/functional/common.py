@@ -297,3 +297,26 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
         return (1 - epsilon) * v + epsilon * prior_dist._value
 
     return forward_op("label_smooth", impl, [label])
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None):
+    """p-norm distance between corresponding rows (ref:
+    nn.functional.pairwise_distance / nn.PairwiseDistance)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return forward_op("pairwise_distance", impl, [x, y])
+
+
+def softmax2d(x, name=None):
+    """Channel-wise softmax over NCHW inputs (ref: nn.Softmax2D)."""
+    x = ensure_tensor(x)
+    if x.ndim not in (3, 4):
+        raise ValueError(f"softmax2d expects CHW or NCHW input, got rank "
+                         f"{x.ndim}")
+    import jax.nn as _jnn
+    return forward_op("softmax2d",
+                      lambda v: _jnn.softmax(v, axis=-3), [x])

@@ -153,3 +153,9 @@ def _conv_transpose(rank: int):
 conv1d_transpose = _conv_transpose(1)
 conv2d_transpose = _conv_transpose(2)
 conv3d_transpose = _conv_transpose(3)
+
+
+# torch-style aliases (the reference ecosystem accepts both spellings)
+conv_transpose1d = conv1d_transpose
+conv_transpose2d = conv2d_transpose
+conv_transpose3d = conv3d_transpose

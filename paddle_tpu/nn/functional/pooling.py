@@ -179,3 +179,114 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
         return summed ** (1.0 / p)
 
     return forward_op("lp_pool2d", impl, [x])
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Power-average pooling over 1-D windows (ref: nn.functional.lp_pool1d)."""
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    channels_last = data_format == "NLC"
+    in_spatial = x.shape[1:-1] if channels_last else x.shape[2:]
+    dims, strides, pads, k, s, _ = _window(1, kernel_size, stride, padding,
+                                           channels_last, ceil_mode,
+                                           in_spatial)
+
+    def impl(v):
+        powed = jnp.abs(v) ** p
+        summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, dims,
+                                       strides, pads)
+        return summed ** (1.0 / p)
+
+    return forward_op("lp_pool1d", impl, [x])
+
+
+def _fractional_pool(nd):
+    def op(x, output_size, kernel_size=None, random_u=None, return_mask=False,
+           name=None):
+        """Fractional max pooling (Graham 2014; ATen interval formula —
+        start_i = floor((i+u)*alpha) - floor(u*alpha), alpha =
+        (in-k)/(out-1), fixed k-window, last window right-aligned; ref:
+        nn.functional.fractional_max_pool2d/3d). Deterministic given
+        ``random_u``; default draws from the framework RNG."""
+        x = ensure_tensor(x)
+        spatial = x.shape[2:]
+        if isinstance(output_size, int):
+            out_sz = (output_size,) * nd
+        else:
+            out_sz = tuple(int(o) for o in output_size)
+        if kernel_size is None:
+            ks = tuple(spatial[d] // out_sz[d] for d in range(nd))
+        elif isinstance(kernel_size, int):
+            ks = (kernel_size,) * nd
+        else:
+            ks = tuple(int(k) for k in kernel_size)
+        if random_u is None:
+            from ...ops import random as _rnd
+            import numpy as _np
+            u = float(_np.asarray(
+                _rnd.uniform([1], min=0.0, max=1.0)._value)[0])
+        else:
+            u = float(random_u)
+
+        import math as _m
+
+        def starts(n_in, n_out, k):
+            if n_out == 1:
+                return [0]
+            a = (n_in - k) / (n_out - 1)
+            return [(n_in - k) if i == n_out - 1 else
+                    int((i + u) * a) - int(u * a) for i in range(n_out)]
+
+        st = [starts(spatial[d], out_sz[d], ks[d]) for d in range(nd)]
+
+        def impl(v):
+            import itertools
+            outs = jnp.zeros(v.shape[:2] + out_sz, v.dtype)
+            for idx in itertools.product(*(range(o) for o in out_sz)):
+                sl = (slice(None), slice(None)) + tuple(
+                    slice(st[d][idx[d]], st[d][idx[d]] + ks[d])
+                    for d in range(nd))
+                red = v[sl]
+                for _ in range(nd):
+                    red = red.max(axis=2)
+                outs = outs.at[(slice(None), slice(None)) + idx].set(red)
+            return outs
+
+        out = forward_op(f"fractional_max_pool{nd}d", impl, [x])
+        if return_mask:
+            def mask_impl(v):
+                import itertools
+                m = jnp.zeros(v.shape[:2] + out_sz, jnp.int64)
+                W = spatial[-1]
+                for idx in itertools.product(*(range(o) for o in out_sz)):
+                    sl = (slice(None), slice(None)) + tuple(
+                        slice(st[d][idx[d]], st[d][idx[d]] + ks[d])
+                        for d in range(nd))
+                    red = v[sl].reshape(v.shape[:2] + (-1,))
+                    loc = jnp.argmax(red, axis=-1)
+                    # flat index within the FULL spatial plane
+                    if nd == 2:
+                        r = st[0][idx[0]] + loc // ks[1]
+                        c = st[1][idx[1]] + loc % ks[1]
+                        flat = r * W + c
+                    else:
+                        k12 = ks[1] * ks[2]
+                        d0 = st[0][idx[0]] + loc // k12
+                        d1 = st[1][idx[1]] + (loc % k12) // ks[2]
+                        d2 = st[2][idx[2]] + loc % ks[2]
+                        flat = (d0 * spatial[1] + d1) * spatial[2] + d2
+                    m = m.at[(slice(None), slice(None)) + idx].set(flat)
+                return m
+            mask = forward_op(f"fractional_max_pool{nd}d_mask", mask_impl,
+                              [x], differentiable=False)
+            return out, mask
+        return out
+
+    op.__name__ = f"fractional_max_pool{nd}d"
+    op.__qualname__ = op.__name__
+    return op
+
+
+fractional_max_pool2d = _fractional_pool(2)
+fractional_max_pool3d = _fractional_pool(3)

@@ -8,7 +8,7 @@ these modules populate it and patch methods onto Tensor (mirroring how the refer
 import types as _types
 
 from . import (creation, extended, extras, linalg, logic, manipulation, math,
-               random, search)
+               random, search, sets, special, windows)
 
 _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
             "register_op", "patch_methods", "unary_factory", "binary_factory",
@@ -30,14 +30,17 @@ def _export(module):
         # truth for the surface: every public op is registered with its doc,
         # whether factory-generated or hand-written)
         if callable(v) and not isinstance(v, type) and k not in OP_REGISTRY:
-            register_op(k, v, doc=(v.__doc__ or "").strip())
+            register_op(k, v, doc=(v.__doc__ or "").strip(), public=v)
+        elif callable(v) and k in OP_REGISTRY and OP_REGISTRY[k].public is None:
+            OP_REGISTRY[k].public = v
     return names
 
 
 __all__ = sorted(set(
     _export(creation) + _export(math) + _export(manipulation) + _export(linalg)
     + _export(logic) + _export(search) + _export(random) + _export(extras)
-    + _export(extended)))
+    + _export(extended) + _export(sets) + _export(special)
+    + _export(windows)))
 # the inplace generator reads the assembled surface above — import it last
 from . import inplace  # noqa: E402
 __all__ = sorted(set(__all__ + _export(inplace)))

@@ -51,6 +51,17 @@ _INPLACE_BASES = [
     "remainder", "mod", "floor_divide",
     "tril", "triu", "masked_fill", "index_fill", "index_put", "index_add",
     "put_along_axis", "renorm",
+    # r4 breadth: arithmetic/rounding/shape in-place twins (paddle's
+    # generated inplace pass covers these upstream)
+    "add", "subtract", "multiply", "divide", "pow", "clip", "ceil", "floor",
+    "round", "rsqrt", "sqrt", "reciprocal", "neg", "scale", "flatten",
+    "reshape", "squeeze", "unsqueeze", "flip", "cumsum", "cumprod",
+    "exp2", "expit", "erfc", "maximum", "minimum", "fmax", "fmin",
+    "heaviside", "deg2rad", "rad2deg", "sinc", "xlogy",
+    "sort", "sgn", "igamma", "igammac", "polygamma", "index_copy",
+    "scatter_add", "scatter_reduce", "true_divide", "trunc_divide",
+    "divide_no_nan", "bitwise_invert", "masked_scatter",
+    "take_along_dim", "narrow", "clip_by_norm",
 ]
 
 

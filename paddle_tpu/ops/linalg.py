@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ._helpers import axes_arg, ensure_tensor, forward_op, patch_methods
+from ._helpers import (axes_arg, ensure_tensor, forward_op,
+                       patch_methods, register_op)
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
@@ -366,3 +367,55 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         return qmat @ ub, s, jnp.swapaxes(vt, -1, -2)
     args = [t] if M is None else [t, ensure_tensor(M)]
     return forward_op("svd_lowrank", f, args)
+
+
+# -- r4 breadth: solve/inverse completions (VERDICT #6) ----------------------
+
+def cholesky_inverse(x, upper: bool = False, name=None) -> Tensor:
+    """Inverse of a matrix given its Cholesky factor (torch.cholesky_inverse
+    parity): A^-1 from u with A = u u^T (lower) / u^T u (upper)."""
+    def impl(u):
+        from jax.scipy.linalg import cho_solve
+        eye = jnp.eye(u.shape[-1], dtype=u.dtype)
+        return cho_solve((u, not upper), eye)
+    return forward_op("cholesky_inverse", impl, [ensure_tensor(x)])
+
+
+def lu_solve(b, lu_data, pivots, trans: int = 0, name=None) -> Tensor:
+    """Solve A x = b from the packed LU factorization (scipy convention;
+    ref: paddle.linalg.lu_solve). ``pivots`` are 1-based (paddle/LAPACK)."""
+    def impl(bv, luv, pv):
+        from jax.scipy.linalg import lu_solve as _ls
+        return _ls((luv, pv.astype(jnp.int32) - 1), bv, trans=trans)
+    return forward_op("lu_solve", impl,
+                      [ensure_tensor(b), ensure_tensor(lu_data),
+                       ensure_tensor(pivots)])
+
+
+def tensorinv(x, ind: int = 2, name=None) -> Tensor:
+    """Inverse of a tensor viewed as a linear map (numpy.linalg.tensorinv)."""
+    return forward_op("tensorinv", lambda v: jnp.linalg.tensorinv(v, ind),
+                      [ensure_tensor(x)])
+
+
+def tensorsolve(x, y, axes=None, name=None) -> Tensor:
+    """Solve the tensor equation a x = b (numpy.linalg.tensorsolve)."""
+    return forward_op("tensorsolve",
+                      lambda a, b: jnp.linalg.tensorsolve(a, b, axes=axes),
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def geqrf(x, name=None):
+    """Raw QR factorization (LAPACK geqrf: packed householder + tau)."""
+    def impl(v):
+        from jax._src.lax import linalg as _ll
+        return tuple(_ll.geqrf(v))
+    return forward_op("geqrf", impl, [ensure_tensor(x)])
+
+
+orgqr = householder_product  # LAPACK name alias (torch.orgqr parity)
+
+for _n, _f in (("cholesky_inverse", cholesky_inverse), ("lu_solve", lu_solve),
+               ("tensorinv", tensorinv), ("tensorsolve", tensorsolve),
+               ("geqrf", geqrf), ("orgqr", orgqr)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0], public=_f)

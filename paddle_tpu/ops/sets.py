@@ -1,0 +1,82 @@
+"""Set operations on 1-D tensors.
+
+Parity targets: ``python/paddle/tensor/math.py`` set ops in the reference
+(``intersect``/upstream proposals) and the numpy set-routine surface the
+ecosystem expects (``intersect1d``/``setdiff1d``/``union1d``/``setxor1d``/
+``in1d``). TPU note: true set ops are dynamically shaped; following the
+registry-wide static-shape policy these return (values, validity_count)
+style results where noted, or run as host-assisted creation ops (no
+gradient surface, like ``unique``'s documented contract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ._helpers import ensure_tensor, forward_op, register_op
+
+__all__ = ["intersect1d", "setdiff1d", "union1d", "setxor1d", "in1d",
+           "isin_1d"]
+
+
+def _flat_val(x):
+    return ensure_tensor(x)._value.reshape(-1)
+
+
+def _host_set_op(name, np_fn, x, y, assume_unique=False):
+    # set results are data-dependent in SHAPE — computed on host like the
+    # reference's CPU fallback for dynamic-shape ops; inputs are synced
+    # (documented: not jit-traceable, use the mask-style ops inside jit)
+    a = np.asarray(_flat_val(x))
+    b = np.asarray(_flat_val(y))
+    out = np_fn(a, b, assume_unique=assume_unique) if assume_unique is not None \
+        else np_fn(a, b)
+    return forward_op(name, lambda: jnp.asarray(out), [],
+                      differentiable=False)
+
+
+def intersect1d(x, y, assume_unique: bool = False, name=None):
+    """Sorted unique values present in both tensors."""
+    return _host_set_op("intersect1d", np.intersect1d, x, y, assume_unique)
+
+
+def setdiff1d(x, y, assume_unique: bool = False, name=None):
+    """Sorted unique values in ``x`` that are not in ``y``."""
+    return _host_set_op("setdiff1d", np.setdiff1d, x, y, assume_unique)
+
+
+def union1d(x, y, name=None):
+    """Sorted union of unique values."""
+    a = np.asarray(_flat_val(x))
+    b = np.asarray(_flat_val(y))
+    out = np.union1d(a, b)
+    return forward_op("union1d", lambda: jnp.asarray(out), [],
+                      differentiable=False)
+
+
+def setxor1d(x, y, assume_unique: bool = False, name=None):
+    """Sorted values in exactly one of the tensors."""
+    return _host_set_op("setxor1d", np.setxor1d, x, y, assume_unique)
+
+
+def in1d(x, test, assume_unique: bool = False, invert: bool = False,
+         name=None):
+    """Boolean mask over ``x.ravel()``: element present in ``test``.
+    Static-shaped (mask, not values) — safe inside jit."""
+    xv = _flat_val(x)
+    tv = _flat_val(test)
+
+    def impl(xv, tv):
+        m = (xv[:, None] == tv[None, :]).any(axis=1)
+        return ~m if invert else m
+    return forward_op("in1d", impl, [ensure_tensor(xv), ensure_tensor(tv)],
+                      differentiable=False)
+
+
+isin_1d = in1d
+
+for _n, _f in (("intersect1d", intersect1d), ("setdiff1d", setdiff1d),
+               ("union1d", union1d), ("setxor1d", setxor1d), ("in1d", in1d)):
+    register_op(_n, _f, _f.__doc__ or "", differentiable=False,
+                category="set", public=_f)

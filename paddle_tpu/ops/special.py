@@ -1,0 +1,567 @@
+"""Special functions and remaining math/manipulation surface (round-4
+breadth: r3 VERDICT #6 — scatter/window/set/special completion).
+
+Parity targets: ``python/paddle/tensor/math.py`` + ``paddle.incubate``
+special functions in the reference; numpy/scipy names are the oracles
+(tests/test_op_sweep.py reaches these through the OpDef.sweep specs in
+``ops/sweep_specs.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ._helpers import (axes_arg, binary_factory, ensure_tensor, forward_op,
+                       register_op, unary_factory)
+
+__all__ = [
+    "xlogy", "xlog1py", "exp2", "expit", "erfc", "erfcx", "igamma",
+    "igammac", "logdet", "vdot", "addmv", "addr", "chain_matmul",
+    "float_power", "std_mean", "var_mean", "gradient",
+    "histogram_bin_edges", "fliplr", "flipud", "rollaxis", "swapdims",
+    "narrow", "narrow_copy", "split_with_sizes", "concatenate", "arctan2",
+    "entr", "rel_entr", "kl_div", "zeta", "betaln", "betainc", "sinc_pi",
+    "log_ndtr", "ndtr", "ndtri", "spherical_bessel_j0", "cbrt",
+    "nanargmax", "nanargmin", "nanstd", "nanvar",
+]
+
+
+# -- elementwise special fns (factories: auto-swept unary/binary) -----------
+
+xlogy = binary_factory("xlogy", jsp.xlogy, "x*log(y), 0 at x==0.")
+xlog1py = binary_factory("xlog1py", jsp.xlog1py, "x*log1p(y), 0 at x==0.")
+exp2 = unary_factory("exp2", jnp.exp2, "2**x elementwise.")
+expit = unary_factory("expit", jsp.expit, "Logistic sigmoid (scipy name).")
+erfc = unary_factory("erfc", jsp.erfc, "1 - erf(x).")
+erfcx = unary_factory("erfcx", lambda x: jnp.exp(x * x) * jsp.erfc(x),
+                      "Scaled complementary error function exp(x^2)*erfc(x).")
+igamma = binary_factory("igamma", jsp.gammainc,
+                        "Regularized lower incomplete gamma P(a, x).")
+igammac = binary_factory("igammac", jsp.gammaincc,
+                         "Regularized upper incomplete gamma Q(a, x).")
+entr = unary_factory("entr", jsp.entr, "-x*log(x) for x>0; 0 at 0.")
+rel_entr = binary_factory("rel_entr", jsp.rel_entr,
+                          "x*log(x/y) (KL integrand).")
+kl_div = binary_factory("kl_div", jsp.kl_div, "x*log(x/y) - x + y.")
+zeta = binary_factory("zeta", jsp.zeta, "Hurwitz zeta(x, q).")
+betaln = binary_factory("betaln", jsp.betaln, "log|B(a, b)|.")
+ndtr = unary_factory("ndtr", jsp.ndtr, "Standard normal CDF.")
+log_ndtr = unary_factory("log_ndtr", jsp.log_ndtr, "log of the normal CDF.")
+ndtri = unary_factory("ndtri", jsp.ndtri, "Inverse of the normal CDF.")
+cbrt = unary_factory("cbrt", jnp.cbrt, "Cube root, sign-preserving.")
+sinc_pi = unary_factory("sinc_pi", jnp.sinc, "Normalized sinc sin(pi x)/(pi x).")
+spherical_bessel_j0 = unary_factory(
+    "spherical_bessel_j0",
+    lambda x: jnp.where(jnp.abs(x) < 1e-6, 1.0 - x * x / 6.0,
+                        jnp.sin(x) / jnp.where(x == 0, 1.0, x)),
+    "Spherical Bessel function j0(x) = sin(x)/x.")
+
+
+def betainc(a, b, x, name=None):
+    """Regularized incomplete beta I_x(a, b)."""
+    return forward_op("betainc", jsp.betainc,
+                      [ensure_tensor(a), ensure_tensor(b), ensure_tensor(x)])
+
+
+register_op("betainc", jsp.betainc, betainc.__doc__, public=betainc)
+
+
+# -- linalg-ish --------------------------------------------------------------
+
+def logdet(x, name=None):
+    """log|det(x)| for positive-determinant batches (torch.logdet parity)."""
+    def impl(v):
+        sign, ld = jnp.linalg.slogdet(v)
+        return jnp.where(sign > 0, ld, jnp.nan)
+    return forward_op("logdet", impl, [ensure_tensor(x)])
+
+
+def vdot(x, y, name=None):
+    """Flattened dot product (conjugating for complex inputs)."""
+    return forward_op("vdot", jnp.vdot,
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def addmv(input, mat, vec, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta*input + alpha*(mat @ vec)."""
+    return forward_op(
+        "addmv", lambda i, m, v: beta * i + alpha * (m @ v),
+        [ensure_tensor(input), ensure_tensor(mat), ensure_tensor(vec)])
+
+
+def addr(input, vec1, vec2, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta*input + alpha*outer(vec1, vec2)."""
+    return forward_op(
+        "addr", lambda i, a, b: beta * i + alpha * jnp.outer(a, b),
+        [ensure_tensor(input), ensure_tensor(vec1), ensure_tensor(vec2)])
+
+
+def chain_matmul(*mats, name=None):
+    """Product of a chain of matrices (optimal association via jnp.linalg
+    multi_dot)."""
+    ts = [ensure_tensor(m) for m in (mats[0] if len(mats) == 1 and
+                                     isinstance(mats[0], (list, tuple))
+                                     else mats)]
+    return forward_op("chain_matmul",
+                      lambda *vs: jnp.linalg.multi_dot(vs), ts)
+
+
+def float_power(x, y, name=None):
+    """x**y computed in float64-free fashion: promote to the widest float
+    available (fp32 here; x64 is disabled on TPU stacks)."""
+    def impl(a, b):
+        return jnp.power(a.astype(jnp.float32), b.astype(jnp.float32))
+    return forward_op("float_power", impl,
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+# -- statistics --------------------------------------------------------------
+
+def std_mean(x, axis=None, unbiased: bool = True, keepdim: bool = False,
+             name=None):
+    """(std, mean) in one pass (torch.std_mean parity)."""
+    ax = axes_arg(axis)
+
+    def impl(v):
+        dd = 1 if unbiased else 0
+        return (jnp.std(v, axis=ax, ddof=dd, keepdims=keepdim),
+                jnp.mean(v, axis=ax, keepdims=keepdim))
+    return forward_op("std_mean", impl, [ensure_tensor(x)])
+
+
+def var_mean(x, axis=None, unbiased: bool = True, keepdim: bool = False,
+             name=None):
+    """(var, mean) in one pass (torch.var_mean parity)."""
+    ax = axes_arg(axis)
+
+    def impl(v):
+        dd = 1 if unbiased else 0
+        return (jnp.var(v, axis=ax, ddof=dd, keepdims=keepdim),
+                jnp.mean(v, axis=ax, keepdims=keepdim))
+    return forward_op("var_mean", impl, [ensure_tensor(x)])
+
+
+def nanargmax(x, axis=None, keepdim: bool = False, name=None):
+    return forward_op("nanargmax",
+                      lambda v: jnp.nanargmax(v, axis=axes_arg(axis),
+                                              keepdims=keepdim),
+                      [ensure_tensor(x)], differentiable=False)
+
+
+def nanargmin(x, axis=None, keepdim: bool = False, name=None):
+    return forward_op("nanargmin",
+                      lambda v: jnp.nanargmin(v, axis=axes_arg(axis),
+                                              keepdims=keepdim),
+                      [ensure_tensor(x)], differentiable=False)
+
+
+def nanstd(x, axis=None, unbiased: bool = True, keepdim: bool = False,
+           name=None):
+    return forward_op(
+        "nanstd",
+        lambda v: jnp.nanstd(v, axis=axes_arg(axis),
+                             ddof=1 if unbiased else 0, keepdims=keepdim),
+        [ensure_tensor(x)])
+
+
+def nanvar(x, axis=None, unbiased: bool = True, keepdim: bool = False,
+           name=None):
+    return forward_op(
+        "nanvar",
+        lambda v: jnp.nanvar(v, axis=axes_arg(axis),
+                             ddof=1 if unbiased else 0, keepdims=keepdim),
+        [ensure_tensor(x)])
+
+
+def gradient(x, spacing: float = 1.0, axis=None, name=None):
+    """Central-difference gradient (numpy.gradient parity; unit spacing or a
+    scalar step)."""
+    ax = axes_arg(axis)
+
+    def impl(v):
+        axes = range(v.ndim) if ax is None else \
+            ([ax] if isinstance(ax, int) else ax)
+        outs = [jnp.gradient(v, spacing, axis=a) for a in axes]
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    return forward_op("gradient", impl, [ensure_tensor(x)])
+
+
+def histogram_bin_edges(x, bins: int = 100, min=0, max=0, name=None):
+    """Bin edges the way paddle.histogram computes them (min==max==0 ->
+    data range)."""
+    def impl(v):
+        lo, hi = (jnp.min(v), jnp.max(v)) if (min == 0 and max == 0) \
+            else (jnp.asarray(min, v.dtype), jnp.asarray(max, v.dtype))
+        hi = jnp.where(hi > lo, hi, lo + 1)
+        return jnp.linspace(lo, hi, bins + 1)
+    return forward_op("histogram_bin_edges", impl, [ensure_tensor(x)],
+                      differentiable=False)
+
+
+# -- manipulation aliases/completions ---------------------------------------
+
+def fliplr(x, name=None):
+    return forward_op("fliplr", jnp.fliplr, [ensure_tensor(x)])
+
+
+def flipud(x, name=None):
+    return forward_op("flipud", jnp.flipud, [ensure_tensor(x)])
+
+
+def rollaxis(x, axis: int, start: int = 0, name=None):
+    return forward_op("rollaxis",
+                      lambda v: jnp.rollaxis(v, axis, start),
+                      [ensure_tensor(x)])
+
+
+def swapdims(x, dim0: int, dim1: int, name=None):
+    return forward_op("swapdims",
+                      lambda v: jnp.swapaxes(v, dim0, dim1),
+                      [ensure_tensor(x)])
+
+
+def narrow(x, axis: int, start: int, length: int, name=None):
+    """Contiguous slice of ``length`` along ``axis`` (torch.narrow parity)."""
+    return forward_op(
+        "narrow",
+        lambda v: lax.slice_in_dim(v, start, start + length, axis=axis),
+        [ensure_tensor(x)])
+
+
+narrow_copy = narrow
+
+
+def split_with_sizes(x, sizes, axis: int = 0, name=None):
+    """Split into chunks of the given sizes along ``axis``."""
+    offs = np.cumsum([0] + list(sizes))
+    if offs[-1] != ensure_tensor(x).shape[axis]:
+        raise ValueError(f"sizes {list(sizes)} do not sum to dim "
+                         f"{ensure_tensor(x).shape[axis]}")
+
+    def impl(v):
+        return tuple(lax.slice_in_dim(v, int(a), int(b), axis=axis)
+                     for a, b in zip(offs[:-1], offs[1:]))
+    return forward_op("split_with_sizes", impl, [ensure_tensor(x)])
+
+
+def concatenate(x, axis: int = 0, name=None):
+    """numpy-name alias of concat."""
+    ts = [ensure_tensor(t) for t in x]
+    return forward_op("concatenate",
+                      lambda *vs: jnp.concatenate(vs, axis=axis), ts)
+
+
+def arctan2(x, y, name=None):
+    return forward_op("arctan2", jnp.arctan2,
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+for _n, _f in (
+        ("logdet", logdet), ("vdot", vdot), ("addmv", addmv), ("addr", addr),
+        ("chain_matmul", chain_matmul), ("float_power", float_power),
+        ("std_mean", std_mean), ("var_mean", var_mean),
+        ("gradient", gradient), ("histogram_bin_edges", histogram_bin_edges),
+        ("fliplr", fliplr), ("flipud", flipud), ("rollaxis", rollaxis),
+        ("swapdims", swapdims), ("narrow", narrow),
+        ("narrow_copy", narrow_copy), ("split_with_sizes", split_with_sizes),
+        ("concatenate", concatenate), ("arctan2", arctan2),
+        ("nanargmax", nanargmax), ("nanargmin", nanargmin),
+        ("nanstd", nanstd), ("nanvar", nanvar)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                public=_f)
+
+
+# -- r4 breadth, second batch: index/scatter/shift completions ---------------
+
+def index_copy(x, index, source, axis: int = 0, name=None):
+    """Copy rows of ``source`` into ``x`` at ``index`` along ``axis``
+    (torch.index_copy parity)."""
+    def impl(v, idx, src):
+        mv = jnp.moveaxis(v, axis, 0)
+        ms = jnp.moveaxis(src, axis, 0)
+        return jnp.moveaxis(mv.at[idx].set(ms), 0, axis)
+    return forward_op("index_copy", impl,
+                      [ensure_tensor(x), ensure_tensor(index),
+                       ensure_tensor(source)])
+
+
+def scatter_add(x, index, updates, axis: int = 0, name=None):
+    """Accumulating scatter along ``axis`` (torch.scatter_add semantics:
+    per-element indices of the same rank as updates)."""
+    def impl(v, idx, upd):
+        oidx = jnp.indices(upd.shape)
+        gather = tuple(idx if d == axis else oidx[d]
+                       for d in range(v.ndim))
+        return v.at[gather].add(upd)
+    return forward_op("scatter_add", impl,
+                      [ensure_tensor(x), ensure_tensor(index),
+                       ensure_tensor(updates)])
+
+
+def scatter_reduce(x, index, updates, reduce: str = "sum", axis: int = 0,
+                   include_self: bool = True, name=None):
+    """Reduce-scatter along ``axis`` with sum/prod/amax/amin/mean modes
+    (torch.scatter_reduce parity; paddle: put_along_axis(reduce=...))."""
+    modes = {"sum": "add", "add": "add", "prod": "multiply",
+             "multiply": "multiply", "amax": "max", "amin": "min",
+             "mean": "add"}
+    if reduce not in modes:
+        raise ValueError(f"unknown reduce {reduce!r}; options "
+                         f"{sorted(modes)}")
+
+    def impl(v, idx, upd):
+        oidx = jnp.indices(upd.shape)
+        gather = tuple(idx if d == axis else oidx[d]
+                       for d in range(v.ndim))
+        at = v.at[gather]
+        out = getattr(at, modes[reduce])(upd)
+        if reduce == "mean":
+            cnt = jnp.zeros_like(v).at[gather].add(jnp.ones_like(upd))
+            base = jnp.ones_like(cnt) * (1.0 if include_self else 0.0)
+            out = out / jnp.maximum(cnt + base, 1)
+        return out
+    return forward_op("scatter_reduce", impl,
+                      [ensure_tensor(x), ensure_tensor(index),
+                       ensure_tensor(updates)])
+
+
+def diag_indices(n: int, ndim: int = 2, name=None):
+    """Indices of the main diagonal of an ``ndim``-d array of side n."""
+    def impl():
+        r = jnp.arange(n)
+        return tuple(r for _ in range(ndim))
+    return forward_op("diag_indices", impl, [], differentiable=False)
+
+
+def unravel_index(indices, shape, name=None):
+    """Flat index -> coordinate tuple (numpy.unravel_index parity)."""
+    return forward_op("unravel_index",
+                      lambda i: jnp.unravel_index(i, tuple(shape)),
+                      [ensure_tensor(indices)], differentiable=False)
+
+
+def ravel_multi_index(multi_index, shape, mode="raise", name=None):
+    """Coordinate arrays -> flat indices."""
+    ts = [ensure_tensor(m) for m in multi_index]
+    return forward_op(
+        "ravel_multi_index",
+        lambda *ms: jnp.ravel_multi_index(ms, tuple(shape), mode="clip"),
+        ts, differentiable=False)
+
+
+def true_divide(x, y, name=None):
+    return forward_op("true_divide", jnp.true_divide,
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def trunc_divide(x, y, name=None):
+    """Division rounded toward zero (paddle.trunc_divide)."""
+    return forward_op("trunc_divide",
+                      lambda a, b: jnp.trunc(a / b),
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def divide_no_nan(x, y, name=None):
+    """x/y with 0 where y == 0 (tf-style safe divide; reference uses it in
+    metric kernels)."""
+    def impl(a, b):
+        safe = jnp.where(b == 0, 1, b)
+        return jnp.where(b == 0, 0, a / safe)
+    return forward_op("divide_no_nan", impl,
+                      [ensure_tensor(x), ensure_tensor(y)])
+
+
+def bitwise_invert(x, name=None):
+    return forward_op("bitwise_invert", jnp.invert, [ensure_tensor(x)],
+                      differentiable=False)
+
+
+def cumulative_sum(x, axis=None, name=None):
+    return forward_op("cumulative_sum",
+                      lambda v: jnp.cumsum(v, axis=axes_arg(axis)),
+                      [ensure_tensor(x)])
+
+
+def cumulative_prod(x, axis=None, name=None):
+    return forward_op("cumulative_prod",
+                      lambda v: jnp.cumprod(v, axis=axes_arg(axis)),
+                      [ensure_tensor(x)])
+
+
+def clip_by_norm(x, max_norm: float, name=None):
+    """Scale ``x`` so its L2 norm is at most ``max_norm`` (ref:
+    paddle.nn.clip_by_norm / ClipGradByNorm kernel)."""
+    def impl(v):
+        n = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+        return (v.astype(jnp.float32) * scale).astype(v.dtype)
+    return forward_op("clip_by_norm", impl, [ensure_tensor(x)])
+
+
+def clip_by_global_norm(t_list, clip_norm: float, name=None):
+    """Scale a LIST of tensors by the global-norm clip factor (ref:
+    ClipGradByGlobalNorm)."""
+    ts = [ensure_tensor(t) for t in t_list]
+
+    def impl(*vs):
+        g2 = sum(jnp.sum(v.astype(jnp.float32) ** 2) for v in vs)
+        gn = jnp.sqrt(g2)
+        scale = clip_norm / jnp.maximum(gn, clip_norm)
+        return tuple((v.astype(jnp.float32) * scale).astype(v.dtype)
+                     for v in vs)
+    return forward_op("clip_by_global_norm", impl, ts)
+
+
+for _n, _f in (("index_copy", index_copy), ("scatter_add", scatter_add),
+               ("scatter_reduce", scatter_reduce),
+               ("diag_indices", diag_indices),
+               ("unravel_index", unravel_index),
+               ("ravel_multi_index", ravel_multi_index),
+               ("true_divide", true_divide), ("trunc_divide", trunc_divide),
+               ("divide_no_nan", divide_no_nan),
+               ("bitwise_invert", bitwise_invert),
+               ("cumulative_sum", cumulative_sum),
+               ("cumulative_prod", cumulative_prod),
+               ("clip_by_norm", clip_by_norm),
+               ("clip_by_global_norm", clip_by_global_norm)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0], public=_f)
+__all__ += ["index_copy", "scatter_add", "scatter_reduce", "diag_indices",
+            "unravel_index", "ravel_multi_index", "true_divide",
+            "trunc_divide", "divide_no_nan", "bitwise_invert",
+            "cumulative_sum", "cumulative_prod", "clip_by_norm",
+            "clip_by_global_norm"]
+
+
+# -- r4 breadth, third batch: aliases + inplace random fills ----------------
+
+def take_along_dim(x, indices, dim: int = 0, name=None):
+    """torch-name alias of take_along_axis."""
+    return forward_op("take_along_dim",
+                      lambda v, i: jnp.take_along_axis(v, i, axis=dim),
+                      [ensure_tensor(x), ensure_tensor(indices)])
+
+
+def permute_dims(x, axes, name=None):
+    """Array-API name for transpose-with-permutation."""
+    return forward_op("permute_dims",
+                      lambda v: jnp.transpose(v, tuple(axes)),
+                      [ensure_tensor(x)])
+
+
+def relu_(x, name=None):
+    """In-place ReLU (ref: paddle.nn.functional.relu_)."""
+    t = ensure_tensor(x)
+    out = forward_op("relu_", lambda v: jnp.maximum(v, 0), [t])
+    t._rebind(out)
+    return t
+
+
+def _random_fill(name, sampler_doc, dist):
+    def op(x, *args, name_=None, **kw):
+        t = ensure_tensor(x)
+        from .random import _next_key
+        import jax.random as jr
+
+        def impl(v):
+            key = _next_key()
+            shp = v.shape
+            if dist == "cauchy":
+                loc = args[0] if args else kw.get("loc", 0.0)
+                scale = args[1] if len(args) > 1 else kw.get("scale", 1.0)
+                u = jr.uniform(key, shp, jnp.float32, 1e-6, 1 - 1e-6)
+                s = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+            elif dist == "geometric":
+                p = args[0] if args else kw.get("probs", 0.5)
+                u = jr.uniform(key, shp, jnp.float32, 1e-9, 1.0)
+                s = jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1
+            else:  # log_normal
+                mean = args[0] if args else kw.get("mean", 1.0)
+                std = args[1] if len(args) > 1 else kw.get("std", 2.0)
+                s = jnp.exp(mean + std * jr.normal(key, shp, jnp.float32))
+            return s.astype(v.dtype)
+        out = forward_op(name, impl, [t], differentiable=False)
+        t._rebind(out)
+        return t
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = sampler_doc
+    register_op(name, op, sampler_doc, differentiable=False,
+                category="random", public=op)
+    return op
+
+
+cauchy_ = _random_fill(
+    "cauchy_", "Fill in place with Cauchy(loc, scale) samples "
+    "(ref: Tensor.cauchy_).", "cauchy")
+geometric_ = _random_fill(
+    "geometric_", "Fill in place with Geometric(p) samples "
+    "(ref: Tensor.geometric_).", "geometric")
+log_normal_ = _random_fill(
+    "log_normal_", "Fill in place with LogNormal(mean, std) samples "
+    "(ref: Tensor.log_normal_).", "log_normal")
+
+
+for _n, _f in (("take_along_dim", take_along_dim),
+               ("permute_dims", permute_dims), ("relu_", relu_)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0], public=_f)
+__all__ += ["take_along_dim", "permute_dims", "relu_", "cauchy_",
+            "geometric_", "log_normal_"]
+
+
+from ._helpers import patch_methods as _patch
+_patch([("cauchy_", cauchy_), ("geometric_", geometric_),
+        ("log_normal_", log_normal_), ("take_along_dim", take_along_dim),
+        ("relu_", relu_), ("xlogy", xlogy), ("vdot", vdot),
+        ("float_power", float_power), ("narrow", narrow),
+        ("fliplr", fliplr), ("flipud", flipud), ("swapdims", swapdims),
+        ("scatter_add", scatter_add), ("index_copy", index_copy),
+        ("scatter_reduce", scatter_reduce), ("exp2", exp2),
+        ("erfc", erfc), ("igamma", igamma), ("igammac", igammac)])
+
+
+# creation/conversion aliases (torch/numpy-style entry points the ecosystem
+# expects; all route to to_tensor / histogram)
+
+def asarray(data, dtype=None, name=None):
+    """numpy-style alias of to_tensor."""
+    from ..core.tensor import to_tensor
+    return to_tensor(data, dtype=dtype)
+
+
+def as_tensor(data, dtype=None, name=None):
+    """torch-style alias of to_tensor (no-copy when already a Tensor of the
+    right dtype)."""
+    from ..core.tensor import Tensor, to_tensor
+    if isinstance(data, Tensor) and (dtype is None or
+                                     str(data.dtype) == str(dtype)):
+        return data
+    return to_tensor(data, dtype=dtype)
+
+
+def from_numpy(array, name=None):
+    """torch-style alias of to_tensor for numpy arrays."""
+    from ..core.tensor import to_tensor
+    return to_tensor(array)
+
+
+def histc(x, bins: int = 100, min=0, max=0, name=None):
+    """torch-name alias of histogram (counts only)."""
+    def impl(v):
+        lo, hi = (jnp.min(v), jnp.max(v)) if (min == 0 and max == 0) \
+            else (jnp.asarray(min, v.dtype), jnp.asarray(max, v.dtype))
+        hi = jnp.where(hi > lo, hi, lo + 1)
+        return jnp.histogram(v, bins=bins, range=(lo, hi))[0]
+    return forward_op("histc", impl, [ensure_tensor(x)],
+                      differentiable=False)
+
+
+for _n, _f in (("asarray", asarray), ("as_tensor", as_tensor),
+               ("from_numpy", from_numpy), ("histc", histc)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                differentiable=False, public=_f)
+__all__ += ["asarray", "as_tensor", "from_numpy", "histc"]

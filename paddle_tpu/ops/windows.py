@@ -1,0 +1,265 @@
+"""Window functions (signal/audio windowing surface).
+
+Parity targets: ``paddle.audio.functional.window.get_window`` and the
+window set scipy exposes through it (reference routes windows through
+``paddle/audio/functional/window.py``); the ``*_window`` creation-op names
+mirror the torch-style aliases the ecosystem expects.
+
+All windows are pure jnp expressions of ``arange(M)`` — creation ops (no
+gradient surface), periodic/symmetric conventions supported the way
+scipy.signal does (``sym=False`` computes the M+1 window and drops the last
+sample).
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax.numpy as jnp
+
+from ._helpers import forward_op, register_op
+from ..core.dtype import canonical_dtype
+
+__all__ = [
+    "blackman_window", "hamming_window", "hann_window", "bartlett_window",
+    "kaiser_window", "nuttall_window", "blackman_harris_window",
+    "bohman_window", "cosine_window", "tukey_window", "gaussian_window",
+    "exponential_window", "general_cosine_window", "general_hamming_window",
+    "triang_window", "taylor_window", "get_window",
+]
+
+
+def _ext(M: int, sym: bool):
+    """scipy's extend/truncate trick for periodic windows."""
+    return (M + 1, True) if (not sym and M > 1) else (M, False)
+
+
+def _general_cosine(M, a, sym):
+    Mx, trunc = _ext(M, sym)
+    if Mx == 1:
+        w = jnp.ones(1)
+    else:
+        fac = jnp.linspace(-_math.pi, _math.pi, Mx)
+        w = sum(ai * jnp.cos(i * fac) for i, ai in enumerate(a))
+    return w[:-1] if trunc else w
+
+
+def _creation(name, fn, doc=""):
+    """Register a window creation op returning a float Tensor."""
+    def op(window_length, *args, sym=True, dtype="float32", name_=None,
+           **kw):
+        dt = canonical_dtype(dtype)
+        M = int(window_length)
+
+        def impl():
+            return fn(M, *args, sym=sym, **kw).astype(dt)
+        return forward_op(name, impl, [], differentiable=False)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"{name} of the given length (sym=False -> periodic)."
+    register_op(name, fn, op.__doc__, differentiable=False,
+                category="window", public=op)
+    return op
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _hamming(M, sym=True):
+    return _general_cosine(M, [0.54, 0.46], sym)
+
+
+def _general_hamming(M, alpha, sym=True):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+def _hann(M, sym=True):
+    return _general_cosine(M, [0.5, 0.5], sym)
+
+
+def _nuttall(M, sym=True):
+    return _general_cosine(M, [0.3635819, 0.4891775, 0.1365995, 0.0106411],
+                           sym)
+
+
+def _blackman_harris(M, sym=True):
+    return _general_cosine(M, [0.35875, 0.48829, 0.14128, 0.01168], sym)
+
+
+def _bartlett(M, sym=True):
+    Mx, trunc = _ext(M, sym)
+    if Mx == 1:
+        return jnp.ones(1)
+    n = jnp.arange(Mx)
+    w = jnp.where(n <= (Mx - 1) / 2.0, 2.0 * n / (Mx - 1),
+                  2.0 - 2.0 * n / (Mx - 1))
+    return w[:-1] if trunc else w
+
+
+def _triang(M, sym=True):
+    Mx, trunc = _ext(M, sym)
+    n = jnp.arange(1, (Mx + 1) // 2 + 1)
+    if Mx % 2 == 0:
+        w = (2 * n - 1.0) / Mx
+        w = jnp.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (Mx + 1.0)
+        w = jnp.concatenate([w, w[-2::-1]])
+    return w[:-1] if trunc else w
+
+
+def _kaiser(M, beta=12.0, sym=True):
+    Mx, trunc = _ext(M, sym)
+    if Mx == 1:
+        return jnp.ones(1)
+    n = jnp.arange(Mx)
+    alpha = (Mx - 1) / 2.0
+    from jax.scipy.special import i0 as _i0
+    w = _i0(beta * jnp.sqrt(jnp.clip(
+        1.0 - ((n - alpha) / alpha) ** 2, 0.0, 1.0))) / _i0(jnp.float32(beta))
+    return w[:-1] if trunc else w
+
+
+def _bohman(M, sym=True):
+    Mx, trunc = _ext(M, sym)
+    if Mx == 1:
+        return jnp.ones(1)
+    fac = jnp.abs(jnp.linspace(-1, 1, Mx))
+    w = (1 - fac) * jnp.cos(_math.pi * fac) + \
+        1.0 / _math.pi * jnp.sin(_math.pi * fac)
+    # endpoints are exactly zero in scipy
+    w = w.at[0].set(0.0).at[-1].set(0.0)
+    return w[:-1] if trunc else w
+
+
+def _cosine(M, sym=True):
+    Mx, trunc = _ext(M, sym)
+    w = jnp.sin(_math.pi / Mx * (jnp.arange(Mx) + 0.5))
+    return w[:-1] if trunc else w
+
+
+def _tukey(M, alpha=0.5, sym=True):
+    Mx, trunc = _ext(M, sym)
+    if Mx == 1:
+        return jnp.ones(1)
+    if alpha <= 0:
+        w = jnp.ones(Mx)
+    elif alpha >= 1:
+        w = _hann(Mx, sym=True)
+    else:
+        n = jnp.arange(Mx)
+        width = alpha * (Mx - 1) / 2.0
+        w = jnp.where(
+            n < width,
+            0.5 * (1 + jnp.cos(_math.pi * (-1 + 2.0 * n / alpha / (Mx - 1)))),
+            jnp.where(
+                n > (Mx - 1) * (1 - alpha / 2.0),
+                0.5 * (1 + jnp.cos(_math.pi * (-2.0 / alpha + 1 +
+                                               2.0 * n / alpha / (Mx - 1)))),
+                1.0))
+    return w[:-1] if trunc else w
+
+
+def _gaussian(M, std=7.0, sym=True):
+    Mx, trunc = _ext(M, sym)
+    n = jnp.arange(Mx) - (Mx - 1.0) / 2.0
+    w = jnp.exp(-(n ** 2) / (2.0 * std * std))
+    return w[:-1] if trunc else w
+
+
+def _exponential(M, center=None, tau=1.0, sym=True):
+    Mx, trunc = _ext(M, sym)
+    c = (Mx - 1) / 2.0 if center is None else center
+    n = jnp.arange(Mx)
+    w = jnp.exp(-jnp.abs(n - c) / tau)
+    return w[:-1] if trunc else w
+
+
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True):
+    Mx, trunc = _ext(M, sym)
+    B = 10 ** (sll / 20.0)
+    A = _math.acosh(B) / _math.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = jnp.arange(1, nbar, dtype=jnp.float32)
+
+    Fm = []
+    import numpy as _np
+    man = _np.arange(1, nbar)
+    for mi in man:
+        numer = (-1) ** (mi + 1) * _np.prod(
+            1 - mi ** 2 / s2 / (A ** 2 + (man - 0.5) ** 2))
+        denom = 2 * _np.prod([1 - mi ** 2 / j ** 2
+                              for j in man if j != mi])
+        Fm.append(numer / denom)
+    Fm = jnp.asarray(_np.asarray(Fm, _np.float32))
+    n = jnp.arange(Mx)
+    w = 1 + 2 * jnp.sum(
+        Fm[:, None] * jnp.cos(2 * _math.pi * ma[:, None] *
+                              (n[None] - Mx / 2.0 + 0.5) / Mx), axis=0)
+    if norm:
+        scale = 1 + 2 * jnp.sum(
+            Fm * jnp.cos(2 * _math.pi * ma * (-0.5 + 0.5)), axis=0)
+        w = w / scale
+    return w[:-1] if trunc else w
+
+
+def _general_cosine_pub(M, a, sym=True):
+    return _general_cosine(M, list(a), sym)
+
+
+blackman_window = _creation("blackman_window", _blackman)
+hamming_window = _creation("hamming_window", _hamming)
+hann_window = _creation("hann_window", _hann)
+bartlett_window = _creation("bartlett_window", _bartlett)
+kaiser_window = _creation("kaiser_window", _kaiser)
+nuttall_window = _creation("nuttall_window", _nuttall)
+blackman_harris_window = _creation("blackman_harris_window", _blackman_harris)
+bohman_window = _creation("bohman_window", _bohman)
+cosine_window = _creation("cosine_window", _cosine)
+tukey_window = _creation("tukey_window", _tukey)
+gaussian_window = _creation("gaussian_window", _gaussian)
+exponential_window = _creation("exponential_window", _exponential)
+general_cosine_window = _creation("general_cosine_window",
+                                  _general_cosine_pub)
+general_hamming_window = _creation("general_hamming_window", _general_hamming)
+triang_window = _creation("triang_window", _triang)
+taylor_window = _creation("taylor_window", _taylor)
+
+_BY_NAME = {
+    "blackman": _blackman, "hamming": _hamming, "hann": _hann,
+    "bartlett": _bartlett, "kaiser": _kaiser, "nuttall": _nuttall,
+    "blackmanharris": _blackman_harris, "bohman": _bohman,
+    "cosine": _cosine, "tukey": _tukey, "gaussian": _gaussian,
+    "exponential": _exponential, "general_cosine": _general_cosine_pub,
+    "general_hamming": _general_hamming, "triang": _triang,
+    "taylor": _taylor,
+}
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float64"):
+    """``paddle.audio.functional.get_window`` parity: window by name (or
+    ``(name, param)`` tuple), periodic by default (``fftbins=True``)."""
+    args = ()
+    if isinstance(window, (tuple, list)):
+        window, *args = window
+    if not isinstance(window, str):
+        raise TypeError(f"window must be a str or (str, param), got "
+                        f"{window!r}")
+    try:
+        fn = _BY_NAME[window]
+    except KeyError:
+        raise ValueError(
+            f"unknown window {window!r}; options: {sorted(_BY_NAME)}") \
+            from None
+    dt = canonical_dtype(dtype)
+    return forward_op("get_window",
+                      lambda: fn(int(win_length), *args,
+                                 sym=not fftbins).astype(dt),
+                      [], differentiable=False)
+
+
+register_op("get_window", get_window, get_window.__doc__,
+            differentiable=False, category="window", public=get_window)

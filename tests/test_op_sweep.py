@@ -12,10 +12,24 @@ Ops with no numpy counterpart still get the run + gradient check.
 import numpy as np
 import pytest
 
+# the op sweep is the default-path's biggest time sink (r3 VERDICT #9):
+# it runs in the slow tier; the fast tier keeps the hand-written op tests
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 
 import paddle_tpu  # populate the registry  # noqa: F401
+# the registry is populated per-domain on import — pull in every surface the
+# schema covers (same set as ops/gen_docs.py)
+import paddle_tpu.nn.functional  # noqa: F401
+import paddle_tpu.sparse  # noqa: F401
+import paddle_tpu.signal  # noqa: F401
+import paddle_tpu.geometric  # noqa: F401
+import paddle_tpu.vision.ops  # noqa: F401
+import paddle_tpu.fft  # noqa: F401
+import paddle_tpu.audio  # noqa: F401
+import paddle_tpu.incubate.nn.functional  # noqa: F401
 from paddle_tpu.core.dispatch import OP_REGISTRY
 
 # safe input domains: (low, high) keeping the op real, finite, and away
@@ -34,7 +48,16 @@ DOMAINS = {
     "i0": (-2.0, 2.0), "i0e": (-2.0, 2.0), "i1": (-2.0, 2.0),
     "i1e": (-2.0, 2.0), "cumprod": (0.3, 1.5), "prod": (0.3, 1.5),
     "elementwise_pow": (0.3, 2.0),
+    # r4 special-function domains
+    "entr": (0.1, 2.0), "ndtri": (0.1, 0.9), "igamma": (0.3, 3.0),
+    "igammac": (0.3, 3.0), "xlogy": (0.3, 3.0), "xlog1py": (0.3, 3.0),
+    "kl_div": (0.3, 3.0), "rel_entr": (0.3, 3.0), "zeta": (1.5, 3.0),
+    "erfcx": (-1.5, 1.5),
 }
+
+# ops whose jax.scipy kernels reject bfloat16 inputs (f32/f64-only)
+NO_BF16 = {"ndtr", "log_ndtr", "ndtri", "entr", "rel_entr", "kl_div",
+           "xlogy", "xlog1py", "zeta", "betaln", "igamma", "igammac"}
 
 # integer-domain ops: sampled as int32, no gradient or bf16 legs
 INT_OPS = {"bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
@@ -134,7 +157,7 @@ def test_unary_sweep(name):
                 err_msg=f"{name}: grad mismatch at [{i},{j}]")
 
     # bf16 dtype sweep: must execute and stay finite
-    if name not in INT_OPS:
+    if name not in INT_OPS and name not in NO_BF16:
         ob = d.fn(jnp.asarray(x, jnp.bfloat16))
         assert np.all(np.isfinite(np.asarray(ob, np.float32))), \
             f"{name}: non-finite under bfloat16"
@@ -174,7 +197,7 @@ def test_binary_sweep(name):
                 float(g[argn][1, 1]), num, rtol=2e-2, atol=2e-3,
                 err_msg=f"{name}: grad mismatch wrt arg {argn}")
 
-    if name not in INT_OPS:
+    if name not in INT_OPS and name not in NO_BF16:
         ob = d.fn(jnp.asarray(x, jnp.bfloat16), jnp.asarray(y, jnp.bfloat16))
         assert np.all(np.isfinite(np.asarray(ob, np.float32))), name
 
@@ -185,3 +208,72 @@ def test_sweep_covers_the_factory_surface():
     u, b = _ops_with("unary"), _ops_with("binary")
     assert len(u) >= 55, len(u)
     assert len(b) >= 30, len(b)
+
+
+# ---------------------------------------------------------------------------
+# composite-op sweep: OpDef.sweep specs (r4; ops/sweep_specs.py)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.ops.sweep_specs import attach_specs, sweep_coverage  # noqa: E402
+
+attach_specs()
+
+
+def _specced_ops():
+    return sorted(n for n, d in OP_REGISTRY.items() if d.sweep is not None)
+
+
+def _to_call_args(args):
+    """numpy arrays in a spec become Tensors; containers recurse."""
+    from paddle_tpu.core.tensor import to_tensor
+    out = []
+    for a in args:
+        if isinstance(a, np.ndarray):
+            out.append(to_tensor(a))
+        elif isinstance(a, list) and a and isinstance(a[0], np.ndarray):
+            out.append([to_tensor(x) for x in a])
+        else:
+            out.append(a)
+    return out
+
+
+def _leaves(x):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return [np.asarray(x._value)]
+    if isinstance(x, (tuple, list)):
+        return [l for e in x for l in _leaves(e)]
+    return [np.asarray(x)]
+
+
+@pytest.mark.parametrize("name", _specced_ops())
+def test_composite_sweep(name):
+    d = OP_REGISTRY[name]
+    rng = np.random.default_rng(sum(map(ord, name)) % 2 ** 31)
+    for args, kwargs, oracle in d.sweep(rng):
+        out = d.public(*_to_call_args(args), **kwargs)
+        got = _leaves(out)
+        for leaf in got:
+            if np.issubdtype(leaf.dtype, np.floating):
+                assert np.all(np.isfinite(leaf)), \
+                    f"{name}: non-finite output"
+        if oracle is not None:
+            np_args = [np.asarray(a) if isinstance(a, np.ndarray) else a
+                       for a in args]
+            expect = oracle(*np_args, **kwargs)
+            exp_leaves = (list(expect) if isinstance(expect, (tuple, list))
+                          else [expect])
+            assert len(exp_leaves) == len(got), \
+                f"{name}: oracle arity {len(exp_leaves)} != {len(got)}"
+            for g, e in zip(got, exp_leaves):
+                np.testing.assert_allclose(
+                    np.asarray(g, np.float64),
+                    np.asarray(e, np.float64), rtol=2e-3, atol=2e-4,
+                    err_msg=name)
+
+
+def test_sweep_coverage_reported():
+    """The coverage number docs/OPS.md claims must match reality."""
+    covered, total = sweep_coverage()
+    assert covered >= 300, (covered, total)   # ratchet, not a vanity target
+    assert total >= 750, total
