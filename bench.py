@@ -303,6 +303,64 @@ def bench_sdxl_attention(steps=10):
     return out
 
 
+def bench_detect(batch=8, steps=8, image=320):
+    """PP-YOLOE-style detector train step (MobileNetV3-small + FPN +
+    decoupled head + center-assigned loss) through eager->to_static
+    (BASELINE.json configs[2] detection capability target)."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn  # noqa: F401
+    from paddle_tpu import amp
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.vision.detection import detection_loss, ppyoloe_mbv3
+
+    paddle.seed(0)
+    det = ppyoloe_mbv3(num_classes=80, image_size=image)
+    opt = Momentum(learning_rate=0.01, momentum=0.9,
+                   parameters=det.parameters())
+    pts, strides = det.anchor_points()
+    rng = np.random.default_rng(0)
+
+    @to_static
+    def train_step(x, gt_b, gt_l):
+        with amp.auto_cast():
+            cls, boxes = det(x)
+        loss = detection_loss(cls, boxes, gt_b, gt_l, pts, strides, 80)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def mk(b):
+        x = paddle.to_tensor(rng.standard_normal(
+            (b, 3, image, image)).astype(np.float32))
+        lo = rng.uniform(0, image - 64, (b, 4, 2)).astype(np.float32)
+        wh = rng.uniform(16, 64, (b, 4, 2)).astype(np.float32)
+        gt_b = paddle.to_tensor(np.concatenate([lo, lo + wh], -1))
+        gt_l = paddle.to_tensor(
+            rng.integers(0, 80, (b, 4)).astype(np.int32))
+        return x, gt_b, gt_l
+
+    xw, bw, lw = mk(2)
+    t0 = time.time()
+    float(train_step(xw, bw, lw))   # eager state-discovery warmup
+    warm_s = time.time() - t0
+    x, gb, gl = mk(batch)
+    t0 = time.time()
+    float(train_step(x, gb, gl))    # compile at the timed size
+    compile_s = time.time() - t0
+    float(train_step(x, gb, gl))
+    t0 = time.time()
+    for _ in range(steps):
+        loss = train_step(x, gb, gl)
+    final = float(loss)
+    per_step = (time.time() - t0) / steps
+    assert np.isfinite(final)
+    return {"images_per_s": batch / per_step, "step_time_s": per_step,
+            "warmup_s": warm_s, "compile_s": compile_s, "loss": final}
+
+
 def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
     """The memory-tuned LLaMA-ratio point (secondary; the headline keeps the
     reference-parity numerics): remat_policy="save_flash" (flash residuals +
@@ -570,7 +628,7 @@ def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
 def main():
     ap = argparse.ArgumentParser()
     _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode",
-                 "tuned", "roofline")
+                 "tuned", "detect", "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
@@ -626,9 +684,10 @@ def main():
     except OSError:
         _warm = False
     _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
-                  "sdxl": 25.0, "decode": 45.0, "tuned": 35.0} if _warm else
+                  "sdxl": 25.0, "decode": 45.0, "tuned": 35.0,
+                  "detect": 120.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
-                  "sdxl": 45.0, "decode": 90.0, "tuned": 60.0})
+                  "sdxl": 45.0, "decode": 90.0, "tuned": 60.0, "detect": 240.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -718,6 +777,15 @@ def main():
             _emit("sdxl_attn_64x64", v, "ms",
                   _R2_ANCHORS["sdxl_attn_64x64"] / v)  # lower is better
         section("sdxl", _sdxl)
+    if want("detect"):
+        def _detect():
+            dt = bench_detect(steps=args.steps)
+            print(json.dumps({"detect_step_s": round(dt["step_time_s"], 4),
+                              "detect_compile_s": round(dt["compile_s"], 1),
+                              "loss": round(dt["loss"], 3)}), file=sys.stderr)
+            _emit("ppyoloe_mbv3_throughput", round(dt["images_per_s"], 1),
+                  "img/s", 1.0)  # first recorded round — self-anchored
+        section("detect", _detect)
     if "roofline" in chosen:   # explicit-only: a diagnostic, not a metric
         def _roof():
             r = bench_roofline(backend, steps=args.steps)

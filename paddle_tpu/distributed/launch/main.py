@@ -66,6 +66,16 @@ def _parse(argv: Optional[List[str]] = None):
                         "Note: a native call holding the GIL longer than "
                         "the timeout starves the stamping thread — size the "
                         "timeout above your longest compile")
+    p.add_argument("--elastic_min_nprocs", type=int, default=0,
+                   help="scale-in floor: when > 0, a restart after a crash "
+                        "or hang RE-RENDEZVOUSES WITH THE SURVIVING WORLD "
+                        "SIZE (failed ranks are dropped, down to this "
+                        "minimum) instead of respawning the full world — "
+                        "the reference's elastic scale-in event (fleet/"
+                        "elastic/manager.py). The script must derive its "
+                        "parallel degrees from PADDLE_TRAINERS_NUM and "
+                        "resume via the distributed checkpoint's "
+                        "reshard-on-load. 0 (default) = fixed world")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -79,9 +89,11 @@ class _Proc:
 
 
 def _spawn(args, restart_round: int,
-           elastic_store: Optional[str] = None) -> List[_Proc]:
+           elastic_store: Optional[str] = None,
+           nproc_override: Optional[int] = None) -> List[_Proc]:
     os.makedirs(args.log_dir, exist_ok=True)
-    nproc = args.nproc_per_node
+    nproc = nproc_override if nproc_override is not None \
+        else args.nproc_per_node
     world = args.nnodes * nproc
     # fresh rendezvous every round: a restarted job must not collide with
     # stale state from the previous coordinator (SURVEY §5 elastic)
@@ -161,9 +173,9 @@ def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
                     print(f"rank {p.rank} exited with {rc} "
                           f"(log: {p.log_path}); peers terminated",
                           file=sys.stderr)
-                    return rc
+                    return rc, [p.rank]
             if alive == 0:
-                return 0
+                return 0, []
             if monitor is not None and ttl > 0 and \
                     time.time() - last_hb_check > min(1.0, ttl / 3):
                 last_hb_check = time.time()
@@ -174,13 +186,13 @@ def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
                           f"> {ttl}s — declaring hung, terminating the job",
                           file=sys.stderr)
                     _kill_all(procs, grace=3.0, force_first=hung)
-                    return HUNG_RC
+                    return HUNG_RC, list(hung)
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:
             if q.popen.poll() is None:
                 q.popen.terminate()
-        return 130
+        return 130, []
 
 
 def launch_procs(args) -> int:
@@ -201,18 +213,32 @@ def launch_procs(args) -> int:
         except Exception as e:  # native lib unavailable: degrade gracefully
             print(f"elastic: heartbeat monitor unavailable ({e}); "
                   f"exit-code watching only", file=sys.stderr)
-    world = args.nnodes * args.nproc_per_node
+    min_nprocs = int(getattr(args, "elastic_min_nprocs", 0) or 0)
+    cur_nproc = args.nproc_per_node
     rc = 1
     try:
         for attempt in range(rounds):
             if monitor is not None:
-                monitor.clear(world)   # stale stamps from the last round
+                monitor.clear(args.nnodes * cur_nproc)  # stale stamps
             procs = _spawn(args, attempt,
-                           elastic_store=monitor.addr if monitor else None)
-            rc = _watch(procs, monitor=monitor, ttl=ttl)
+                           elastic_store=monitor.addr if monitor else None,
+                           nproc_override=cur_nproc)
+            rc, bad = _watch(procs, monitor=monitor, ttl=ttl)
             if rc == 0 or rc == 130:
                 return rc
             if attempt < rounds - 1:
+                if min_nprocs > 0 and bad:
+                    # scale-in: drop the failed/hung ranks from the world
+                    # (ref: elastic manager's scale event -> rendezvous
+                    # re-init with the surviving node set); the script
+                    # resumes at the NEW topology via the distributed
+                    # checkpoint's reshard-on-load
+                    new_nproc = max(min_nprocs, cur_nproc - len(bad))
+                    if new_nproc != cur_nproc:
+                        print(f"elastic: scale-in {cur_nproc} -> "
+                              f"{new_nproc} procs (lost ranks {bad})",
+                              file=sys.stderr)
+                    cur_nproc = new_nproc
                 print(f"elastic: restarting job "
                       f"(attempt {attempt + 2}/{rounds})", file=sys.stderr)
     finally:

@@ -259,3 +259,187 @@ class LeNet(Layer):
             from ..ops.manipulation import flatten
             x = self.fc(flatten(x, 1))
         return x
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (ref: vision.models.MobileNetV3Small/Large — the backbone the
+# detection family rides on; PP-LCNet/PP-YOLOE ecosystem target)
+# ---------------------------------------------------------------------------
+
+from ..nn import Hardsigmoid, Hardswish, Identity, Sigmoid  # noqa: E402
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, cin, cout, k, stride=1, groups=1, act=None):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride,
+                           padding=(k - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = ({"relu": ReLU, "hardswish": Hardswish}.get(act) or
+                    Identity)()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class SEModule(Layer):
+    def __init__(self, channel, reduction=4):
+        super().__init__()
+        self.avg_pool = AdaptiveAvgPool2D(1)
+        self.conv1 = Conv2D(channel, channel // reduction, 1)
+        self.relu = ReLU()
+        self.conv2 = Conv2D(channel // reduction, channel, 1)
+        self.hs = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hs(self.conv2(self.relu(self.conv1(self.avg_pool(x)))))
+        return x * s
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cin, mid, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        self.expand = (ConvBNLayer(cin, mid, 1, act=act)
+                       if mid != cin else Identity())
+        self.dw = ConvBNLayer(mid, mid, k, stride=stride, groups=mid,
+                              act=act)
+        self.se = SEModule(mid) if use_se else Identity()
+        self.pw = ConvBNLayer(mid, cout, 1, act=None)
+
+    def forward(self, x):
+        y = self.pw(self.se(self.dw(self.expand(x))))
+        return x + y if self.use_res else y
+
+
+# (kernel, exp, out, se, act, stride) per block — the reference configs
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+_MBV3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    """ref: vision.models.MobileNetV3Small/Large. ``feature_only=True``
+    returns the three detection-scale feature maps (stride 8/16/32) for
+    FPN necks (vision/detection.py)."""
+
+    def __init__(self, config, last_channels, scale=1.0,
+                 num_classes=1000, feature_only=False):
+        super().__init__()
+        self.feature_only = feature_only
+        self.num_classes = num_classes
+        cin = _make_divisible(16 * scale)
+        self.stem = ConvBNLayer(3, cin, 3, stride=2, act="hardswish")
+        blocks = []
+        self._feat_idx = []
+        strides_seen = 2
+        for i, (k, exp, cout, se, act, stride) in enumerate(config):
+            mid = _make_divisible(exp * scale)
+            co = _make_divisible(cout * scale)
+            blocks.append(InvertedResidual(cin, mid, co, k, stride, se, act))
+            cin = co
+            strides_seen *= stride
+            # record the LAST block of each stride level (C3/C4/C5)
+        self.blocks = Sequential(*blocks)
+        self._config = config
+        self._scale = scale
+        self.out_channels = cin
+        if not feature_only:
+            mid = _make_divisible(last_channels * scale)
+            self.last_conv = ConvBNLayer(cin, mid, 1, act="hardswish")
+            self.pool = AdaptiveAvgPool2D(1)
+            self.fc = Linear(mid, num_classes)
+
+    def _feature_cuts(self):
+        """Indices after which stride increases (C3=stride8 ... C5=32)."""
+        cuts = []
+        stride = 2  # stem
+        for i, (_, _, _, _, _, s) in enumerate(self._config):
+            if s == 2:
+                stride *= s
+                if stride in (16, 32):  # the block BEFORE this one closes
+                    cuts.append(i - 1)  # the previous level
+        cuts.append(len(self._config) - 1)
+        return cuts[-3:]
+
+    def forward(self, x):
+        x = self.stem(x)
+        if not self.feature_only:
+            x = self.blocks(x)
+            x = self.last_conv(x)
+            x = self.pool(x)
+            from ..ops.manipulation import flatten
+            return self.fc(flatten(x, 1))
+        feats = []
+        cuts = set(self._feature_cuts())
+        for i, blk in enumerate(self.blocks):
+            x = blk(x)
+            if i in cuts:
+                feats.append(x)
+        return feats
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3(_MBV3_SMALL, 1024, scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3(_MBV3_LARGE, 1280, scale=scale, **kwargs)
+
+
+class AlexNet(Layer):
+    """ref: vision.models.AlexNet."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2))
+        self.pool = AdaptiveAvgPool2D(6)
+        self.classifier = Sequential(
+            Dropout(dropout), Linear(256 * 36, 4096), ReLU(),
+            Dropout(dropout), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        from ..ops.manipulation import flatten
+        return self.classifier(flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return AlexNet(**kwargs)
+
+
+__all__ += ["MobileNetV3", "mobilenet_v3_small", "mobilenet_v3_large",
+            "AlexNet", "alexnet"]

@@ -223,3 +223,182 @@ class TestLaunchDistributedInit:
         assert rc == 0, logs
         for r in range(2):
             assert "COLLECTIVE_OK" in logs[r], logs[r]
+
+
+class TestElasticScaleIn:
+    @pytest.mark.slow
+    def test_2proc_loses_worker_restarts_as_1proc_and_resumes(self,
+                                                              tmp_path):
+        """r3 VERDICT #7 end to end: a 2-proc dp job loses rank 1 (crash);
+        with --elastic_min_nprocs the launcher re-rendezvouses with the
+        SURVIVING world size (1), and the script resumes from the
+        distributed checkpoint — reshard-on-load across the topology
+        change — and converges (ref: fleet/elastic/manager.py scale-in)."""
+        import numpy as np
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        script = _script(tmp_path, f"""
+            import os, sys, time
+            sys.path.insert(0, "/root/repo")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            rnd = int(os.environ["PADDLE_RESTART_ROUND"])
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                           save_state_dict)
+            ck = {str(ckpt_dir)!r}
+            state = {{"w": paddle.to_tensor(np.zeros((3, 1), np.float32)),
+                      "step": paddle.to_tensor(np.zeros((), np.float32))}}
+            if os.path.exists(os.path.join(ck, "metadata.pkl")):
+                load_state_dict(state, ck)   # reshard-on-load: the ckpt was
+                # written by the 2-proc round, read by the 1-proc round
+                open(os.path.join(ck, "resumed.w%d.r%d" % (world, rank)),
+                     "w").write(str(float(state["step"])))
+            start = int(float(state["step"]))
+            # dp data shard: each rank sees its slice; world=1 sees all
+            rng = np.random.RandomState(0)
+            Xall = rng.randn(32, 3).astype("float32")
+            X = paddle.to_tensor(Xall[rank::world])
+            y = X.matmul(paddle.to_tensor(
+                np.array([[1.5], [-2.0], [0.5]], np.float32)))
+            wt = paddle.Parameter(state["w"].numpy())
+            for step in range(start, 10):
+                loss = ((X.matmul(wt) - y) ** 2).mean()
+                loss.backward()
+                wt.set_value(wt.numpy() - 0.1 * wt.grad.numpy())
+                wt.clear_grad()
+                if rank == 0:
+                    save_state_dict(
+                        {{"w": paddle.to_tensor(wt.numpy()),
+                          "step": paddle.to_tensor(np.float32(step + 1))}},
+                        ck)
+                if rnd == 0 and rank == 1 and step == 3:
+                    os._exit(17)          # rank 1 dies -> scale-in event
+                if rnd == 0:
+                    time.sleep(0.2)       # keep rank 0 mid-training so the
+                    # kill-all lands before it finishes (no barrier in this
+                    # toy script)
+            final = float(((X.matmul(wt) - y) ** 2).mean())
+            open(os.path.join(ck, "final.w%d.r%d" % (world, rank)),
+                 "w").write(str(final))
+        """)
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)
+        try:
+            rc = launch_procs(_args(tmp_path, script, "--nproc_per_node",
+                                    "2", "--max_restart", "2",
+                                    "--elastic_min_nprocs", "1"))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        log0 = (tmp_path / "log" / "workerlog.0").read_text()
+        assert rc == 0, log0
+        # round 1 ran at world=1 and RESUMED from the 2-proc checkpoint
+        resumed = list(ckpt_dir.glob("resumed.w1.r0"))
+        assert resumed, list(ckpt_dir.iterdir())
+        assert float(resumed[0].read_text()) >= 3
+        final = float((ckpt_dir / "final.w1.r0").read_text())
+        assert np.isfinite(final) and final < 0.5, final
+        # no 2-proc final: the original world never finished
+        assert not list(ckpt_dir.glob("final.w2.*"))
+
+
+class TestMultiProcessTrainingParity:
+    @pytest.mark.slow
+    def test_2proc_dp_training_loss_parity_vs_serial(self, tmp_path):
+        """r3 VERDICT #10: launcher-driven 2-PROCESS dp training (real
+        jax.distributed over the localhost rendezvous) reproduces the
+        single-process loss trajectory exactly — closing the gap between
+        'the collective works' and 'training works multi-process'
+        (SURVEY §4 loss-parity-vs-serial oracle, test_dist_base pattern)."""
+        import json
+        import numpy as np
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        body = f"""
+            import os, sys, json
+            sys.path.insert(0, "/root/repo")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            # one device per process: the parent test env carries the
+            # 8-device virtual-mesh flag, which must not leak in
+            os.environ["XLA_FLAGS"] = " ".join(
+                f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "host_platform_device_count" not in f)
+            import numpy as np
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_default_matmul_precision", "highest")
+            world_env = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            from paddle_tpu.distributed import init_parallel_env
+            if world_env > 1:
+                init_parallel_env()
+            import jax.numpy as jnp
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+
+            # tiny 2-layer MLP, pure-functional dp train loop: batch is
+            # dp-sharded over the GLOBAL device mesh (2 procs x 1 dev);
+            # GSPMD inserts the cross-process grad all-reduce
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs.reshape(-1), ("dp",))
+            rng = np.random.RandomState(0)
+            W1 = jnp.asarray(rng.randn(4, 16).astype("float32") * 0.3)
+            W2 = jnp.asarray(rng.randn(16, 1).astype("float32") * 0.3)
+            X = rng.randn(8, 4).astype("float32")
+            Y = (X @ rng.randn(4, 1)).astype("float32")
+
+            def loss_fn(params, x, y):
+                W1, W2 = params
+                h = jnp.tanh(x @ W1)
+                return (((h @ W2) - y) ** 2).mean()
+
+            def step(params, x, y):
+                l, g = jax.value_and_grad(loss_fn)(params, x, y)
+                return [p - 0.1 * gg for p, gg in zip(params, g)], l
+
+            jstep = jax.jit(step)
+            bs = NamedSharding(mesh, P("dp"))
+            from jax.experimental import multihost_utils
+            if jax.process_count() > 1:
+                Xg = multihost_utils.host_local_array_to_global_array(
+                    X[jax.process_index()::2], mesh, P("dp"))
+                Yg = multihost_utils.host_local_array_to_global_array(
+                    Y[jax.process_index()::2], mesh, P("dp"))
+            else:
+                # serial oracle: SAME global batch ORDER as the dp run's
+                # interleaved shards
+                order = np.argsort(
+                    np.arange(8).reshape(2, 4).T.reshape(-1), kind="stable")
+                idx = np.concatenate([np.arange(0, 8, 2),
+                                      np.arange(1, 8, 2)])
+                Xg, Yg = jnp.asarray(X[idx]), jnp.asarray(Y[idx])
+            params = [W1, W2]
+            losses = []
+            for _ in range(6):
+                params, l = jstep(params, Xg, Yg)
+                losses.append(float(l))
+            if int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0:
+                tag = "dp" if world_env > 1 else "serial"
+                open(os.path.join({str(out_dir)!r}, tag + ".json"),
+                     "w").write(json.dumps(losses))
+        """
+        script = _script(tmp_path, body)
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)
+        try:
+            rc2 = launch_procs(_args(tmp_path, script,
+                                     "--nproc_per_node", "2"))
+            rc1 = launch_procs(_args(tmp_path, script,
+                                     "--nproc_per_node", "1"))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        logs = [(tmp_path / "log" / f"workerlog.{r}").read_text()
+                for r in range(2)]
+        assert rc2 == 0 and rc1 == 0, logs
+        dp = json.loads((out_dir / "dp.json").read_text())
+        serial = json.loads((out_dir / "serial.json").read_text())
+        np.testing.assert_allclose(dp, serial, rtol=1e-5, atol=1e-6)
+        assert dp[-1] < dp[0]    # and it actually trains
