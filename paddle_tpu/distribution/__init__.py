@@ -377,3 +377,16 @@ def _kl_exp_exp(p, q):
     def f(pr, qr):
         return jnp.log(pr) - jnp.log(qr) + qr / pr - 1.0
     return forward_op("kl_exp_exp", f, [p.rate, q.rate])
+
+
+# r4 families (Beta/Gamma/Dirichlet/Multinomial/... + transforms) — imported
+# at the end so they can extend the KL registry defined above
+from .families import (AffineTransform, Beta, Binomial, Cauchy, Chi2,   # noqa: E402
+                       Dirichlet, ExpTransform, Gamma, Geometric,
+                       LogNormal, Multinomial, Poisson, SigmoidTransform,
+                       StudentT, Transform, TransformedDistribution)
+
+__all__ += ["Beta", "Gamma", "Dirichlet", "Multinomial", "Binomial",
+            "Poisson", "Chi2", "StudentT", "LogNormal", "Geometric",
+            "Cauchy", "Transform", "AffineTransform", "ExpTransform",
+            "SigmoidTransform", "TransformedDistribution"]

@@ -1,0 +1,548 @@
+"""Distribution families beyond the core set (r3 VERDICT missing #6).
+
+Parity target: ``python/paddle/distribution/`` in the reference (~25
+classes: Beta, Gamma, Dirichlet, Multinomial, Binomial, Poisson, Chi2,
+StudentT, LogNormal, Geometric, Cauchy, plus TransformedDistribution with
+its transform algebra). Samplers ride jax.random; densities ride
+jax.scipy.stats (scipy is the test oracle).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+from jax.scipy import stats as jstats
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor, forward_op
+from ..ops.random import _next_key
+from . import Distribution, kl_divergence, register_kl
+
+__all__ = ["Beta", "Gamma", "Dirichlet", "Multinomial", "Binomial",
+           "Poisson", "Chi2", "StudentT", "LogNormal", "Geometric",
+           "Cauchy", "Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TransformedDistribution"]
+
+
+def _f32(x):
+    return ensure_tensor(x).astype("float32")
+
+
+class Beta(Distribution):
+    """Beta(alpha, beta) on (0, 1) (ref: paddle.distribution.Beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _f32(alpha)
+        self.beta = _f32(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._value.shape,
+                                              self.beta._value.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return (self.alpha * self.beta) / (s * s * (s + 1.0))
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "beta_rsample",
+            lambda a, b: jax.random.beta(key, a, b, shape),
+            [self.alpha, self.beta])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return forward_op(
+            "beta_log_prob",
+            lambda v, a, b: jstats.beta.logpdf(v, a, b),
+            [ensure_tensor(value), self.alpha, self.beta])
+
+    def entropy(self):
+        def impl(a, b):
+            s = a + b
+            return (jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b) + (s - 2) * jsp.digamma(s))
+        return forward_op("beta_entropy", impl, [self.alpha, self.beta])
+
+
+class Gamma(Distribution):
+    """Gamma(concentration, rate) (ref: paddle.distribution.Gamma)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _f32(concentration)
+        self.rate = _f32(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._value.shape, self.rate._value.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate * self.rate)
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "gamma_rsample",
+            lambda a, r: jax.random.gamma(key, a, shape) / r,
+            [self.concentration, self.rate])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return forward_op(
+            "gamma_log_prob",
+            lambda v, a, r: jstats.gamma.logpdf(v, a, scale=1.0 / r),
+            [ensure_tensor(value), self.concentration, self.rate])
+
+    def entropy(self):
+        def impl(a, r):
+            return (a - jnp.log(r) + jsp.gammaln(a)
+                    + (1.0 - a) * jsp.digamma(a))
+        return forward_op("gamma_entropy", impl,
+                          [self.concentration, self.rate])
+
+
+class Chi2(Gamma):
+    """Chi-squared with ``df`` degrees of freedom (Gamma(df/2, 1/2))."""
+
+    def __init__(self, df, name=None):
+        self.df = _f32(df)
+        super().__init__(self.df / 2.0, ensure_tensor(0.5))
+
+
+class Dirichlet(Distribution):
+    """Dirichlet(concentration) on the simplex (ref:
+    paddle.distribution.Dirichlet)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _f32(concentration)
+        shape = self.concentration._value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        from ..ops import math as _m
+        s = _m.sum(self.concentration, axis=-1, keepdim=True)
+        return self.concentration / s
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        return forward_op(
+            "dirichlet_rsample",
+            lambda a: jax.random.dirichlet(key, a, shape[:-1]),
+            [self.concentration])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def impl(v, a):
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    - jnp.sum(jsp.gammaln(a), -1)
+                    + jsp.gammaln(jnp.sum(a, -1)))
+        return forward_op("dirichlet_log_prob", impl,
+                          [ensure_tensor(value), self.concentration])
+
+    def entropy(self):
+        def impl(a):
+            a0 = jnp.sum(a, -1)
+            K = a.shape[-1]
+            lnB = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+            return (lnB + (a0 - K) * jsp.digamma(a0)
+                    - jnp.sum((a - 1) * jsp.digamma(a), -1))
+        return forward_op("dirichlet_entropy", impl, [self.concentration])
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) (ref:
+    paddle.distribution.Multinomial)."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _f32(probs)
+        shape = self.probs._value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    def sample(self, shape=()):
+        key = _next_key()
+        n = self.total_count
+
+        def impl(p):
+            idx = jax.random.categorical(
+                key, jnp.log(p), axis=-1,
+                shape=tuple(shape) + self.batch_shape + (n,))
+            return jax.nn.one_hot(idx, p.shape[-1]).sum(-2)
+        return forward_op("multinomial_sample", impl, [self.probs],
+                          differentiable=False)
+
+    def log_prob(self, value):
+        def impl(v, p):
+            return (jsp.gammaln(jnp.float32(self.total_count + 1))
+                    - jnp.sum(jsp.gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return forward_op("multinomial_log_prob", impl,
+                          [ensure_tensor(value), self.probs])
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (ref: paddle.distribution.Binomial)."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _f32(probs)
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs) * float(self.total_count)
+
+    def sample(self, shape=()):
+        key = _next_key()
+        n = self.total_count
+
+        def impl(p):
+            u = jax.random.uniform(
+                key, tuple(shape) + self.batch_shape + (n,))
+            return (u < p[..., None]).sum(-1).astype(jnp.float32)
+        return forward_op("binomial_sample", impl, [self.probs],
+                          differentiable=False)
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def impl(v, p):
+            return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return forward_op("binomial_log_prob", impl,
+                          [ensure_tensor(value), self.probs])
+
+
+class Poisson(Distribution):
+    """Poisson(rate) (ref: paddle.distribution.Poisson)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _f32(rate)
+        super().__init__(self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "poisson_sample",
+            lambda r: jax.random.poisson(key, r, shape).astype(jnp.float32),
+            [self.rate], differentiable=False)
+
+    def log_prob(self, value):
+        return forward_op(
+            "poisson_log_prob",
+            lambda v, r: jstats.poisson.logpmf(v, r),
+            [ensure_tensor(value), self.rate])
+
+    def entropy(self):
+        # series-free surrogate: exact only asymptotically; match the
+        # reference's closed-form small-rate correction via logpmf sum over
+        # a truncated support
+        def impl(r):
+            k = jnp.arange(0, 64, dtype=jnp.float32)
+            lp = jstats.poisson.logpmf(k[:, None], r.reshape(-1))
+            ent = -(jnp.exp(lp) * lp).sum(0)
+            return ent.reshape(r.shape)
+        return forward_op("poisson_entropy", impl, [self.rate])
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale) (ref: paddle.distribution.StudentT)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _f32(df)
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df._value.shape, self.loc._value.shape,
+            self.scale._value.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "student_t_rsample",
+            lambda d, l, s: l + s * jax.random.t(key, d, shape),
+            [self.df, self.loc, self.scale])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return forward_op(
+            "student_t_log_prob",
+            lambda v, d, l, s: jstats.t.logpdf(v, d, loc=l, scale=s),
+            [ensure_tensor(value), self.df, self.loc, self.scale])
+
+
+class LogNormal(Distribution):
+    """exp(Normal(loc, scale)) (ref: paddle.distribution.LogNormal)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    @property
+    def mean(self):
+        from ..ops import math as _m
+        return _m.exp(self.loc + 0.5 * self.scale * self.scale)
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "lognormal_rsample",
+            lambda l, s: jnp.exp(l + s * jax.random.normal(key, shape)),
+            [self.loc, self.scale])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def impl(v, l, s):
+            lv = jnp.log(v)
+            return (-((lv - l) ** 2) / (2 * s * s) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+        return forward_op("lognormal_log_prob", impl,
+                          [ensure_tensor(value), self.loc, self.scale])
+
+
+class Geometric(Distribution):
+    """Geometric(probs): trials until first success, support {1, 2, ...}
+    (the reference's convention)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _f32(probs)
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.probs
+
+    def sample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+
+        def impl(p):
+            u = jax.random.uniform(key, shape, minval=1e-9)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1.0
+        return forward_op("geometric_sample", impl, [self.probs],
+                          differentiable=False)
+
+    def log_prob(self, value):
+        return forward_op(
+            "geometric_log_prob",
+            lambda v, p: (v - 1.0) * jnp.log1p(-p) + jnp.log(p),
+            [ensure_tensor(value), self.probs])
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (ref: paddle.distribution.Cauchy)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._value.shape,
+                                              self.scale._value.shape))
+
+    def rsample(self, shape=()):
+        key = _next_key()
+        shape = tuple(shape) + self.batch_shape
+        return forward_op(
+            "cauchy_rsample",
+            lambda l, s: l + s * jnp.tan(
+                jnp.pi * (jax.random.uniform(key, shape) - 0.5)),
+            [self.loc, self.scale])
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return forward_op(
+            "cauchy_log_prob",
+            lambda v, l, s: jstats.cauchy.logpdf(v, loc=l, scale=s),
+            [ensure_tensor(value), self.loc, self.scale])
+
+    def entropy(self):
+        return forward_op(
+            "cauchy_entropy",
+            lambda l, s: jnp.broadcast_to(
+                jnp.log(4 * jnp.pi * s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            [self.loc, self.scale])
+
+
+# ---------------------------------------------------------------------------
+# transforms (ref: paddle.distribution.TransformedDistribution + transforms)
+# ---------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * ensure_tensor(x)
+
+    def inverse(self, y):
+        return (ensure_tensor(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops import math as _m
+        return _m.log(_m.abs(self.scale)) + 0.0 * ensure_tensor(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        from ..ops import math as _m
+        return _m.exp(ensure_tensor(x))
+
+    def inverse(self, y):
+        from ..ops import math as _m
+        return _m.log(ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return ensure_tensor(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return forward_op("sigmoid_t", jax.nn.sigmoid, [ensure_tensor(x)])
+
+    def inverse(self, y):
+        return forward_op("sigmoid_t_inv",
+                          lambda v: jnp.log(v) - jnp.log1p(-v),
+                          [ensure_tensor(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return forward_op(
+            "sigmoid_t_ldj",
+            lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+            [ensure_tensor(x)])
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms; log_prob via
+    the change-of-variables formula."""
+
+    def __init__(self, base: Distribution, transforms: Sequence[Transform],
+                 name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = ensure_tensor(value)
+        ldj_total = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            ldj_total = ldj if ldj_total is None else ldj_total + ldj
+            y = x
+        lp = self.base.log_prob(y)
+        return lp - ldj_total if ldj_total is not None else lp
+
+
+# ---------------------------------------------------------------------------
+# KL registrations
+# ---------------------------------------------------------------------------
+
+@register_kl(Beta, Beta)
+def _kl_beta(p: Beta, q: Beta):
+    def impl(pa, pb, qa, qb):
+        ps = pa + pb
+        return (jsp.betaln(qa, qb) - jsp.betaln(pa, pb)
+                + (pa - qa) * jsp.digamma(pa) + (pb - qb) * jsp.digamma(pb)
+                + (qa - pa + qb - pb) * jsp.digamma(ps))
+    return forward_op("kl_beta", impl, [p.alpha, p.beta, q.alpha, q.beta])
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p: Gamma, q: Gamma):
+    def impl(pa, pr, qa, qr):
+        return ((pa - qa) * jsp.digamma(pa) - jsp.gammaln(pa)
+                + jsp.gammaln(qa) + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr - pr) / pr)
+    return forward_op("kl_gamma", impl,
+                      [p.concentration, p.rate, q.concentration, q.rate])
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p: Dirichlet, q: Dirichlet):
+    def impl(pa, qa):
+        p0 = jnp.sum(pa, -1)
+        return (jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pa), -1)
+                - jsp.gammaln(jnp.sum(qa, -1))
+                + jnp.sum(jsp.gammaln(qa), -1)
+                + jnp.sum((pa - qa) * (jsp.digamma(pa)
+                                       - jsp.digamma(p0[..., None])), -1))
+    return forward_op("kl_dirichlet", impl,
+                      [p.concentration, q.concentration])
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p: Poisson, q: Poisson):
+    def impl(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return forward_op("kl_poisson", impl, [p.rate, q.rate])

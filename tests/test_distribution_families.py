@@ -1,0 +1,150 @@
+"""r4 distribution families vs scipy.stats oracles (SURVEY §2.3
+sparse/linalg/fft/distribution row; ref: python/paddle/distribution/)."""
+
+import numpy as np
+import pytest
+import scipy.integrate as si
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+    yield
+
+
+class TestLogProbOracles:
+    CASES = [
+        (lambda: D.Beta(2.0, 3.0), lambda v: st.beta.logpdf(v, 2, 3), 0.3),
+        (lambda: D.Gamma(2.0, 1.5),
+         lambda v: st.gamma.logpdf(v, 2, scale=1 / 1.5), 1.2),
+        (lambda: D.Chi2(4.0), lambda v: st.chi2.logpdf(v, 4), 2.5),
+        (lambda: D.Poisson(3.0), lambda v: st.poisson.logpmf(v, 3), 2.0),
+        (lambda: D.StudentT(5.0, 1.0, 2.0),
+         lambda v: st.t.logpdf(v, 5, 1, 2), 0.5),
+        (lambda: D.LogNormal(0.5, 0.8),
+         lambda v: st.lognorm.logpdf(v, 0.8, scale=np.exp(0.5)), 1.3),
+        (lambda: D.Cauchy(0.0, 2.0),
+         lambda v: st.cauchy.logpdf(v, 0, 2), 1.0),
+        (lambda: D.Binomial(10, 0.4),
+         lambda v: st.binom.logpmf(v, 10, 0.4), 4.0),
+        (lambda: D.Geometric(0.3),
+         lambda v: st.geom.logpmf(v, 0.3), 3.0),
+    ]
+
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_matches_scipy(self, i):
+        mk, oracle, v = self.CASES[i]
+        got = float(mk().log_prob(v).numpy())
+        np.testing.assert_allclose(got, oracle(v), rtol=1e-5, atol=1e-5)
+
+    def test_dirichlet_multinomial(self):
+        d = D.Dirichlet(np.asarray([1.0, 2.0, 3.0], np.float32))
+        x = np.asarray([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(float(d.log_prob(x).numpy()),
+                                   st.dirichlet.logpdf(x, [1, 2, 3]),
+                                   rtol=1e-4, atol=1e-4)
+        m = D.Multinomial(6, np.asarray([0.2, 0.3, 0.5], np.float32))
+        cnt = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            float(m.log_prob(cnt).numpy()),
+            st.multinomial.logpmf(cnt, 6, [0.2, 0.3, 0.5]),
+            rtol=1e-5, atol=1e-5)
+
+
+class TestSampling:
+    def test_sample_moments(self):
+        for dist, mean, var in [
+                (D.Beta(2.0, 3.0), 2 / 5, (2 * 3) / (25 * 6)),
+                (D.Gamma(3.0, 2.0), 1.5, 0.75),
+                (D.Poisson(4.0), 4.0, 4.0),
+                (D.LogNormal(0.0, 0.5), np.exp(0.125), None)]:
+            s = np.asarray(dist.sample((4000,)).numpy())
+            np.testing.assert_allclose(s.mean(), mean, rtol=0.1)
+            if var is not None:
+                np.testing.assert_allclose(s.var(), var, rtol=0.25)
+
+    def test_dirichlet_simplex(self):
+        d = D.Dirichlet(np.asarray([2.0, 2.0, 2.0], np.float32))
+        s = np.asarray(d.sample((100,)).numpy())
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        assert (s >= 0).all()
+
+    def test_multinomial_counts(self):
+        m = D.Multinomial(8, np.asarray([0.5, 0.5], np.float32))
+        s = np.asarray(m.sample((50,)).numpy())
+        assert (s.sum(-1) == 8).all()
+
+    def test_rsample_differentiable(self):
+        """Pathwise gradient through Beta/Gamma rsample (jax.random's
+        implicit-reparameterization samplers)."""
+        a = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        b = D.Beta(a, 3.0)
+        s = b.rsample((64,)).mean()
+        s.backward()
+        assert a.grad is not None and np.isfinite(float(a.grad.numpy()))
+
+
+class TestEntropyAndKL:
+    def test_entropies(self):
+        np.testing.assert_allclose(float(D.Beta(2., 3.).entropy().numpy()),
+                                   st.beta.entropy(2, 3), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.Gamma(2., 1.5).entropy().numpy()),
+            st.gamma.entropy(2, scale=1 / 1.5), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.Dirichlet(np.asarray([1., 2., 3.],
+                                         np.float32)).entropy().numpy()),
+            st.dirichlet.entropy([1, 2, 3]), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            float(D.Poisson(3.0).entropy().numpy()),
+            st.poisson.entropy(3), rtol=1e-3)
+
+    def test_kl_numeric(self):
+        kb = float(D.kl_divergence(D.Beta(2., 3.), D.Beta(3., 2.)).numpy())
+        f = (lambda x: st.beta.pdf(x, 2, 3) *
+             (st.beta.logpdf(x, 2, 3) - st.beta.logpdf(x, 3, 2)))
+        np.testing.assert_allclose(kb, si.quad(f, 0, 1)[0], atol=1e-4)
+        kg = float(D.kl_divergence(D.Gamma(2., 1.), D.Gamma(3., 2.)).numpy())
+        g = (lambda x: st.gamma.pdf(x, 2) *
+             (st.gamma.logpdf(x, 2) - st.gamma.logpdf(x, 3, scale=0.5)))
+        np.testing.assert_allclose(kg, si.quad(g, 0, np.inf)[0], atol=1e-4)
+        kp = float(D.kl_divergence(D.Poisson(3.), D.Poisson(5.)).numpy())
+        ks = sum(st.poisson.pmf(k, 3) * (st.poisson.logpmf(k, 3)
+                                         - st.poisson.logpmf(k, 5))
+                 for k in range(40))
+        np.testing.assert_allclose(kp, ks, atol=1e-5)
+
+
+class TestTransformed:
+    def test_exp_normal_is_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.5, 0.8),
+                                       [D.ExpTransform()])
+        ln = D.LogNormal(0.5, 0.8)
+        for v in (0.4, 1.3, 3.0):
+            np.testing.assert_allclose(float(td.log_prob(v).numpy()),
+                                       float(ln.log_prob(v).numpy()),
+                                       rtol=1e-5)
+
+    def test_affine_normal(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), [D.AffineTransform(2.0, 3.0)])
+        for v in (-1.0, 2.0, 5.0):
+            np.testing.assert_allclose(float(td.log_prob(v).numpy()),
+                                       st.norm.logpdf(v, 2.0, 3.0),
+                                       rtol=1e-5)
+
+    def test_sigmoid_chain(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.SigmoidTransform()])
+        s = np.asarray(td.sample((200,)).numpy())
+        assert ((s > 0) & (s < 1)).all()
+        # logistic-normal density via change of variables
+        v = 0.3
+        x = np.log(v / (1 - v))
+        expect = st.norm.logpdf(x) - (np.log(v) + np.log(1 - v))
+        np.testing.assert_allclose(float(td.log_prob(v).numpy()), expect,
+                                   rtol=1e-4)
