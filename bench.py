@@ -685,7 +685,7 @@ def main():
         _warm = False
     _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0,
-                  "detect": 120.0} if _warm else
+                  "detect": 150.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0, "detect": 240.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),

@@ -12,16 +12,14 @@ from __future__ import annotations
 import math
 from typing import Sequence
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.scipy import special as jsp
 from jax.scipy import stats as jstats
 
-from ..core.tensor import Tensor
 from ..ops._helpers import ensure_tensor, forward_op
 from ..ops.random import _next_key
-from . import Distribution, kl_divergence, register_kl
+from . import Distribution, register_kl
 
 __all__ = ["Beta", "Gamma", "Dirichlet", "Multinomial", "Binomial",
            "Poisson", "Chi2", "StudentT", "LogNormal", "Geometric",
@@ -59,7 +57,10 @@ class Beta(Distribution):
             lambda a, b: jax.random.beta(key, a, b, shape),
             [self.alpha, self.beta])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         return forward_op(
@@ -100,7 +101,10 @@ class Gamma(Distribution):
             lambda a, r: jax.random.gamma(key, a, shape) / r,
             [self.concentration, self.rate])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         return forward_op(
@@ -147,7 +151,10 @@ class Dirichlet(Distribution):
             lambda a: jax.random.dirichlet(key, a, shape[:-1]),
             [self.concentration])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         def impl(v, a):
@@ -186,10 +193,14 @@ class Multinomial(Distribution):
         n = self.total_count
 
         def impl(p):
+            logits = jnp.log(p)
+            batch = logits.shape[:-1]
+            # categorical wants the BATCH dims trailing in `shape`; draw the
+            # n trials as a leading axis and reduce it away
             idx = jax.random.categorical(
-                key, jnp.log(p), axis=-1,
-                shape=tuple(shape) + self.batch_shape + (n,))
-            return jax.nn.one_hot(idx, p.shape[-1]).sum(-2)
+                key, logits, shape=tuple(shape) + (n,) + batch)
+            oh = jax.nn.one_hot(idx, p.shape[-1])
+            return oh.sum(axis=len(tuple(shape)))
         return forward_op("multinomial_sample", impl, [self.probs],
                           differentiable=False)
 
@@ -223,9 +234,9 @@ class Binomial(Distribution):
         n = self.total_count
 
         def impl(p):
-            u = jax.random.uniform(
-                key, tuple(shape) + self.batch_shape + (n,))
-            return (u < p[..., None]).sum(-1).astype(jnp.float32)
+            return jax.random.binomial(
+                key, n, p,
+                shape=tuple(shape) + self.batch_shape).astype(jnp.float32)
         return forward_op("binomial_sample", impl, [self.probs],
                           differentiable=False)
 
@@ -270,14 +281,18 @@ class Poisson(Distribution):
             [ensure_tensor(value), self.rate])
 
     def entropy(self):
-        # series-free surrogate: exact only asymptotically; match the
-        # reference's closed-form small-rate correction via logpmf sum over
-        # a truncated support
         def impl(r):
-            k = jnp.arange(0, 64, dtype=jnp.float32)
-            lp = jstats.poisson.logpmf(k[:, None], r.reshape(-1))
-            ent = -(jnp.exp(lp) * lp).sum(0)
-            return ent.reshape(r.shape)
+            rf = r.reshape(-1)
+            # exact truncated-support sum where the tail is negligible
+            # (k < 256 covers rate <= ~128 to fp32 accuracy), asymptotic
+            # expansion beyond (Evans: H ~ 0.5 ln(2 pi e r) - 1/(12r) - ...)
+            k = jnp.arange(0, 256, dtype=jnp.float32)
+            lp = jstats.poisson.logpmf(k[:, None], rf)
+            exact = -(jnp.exp(lp) * lp).sum(0)
+            asym = (0.5 * jnp.log(2 * jnp.pi * jnp.e * rf)
+                    - 1.0 / (12 * rf) - 1.0 / (24 * rf * rf)
+                    - 19.0 / (360 * rf ** 3))
+            return jnp.where(rf < 128.0, exact, asym).reshape(r.shape)
         return forward_op("poisson_entropy", impl, [self.rate])
 
 
@@ -304,7 +319,10 @@ class StudentT(Distribution):
             lambda d, l, s: l + s * jax.random.t(key, d, shape),
             [self.df, self.loc, self.scale])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         return forward_op(
@@ -335,7 +353,10 @@ class LogNormal(Distribution):
             lambda l, s: jnp.exp(l + s * jax.random.normal(key, shape)),
             [self.loc, self.scale])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         def impl(v, l, s):
@@ -393,7 +414,10 @@ class Cauchy(Distribution):
                 jnp.pi * (jax.random.uniform(key, shape) - 0.5)),
             [self.loc, self.scale])
 
-    sample = rsample
+    def sample(self, shape=()):
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
 
     def log_prob(self, value):
         return forward_op(
@@ -526,6 +550,13 @@ def _kl_gamma(p: Gamma, q: Gamma):
                 + pa * (qr - pr) / pr)
     return forward_op("kl_gamma", impl,
                       [p.concentration, p.rate, q.concentration, q.rate])
+
+
+# Chi2 IS-A Gamma but kl_divergence dispatches on exact type — register
+# the Gamma formula for every (sub)type pairing
+register_kl(Chi2, Chi2)(_kl_gamma)
+register_kl(Chi2, Gamma)(_kl_gamma)
+register_kl(Gamma, Chi2)(_kl_gamma)
 
 
 @register_kl(Dirichlet, Dirichlet)
