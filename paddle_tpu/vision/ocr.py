@@ -1,0 +1,114 @@
+"""OCR text recognition: CRNN backbone + BiLSTM neck + CTC head.
+
+Capability target: the reference ecosystem's PP-OCR recognition stack
+(PaddleOCR ``ppocr/modeling``: MobileNet/ResNet rec backbones, the
+SequenceEncoder rnn neck, CTCHead; BASELINE.json configs[2] names PP-OCRv4
+as a capability target). The detection side of PP-OCR is the
+``vision/detection.py`` family; this module is the recognizer.
+
+TPU notes: the conv stack pools height to 1 so the sequence axis is the
+image WIDTH (static); the BiLSTM neck compiles as lax.scan per direction;
+CTC loss is the in-graph alpha recursion (`nn.functional.ctc_loss`);
+greedy CTC decode (collapse repeats, drop blanks) is a static-shape scan
+emitting a fixed-width token buffer + validity count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import forward_op
+from ..nn import LSTM, BatchNorm2D, Conv2D, Linear, MaxPool2D, ReLU, Sequential
+from ..nn.layer import Layer
+
+__all__ = ["CRNN", "crnn_mobilenet", "ctc_greedy_decode"]
+
+
+class _ConvBlock(Layer):
+    def __init__(self, cin, cout, pool):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, 3, padding=1, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = ReLU()
+        self.pool = MaxPool2D(pool, pool) if pool else None
+
+    def forward(self, x):
+        x = self.act(self.bn(self.conv(x)))
+        return self.pool(x) if self.pool else x
+
+
+class CRNN(Layer):
+    """Conv stack (H -> 1) + BiLSTM neck + CTC projection head.
+
+    ``forward(images [B, C, H, W])`` -> logits ``[T, B, num_classes]``
+    (paddle CTC layout, T = W / 4); class 0 is the CTC blank.
+    """
+
+    def __init__(self, num_classes: int, in_channels: int = 3,
+                 image_height: int = 32, hidden_size: int = 96):
+        super().__init__()
+        if image_height % 16:
+            raise ValueError(f"image_height {image_height} must be a "
+                             "multiple of 16 (four height-halvings)")
+        self.num_classes = num_classes
+        # pools: (2,2) (2,2) -> T = W/4; then height-only (2,1) pools
+        self.features = Sequential(
+            _ConvBlock(in_channels, 32, (2, 2)),
+            _ConvBlock(32, 64, (2, 2)),
+            _ConvBlock(64, 96, (2, 1)),
+            _ConvBlock(96, 96, (2, 1)),
+        )
+        self._feat_h = image_height // 16
+        self.neck = LSTM(96 * self._feat_h, hidden_size,
+                         direction="bidirectional")
+        self.head = Linear(2 * hidden_size, num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape, transpose
+        f = self.features(x)                       # [B, C, H/16, W/4]
+        B, C, H, W = f.shape
+        seq = reshape(transpose(f, [0, 3, 1, 2]), [B, W, C * H])
+        out, _ = self.neck(seq)                    # [B, T, 2*hidden]
+        logits = self.head(out)                    # [B, T, num_classes]
+        return transpose(logits, [1, 0, 2])        # [T, B, C] (CTC layout)
+
+
+def crnn_mobilenet(num_classes: int, **kw) -> CRNN:
+    """PP-OCR-rec-shaped factory (conv backbone scaled for mobile)."""
+    return CRNN(num_classes, **kw)
+
+
+def ctc_greedy_decode(logits, blank: int = 0, merge_repeats: bool = True):
+    """Greedy CTC decoding with STATIC shapes: argmax per step, collapse
+    repeats, drop blanks — emitted as a fixed-width ``[B, T]`` token buffer
+    (left-aligned, padded with ``blank``) plus per-row valid counts.
+
+    ``logits [T, B, C]`` -> ``(tokens [B, T], lengths [B])``; jit-safe (the
+    scatter of kept tokens is a sort by emit-index, not a dynamic gather).
+    """
+    v = logits._value if isinstance(logits, Tensor) else jnp.asarray(logits)
+
+    def impl(lp):
+        T, B, C = lp.shape
+        ids = jnp.argmax(lp, axis=-1).T               # [B, T]
+        if merge_repeats:
+            prev = jnp.concatenate(
+                [jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]], axis=1)
+            keep = (ids != blank) & (ids != prev)
+        else:
+            keep = ids != blank
+        # left-align kept tokens: emit position = cumsum(keep) - 1; a
+        # stable argsort over (not kept, position) pulls kept tokens first
+        order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+        toks = jnp.take_along_axis(ids, order, axis=1)
+        lengths = keep.sum(axis=1)
+        mask = jnp.arange(T)[None, :] < lengths[:, None]
+        return jnp.where(mask, toks, blank), lengths
+
+    return forward_op("ctc_greedy_decode", impl, [v],
+                      differentiable=False)
