@@ -367,12 +367,15 @@ def attach_specs():
     explicit.update(_fft_specs())
     explicit.update(_set_specs())
     explicit.update(_composite_specs())
+    explicit.update(_bulk_specs())
 
     attached = 0
     for name, spec in explicit.items():
         d = OP_REGISTRY.get(name)
         if d is not None:
             d.sweep = spec
+            if d.public is None:   # older registrations stored the public
+                d.public = d.fn    # wrapper as fn (signal/geometric style)
             attached += 1
     for name, d in OP_REGISTRY.items():
         if d.sweep is not None or d.category in ("unary", "binary"):
@@ -398,3 +401,388 @@ def sweep_coverage():
     covered = sum(1 for d in OP_REGISTRY.values()
                   if d.category in ("unary", "binary") or d.sweep is not None)
     return covered, total
+
+
+def _bulk_specs():
+    """r4 second batch: matmul/manipulation/indexing/creation/search/loss/
+    pool/segment groups. Oracle = numpy where a clean counterpart exists,
+    else run-only (finiteness; the op keeps its hand-written domain test)."""
+    sp = {}
+
+    def add(name, spec):
+        sp[name] = spec
+
+    i32 = np.int32
+
+    # ---- matmul family ----
+    add("matmul", lambda rng: [((_x(rng, (3, 4)), _x(rng, (4, 2))), {},
+                                lambda a, b, **k: a @ b)])
+    add("mm", lambda rng: [((_x(rng, (3, 4)), _x(rng, (4, 2))), {},
+                            lambda a, b, **k: a @ b)])
+    add("bmm", lambda rng: [((_x(rng, (2, 3, 4)), _x(rng, (2, 4, 2))), {},
+                             lambda a, b, **k: a @ b)])
+    add("mv", lambda rng: [((_x(rng, (3, 4)), _x(rng, (4,))), {},
+                            lambda a, b, **k: a @ b)])
+    add("dot", lambda rng: [((_x(rng, (5,)), _x(rng, (5,))), {},
+                             lambda a, b, **k: np.dot(a, b))])
+    add("cross", lambda rng: [((_x(rng, (4, 3)), _x(rng, (4, 3))), {},
+                               lambda a, b, **k: np.cross(a, b))])
+    add("kron", lambda rng: [((_x(rng, (2, 2)), _x(rng, (3, 2))), {},
+                              lambda a, b, **k: np.kron(a, b))])
+    add("tensordot", lambda rng: [((_x(rng, (3, 4)), _x(rng, (4, 5))),
+                                   {"axes": 1},
+                                   lambda a, b, **k: np.tensordot(a, b, 1))])
+    add("addmm", lambda rng: [
+        ((_x(rng, (3, 2)), _x(rng, (3, 4)), _x(rng, (4, 2))), {},
+         lambda i, a, b, **k: i + a @ b)])
+    add("baddbmm", lambda rng: [
+        ((_x(rng, (2, 3, 2)), _x(rng, (2, 3, 4)), _x(rng, (2, 4, 2))), {},
+         lambda i, a, b, **k: i + a @ b)])
+    add("multi_dot", lambda rng: [
+        (([_x(rng, (2, 3)), _x(rng, (3, 4)), _x(rng, (4, 2))],), {},
+         lambda ms, **k: np.linalg.multi_dot(ms))])
+    add("einsum", lambda rng: [
+        (("ij,jk->ik", _x(rng, (3, 4)), _x(rng, (4, 2))), {},
+         lambda eq, a, b, **k: np.einsum(eq, a, b))])
+    add("outer", lambda rng: [((_x(rng, (3,)), _x(rng, (4,))), {},
+                               lambda a, b, **k: np.outer(a, b))])
+    add("inner", lambda rng: [((_x(rng, (3, 4)), _x(rng, (2, 4))), {},
+                               lambda a, b, **k: np.inner(a, b))])
+
+    # ---- manipulation ----
+    add("reshape", lambda rng: [((_x(rng, (3, 4)), [2, 6]), {},
+                                 lambda a, *r, **k: a.reshape(2, 6))])
+    add("transpose", lambda rng: [((_x(rng, (2, 3, 4)), [2, 0, 1]), {},
+                                   lambda a, *r, **k: a.transpose(2, 0, 1))])
+    add("unsqueeze", lambda rng: [((_x(rng, (3, 4)), 1), {},
+                                   lambda a, *r, **k: a[:, None])])
+    add("tile", lambda rng: [((_x(rng, (2, 3)), [2, 2]), {},
+                              lambda a, *r, **k: np.tile(a, (2, 2)))])
+    add("broadcast_to", lambda rng: [((_x(rng, (1, 4)), [3, 4]), {},
+                                      lambda a, *r, **k:
+                                      np.broadcast_to(a, (3, 4)))])
+    add("expand", lambda rng: [((_x(rng, (1, 4)), [3, 4]), {},
+                                lambda a, *r, **k:
+                                np.broadcast_to(a, (3, 4)))])
+    add("expand_as", lambda rng: [((_x(rng, (1, 4)), _x(rng, (3, 4))), {},
+                                   lambda a, b, **k:
+                                   np.broadcast_to(a, b.shape))])
+    add("moveaxis", lambda rng: [((_x(rng, (2, 3, 4)), 0, 2), {},
+                                  lambda a, *r, **k: np.moveaxis(a, 0, 2))])
+    add("swapaxes", lambda rng: [((_x(rng, (2, 3, 4)), 0, 2), {},
+                                  lambda a, *r, **k: np.swapaxes(a, 0, 2))])
+    add("roll", lambda rng: [((_x(rng, (3, 4)), 2), {},
+                              lambda a, *r, **k: np.roll(a, 2))])
+    add("flip", lambda rng: [((_x(rng, (3, 4)), 0), {},
+                              lambda a, *r, **k: np.flip(a, 0))])
+    add("chunk", lambda rng: [((_x(rng, (6, 4)), 3), {},
+                               lambda a, *r, **k:
+                               tuple(np.split(a, 3, 0)))])
+    add("split", lambda rng: [((_x(rng, (6, 4)), 3), {},
+                               lambda a, *r, **k:
+                               tuple(np.split(a, 3, 0)))])
+    add("hsplit", lambda rng: [((_x(rng, (4, 6)), 3), {},
+                                lambda a, *r, **k:
+                                tuple(np.hsplit(a, 3)))])
+    add("vsplit", lambda rng: [((_x(rng, (6, 4)), 3), {},
+                                lambda a, *r, **k:
+                                tuple(np.vsplit(a, 3)))])
+    add("dsplit", lambda rng: [((_x(rng, (2, 3, 6)), 3), {},
+                                lambda a, *r, **k:
+                                tuple(np.dsplit(a, 3)))])
+    add("tensor_split", lambda rng: [((_x(rng, (7, 4)), 3), {},
+                                      lambda a, *r, **k:
+                                      tuple(np.array_split(a, 3, 0)))])
+    add("repeat_interleave", lambda rng: [((_x(rng, (3, 2)), 2), {},
+                                           lambda a, *r, **k:
+                                           np.repeat(a, 2, axis=None))])
+    add("unflatten", lambda rng: [((_x(rng, (2, 6)), 1, [2, 3]), {},
+                                   lambda a, *r, **k:
+                                   a.reshape(2, 2, 3))])
+    add("cast", lambda rng: [((_x(rng), "float32"), {}, None)])
+    add("reverse", lambda rng: [((_x(rng, (3, 4)), 0), {},
+                                 lambda a, *r, **k: np.flip(a, 0))])
+    add("crop", lambda rng: [((_x(rng, (4, 5)), [2, 3], [1, 1]), {},
+                              lambda a, *r, **k: a[1:3, 1:4])])
+    add("strided_slice", lambda rng: [
+        ((_x(rng, (6, 5)), [0], [1], [5], [2]), {},
+         lambda a, *r, **k: a[1:5:2])])
+    add("pad", lambda rng: [((_x(rng, (3, 4)), [1, 1, 0, 0]), {},
+                             None)])
+    add("meshgrid", lambda rng: [
+        (([np.arange(3, dtype=np.float32),
+           np.arange(4, dtype=np.float32)],), {}, None)])
+    add("atleast_1d", lambda rng: [((_x(rng, (3,)),), {},
+                                    lambda a, **k: np.atleast_1d(a))])
+    add("atleast_2d", lambda rng: [((_x(rng, (3,)),), {},
+                                    lambda a, **k: np.atleast_2d(a))])
+    add("atleast_3d", lambda rng: [((_x(rng, (3,)),), {},
+                                    lambda a, **k: np.atleast_3d(a))])
+
+    # ---- indexing / scatter ----
+    idx2 = np.asarray([0, 2], i32)
+    add("take", lambda rng: [((_x(rng, (3, 4)), np.asarray([1, 5], i32)),
+                              {}, lambda a, i, **k: a.ravel()[i])])
+    add("take_along_axis", lambda rng: [
+        ((_x(rng, (3, 4)), np.asarray([[0], [1], [2]], i32), 1), {},
+         lambda a, i, ax, **k: np.take_along_axis(a, i, 1))])
+    add("index_select", lambda rng: [
+        ((_x(rng, (4, 3)), idx2), {},
+         lambda a, i, **k: a[i])])
+    add("gather", lambda rng: [
+        ((_x(rng, (4, 3)), idx2), {},
+         lambda a, i, **k: a[i])])
+    add("gather_nd", lambda rng: [
+        ((_x(rng, (4, 3)), np.asarray([[0, 1], [2, 2]], i32)), {},
+         lambda a, i, **k: a[i[:, 0], i[:, 1]])])
+    add("index_sample", lambda rng: [
+        ((_x(rng, (3, 4)), np.asarray([[0, 1], [1, 2], [2, 3]], i32)), {},
+         lambda a, i, **k: np.take_along_axis(a, i, 1))])
+    add("masked_fill", lambda rng: [
+        ((_x(rng, (3, 4)), _x(rng, (3, 4)) > 0, 9.0), {},
+         lambda a, m, v, **k: np.where(m, v, a))])
+    add("masked_scatter", lambda rng: [
+        ((_x(rng, (2, 3)), np.asarray([[1, 0, 1], [0, 1, 0]], bool),
+          _x(rng, (6,))), {}, None)])
+    add("index_fill", lambda rng: [
+        ((_x(rng, (4, 3)), idx2, 0, 7.0), {},
+         lambda a, i, ax, v, **k: _np_index_fill(a, i, v))])
+    add("index_add", lambda rng: [
+        ((_x(rng, (4, 3)), idx2, 0, _x(rng, (2, 3))), {}, None)])
+    add("index_put", lambda rng: [
+        ((_x(rng, (4, 3)), (idx2, np.asarray([0, 1], i32)),
+          np.asarray([5.0, 6.0], np.float32)), {}, None)])
+    add("put_along_axis", lambda rng: [
+        ((_x(rng, (3, 4)), np.asarray([[0], [1], [2]], i32),
+          9.0, 1), {}, None)])
+    add("scatter", lambda rng: [
+        ((_x(rng, (4, 3)), idx2, _x(rng, (2, 3))), {}, None)])
+    add("scatter_nd", lambda rng: [
+        ((np.asarray([[1], [3]], i32), _x(rng, (2, 3)), [5, 3]), {},
+         None)])
+    add("scatter_nd_add", lambda rng: [
+        ((_x(rng, (5, 3)), np.asarray([[1], [3]], i32),
+          _x(rng, (2, 3))), {}, None)])
+    add("select_scatter", lambda rng: [
+        ((_x(rng, (3, 4)), _x(rng, (4,)), 0, 1), {}, None)])
+    add("slice_scatter", lambda rng: [
+        ((_x(rng, (6, 3)), _x(rng, (2, 3)), [0], [1], [5], [2]), {},
+         None)])
+    add("diagonal_scatter", lambda rng: [
+        ((_x(rng, (3, 3)), _x(rng, (3,))), {}, None)])
+
+    add("multiplex", lambda rng: [
+        (([_x(rng, (3, 4)), _x(rng, (3, 4))],
+          np.asarray([0, 1, 0], i32)), {}, None)])
+    add("shard_index", lambda rng: [
+        ((np.asarray([[1], [6]], np.int64), 8, 2, -1), {}, None)])
+
+    # ---- creation ----
+    add("arange", lambda rng: [((0, 10, 2), {},
+                                lambda *a, **k: np.arange(0, 10, 2))])
+    add("linspace", lambda rng: [((0.0, 1.0, 5), {},
+                                  lambda *a, **k:
+                                  np.linspace(0, 1, 5,
+                                              dtype=np.float32))])
+    add("logspace", lambda rng: [((0.0, 2.0, 3), {},
+                                  lambda *a, **k:
+                                  np.logspace(0, 2, 3,
+                                              dtype=np.float32))])
+    add("eye", lambda rng: [((3, 4), {},
+                             lambda *a, **k: np.eye(3, 4,
+                                                    dtype=np.float32))])
+    add("ones", lambda rng: [(([2, 3],), {},
+                              lambda *a, **k: np.ones((2, 3),
+                                                      np.float32))])
+    add("zeros", lambda rng: [(([2, 3],), {},
+                               lambda *a, **k: np.zeros((2, 3),
+                                                        np.float32))])
+    add("full", lambda rng: [(([2, 3], 7.0), {},
+                              lambda *a, **k: np.full((2, 3), 7.0,
+                                                      np.float32))])
+    add("full_like", lambda rng: [((_x(rng), 7.0), {},
+                                   lambda a, v, **k:
+                                   np.full_like(a, 7.0))])
+    add("empty", lambda rng: [(([2, 3],), {}, None)])
+    add("empty_like", lambda rng: [((_x(rng),), {}, None)])
+    add("complex", lambda rng: [((_x(rng), _x(rng)), {},
+                                 lambda a, b, **k: a + 1j * b)])
+    add("broadcast_shape", lambda rng: [(([2, 1, 3], [4, 3]), {}, None)])
+
+    # ---- search / compare ----
+    add("searchsorted", lambda rng: [
+        ((np.sort(_x(rng, (6,))), _x(rng, (4,))), {},
+         lambda s, v, **k: np.searchsorted(s, v))])
+    add("bucketize", lambda rng: [
+        ((_x(rng, (4,)), np.sort(_x(rng, (5,)))), {},
+         lambda v, s, **k: np.searchsorted(s, v))])
+    add("topk", lambda rng: [((_x(rng, (3, 6)), 2), {},
+                              lambda a, kk, **k:
+                              (np.sort(a, -1)[:, ::-1][:, :2],
+                               np.argsort(-a, -1, kind="stable")[:, :2]))])
+    add("kthvalue", lambda rng: [((_x(rng, (3, 6)), 2), {}, None)])
+    add("isclose", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: np.isclose(a, b, 1e-5, 1e-8))])
+    add("allclose", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: np.allclose(a, b, 1e-5, 1e-8))])
+    add("equal_all", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: np.array_equal(a, b))])
+    add("isin", lambda rng: [
+        ((np.asarray([1, 2, 3, 4], i32), np.asarray([2, 4], i32)), {},
+         lambda a, t, **k: np.isin(a, t))])
+
+    # ---- elementwise leftovers ----
+    add("lerp", lambda rng: [
+        ((_x(rng), _x(rng), 0.3), {},
+         lambda a, b, w, **k: a + 0.3 * (b - a))])
+    add("floor_mod", lambda rng: [
+        ((_pos(rng), _pos(rng)), {},
+         lambda a, b, **k: np.mod(a, b))])
+    add("mod", lambda rng: [
+        ((_pos(rng), _pos(rng)), {},
+         lambda a, b, **k: np.mod(a, b))])
+    add("pow", lambda rng: [
+        ((_pos(rng), 2.0), {}, lambda a, b, **k: a ** 2.0)])
+    add("quantile", lambda rng: [
+        ((_x(rng, (16,)), 0.5), {},
+         lambda a, q, **k: np.quantile(a, 0.5).astype(np.float32))])
+    add("nanquantile", lambda rng: [
+        ((_x(rng, (16,)), 0.5), {},
+         lambda a, q, **k: np.nanquantile(a, 0.5).astype(np.float32))])
+    add("renorm", lambda rng: [((_x(rng, (3, 4)), 2.0, 0, 1.0), {}, None)])
+    add("dist", lambda rng: [((_x(rng), _x(rng)), {},
+                              lambda a, b, **k:
+                              np.linalg.norm((a - b).ravel()))])
+
+    # ---- linalg solves ----
+    def spd3(rng):
+        m = _x(rng, (3, 3))
+        return m @ m.T + 3 * np.eye(3, dtype=np.float32)
+    add("solve", lambda rng: [((spd3(rng), _x(rng, (3,))), {},
+                               lambda a, b, **k: np.linalg.solve(a, b))])
+    add("cholesky_solve", lambda rng: [
+        ((_x(rng, (3,)), np.linalg.cholesky(spd3(rng)).astype(np.float32)),
+         {}, None)])
+    add("triangular_solve", lambda rng: [
+        ((np.tril(spd3(rng)).astype(np.float32), _x(rng, (3, 1))),
+         {"upper": False}, None)])
+    add("lstsq", lambda rng: [((_x(rng, (5, 3)), _x(rng, (5, 1))), {},
+                               None)])
+    add("matrix_power", lambda rng: [
+        ((spd3(rng), 3), {},
+         lambda a, n, **k: np.linalg.matrix_power(a, 3))])
+    add("lu_unpack", lambda rng: [
+        ((np.asarray([[4.0, 2.0], [0.5, 2.0]], np.float32),
+          np.asarray([2, 2], i32)), {}, None)])
+
+    # ---- losses / nn functional ----
+    t32 = (0.1 + 0.8 * np.random.default_rng(3).random((4, 3))
+           ).astype(np.float32)
+    add("l1_loss", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: np.abs(a - b).mean())])
+    add("mse_loss", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: ((a - b) ** 2).mean())])
+    add("smooth_l1_loss", lambda rng: [((_x(rng), _x(rng)), {}, None)])
+    add("huber_loss", lambda rng: [((_x(rng), _x(rng)), {}, None)])
+    add("log_loss", lambda rng: [((t32, (t32 > 0.5).astype(np.float32)),
+                                  {}, None)])
+    add("binary_cross_entropy", lambda rng: [
+        ((t32, (t32 > 0.5).astype(np.float32)), {}, None)])
+    add("binary_cross_entropy_with_logits", lambda rng: [
+        ((_x(rng), ( _x(rng) > 0).astype(np.float32)), {}, None)])
+    add("nll_loss", lambda rng: [
+        ((np.log(t32 / t32.sum(-1, keepdims=True)),
+          np.asarray([0, 1, 2, 0], np.int64)), {}, None)])
+    add("cross_entropy", lambda rng: [
+        ((_x(rng, (4, 3)), np.asarray([0, 1, 2, 0], np.int64)), {}, None)])
+    add("softmax_with_cross_entropy", lambda rng: [
+        ((_x(rng, (4, 3)), np.asarray([[0], [1], [2], [0]], np.int64)),
+         {}, None)])
+    add("cosine_similarity", lambda rng: [
+        ((_x(rng), _x(rng)), {},
+         lambda a, b, **k: (a * b).sum(-1) /
+         (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)))])
+    add("one_hot", lambda rng: [
+        ((np.asarray([0, 2, 1], i32), 4), {},
+         lambda a, n, **k: np.eye(4, dtype=np.float32)[a])])
+    add("embedding", lambda rng: [
+        ((np.asarray([0, 2], i32), _x(rng, (5, 4))), {},
+         lambda i, w, **k: w[i])])
+    add("linear", lambda rng: [
+        ((_x(rng, (2, 4)), _x(rng, (4, 3)), _x(rng, (3,))), {},
+         lambda x, w, b, **k: x @ w + b)])
+
+    # ---- pools / convs: run-only legs (hand-tested with oracles elsewhere)
+    for n, shape, extra in (
+            ("avg_pool1d", (1, 2, 8), (2,)), ("avg_pool2d", (1, 2, 8, 8),
+                                              (2,)),
+            ("avg_pool3d", (1, 1, 4, 4, 4), (2,)),
+            ("max_pool1d", (1, 2, 8), (2,)), ("max_pool2d", (1, 2, 8, 8),
+                                              (2,)),
+            ("max_pool3d", (1, 1, 4, 4, 4), (2,)),
+            ("adaptive_avg_pool1d", (1, 2, 8), (2,)),
+            ("adaptive_avg_pool2d", (1, 2, 8, 8), (2,)),
+            ("adaptive_avg_pool3d", (1, 1, 4, 4, 4), (2,)),
+            ("adaptive_max_pool1d", (1, 2, 8), (2,)),
+            ("adaptive_max_pool2d", (1, 2, 8, 8), (2,)),
+            ("adaptive_max_pool3d", (1, 1, 4, 4, 4), (2,))):
+        add(n, (lambda shape=shape, extra=extra:
+                (lambda rng: [((_x(rng, shape),) + extra, {}, None)]))())
+    for n, xs, ws in (("conv1d", (1, 2, 8), (3, 2, 3)),
+                      ("conv2d", (1, 2, 8, 8), (3, 2, 3, 3)),
+                      ("conv3d", (1, 1, 6, 6, 6), (2, 1, 3, 3, 3))):
+        add(n, (lambda xs=xs, ws=ws:
+                (lambda rng: [((_x(rng, xs), _x(rng, ws)), {}, None)]))())
+
+    # ---- segments (numpy oracle) ----
+    seg = np.asarray([0, 0, 1, 2, 2], i32)
+
+    def seg_oracle(red):
+        def o(x, s, **k):
+            return np.stack([red(x[s == g]) for g in range(int(s.max()) + 1)])
+        return o
+    add("segment_sum", lambda rng: [
+        ((_x(rng, (5, 3)), seg), {},
+         seg_oracle(lambda v: v.sum(0)))])
+    add("segment_mean", lambda rng: [
+        ((_x(rng, (5, 3)), seg), {},
+         seg_oracle(lambda v: v.mean(0)))])
+    add("segment_max", lambda rng: [
+        ((_x(rng, (5, 3)), seg), {},
+         seg_oracle(lambda v: v.max(0)))])
+    add("segment_min", lambda rng: [
+        ((_x(rng, (5, 3)), seg), {},
+         seg_oracle(lambda v: v.min(0)))])
+
+    # ---- random / signal: run-only (statistical tests live elsewhere) ----
+    for n, args in (("rand", ([2, 3],)), ("randn", ([2, 3],)),
+                    ("randint", (0, 5, [2, 3])), ("randperm", (6,)),
+                    ("uniform", ([2, 3],)), ("normal", (0.0, 1.0, [2, 3])),
+                    ("standard_normal", ([2, 3],)),
+                    ("standard_gamma", (2.0, [2, 3]))):
+        add(n, (lambda args=args:
+                (lambda rng: [(args, {}, None)]))())
+    add("stft", lambda rng: [
+        ((_x(rng, (1, 256)), 64), {"hop_length": 32,
+                                   "window": np.hanning(64).astype(
+                                       np.float32)}, None)])
+    add("frame", lambda rng: [
+        ((_x(rng, (1, 64)), 16, 8), {}, None)])
+    return sp
+
+
+def _np_index_fill(a, i, v):
+    out = a.copy()
+    out[i] = v
+    return out
+
+
+def _np_fill_diag(a, v):
+    out = a.copy()
+    np.fill_diagonal(out, v)
+    return out

@@ -111,5 +111,6 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     return forward_op("istft", impl, [t])
 
 
-register_op("stft", lambda v: v, "Short-time Fourier transform.")
-register_op("istft", lambda v: v, "Inverse STFT (windowed overlap-add).")
+register_op("stft", stft, "Short-time Fourier transform.", public=stft)
+register_op("istft", istft, "Inverse STFT (windowed overlap-add).",
+            public=istft)
