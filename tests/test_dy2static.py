@@ -311,3 +311,95 @@ class TestReviewRegressions:
         # without gradients it runs fine
         out = g(t([1.0]))
         assert float(out.numpy()) == 4.0
+
+
+class TestCountedForToScan:
+    """r3 VERDICT weak #3: `for i in range(n)` over tensor-carried loop vars
+    lowers to jit.scan (one trace, differentiable) instead of trace-time
+    unrolling; non-conforming loops keep exact python semantics."""
+
+    def test_parity_and_engagement(self):
+        import paddle_tpu.jit.dy2static as D
+
+        def f(x, n):
+            y = x
+            for i in range(n):
+                y = y * 2.0 + 0.1
+            return y
+
+        g = ast_transform(f)
+        x = t([1.0])
+        np.testing.assert_allclose(g(x, 5).numpy(), f(x, 5).numpy(),
+                                   rtol=1e-6)
+        hits = []
+        orig = D.convert_range_for
+        D.convert_range_for = lambda *a: hits.append(a) or orig(*a)
+        try:
+            g(x, 7)
+        finally:
+            D.convert_range_for = orig
+        assert hits, "rewrite did not engage"
+
+    def test_gradients_flow_through_scan(self):
+        def h(w):
+            y = w
+            for i in range(4):
+                y = y * 2.0
+            return y.sum()
+
+        hh = ast_transform(h)
+        w = t([1.0], sg=False)
+        loss = hh(w)
+        loss.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [16.0])
+
+    def test_scan_lowering_single_trace(self):
+        """Under jit the loop must NOT unroll: op count in the jaxpr is
+        trip-count independent."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import _wrap_value
+
+        def f(x):
+            y = x
+            for i in range(64):
+                y = y * 2.0 + 0.1
+            return y
+
+        g = ast_transform(f)
+        jaxpr = jax.make_jaxpr(
+            lambda v: g(_wrap_value(v, stop_gradient=True))._value)(
+                jnp.ones((2,)))
+        assert any(e.primitive.name == "scan"
+                   for e in jaxpr.eqns), jaxpr
+        assert len(jaxpr.eqns) < 20   # 64 iterations did not unroll
+
+    def test_shape_growing_body_falls_back(self):
+        def grow(x, n):
+            y = x
+            for i in range(n):
+                y = paddle.concat([y, y], axis=0)
+            return y
+
+        gg = ast_transform(grow)
+        assert gg(t([1.0]), 3).shape == [8]
+
+    def test_index_read_after_loop_keeps_python(self):
+        def tail(x, n):
+            for i in range(n):
+                x = x + 1.0
+            return x, i
+
+        tt = ast_transform(tail)
+        out, last = tt(t([0.0]), 4)
+        assert last == 3
+        np.testing.assert_allclose(out.numpy(), [4.0])
+
+    def test_python_only_carry_unchanged(self):
+        def acc(n):
+            s = 0
+            for i in range(n):
+                s = s + i
+            return s
+
+        assert ast_transform(acc)(5) == 10
