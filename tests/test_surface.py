@@ -728,3 +728,52 @@ class TestLBFGS:
         first = float(closure())
         loss = opt.step(closure)
         assert float(loss) < first * 0.05
+
+
+class TestSparseAttention:
+    """r4: sparse.nn.functional.attention (CSR-masked SDPA; ref:
+    paddle.sparse.nn.functional.attention)."""
+
+    def test_csr_mask_matches_dense_oracle(self):
+        import paddle_tpu.sparse as sparse
+        rng = np.random.default_rng(0)
+        B, H, S, D = 2, 2, 8, 4
+        q = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(
+            np.float32))
+        k = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(
+            np.float32))
+        v = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(
+            np.float32))
+        dense = np.tril(np.ones((S, S), np.float32))
+        coo = sparse.sparse_coo_tensor(np.stack(np.nonzero(dense)),
+                                       dense[dense > 0], (S, S))
+        out = sparse.attention(q, k, v, coo.to_sparse_csr())
+        s = np.einsum("bhqd,bhkd->bhqk", q.numpy(), k.numpy()) / np.sqrt(D)
+        s = np.where(dense[None, None] > 0, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-5)
+
+    def test_key_padding_mask_and_namespace(self):
+        import paddle_tpu.sparse as sparse
+        rng = np.random.default_rng(1)
+        B, H, S, D = 1, 2, 6, 4
+        q = paddle.to_tensor(rng.standard_normal((B, H, S, D)).astype(
+            np.float32))
+        dense = np.ones((S, S), np.float32)
+        csr = sparse.sparse_coo_tensor(
+            np.stack(np.nonzero(dense)), dense[dense > 0],
+            (S, S)).to_sparse_csr()
+        kp = np.ones((B, S), np.float32)
+        kp[:, -2:] = 0
+        out = sparse.nn.functional.attention(
+            q, q, q, csr, key_padding_mask=paddle.to_tensor(kp))
+        # padded keys receive zero attention: output equals attention over
+        # the first S-2 keys only
+        s = np.einsum("bhqd,bhkd->bhqk", q.numpy(), q.numpy()) / np.sqrt(D)
+        s = s[..., :4]
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, q.numpy()[:, :, :4])
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-5)
