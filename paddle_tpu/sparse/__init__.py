@@ -574,19 +574,7 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     return forward_op("sparse_attention", impl, [q, k, v])
 
 
-class _SparseNNFunctional:
-    attention = staticmethod(attention)
-
-
-class _SparseNN:
-    functional = _SparseNNFunctional()
-
-    class ReLU:
-        """sparse.nn.ReLU (ref parity): relu on the values, pattern kept."""
-
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
-__all__ += ["attention", "nn"]
+# extend the existing sparse.nn namespace (defined above) rather than
+# shadowing it
+nn.functional.attention = staticmethod(attention)
+__all__ += ["attention"]
