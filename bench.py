@@ -593,6 +593,10 @@ _R2_ANCHORS = {
     "resnet50_throughput": 964.0,     # img/s (round 2)
     "bert_base_throughput": 605.0,    # ex/s (round 2)
     "sdxl_attn_64x64": 10.5,          # ms, lower is better (round 3, bf16)
+    # round-4 anchors for the new metrics (first recorded round)
+    "llama_decode_tok_s_b8": 2500.0,  # tok/s (r4; 2000-2530 observed)
+    "ppyoloe_mbv3_throughput": 400.0,  # img/s (r4)
+    "llama_train_mfu_tuned": 56.4,    # % (r4)
 }
 
 
@@ -784,7 +788,8 @@ def main():
                               "detect_compile_s": round(dt["compile_s"], 1),
                               "loss": round(dt["loss"], 3)}), file=sys.stderr)
             _emit("ppyoloe_mbv3_throughput", round(dt["images_per_s"], 1),
-                  "img/s", 1.0)  # first recorded round — self-anchored
+                  "img/s", dt["images_per_s"] /
+                  _R2_ANCHORS["ppyoloe_mbv3_throughput"])
         section("detect", _detect)
     if "roofline" in chosen:   # explicit-only: a diagnostic, not a metric
         def _roof():
@@ -796,14 +801,15 @@ def main():
             m, st = bench_tuned(backend, peak, steps=args.steps)
             print(json.dumps({"tuned_step_s": round(st, 4),
                               "tuned_mfu": round(m, 2)}), file=sys.stderr)
-            _emit("llama_train_mfu_tuned", round(m, 2), "%", m / 50.0)
+            _emit("llama_train_mfu_tuned", round(m, 2), "%",
+                  m / _R2_ANCHORS["llama_train_mfu_tuned"])
         section("tuned", _tuned)
     if want("decode"):
         def _decode():
             d = bench_decode(backend)
             print(json.dumps(d), file=sys.stderr)
             _emit("llama_decode_tok_s_b8", d["decode_b8_tok_s"], "tok/s",
-                  1.0)  # first recorded round — self-anchored
+                  d["decode_b8_tok_s"] / _R2_ANCHORS["llama_decode_tok_s_b8"])
         section("decode", _decode)
     if want("wide"):
         def _wide():
