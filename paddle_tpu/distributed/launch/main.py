@@ -169,11 +169,16 @@ def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
                 if rc is None:
                     alive += 1
                 elif rc != 0:
+                    # Collect every rank already dead BEFORE killing peers
+                    # (post-kill, terminated peers also report nonzero) so a
+                    # scale-in round sheds all lost ranks at once.
+                    dead = [q.rank for q in procs
+                            if q.popen.poll() not in (None, 0)]
                     _kill_all(procs)
-                    print(f"rank {p.rank} exited with {rc} "
-                          f"(log: {p.log_path}); peers terminated",
-                          file=sys.stderr)
-                    return rc, [p.rank]
+                    print(f"rank(s) {dead} exited nonzero (first: rank "
+                          f"{p.rank} rc {rc}, log: {p.log_path}); peers "
+                          f"terminated", file=sys.stderr)
+                    return rc, dead
             if alive == 0:
                 return 0, []
             if monitor is not None and ttl > 0 and \

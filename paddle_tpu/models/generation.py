@@ -202,6 +202,7 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
+        top_k = min(top_k, logits.shape[-1])
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:

@@ -35,17 +35,17 @@ __all__ = ["UNet2DConditionModel", "sdxl_unet_mini", "timestep_embedding"]
 
 def timestep_embedding(t, dim: int, max_period: float = 10000.0):
     """Sinusoidal timestep embedding [B] -> [B, dim] (DDPM convention)."""
-    tv = t._value if isinstance(t, Tensor) else t
-
     def impl(tv):
         half = dim // 2
         freqs = jnp.exp(-math.log(max_period) *
                         jnp.arange(half, dtype=jnp.float32) / half)
         args = tv.astype(jnp.float32)[:, None] * freqs[None]
         return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    # Tensors pass through unchanged (a to-host round trip would break
+    # under a to_static trace); only raw arrays/lists get wrapped.
     return forward_op("timestep_embedding", impl,
-                      [tv if isinstance(tv, Tensor) else
-                       __import__("paddle_tpu").to_tensor(np.asarray(tv))])
+                      [t if isinstance(t, Tensor) else
+                       __import__("paddle_tpu").to_tensor(np.asarray(t))])
 
 
 def _groups(c: int, cap: int = 8) -> int:

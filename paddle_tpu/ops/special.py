@@ -313,16 +313,35 @@ def scatter_reduce(x, index, updates, reduce: str = "sum", axis: int = 0,
         raise ValueError(f"unknown reduce {reduce!r}; options "
                          f"{sorted(modes)}")
 
+    identities = {"add": 0, "multiply": 1, "max": None, "min": None}
+
     def impl(v, idx, upd):
         oidx = jnp.indices(upd.shape)
         gather = tuple(idx if d == axis else oidx[d]
                        for d in range(v.ndim))
-        at = v.at[gather]
+        base = v
+        if not include_self:
+            # Destination values must not participate: overwrite every
+            # scattered position with the reduce identity first (for
+            # amax/amin, the dtype's -inf/+inf extremum).
+            mode = modes[reduce]
+            if identities[mode] is None:
+                if jnp.issubdtype(v.dtype, jnp.inexact):
+                    ident = jnp.array(
+                        -jnp.inf if mode == "max" else jnp.inf, v.dtype)
+                else:
+                    info = jnp.iinfo(v.dtype)
+                    ident = jnp.array(
+                        info.min if mode == "max" else info.max, v.dtype)
+            else:
+                ident = jnp.array(identities[mode], v.dtype)
+            base = v.at[gather].set(jnp.broadcast_to(ident, upd.shape))
+        at = base.at[gather]
         out = getattr(at, modes[reduce])(upd)
         if reduce == "mean":
             cnt = jnp.zeros_like(v).at[gather].add(jnp.ones_like(upd))
-            base = jnp.ones_like(cnt) * (1.0 if include_self else 0.0)
-            out = out / jnp.maximum(cnt + base, 1)
+            self_cnt = jnp.ones_like(cnt) * (1.0 if include_self else 0.0)
+            out = out / jnp.maximum(cnt + self_cnt, 1)
         return out
     return forward_op("scatter_reduce", impl,
                       [ensure_tensor(x), ensure_tensor(index),

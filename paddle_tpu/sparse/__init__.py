@@ -522,6 +522,11 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     practical mask densities; block-SPARSE execution (whole tiles skipped)
     is the `kernels.flash_attention` segment-ids path.
 
+    Eager-only contract (same as the sparse set ops): the CSR pattern is
+    materialized on host, so calling this under ``to_static``/``jit``
+    graph-breaks. The dense mask is cached on the ``sparse_mask`` object —
+    repeated calls with the same pattern skip the host decode.
+
     Shapes: query/key/value ``[B, H, S, D]``; sparse_mask a
     :class:`SparseCsrTensor` with shape ``[B*H, S, S]`` or ``[S, S]``
     (the reference's layout). Returns ``[B, H, S, D]``.
@@ -534,7 +539,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     B, H, S, D = q.shape
 
     if isinstance(sparse_mask, SparseCsrTensor):
-        if len(sparse_mask.shape) == 3:
+        cached = getattr(sparse_mask, "_dense_mask_cache", None)
+        if cached is not None and cached.shape[-2:] == (S, S):
+            mask = cached
+        elif len(sparse_mask.shape) == 3:
             # [B*H, S, S]: per-head patterns — build the stacked dense mask
             crows = _np.asarray(sparse_mask.crows().numpy())
             cols = _np.asarray(sparse_mask.cols().numpy())
@@ -547,8 +555,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
                 for r in range(S):
                     m[i, r, cols[cr[r]:cr[r + 1]]] = True
             mask = m.reshape(B, H, S, S)
+            sparse_mask._dense_mask_cache = mask
         else:
             mask = _csr_to_dense_mask(sparse_mask, S, S)[None, None]
+            sparse_mask._dense_mask_cache = mask
     else:
         mask = _np.asarray(ensure_tensor(sparse_mask).numpy()) != 0
         if mask.ndim == 2:

@@ -3,6 +3,7 @@ with ReduceOnPlateau, weight_norm param removal, expand -1 validation,
 MultiHeadAttention need_weights)."""
 
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 import paddle_tpu as paddle
@@ -99,3 +100,63 @@ def test_mha_need_weights():
     mha.need_weights = False
     out2 = mha(x, x, x)
     np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-5 ADVICE fixes
+# ---------------------------------------------------------------------------
+
+def test_scatter_reduce_include_self_false():
+    # torch.scatter_reduce(include_self=False) oracle values
+    x = paddle.to_tensor(np.array([10.0, 20.0, 30.0], np.float32))
+    idx = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+    upd = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = paddle.scatter_reduce(x, idx, upd, reduce="sum",
+                                include_self=False)
+    np.testing.assert_allclose(out.numpy(), [3.0, 3.0, 30.0])
+    out = paddle.scatter_reduce(x, idx, upd, reduce="prod",
+                                include_self=False)
+    np.testing.assert_allclose(out.numpy(), [2.0, 3.0, 30.0])
+    out = paddle.scatter_reduce(x, idx, upd, reduce="amax",
+                                include_self=False)
+    np.testing.assert_allclose(out.numpy(), [2.0, 3.0, 30.0])
+    out = paddle.scatter_reduce(x, idx, upd, reduce="amin",
+                                include_self=False)
+    np.testing.assert_allclose(out.numpy(), [1.0, 3.0, 30.0])
+    out = paddle.scatter_reduce(x, idx, upd, reduce="mean",
+                                include_self=False)
+    np.testing.assert_allclose(out.numpy(), [1.5, 3.0, 30.0])
+    # include_self=True unchanged
+    out = paddle.scatter_reduce(x, idx, upd, reduce="sum",
+                                include_self=True)
+    np.testing.assert_allclose(out.numpy(), [13.0, 23.0, 30.0])
+
+
+def test_scatter_reduce_include_self_false_int():
+    x = paddle.to_tensor(np.array([5, 7], np.int32))
+    idx = paddle.to_tensor(np.array([0, 0], np.int64))
+    upd = paddle.to_tensor(np.array([2, 3], np.int32))
+    out = paddle.scatter_reduce(x, idx, upd, reduce="amax",
+                                include_self=False)
+    np.testing.assert_array_equal(out.numpy(), [3, 7])
+
+
+def test_timestep_embedding_traces():
+    from paddle_tpu.models.unet import timestep_embedding
+    from paddle_tpu import jit
+
+    def f(t):
+        return timestep_embedding(t, 8)
+
+    t = paddle.to_tensor(np.array([0.0, 5.0], np.float32))
+    eager = f(t).numpy()
+    traced = jit.to_static(f)(t).numpy()
+    np.testing.assert_allclose(eager, traced, rtol=1e-6)
+
+
+def test_sample_top_k_clamped_to_vocab():
+    from paddle_tpu.models.generation import _sample
+    import jax
+    logits = jnp.array([[0.0, 1.0, 2.0]])
+    tok = _sample(logits, jax.random.PRNGKey(0), 1.0, top_k=10, top_p=None)
+    assert int(tok[0]) in (0, 1, 2)
