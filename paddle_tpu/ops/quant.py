@@ -1,0 +1,359 @@
+"""Quantization op family.
+
+Parity targets: the fake-quant training ops
+(``paddle/fluid/operators/fake_quantize_op.*`` — QAT observers), the
+quantize/dequantize_linear pair (``paddle/fluid/operators/quantize_linear_op``),
+and the weight-only inference surface
+(``paddle/incubate/nn/functional/weight_only_linear``, ``weight_quantize`` /
+``weight_dequantize``, ``llm_int8_linear``).
+
+TPU redesign: the reference implements each observer as a stateful CUDA
+kernel mutating scale buffers in place; here every op is a pure function —
+state (moving scales, accumulators) goes in and comes out explicitly, which
+is what makes them jit/scan-compatible under XLA. The weight-only path
+routes through the Pallas int8 stream kernel (``kernels/quant_matmul.py``)
+on TPU backends and an XLA dequant-matmul elsewhere.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_range_abs_max", "fake_quantize_moving_average_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "moving_average_abs_max_scale", "quantize_linear", "dequantize_linear",
+    "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "llm_int8_linear",
+]
+
+
+def _qmax(bit_length: int) -> float:
+    return float((1 << (bit_length - 1)) - 1)
+
+
+# ---------------------------------------------------------------------------
+# fake-quant observers (QAT). Pure: (x, state...) -> (out, new_state...)
+# ---------------------------------------------------------------------------
+
+def fake_quantize_abs_max(x, bit_length: int = 8, name=None):
+    """Per-tensor abs-max quantization: returns ``(q, scale)`` with
+    ``q = round(x / scale * qmax)`` as int round kept in float storage (the
+    reference's fake-quant contract)."""
+    xt = ensure_tensor(x)
+    qmax = _qmax(bit_length)
+
+    def impl(xv):
+        scale = jnp.max(jnp.abs(xv))
+        s = jnp.maximum(scale, 1e-8)
+        return jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax), scale
+
+    return forward_op("fake_quantize_abs_max", impl, [xt],
+                      differentiable=False)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length: int = 8, name=None):
+    """Quantize-then-dequantize (the straight-through QAT forward); returns
+    ``(out, scale)``. Differentiable via the STE: gradient flows as
+    identity within the clip range (jnp formulation uses the same rounding
+    but the tape records the smooth surrogate)."""
+    xt = ensure_tensor(x)
+    qmax = _qmax(bit_length)
+
+    def impl(xv):
+        scale = jnp.max(jnp.abs(xv))
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax)
+        # straight-through estimator: identity gradient through the rounding
+        deq = xv + jax.lax.stop_gradient(q * s / qmax - xv)
+        return deq, scale
+
+    return forward_op("fake_quantize_dequantize_abs_max", impl, [xt])
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
+                                       quant_axis: int = 0, name=None):
+    """Per-channel abs-max quantization along ``quant_axis``; returns
+    ``(q, scales)``."""
+    xt = ensure_tensor(x)
+    qmax = _qmax(bit_length)
+
+    def impl(xv):
+        axes = tuple(d for d in range(xv.ndim) if d != quant_axis)
+        scale = jnp.max(jnp.abs(xv), axis=axes)
+        shape = [1] * xv.ndim
+        shape[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-8).reshape(shape)
+        return jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax), scale
+
+    return forward_op("fake_channel_wise_quantize_abs_max", impl, [xt],
+                      differentiable=False)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length: int = 8,
+                                                  quant_axis: int = 0,
+                                                  name=None):
+    """Per-channel quantize-dequantize with STE gradient; returns
+    ``(out, scales)``."""
+    xt = ensure_tensor(x)
+    qmax = _qmax(bit_length)
+
+    def impl(xv):
+        axes = tuple(d for d in range(xv.ndim) if d != quant_axis)
+        scale = jnp.max(jnp.abs(xv), axis=axes)
+        shape = [1] * xv.ndim
+        shape[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-8).reshape(shape)
+        q = jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax)
+        deq = xv + jax.lax.stop_gradient(q * s / qmax - xv)
+        return deq, scale
+
+    return forward_op("fake_channel_wise_quantize_dequantize_abs_max",
+                      impl, [xt])
+
+
+def fake_quantize_range_abs_max(x, in_scale, window_size: int = 10000,
+                                bit_length: int = 8, is_test: bool = False,
+                                name=None):
+    """Range-tracked abs-max: scale = max(current batch max, tracked scale)
+    (the reference's windowed observer made pure: the tracked scale is an
+    explicit input/output). Returns ``(q, out_scale)``."""
+    xt = ensure_tensor(x)
+    st = ensure_tensor(in_scale)
+    qmax = _qmax(bit_length)
+
+    def impl(xv, sv):
+        cur = jnp.max(jnp.abs(xv))
+        scale = sv if is_test else jnp.maximum(cur, sv)
+        s = jnp.maximum(scale, 1e-8)
+        return jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax), scale
+
+    return forward_op("fake_quantize_range_abs_max", impl, [xt, st],
+                      differentiable=False)
+
+
+def moving_average_abs_max_scale(x, accum, state, rate: float = 0.9,
+                                 name=None):
+    """EMA abs-max observer: returns ``(scale, new_accum, new_state)`` with
+    ``accum = rate*accum + |x|_max``, ``state = rate*state + 1``,
+    ``scale = accum/state`` (pure form of the reference's in-place
+    moving_average_abs_max_scale_op)."""
+    xt = ensure_tensor(x)
+    at = ensure_tensor(accum)
+    st = ensure_tensor(state)
+
+    def impl(xv, av, sv):
+        cur = jnp.max(jnp.abs(xv))
+        na = rate * av + cur
+        ns = rate * sv + 1.0
+        return na / ns, na, ns
+
+    return forward_op("moving_average_abs_max_scale", impl, [xt, at, st],
+                      differentiable=False)
+
+
+def fake_quantize_moving_average_abs_max(x, accum, state, rate: float = 0.9,
+                                         bit_length: int = 8,
+                                         is_test: bool = False, name=None):
+    """EMA-scaled fake quantization; returns ``(q, scale, accum, state)``."""
+    xt = ensure_tensor(x)
+    at = ensure_tensor(accum)
+    st = ensure_tensor(state)
+    qmax = _qmax(bit_length)
+
+    def impl(xv, av, sv):
+        if is_test:
+            scale, na, ns = av / jnp.maximum(sv, 1e-8), av, sv
+        else:
+            cur = jnp.max(jnp.abs(xv))
+            na = rate * av + cur
+            ns = rate * sv + 1.0
+            scale = na / ns
+        s = jnp.maximum(scale, 1e-8)
+        return (jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax),
+                scale, na, ns)
+
+    return forward_op("fake_quantize_moving_average_abs_max", impl,
+                      [xt, at, st], differentiable=False)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, accum, state, rate: float = 0.9, bit_length: int = 8,
+        is_test: bool = False, name=None):
+    """EMA-scaled quantize-dequantize with STE gradient; returns
+    ``(out, scale, accum, state)``."""
+    xt = ensure_tensor(x)
+    at = ensure_tensor(accum)
+    st = ensure_tensor(state)
+    qmax = _qmax(bit_length)
+
+    def impl(xv, av, sv):
+        if is_test:
+            scale, na, ns = av / jnp.maximum(sv, 1e-8), av, sv
+        else:
+            cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xv)))
+            na = rate * av + cur
+            ns = rate * sv + 1.0
+            scale = na / ns
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(xv / s * qmax), -qmax, qmax)
+        deq = xv + jax.lax.stop_gradient(q * s / qmax - xv)
+        return deq, scale, na, ns
+
+    return forward_op("fake_quantize_dequantize_moving_average_abs_max",
+                      impl, [xt, at, st])
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize_linear (ONNX-style affine pair)
+# ---------------------------------------------------------------------------
+
+def quantize_linear(x, scale, zero_point=None, quant_axis: int = -1,
+                    bit_length: int = 8, name=None):
+    """Affine quantization ``q = clip(round(x/scale) + zp)`` (ref:
+    quantize_linear_op). ``quant_axis=-1`` is per-tensor; otherwise
+    per-channel along that axis. Returns int8-ranged values (int32
+    storage, matching the reference's out dtype pre-cast)."""
+    xt = ensure_tensor(x)
+    st = ensure_tensor(scale)
+    qmax = _qmax(bit_length)
+    args = [xt, st]
+    if zero_point is not None:
+        args.append(ensure_tensor(zero_point))
+
+    def impl(xv, sv, *zp):
+        z = zp[0] if zp else 0
+        if quant_axis >= 0 and sv.ndim:
+            shape = [1] * xv.ndim
+            shape[quant_axis] = -1
+            sv = sv.reshape(shape)
+            z = z.reshape(shape) if zp else 0
+        q = jnp.round(xv / jnp.maximum(sv, 1e-8)) + z
+        return jnp.clip(q, -qmax - 1, qmax).astype(jnp.int32)
+
+    return forward_op("quantize_linear", impl, args, differentiable=False)
+
+
+def dequantize_linear(x, scale, zero_point=None, quant_axis: int = -1,
+                      name=None):
+    """Affine dequantization ``(q - zp) * scale`` (ref:
+    dequantize_linear_op)."""
+    xt = ensure_tensor(x)
+    st = ensure_tensor(scale)
+    args = [xt, st]
+    if zero_point is not None:
+        args.append(ensure_tensor(zero_point))
+
+    def impl(xv, sv, *zp):
+        z = zp[0] if zp else 0
+        if quant_axis >= 0 and sv.ndim:
+            shape = [1] * xv.ndim
+            shape[quant_axis] = -1
+            sv = sv.reshape(shape)
+            z = z.reshape(shape) if zp else 0
+        return (xv.astype(jnp.float32) - z) * sv
+
+    return forward_op("dequantize_linear", impl, args, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# weight-only inference surface (paddle.incubate parity)
+# ---------------------------------------------------------------------------
+
+def weight_quantize(w, algo: str = "weight_only_int8", name=None):
+    """Per-output-channel symmetric int8 weight quantization; returns
+    ``(int8_weight [K, N], scales [N])`` (ref:
+    paddle.incubate.nn.functional.weight_quantize; the reference also
+    repacks for its CUDA tile layout — XLA/Pallas needs no repack, the
+    kernel reads the natural [K, N] layout)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r} (int4 packing has no "
+                         "TPU kernel here)")
+    wt = ensure_tensor(w)
+
+    def impl(wv):
+        scale = jnp.maximum(jnp.max(jnp.abs(wv), axis=0), 1e-8)  # [N]
+        q = jnp.clip(jnp.round(wv / scale[None, :] * 127.0), -127, 127)
+        return q.astype(jnp.int8), (scale / 127.0).astype(jnp.float32)
+
+    return forward_op("weight_quantize", impl, [wt], differentiable=False)
+
+
+def weight_dequantize(w, scale, name=None):
+    """Inverse of :func:`weight_quantize`: ``w_int8 * scale`` -> float."""
+    wt = ensure_tensor(w)
+    st = ensure_tensor(scale)
+    return forward_op(
+        "weight_dequantize",
+        lambda wv, sv: wv.astype(jnp.float32) * sv[None, :],
+        [wt, st], differentiable=False)
+
+
+def weight_only_linear(x, weight, scale, bias=None, weight_dtype="int8",
+                       name=None):
+    """``x @ dequant(weight)`` with int8 weights streamed from HBM (ref:
+    paddle.incubate.nn.functional.weight_only_linear). On TPU backends this
+    routes to the Pallas stream-dequant kernel
+    (``kernels.quant_matmul.quant_matmul``); elsewhere an XLA
+    dequant-matmul with identical numerics."""
+    if weight_dtype != "int8":
+        raise ValueError("only int8 weights are supported")
+    xt = ensure_tensor(x)
+    wt = ensure_tensor(weight)
+    st = ensure_tensor(scale)
+    args = [xt, wt, st]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def impl(xv, wv, sv, *b):
+        lead = xv.shape[:-1]
+        x2 = xv.reshape(-1, xv.shape[-1])
+        import jax as _jax
+        if _jax.default_backend() == "tpu":
+            from ..kernels.quant_matmul import weight_only_matmul
+            out = weight_only_matmul(x2, wv, sv,
+                                     out_dtype=x2.dtype).astype(x2.dtype)
+        else:
+            out = x2 @ (wv.astype(x2.dtype) * sv[None, :].astype(x2.dtype))
+        out = out.reshape(lead + (wv.shape[1],))
+        return out + b[0] if b else out
+
+    return forward_op("weight_only_linear", impl, args)
+
+
+def llm_int8_linear(x, weight, scale, threshold: float = 6.0, name=None):
+    """LLM.int8: columns of ``x`` with amax above ``threshold`` run in
+    fp16/bf16, the rest through the int8 path (ref:
+    paddle.incubate.nn.functional.llm_int8_linear). TPU formulation: the
+    split is a mask, both paths are dense matmuls, XLA fuses the merge —
+    no dynamic shapes."""
+    xt = ensure_tensor(x)
+    wt = ensure_tensor(weight)
+    st = ensure_tensor(scale)
+
+    def impl(xv, wv, sv):
+        lead = xv.shape[:-1]
+        x2 = xv.reshape(-1, xv.shape[-1])
+        outlier = jnp.max(jnp.abs(x2), axis=0) > threshold       # [K]
+        # inlier path: dynamic per-row int8 activation quant, int8xint8
+        # matmul accumulated in int32 (MXU native), double dequant
+        x_in = jnp.where(outlier[None, :], 0, x2)
+        xs = jnp.maximum(jnp.max(jnp.abs(x_in), axis=1), 1e-8)   # [M]
+        xq = jnp.clip(jnp.round(x_in / xs[:, None] * 127.0),
+                      -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot(xq, wv, preferred_element_type=jnp.int32)
+        inl = acc.astype(jnp.float32) * (xs[:, None] / 127.0) * sv[None, :]
+        # outlier columns stay in floating point
+        x_out = jnp.where(outlier[None, :], x2, 0)
+        wf = wv.astype(x2.dtype) * sv[None, :].astype(x2.dtype)
+        out = inl.astype(x2.dtype) + x_out @ wf
+        return out.reshape(lead + (wv.shape[1],))
+
+    return forward_op("llm_int8_linear", impl, [xt, wt, st])

@@ -247,3 +247,10 @@ for _n, _f, _d in [
     ("box_coder", lambda p, t: t, "SSD/R-CNN box regression transform"),
 ]:
     register_op(_n, _f, f"vision.ops.{_n}: {_d}")
+
+
+# legacy detection family (deform conv, priors/anchors, proposals, NMS
+# variants, SSD matching) — see det_ops.py for the TPU design notes
+from .det_ops import *  # noqa: F401,E402,F403
+from .det_ops import __all__ as _det_all  # noqa: E402
+__all__ = list(__all__) + list(_det_all)

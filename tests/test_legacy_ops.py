@@ -1,0 +1,378 @@
+"""Tests for the round-5 legacy op families: sequence (LoD), fake-quant /
+weight-only, and the legacy detection ops (SURVEY §2.3 long tail)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+# ---------------------------------------------------------------------------
+# sequence family — numpy oracles over the dense+lens representation
+# ---------------------------------------------------------------------------
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+        lens = np.array([2, 4])
+        padded, out_lens = paddle.sequence_pad(flat, 0.0, 4, lens)
+        assert padded.shape == [2, 4, 2]
+        np.testing.assert_array_equal(padded.numpy()[0, :2], flat[:2])
+        np.testing.assert_array_equal(padded.numpy()[0, 2:], 0)
+        np.testing.assert_array_equal(padded.numpy()[1], flat[2:])
+        back = paddle.sequence_unpad(padded, out_lens)
+        np.testing.assert_array_equal(back.numpy(), flat)
+
+    def test_reverse(self):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        out = paddle.sequence_reverse(x, np.array([3, 4]))
+        np.testing.assert_array_equal(out.numpy()[0], [2, 1, 0, 3])
+        np.testing.assert_array_equal(out.numpy()[1], [7, 6, 5, 4])
+
+    def test_softmax_masks_padding(self):
+        x = np.ones((2, 4), np.float32)
+        out = paddle.sequence_softmax(x, np.array([2, 4]))
+        np.testing.assert_allclose(out.numpy()[0], [0.5, 0.5, 0, 0])
+        np.testing.assert_allclose(out.numpy()[1], [0.25] * 4)
+
+    def test_pool_modes(self):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        lens = np.array([2, 3])
+        assert paddle.sequence_pool(x, "sum", lens).numpy().tolist() == [1, 15]
+        assert paddle.sequence_pool(x, "mean", lens).numpy().tolist() == [0.5, 5]
+        assert paddle.sequence_pool(x, "max", lens).numpy().tolist() == [1, 6]
+        assert paddle.sequence_first_step(x, lens).numpy().tolist() == [0, 4]
+        assert paddle.sequence_last_step(x, lens).numpy().tolist() == [1, 6]
+        np.testing.assert_allclose(
+            paddle.sequence_pool(x, "sqrt", lens).numpy(),
+            [1 / np.sqrt(2), 15 / np.sqrt(3)], rtol=1e-6)
+
+    def test_erase(self):
+        x = np.array([[1, 2, 3, 2], [2, 2, 2, 4]])
+        out, lens = paddle.sequence_erase(x, [2], np.array([4, 4]))
+        np.testing.assert_array_equal(out.numpy(), [[1, 3, 0, 0],
+                                                    [4, 0, 0, 0]])
+        assert lens.numpy().tolist() == [2, 1]
+
+    def test_expand_and_expand_as(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        out, lens = paddle.sequence_expand(x, np.array([2, 3]))
+        assert out.shape == [2, 3, 1]
+        np.testing.assert_array_equal(out.numpy()[0, :, 0], [1, 1, 0])
+        np.testing.assert_array_equal(out.numpy()[1, :, 0], [2, 2, 2])
+        y = np.zeros((2, 5, 1), np.float32)
+        out2 = paddle.sequence_expand_as(x, y)
+        assert out2.shape == [2, 5, 1]
+
+    def test_slice_concat_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        out, lens = paddle.sequence_slice(x, np.array([1, 2]),
+                                          np.array([2, 3]))
+        np.testing.assert_array_equal(out.numpy()[0], [1, 2, 0, 0, 0, 0])
+        np.testing.assert_array_equal(out.numpy()[1], [8, 9, 10, 0, 0, 0])
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        cat, cl = paddle.sequence_concat(
+            [a, b], [np.array([1, 2]), np.array([3, 1])])
+        np.testing.assert_array_equal(cat.numpy()[0], [1, 2, 2, 2, 0])
+        np.testing.assert_array_equal(cat.numpy()[1], [1, 1, 2, 0, 0])
+        assert cl.numpy().tolist() == [4, 3]
+        s = paddle.sequence_scatter(np.zeros((2, 4), np.float32),
+                                    np.array([[1], [2]]),
+                                    np.array([[5.0], [7.0]]))
+        assert s.numpy()[0, 1] == 5 and s.numpy()[1, 2] == 7
+
+    def test_enumerate_reshape_lod_reset(self):
+        x = np.array([[1, 2, 3, 4]])
+        win = paddle.sequence_enumerate(x, 2, pad_value=0)
+        np.testing.assert_array_equal(win.numpy()[0, 0], [1, 2])
+        np.testing.assert_array_equal(win.numpy()[0, 3], [4, 0])
+        r, rl = paddle.sequence_reshape(
+            np.arange(8, dtype=np.float32).reshape(1, 2, 4), 2,
+            np.array([2]))
+        assert r.shape == [1, 4, 2] and rl.numpy().tolist() == [4]
+        y, yl = paddle.lod_reset(x, np.array([2]))
+        np.testing.assert_array_equal(y.numpy(), x)
+
+    def test_sequence_conv_matches_manual(self):
+        x = np.random.randn(1, 5, 3).astype(np.float32)
+        w = np.random.randn(9, 4).astype(np.float32)   # context 3
+        out = paddle.sequence_conv(x, w, 3, context_start=-1,
+                                   seq_lens=np.array([5]))
+        # manual: window [t-1, t, t+1] concat then matmul
+        padded = np.concatenate([np.zeros((1, 1, 3)), x,
+                                 np.zeros((1, 1, 3))], 1)
+        win = np.stack([padded[:, i:i + 5] for i in range(3)], 2)
+        ref = win.reshape(1, 5, 9) @ w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_row_conv(self):
+        x = np.random.randn(1, 4, 2).astype(np.float32)
+        w = np.random.randn(2, 2).astype(np.float32)
+        out = paddle.row_conv(x, w)
+        ref = np.zeros_like(x)
+        for t in range(4):
+            for k in range(2):
+                if t + k < 4:
+                    ref[:, t] += x[:, t + k] * w[k]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_im2sequence(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        out = paddle.im2sequence(x, 2, stride=2)
+        assert out.shape == [1, 4, 8]
+
+
+# ---------------------------------------------------------------------------
+# quant family
+# ---------------------------------------------------------------------------
+
+class TestQuantOps:
+    def test_abs_max_roundtrip(self):
+        w = np.random.randn(8, 4).astype(np.float32)
+        q, s = paddle.fake_quantize_abs_max(w)
+        assert float(s.numpy()) == pytest.approx(np.abs(w).max(), rel=1e-6)
+        assert np.abs(q.numpy()).max() <= 127
+        dq, _ = paddle.fake_quantize_dequantize_abs_max(w)
+        assert np.abs(dq.numpy() - w).max() < np.abs(w).max() / 100
+
+    def test_channel_wise(self):
+        w = np.random.randn(6, 3).astype(np.float32)
+        q, s = paddle.fake_channel_wise_quantize_abs_max(w, quant_axis=1)
+        np.testing.assert_allclose(s.numpy(), np.abs(w).max(0), rtol=1e-6)
+        dq, _ = paddle.fake_channel_wise_quantize_dequantize_abs_max(
+            w, quant_axis=1)
+        assert np.abs(dq.numpy() - w).max() < 0.02
+
+    def test_moving_average_state_is_pure(self):
+        w = np.random.randn(4, 4).astype(np.float32)
+        accum = np.zeros((), np.float32)
+        state = np.zeros((), np.float32)
+        q, scale, a1, s1 = paddle.fake_quantize_moving_average_abs_max(
+            w, accum, state)
+        assert float(s1.numpy()) == pytest.approx(1.0)
+        assert float(a1.numpy()) == pytest.approx(np.abs(w).max(), rel=1e-6)
+        # second step uses the carried state
+        q2, scale2, a2, s2 = paddle.fake_quantize_moving_average_abs_max(
+            w, a1, s1)
+        assert float(s2.numpy()) == pytest.approx(1.9)
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        x.stop_gradient = False
+        out, _ = paddle.fake_quantize_dequantize_abs_max(x)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)
+
+    def test_quantize_dequantize_linear(self):
+        w = np.random.randn(8, 4).astype(np.float32)
+        s = np.float32(0.05)
+        q = paddle.quantize_linear(w, s)
+        assert q.numpy().dtype == np.int32
+        dq = paddle.dequantize_linear(q, s)
+        assert np.abs(dq.numpy() - w).max() <= 0.05 / 2 + 1e-6 or \
+            np.abs(w).max() > 127 * 0.05
+
+    def test_weight_only_linear_parity(self):
+        w = np.random.randn(16, 8).astype(np.float32)
+        x = np.random.randn(3, 16).astype(np.float32)
+        q, s = paddle.weight_quantize(w)
+        assert q.numpy().dtype == np.int8
+        y = paddle.weight_only_linear(x, q, s)
+        ref = x @ w
+        assert np.abs(y.numpy() - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+        y2 = paddle.llm_int8_linear(x, q, s)
+        assert np.abs(y2.numpy() - ref).max() < 0.1 * np.abs(ref).max() + 0.1
+
+    def test_weight_only_linear_bias_and_batch(self):
+        w = np.random.randn(8, 4).astype(np.float32)
+        x = np.random.randn(2, 5, 8).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        q, s = paddle.weight_quantize(w)
+        y = paddle.weight_only_linear(x, q, s, bias=b)
+        assert y.shape == [2, 5, 4]
+        np.testing.assert_allclose(y.numpy(), x @ w + b, atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# detection family
+# ---------------------------------------------------------------------------
+
+class TestDetectionOps:
+    def test_deform_conv_zero_offsets_is_conv(self):
+        import jax.numpy as jnp
+        from jax import lax
+        x = np.random.randn(1, 4, 6, 6).astype(np.float32)
+        w = np.random.randn(8, 4, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        out = vops.deform_conv2d(x, off, w, padding=1)
+        ref = lax.conv_general_dilated(jnp.asarray(x), jnp.asarray(w),
+                                       (1, 1), [(1, 1), (1, 1)])
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_deform_conv_mask_halves_output(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        w = np.random.randn(2, 2, 1, 1).astype(np.float32)
+        off = np.zeros((1, 2, 4, 4), np.float32)
+        full = vops.deform_conv2d(x, off, w)
+        half = vops.deform_conv2d(x, off, w,
+                                  mask=0.5 * np.ones((1, 1, 4, 4),
+                                                     np.float32))
+        np.testing.assert_allclose(half.numpy(), 0.5 * full.numpy(),
+                                   rtol=1e-5)
+
+    def test_multiclass_nms_per_class_semantics(self):
+        # same box region, two classes: class-agnostic NMS would keep one;
+        # per-class keeps both (the reference's multiclass_nms contract)
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5]]],
+                         np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 0] = [0.9, 0.0]
+        scores[0, 1] = [0.0, 0.8]
+        out, idx, cnt = vops.multiclass_nms(boxes, scores,
+                                            nms_threshold=0.5)
+        assert int(cnt.numpy()[0]) == 2
+        labels = sorted(out.numpy()[0, :2, 0].tolist())
+        assert labels == [0.0, 1.0]
+        # within one class the overlap IS suppressed
+        scores2 = np.zeros((1, 2, 2), np.float32)
+        scores2[0, 0] = [0.9, 0.8]
+        _, _, cnt2 = vops.multiclass_nms(boxes, scores2, nms_threshold=0.5)
+        assert int(cnt2.numpy()[0]) == 1
+
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 1, 3), np.float32)
+        scores[0, 0] = [0.9, 0.8, 0.7]
+        out, idx, cnt = vops.matrix_nms(boxes, scores,
+                                        score_threshold=0.01)
+        s = out.numpy()[0, :, 1]
+        # top box undecayed, overlap decayed below its raw score,
+        # distant box (no overlap) kept at its raw score
+        assert s[0] == pytest.approx(0.9, abs=1e-5)
+        by_idx = {int(i): float(v) for i, v in
+                  zip(idx.numpy()[0], s) if i >= 0}
+        assert by_idx[1] < 0.8 - 0.05
+        assert by_idx[2] == pytest.approx(0.7, abs=1e-5)
+
+    def test_prior_box_count_and_range(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        img = np.zeros((1, 3, 64, 64), np.float32)
+        boxes, var = vops.prior_box(feat, img, [10.0], [20.0], [2.0],
+                                    flip=True, clip=True)
+        # P = min(1) * ars(1, 2, 0.5) + max = 4
+        assert boxes.shape == [4, 4, 4, 4]
+        b = boxes.numpy()
+        assert b.min() >= 0 and b.max() <= 1
+        assert (b[..., 2] > b[..., 0]).all()
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_anchor_generator_centers(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        anchors, _ = vops.anchor_generator(feat, [32.0], [1.0],
+                                           stride=(16.0, 16.0))
+        a = anchors.numpy()[0, 0, 0]
+        # first cell center at (8, 8), size 32 -> [-8, -8, 24, 24]
+        np.testing.assert_allclose(a, [-8, -8, 24, 24], atol=1e-4)
+
+    def test_yolo_box_shapes_and_conf(self):
+        x = np.zeros((1, 3 * 7, 2, 2), np.float32)
+        x[0, 4] = 10.0   # anchor 0 objectness high everywhere
+        boxes, scores = vops.yolo_box(x, np.array([[64, 64]]),
+                                      [10, 13, 16, 30, 33, 23], 2,
+                                      conf_thresh=0.5)
+        assert boxes.shape == [1, 12, 4]
+        sc = scores.numpy()[0]
+        assert (sc[[1, 2, 3]] > 0).any() or (sc > 0).any()
+
+    def test_generate_proposals_static(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        anchors, _ = vops.anchor_generator(feat, [16.0], [0.5, 1.0, 2.0])
+        sc = np.random.rand(1, 3, 4, 4).astype(np.float32)
+        dl = (np.random.randn(1, 12, 4, 4) * 0.1).astype(np.float32)
+        rois, rs, n = vops.generate_proposals(
+            sc, dl, np.array([[64.0, 64.0]], np.float32), anchors,
+            pre_nms_top_n=20, post_nms_top_n=5)
+        assert rois.shape == [1, 5, 4] and rs.shape == [1, 5]
+        r = rois.numpy()[0]
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 63).all()
+
+    def test_bipartite_match(self):
+        gt = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+        pr = np.array([[0, 0, 9, 9], [19, 19, 31, 31], [5, 5, 6, 6]],
+                      np.float32)
+        iou = vops.iou_similarity(gt, pr)
+        m, d = vops.bipartite_match(iou)
+        assert m.numpy().tolist() == [0, 1, -1]
+        t, wgt = vops.target_assign(
+            np.array([[1.0, 2.0], [3.0, 4.0]], np.float32), m)
+        assert t.numpy()[2].tolist() == [0, 0]
+        assert wgt.numpy()[:, 0].tolist() == [1, 1, 0]
+
+    def test_distribute_and_collect_fpn(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 200, 200], [0, 0, 60, 60]],
+                        np.float32)
+        outs, restore = vops.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        sizes = [int(o.shape[0]) for o in outs]
+        assert sum(sizes) == 3
+        order = np.concatenate([o.numpy() for o in outs if o.shape[0]])
+        np.testing.assert_array_equal(order[restore.numpy()], rois)
+
+    def test_ssd_loss_and_mining(self):
+        P, C = 8, 3
+        loss = vops.ssd_loss(
+            (np.random.randn(P, 4) * 0.1).astype(np.float32),
+            np.random.randn(P, C).astype(np.float32),
+            np.array([[0, 0, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]], np.float32),
+            np.array([1, 2], np.int64),
+            np.random.rand(P, 4).astype(np.float32))
+        assert np.isfinite(float(loss.numpy()))
+        mask = vops.mine_hard_examples(
+            np.random.rand(10).astype(np.float32),
+            np.array([0, -1, -1, -1, 1, -1, -1, -1, -1, -1]))
+        assert int(mask.numpy().sum()) == 6  # 3x ratio * 2 positives
+
+    def test_yolo_loss_finite_and_responds_to_gt(self):
+        x = (np.random.randn(1, 3 * 7, 4, 4) * 0.1).astype(np.float32)
+        gt = np.zeros((1, 2, 4), np.float32)
+        gt[0, 0] = [0.5, 0.5, 0.3, 0.3]
+        gtl = np.zeros((1, 2), np.int64)
+        l1 = vops.yolo_loss(x, gt, gtl, [10, 13, 16, 30, 33, 23],
+                            [0, 1, 2], 2)
+        assert np.isfinite(l1.numpy()).all()
+        # no gt -> pure objectness loss, different value
+        l0 = vops.yolo_loss(x, np.zeros((1, 2, 4), np.float32), gtl,
+                            [10, 13, 16, 30, 33, 23], [0, 1, 2], 2)
+        assert abs(float(l1.numpy()[0]) - float(l0.numpy()[0])) > 1e-4
+
+    def test_box_clip_and_polygon(self):
+        b = vops.box_clip(np.array([[-5, -5, 100, 100]], np.float32),
+                          np.array([[64.0, 64.0, 1.0]], np.float32))
+        np.testing.assert_array_equal(b.numpy(), [[0, 0, 63, 63]])
+        p = vops.polygon_box_transform(np.ones((1, 8, 2, 2), np.float32))
+        assert p.shape == [1, 8, 2, 2]
+
+    def test_detection_output_pipeline(self):
+        P, C = 8, 3
+        out, idx, cnt = vops.detection_output(
+            (np.random.randn(1, P, 4) * 0.1).astype(np.float32),
+            np.random.rand(1, P, C).astype(np.float32),
+            np.random.rand(P, 4).astype(np.float32))
+        assert out.shape[2] == 6
+        assert int(cnt.numpy()[0]) <= out.shape[1]
+
+    def test_psroi_pool_group_selectivity(self):
+        # constant-per-channel-group input: bin (i, j) must read group i*pw+j
+        ph = pw = 2
+        oc = 1
+        x = np.zeros((1, oc * ph * pw, 4, 4), np.float32)
+        for g in range(ph * pw):
+            x[0, g] = g + 1
+        out = vops.psroi_pool(x, np.array([[0, 0, 4, 4]], np.float32),
+                              output_size=2)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[1, 2], [3, 4]], atol=1e-5)
