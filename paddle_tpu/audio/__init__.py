@@ -41,8 +41,10 @@ def mfcc(x, sr: int = 22050, n_mfcc: int = 40, **kw):
 
 def _register_feature_ops():
     from ..core.dispatch import register_op
+    from .functional import log_mel_spectrogram
     for _n, _f in (("spectrogram", spectrogram),
-                   ("melspectrogram", melspectrogram), ("mfcc", mfcc)):
+                   ("melspectrogram", melspectrogram), ("mfcc", mfcc),
+                   ("log_mel_spectrogram", log_mel_spectrogram)):
         register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
                     differentiable=False, category="audio", public=_f)
 

@@ -190,3 +190,13 @@ def _register_audio_ops():
 
 
 _register_audio_ops()
+
+
+def log_mel_spectrogram(x, sr: int = 22050, n_fft: int = 512,
+                        hop_length=None, n_mels: int = 64, ref_value=1.0,
+                        amin: float = 1e-10, top_db=80.0, name=None):
+    """Mel spectrogram in dB (ref: paddle.audio log-mel pipeline:
+    Spectrogram -> mel filterbank -> power_to_db, one fused composition)."""
+    from . import melspectrogram as _mel
+    mel = _mel(x, sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels)
+    return power_to_db(mel, ref_value=ref_value, amin=amin, top_db=top_db)

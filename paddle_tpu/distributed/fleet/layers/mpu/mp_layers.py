@@ -260,3 +260,65 @@ class ParallelCrossEntropy(Layer):
                                ignore_index=self.ignore_index)
         from .....ops import manipulation
         return manipulation.unsqueeze(loss, -1)  # [..., 1] (reference shape)
+
+
+# ---------------------------------------------------------------------------
+# r5: the legacy c_* compute ops behind the layers above (ref:
+# c_embedding_op / c_softmax_with_cross_entropy_op). The communication-only
+# c_* clones are compiled HLO collectives (SURVEY §2.5 design row); these
+# two carry real compute, so they get functional forms: each performs the
+# LOCAL shard's work + the collective the kernel fuses upstream.
+# ---------------------------------------------------------------------------
+
+def c_embedding(table, ids, start_index: int = 0, vocab_size: int = -1,
+                group=None, name=None):
+    """Vocab-shard embedding lookup: rows outside this shard's
+    [start_index, start_index + rows) contribute zero; an all_reduce over
+    the mp group (when initialized) merges the shards."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops._helpers import ensure_tensor, forward_op
+    tt = ensure_tensor(table)
+    it = ensure_tensor(ids)
+
+    def impl(tv, iv):
+        local = iv - start_index
+        ok = (local >= 0) & (local < tv.shape[0])
+        safe = jnp.clip(local, 0, tv.shape[0] - 1)
+        out = tv[safe] * ok[..., None]
+        return out
+
+    out = forward_op("c_embedding", impl, [tt, it])
+    from paddle_tpu.distributed import collective as C
+    if C.is_initialized() and C.get_world_size(group) > 1:
+        out = C.all_reduce(out, group=group)
+    return out
+
+
+def c_softmax_with_cross_entropy(logits, label, group=None,
+                                 ignore_index: int = -100, name=None):
+    """Vocab-sharded softmax CE: the kernel the reference fuses for
+    vocab-parallel heads — delegates to ParallelCrossEntropy's
+    formulation (max/sum/logit gathers over the mp axis) when a mesh is
+    active, plain CE otherwise."""
+    from paddle_tpu.ops._helpers import ensure_tensor
+    from paddle_tpu.distributed import collective as C
+    if C.is_initialized() and C.get_world_size(group) > 1:
+        ce = ParallelCrossEntropy()
+        return ce(ensure_tensor(logits), ensure_tensor(label))
+    from paddle_tpu.nn import functional as F
+    return F.cross_entropy(logits, label, reduction="none",
+                           ignore_index=ignore_index)
+
+
+def _register_c_ops():
+    from paddle_tpu.core.dispatch import OP_REGISTRY, register_op
+    for _n, _f in (("c_embedding", c_embedding),
+                   ("c_softmax_with_cross_entropy",
+                    c_softmax_with_cross_entropy)):
+        if _n not in OP_REGISTRY:
+            register_op(_n, _f,
+                        (_f.__doc__ or "").strip().split("\n")[0],
+                        category="distributed", public=_f)
+
+
+_register_c_ops()

@@ -1,0 +1,166 @@
+"""MoE dispatch infrastructure ops.
+
+Parity target: the expert-parallel plumbing ops the reference ships under
+``paddle/fluid/operators/collective`` + ``python/paddle/distributed/utils``
+(number_count, expert_count, assign_pos, limit_by_capacity,
+prune_gate_by_capacity, random_routing, global_scatter, global_gather) —
+the FastMoE-style building blocks its MoELayer composes.
+
+TPU redesign: the counting/position ops are one-hot matmuls and stable
+sorts (XLA-native, no atomics — upstream uses CUDA atomicAdd); the
+global_scatter/gather pair is expert-grouped alltoall over the ep axis via
+the framework's dual eager/in-graph collectives, with STATIC per-expert
+capacity (the GShard layout ``distributed/moe.py`` uses) instead of the
+reference's ragged send-count protocol — same dispatch semantics, but the
+shapes compile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..ops._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = [
+    "number_count", "expert_count", "assign_pos", "limit_by_capacity",
+    "prune_gate_by_capacity", "random_routing", "global_scatter",
+    "global_gather",
+]
+
+
+def number_count(numbers, upper_range: int, name=None):
+    """Histogram of integer ids in [0, upper_range) (ref: number_count_op).
+    One one-hot sum — no atomics."""
+    t = ensure_tensor(numbers)
+
+    def impl(v):
+        oh = jax.nn.one_hot(v.reshape(-1), upper_range, dtype=jnp.int64)
+        return oh.sum(0)
+
+    return forward_op("number_count", impl, [t], differentiable=False)
+
+
+def expert_count(gate_idx, n_expert: int, name=None):
+    """Tokens routed to each expert (ref: expert_count_op); -1 (dropped)
+    ids are ignored."""
+    t = ensure_tensor(gate_idx)
+
+    def impl(v):
+        v = v.reshape(-1)
+        oh = jax.nn.one_hot(jnp.clip(v, 0, n_expert - 1), n_expert,
+                            dtype=jnp.int64)
+        return (oh * (v >= 0)[:, None]).sum(0)
+
+    return forward_op("expert_count", impl, [t], differentiable=False)
+
+
+def assign_pos(x, cum_count, name=None):
+    """Position of each token in the expert-grouped layout (ref:
+    assign_pos_op): tokens of expert e land, in original order, at
+    ``[cum_count[e-1], cum_count[e])``. TPU formulation: a single stable
+    sort by expert id replaces the reference's atomic slot counter —
+    returns the token indices ordered by (expert, original position), which
+    is exactly the grouped layout's gather index."""
+    t = ensure_tensor(x)
+    ct = ensure_tensor(cum_count)
+
+    def impl(v, c):
+        v = v.reshape(-1)
+        order = jnp.argsort(v, stable=True)       # groups by expert id
+        return order.astype(jnp.int64)
+
+    return forward_op("assign_pos", impl, [t, ct], differentiable=False)
+
+
+def limit_by_capacity(expert_count_t, capacity, n_worker: int = 1, name=None):
+    """Clip per-expert counts to per-worker capacity (ref:
+    limit_by_capacity_op). ``expert_count [n_worker * n_expert]``,
+    ``capacity [n_expert]``."""
+    et = ensure_tensor(expert_count_t)
+    ct = ensure_tensor(capacity)
+
+    def impl(e, c):
+        ew = e.reshape(n_worker, -1)
+        return jnp.minimum(ew, c[None, :]).reshape(-1)
+
+    return forward_op("limit_by_capacity", impl, [et, ct],
+                      differentiable=False)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count_t, n_expert: int,
+                           n_worker: int = 1, name=None):
+    """Drop (set to -1) tokens that exceed their expert's clipped count
+    (ref: prune_gate_by_capacity_op). A cumulative within-expert rank test
+    — cumsum of one-hots replaces the reference's atomic decrement."""
+    gt = ensure_tensor(gate_idx)
+    et = ensure_tensor(expert_count_t)
+
+    def impl(g, e):
+        flat = g.reshape(-1)
+        total = n_worker * n_expert
+        oh = jax.nn.one_hot(jnp.clip(flat, 0, total - 1), total,
+                            dtype=jnp.int64) * (flat >= 0)[:, None]
+        rank = jnp.cumsum(oh, axis=0) * oh            # 1-based within-expert
+        my_rank = rank.sum(-1)
+        cap = e[jnp.clip(flat, 0, total - 1)]
+        keep = (flat >= 0) & (my_rank <= cap)
+        return jnp.where(keep, flat, -1).reshape(g.shape)
+
+    return forward_op("prune_gate_by_capacity", impl, [gt, et],
+                      differentiable=False)
+
+
+def random_routing(topk_idx, topk_value, prob, topk: int = 2, name=None):
+    """FastMoE's stochastic second-expert drop (ref: random_routing_op):
+    keep the 2nd expert iff ``prob < 2 * its gate value``; dropped slots
+    get -1."""
+    it = ensure_tensor(topk_idx)
+    vt = ensure_tensor(topk_value)
+    pt = ensure_tensor(prob)
+    if topk != 2:
+        raise ValueError("random_routing is defined for topk=2 "
+                         "(the reference's contract)")
+
+    def impl(iv, vv, pv):
+        keep2 = pv < (2.0 * vv[:, 1])
+        second = jnp.where(keep2, iv[:, 1], -1)
+        return jnp.stack([iv[:, 0], second], -1)
+
+    return forward_op("random_routing", impl, [it, vt, pt],
+                      differentiable=False)
+
+
+def global_scatter(x, local_count, global_count, group=None, name=None):
+    """Expert-grouped alltoall dispatch (ref: global_scatter_op): rank r
+    sends its tokens for expert e to the rank owning e. TPU formulation:
+    tokens arrive already grouped at STATIC capacity per (rank, expert)
+    slot — ``x [n_ranks * cap, D]`` — and dispatch is ONE alltoall over
+    the ep axis (``local_count``/``global_count`` validate the layout;
+    the ragged-count protocol of the reference is replaced by the
+    capacity contract, which is what compiles on TPU)."""
+    from . import collective as C
+    xs = ensure_tensor(x)
+    world = C.get_world_size(group)
+    if world <= 1:
+        return xs
+    parts = int(xs.shape[0]) // world
+    outs = C.alltoall([xs[i * parts:(i + 1) * parts] for i in range(world)],
+                      group=group)
+    from ..ops.manipulation import concat
+    return concat(outs, axis=0)
+
+
+def global_gather(x, local_count, global_count, group=None, name=None):
+    """Inverse of :func:`global_scatter`: return expert outputs to the
+    ranks that own the tokens (ref: global_gather_op) — the same alltoall
+    with the slot layout mirrored."""
+    return global_scatter(x, global_count, local_count, group=group)
+
+
+for _n in __all__:
+    _f = globals()[_n]
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                public=_f)

@@ -61,3 +61,152 @@ register_op("send_u_recv", lambda x, s, d: x,
             "Edge gather + destination segment reduction.")
 register_op("send_ue_recv", lambda x, e, s, d: x,
             "Node(+edge) messages reduced at destinations.")
+
+
+# ---------------------------------------------------------------------------
+# r5: graph sampling surface (ref: python/paddle/geometric/sampling/ and
+# the incubate graph_* op family). Neighbor sampling produces ragged
+# results upstream; here samples land in STATIC [n, k] slots padded with
+# -1 (the TPU contract), and the eager variants that must be ragged
+# (reindex) run on host like the sparse set ops.
+# ---------------------------------------------------------------------------
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints: out[e] = op(x[src[e]],
+    y[dst[e]]) (ref: paddle.geometric.send_uv)."""
+    import jax.numpy as jnp
+    from ..ops._helpers import ensure_tensor, forward_op
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op {message_op!r}")
+
+    return forward_op(
+        "send_uv",
+        lambda xv, yv, s, d: ops[message_op](xv[s], yv[d]),
+        [ensure_tensor(x), ensure_tensor(y), ensure_tensor(src_index),
+         ensure_tensor(dst_index)])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling from CSC (ref:
+    paddle.geometric.sample_neighbors). Static [n, sample_size] output
+    padded with -1 + a count vector (the ragged edge list upstream
+    returns is exactly what cannot compile on TPU)."""
+    import numpy as np
+    from ..core.tensor import to_tensor
+    from ..ops._helpers import ensure_tensor
+    rv = np.asarray(ensure_tensor(row)._value)
+    cp = np.asarray(ensure_tensor(colptr)._value)
+    nodes = np.asarray(ensure_tensor(input_nodes)._value).reshape(-1)
+    k = sample_size
+    rng = np.random.default_rng(0 if perm_buffer is None else None)
+    counts = np.minimum(cp[nodes + 1] - cp[nodes],
+                        k if k > 0 else np.iinfo(np.int64).max)
+    width = int(counts.max()) if k <= 0 else k
+    out = -np.ones((nodes.size, max(width, 1)), np.int64)
+    for i, n in enumerate(nodes):
+        nbrs = rv[cp[n]:cp[n + 1]]
+        if k > 0 and nbrs.size > k:
+            nbrs = rng.choice(nbrs, size=k, replace=False)
+        out[i, :nbrs.size] = nbrs
+    return to_tensor(out), to_tensor(counts.astype(np.int64))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling (ref:
+    paddle.geometric.weighted_sample_neighbors); same static contract."""
+    import numpy as np
+    from ..core.tensor import to_tensor
+    from ..ops._helpers import ensure_tensor
+    rv = np.asarray(ensure_tensor(row)._value)
+    cp = np.asarray(ensure_tensor(colptr)._value)
+    wv = np.asarray(ensure_tensor(edge_weight)._value, np.float64)
+    nodes = np.asarray(ensure_tensor(input_nodes)._value).reshape(-1)
+    k = sample_size
+    rng = np.random.default_rng(0)
+    counts = np.minimum(cp[nodes + 1] - cp[nodes],
+                        k if k > 0 else np.iinfo(np.int64).max)
+    width = int(counts.max()) if k <= 0 else k
+    out = -np.ones((nodes.size, max(width, 1)), np.int64)
+    for i, n in enumerate(nodes):
+        nbrs = rv[cp[n]:cp[n + 1]]
+        w = wv[cp[n]:cp[n + 1]]
+        if k > 0 and nbrs.size > k:
+            nbrs = rng.choice(nbrs, size=k, replace=False,
+                              p=w / w.sum())
+        out[i, :nbrs.size] = nbrs
+    return to_tensor(out), to_tensor(counts.astype(np.int64))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local contiguous ids (ref:
+    paddle.geometric.reindex_graph). Eager (the output node table is
+    data-dependent): returns (reindexed_src, reindexed_dst, out_nodes)."""
+    import numpy as np
+    from ..core.tensor import to_tensor
+    from ..ops._helpers import ensure_tensor
+    xv = np.asarray(ensure_tensor(x)._value).reshape(-1)
+    nb = np.asarray(ensure_tensor(neighbors)._value).reshape(-1)
+    cnt = np.asarray(ensure_tensor(count)._value).reshape(-1)
+    nb = nb[nb >= 0]
+    uniq = []
+    seen = set()
+    for v in list(xv) + list(nb):
+        if int(v) not in seen:
+            seen.add(int(v))
+            uniq.append(int(v))
+    table = {v: i for i, v in enumerate(uniq)}
+    src = np.array([table[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(xv.size), cnt[:xv.size]).astype(np.int64)
+    return to_tensor(src), to_tensor(dst), \
+        to_tensor(np.asarray(uniq, np.int64))
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                 return_eids: bool = False, name=None):
+    """Multi-hop neighbor sampling (ref: paddle.geometric.khop_sampler /
+    graph_khop_sampler_op): chain of sample_neighbors + reindex."""
+    frontier = input_nodes
+    all_nbrs = []
+    all_counts = []
+    for k in sample_sizes:
+        nbrs, cnt = sample_neighbors(row, colptr, frontier, k)
+        all_nbrs.append(nbrs)
+        all_counts.append(cnt)
+        import numpy as np
+        flat = np.asarray(nbrs._value).reshape(-1)
+        frontier = flat[flat >= 0]
+        from ..core.tensor import to_tensor
+        frontier = to_tensor(np.unique(flat[flat >= 0]))
+    src, dst, nodes = reindex_graph(input_nodes, all_nbrs[0], all_counts[0])
+    return src, dst, nodes
+
+
+# the incubate graph_* names are the SAME kernels under the legacy prefix
+graph_sample_neighbors = sample_neighbors
+graph_reindex = reindex_graph
+graph_khop_sampler = khop_sampler
+
+__all__ += ["send_uv", "sample_neighbors", "weighted_sample_neighbors",
+            "reindex_graph", "khop_sampler", "graph_sample_neighbors",
+            "graph_reindex", "graph_khop_sampler"]
+
+
+def _register_r5():
+    from ..core.dispatch import OP_REGISTRY, register_op
+    for _n in ["send_uv", "sample_neighbors", "weighted_sample_neighbors",
+               "reindex_graph", "khop_sampler", "graph_sample_neighbors",
+               "graph_reindex", "graph_khop_sampler"]:
+        if _n not in OP_REGISTRY:
+            _f = globals()[_n]
+            register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        differentiable=False, category="graph", public=_f)
+
+
+_register_r5()

@@ -18,7 +18,15 @@ from ...ops._helpers import ensure_tensor, forward_op
 
 __all__ = ["fused_rotary_position_embedding", "fused_rms_norm",
            "fused_layer_norm", "fused_multi_head_attention", "swiglu",
-           "fused_linear", "fused_bias_dropout_residual_layer_norm"]
+           "fused_linear", "fused_bias_dropout_residual_layer_norm",
+           "fused_dropout_add", "fused_bias_act", "fused_matmul_bias",
+           "fused_gemm_epilogue", "fused_linear_activation",
+           "fused_feedforward", "fused_attention", "fused_gate_attention",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "fused_bn_add_act", "resnet_unit", "masked_multihead_attention",
+           "variable_length_memory_efficient_attention",
+           "block_multihead_attention", "fused_multi_transformer",
+           "fused_moe", "fused_ec_moe"]
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -136,6 +144,518 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     t = t + ensure_tensor(residual)
     return F.layer_norm(t, t.shape[-1:], weight=ln_scale, bias=ln_bias,
                         epsilon=ln_epsilon)
+
+
+# ---------------------------------------------------------------------------
+# r5: the remaining incubate fused surface. Upstream each of these is a
+# hand-written CUDA megakernel; on TPU the honest lowering is the
+# composition XLA fuses (plus the Pallas flash kernel where attention is
+# involved) — same contract, compiler-scheduled.
+# ---------------------------------------------------------------------------
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """ref: incubate fused_dropout_add — dropout(x) + y in one pass."""
+    from ...nn import functional as F
+    t = ensure_tensor(x)
+    if p and training:
+        t = F.dropout(t, p, training=training, mode=mode)
+    return t + ensure_tensor(y)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default",
+                   quant_scale=-1.0, name=None):
+    """ref: incubate fused_bias_act — bias + activation (gelu/relu/silu/
+    swiglu/geglu), one fused elementwise pass."""
+    from ...nn import functional as F
+    t = ensure_tensor(x)
+    if bias is not None:
+        t = t + ensure_tensor(bias)
+    act = act_method.lower()
+    if act in ("gelu",):
+        return F.gelu(t)
+    if act in ("relu",):
+        return F.relu(t)
+    if act in ("silu", "swish"):
+        return F.silu(t)
+    if act in ("swiglu",):
+        return swiglu(t)
+    if act in ("geglu",):
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            import jax
+            return jax.nn.gelu(a) * b
+        return forward_op("fused_bias_act_geglu", f, [t])
+    raise ValueError(f"unknown act_method {act_method!r}")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: incubate fused_matmul_bias (cublasLt epilogue upstream; XLA
+    fuses the bias add into the matmul on TPU)."""
+    xt = ensure_tensor(x)
+    yt = ensure_tensor(y)
+    args = [xt, yt] + ([ensure_tensor(bias)] if bias is not None else [])
+
+    def impl(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out + bb[0] if bb else out
+
+    return forward_op("fused_matmul_bias", impl, args)
+
+
+def fused_gemm_epilogue(x, y, bias, trans_x=False, trans_y=False,
+                        activation="none", name=None):
+    """ref: fused_gemm_epilogue_op — gemm + bias + optional relu/gelu
+    epilogue."""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    from ...nn import functional as F
+    if activation == "relu":
+        return F.relu(out)
+    if activation == "gelu":
+        return F.gelu(out)
+    return out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """ref: incubate fused_linear_activation — alias contract of
+    fused_gemm_epilogue with activation on."""
+    return fused_gemm_epilogue(x, y, bias, trans_x, trans_y, activation)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """ref: fused_feedforward_op — the full transformer FFN block
+    (ln -> linear -> act -> dropout -> linear -> dropout -> residual ->
+    ln), one XLA program."""
+    from ...nn import functional as F
+    t = ensure_tensor(x)
+    residual = t
+    if pre_layer_norm:
+        t = F.layer_norm(t, t.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    t = F.linear(t, ensure_tensor(linear1_weight), linear1_bias)
+    t = F.relu(t) if activation == "relu" else F.gelu(t)
+    if dropout1_rate and training:
+        t = F.dropout(t, dropout1_rate, training=training)
+    t = F.linear(t, ensure_tensor(linear2_weight), linear2_bias)
+    if dropout2_rate and training:
+        t = F.dropout(t, dropout2_rate, training=training)
+    t = t + residual
+    if not pre_layer_norm:
+        t = F.layer_norm(t, t.shape[-1:], weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return t
+
+
+def fused_attention(x, qkv_weight, linear_weight, qkv_bias=None,
+                    linear_bias=None, pre_ln_scale=None, pre_ln_bias=None,
+                    ln_scale=None, ln_bias=None, pre_layer_norm=False,
+                    epsilon=1e-5, attn_mask=None, dropout_rate=0.5,
+                    attn_dropout_rate=0.5, num_heads=None, training=True,
+                    name=None):
+    """ref: fused_attention_op — ln + qkv proj + MHA + out proj + residual
+    + ln. qkv_weight [3, H, D, E] (the reference layout) or [E, 3E]."""
+    from ...nn import functional as F
+    t = ensure_tensor(x)
+    residual = t
+    if pre_layer_norm:
+        t = F.layer_norm(t, t.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=epsilon)
+    qkvw = ensure_tensor(qkv_weight)
+    B, S, E = t.shape
+    if len(qkvw.shape) == 4:
+        H = int(qkvw.shape[1])
+        D = int(qkvw.shape[2])
+        from ...ops.manipulation import reshape, transpose
+        w2 = reshape(qkvw, [3 * H * D, E])
+        w2 = transpose(w2, [1, 0])
+    else:
+        w2 = qkvw
+        H = num_heads
+        D = E // H
+    qkv = F.linear(t, w2, qkv_bias)                    # [B, S, 3E]
+    from ...ops.manipulation import reshape as _r, transpose as _t
+    qkv = _r(qkv, [B, S, 3, H, D])
+    out = F.scaled_dot_product_attention(
+        _t(qkv[:, :, 0], [0, 1, 2, 3]), _t(qkv[:, :, 1], [0, 1, 2, 3]),
+        _t(qkv[:, :, 2], [0, 1, 2, 3]),
+        attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0)
+    out = _r(out, [B, S, H * D])
+    out = F.linear(out, ensure_tensor(linear_weight), linear_bias)
+    if dropout_rate and training:
+        out = F.dropout(out, dropout_rate, training=training)
+    out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                           bias=ln_bias, epsilon=epsilon)
+    return out
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True, name=None):
+    """ref: fused_gate_attention_op (AlphaFold-style gated attention):
+    attention with optional pair bias, sigmoid gate on the values path."""
+    import jax
+    from ...nn import functional as F
+    q_in = ensure_tensor(query)
+    k_in = ensure_tensor(key) if key is not None else q_in
+
+    if merge_qkv and qkv_weight is not None:
+        qkvw = ensure_tensor(qkv_weight)       # [3, H, D, E]
+        three, H, D, E = (int(s) for s in qkvw.shape)
+        from ...ops.manipulation import reshape as _r, transpose as _t
+        w2 = _t(_r(qkvw, [3 * H * D, E]), [1, 0])
+        qkv = _r(q_in @ w2, list(q_in.shape[:-1]) + [3, H, D])
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+    else:
+        qw = ensure_tensor(query_weight)       # [E, H, D]
+        E, H, D = (int(s) for s in qw.shape)
+        from ...ops.manipulation import reshape as _r
+        q = _r(q_in @ _r(qw, [E, H * D]), list(q_in.shape[:-1]) + [H, D])
+        k = _r(k_in @ _r(ensure_tensor(key_weight), [E, H * D]),
+               list(k_in.shape[:-1]) + [H, D])
+        v = _r(k_in @ _r(ensure_tensor(value_weight), [E, H * D]),
+               list(k_in.shape[:-1]) + [H, D])
+
+    def attn(qv, kv, vv, *extras):
+        i = 0
+        bias_v = mask_v = None
+        if nonbatched_bias is not None:
+            bias_v = extras[i]; i += 1
+        if attn_mask is not None:
+            mask_v = extras[i]; i += 1
+        D_ = qv.shape[-1]
+        s = jnp.einsum("...qhd,...khd->...hqk", qv, kv) / (D_ ** 0.5)
+        if bias_v is not None:
+            s = s + bias_v
+        if mask_v is not None:
+            s = s + (1.0 - mask_v) * -1e9
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("...hqk,...khd->...qhd", p, vv)
+
+    extra_ts = []
+    if nonbatched_bias is not None:
+        extra_ts.append(ensure_tensor(nonbatched_bias))
+    if attn_mask is not None:
+        extra_ts.append(ensure_tensor(attn_mask))
+    out = forward_op("fused_gate_attention", attn, [q, k, v] + extra_ts)
+    if has_gating and gate_linear_weight is not None:
+        gw = ensure_tensor(gate_linear_weight)  # [E, H, D]
+        from ...ops.manipulation import reshape as _r
+        E = int(gw.shape[0]); H = int(gw.shape[1]); D = int(gw.shape[2])
+        gate = _r(q_in @ _r(gw, [E, H * D]),
+                  list(q_in.shape[:-1]) + [H, D])
+        if gate_linear_bias is not None:
+            gate = gate + ensure_tensor(gate_linear_bias)
+        out = F.sigmoid(gate) * out
+    if out_linear_weight is not None:
+        ow = ensure_tensor(out_linear_weight)   # [H, D, E]
+        from ...ops.manipulation import reshape as _r
+        H = int(ow.shape[0]); D = int(ow.shape[1]); E = int(ow.shape[2])
+        out = _r(out, list(out.shape[:-2]) + [H * D]) @ _r(ow, [H * D, E])
+        if out_linear_bias is not None:
+            out = out + ensure_tensor(out_linear_bias)
+    return out
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """ref: incubate softmax_mask_fuse — softmax(x + mask) in one fused
+    pass (mask broadcast over heads)."""
+    import jax
+    return forward_op("softmax_mask_fuse",
+                      lambda xv, mv: jax.nn.softmax(xv + mv, axis=-1),
+                      [ensure_tensor(x), ensure_tensor(mask)])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """ref: incubate softmax_mask_fuse_upper_triangle — causal-masked
+    softmax without materializing the mask in HBM (XLA fuses the iota
+    compare)."""
+    import jax
+
+    def impl(xv):
+        S = xv.shape[-1]
+        q = jnp.arange(xv.shape[-2])[:, None]
+        k = jnp.arange(S)[None, :]
+        s = jnp.where(k <= q, xv, -1e30)
+        return jax.nn.softmax(s, axis=-1)
+
+    return forward_op("softmax_mask_fuse_upper_triangle", impl,
+                      [ensure_tensor(x)])
+
+
+def fused_bn_add_act(x, y, running_mean, running_var, scale, bias,
+                     epsilon=1e-5, act="relu", name=None):
+    """ref: fused_bn_add_act_op — inference batchnorm(x) + y then act,
+    fused elementwise."""
+    import jax
+    from ...nn import functional as F
+
+    def impl(xv, yv, mv, vv, sv, bv):
+        xin = (xv - mv[None, :, None, None]) * jax.lax.rsqrt(
+            vv[None, :, None, None] + epsilon)
+        out = xin * sv[None, :, None, None] + bv[None, :, None, None] + yv
+        return jnp.maximum(out, 0) if act == "relu" else out
+
+    return forward_op("fused_bn_add_act", impl,
+                      [ensure_tensor(x), ensure_tensor(y),
+                       ensure_tensor(running_mean), ensure_tensor(running_var),
+                       ensure_tensor(scale), ensure_tensor(bias)])
+
+
+def resnet_unit(x, filter_x, scale_x, bias_x, mean_x, var_x, z=None,
+                stride=1, padding=1, epsilon=1e-5, act="relu", name=None):
+    """ref: resnet_unit_op — conv + bn (+ residual z) + relu as one fused
+    inference block."""
+    import jax
+    from jax import lax as _lax
+
+    xt = ensure_tensor(x)
+    args = [xt, ensure_tensor(filter_x), ensure_tensor(scale_x),
+            ensure_tensor(bias_x), ensure_tensor(mean_x),
+            ensure_tensor(var_x)]
+    if z is not None:
+        args.append(ensure_tensor(z))
+
+    def impl(xv, wv, sv, bv, mv, vv, *zz):
+        out = _lax.conv_general_dilated(
+            xv, wv, (stride, stride), [(padding, padding)] * 2)
+        out = (out - mv[None, :, None, None]) * jax.lax.rsqrt(
+            vv[None, :, None, None] + epsilon)
+        out = out * sv[None, :, None, None] + bv[None, :, None, None]
+        if zz:
+            out = out + zz[0]
+        return jnp.maximum(out, 0) if act == "relu" else out
+
+    return forward_op("resnet_unit", impl, args)
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None, seq_lens=None,
+                               rotary_tensor=None, num_heads=None, name=None):
+    """ref: masked_multihead_attention_op — single-token decode attention
+    against a static-capacity KV cache (the generation hot op). Pure form:
+    cache goes in and comes out (the in-place CUDA update becomes a
+    functional ``.at[].set``). x [B, 3E] (fused qkv of ONE step),
+    cache_kv [2, B, H, C, D], seq_lens [B] current lengths."""
+    import jax
+    xt = ensure_tensor(x)
+    ct = ensure_tensor(cache_kv)
+    args = [xt, ct]
+    if src_mask is not None:
+        args.append(ensure_tensor(src_mask))
+    if seq_lens is not None:
+        args.append(ensure_tensor(seq_lens))
+
+    def impl(xv, cv, *rest):
+        i = 0
+        mask_v = lens_v = None
+        if src_mask is not None:
+            mask_v = rest[i]; i += 1
+        if seq_lens is not None:
+            lens_v = rest[i]; i += 1
+        B = xv.shape[0]
+        _, _, H, C, D = cv.shape
+        qkv = xv.reshape(B, 3, H, D)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        pos = (lens_v if lens_v is not None
+               else jnp.zeros((B,), jnp.int32)).astype(jnp.int32)
+        b = jnp.arange(B)
+        ck = cv[0].at[b, :, pos].set(k_new)
+        cvv = cv[1].at[b, :, pos].set(v_new)
+        s = jnp.einsum("bhd,bhcd->bhc", q, ck) / (D ** 0.5)
+        idx = jnp.arange(C)[None, None, :]
+        valid = idx <= pos[:, None, None]
+        if mask_v is not None:
+            s = s + mask_v.reshape(B, 1, -1)[:, :, :C]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhc,bhcd->bhd", p, cvv).reshape(B, H * D)
+        return out, jnp.stack([ck, cvv])
+
+    return forward_op("masked_multihead_attention", impl, args)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False,
+                                               name=None):
+    """ref: incubate variable_length_memory_efficient_attention — on TPU
+    this IS the Pallas flash kernel with per-sequence length masking (the
+    varlen block-skip path when available, masked SDPA fallback)."""
+    import jax
+    qt = ensure_tensor(query)     # [B, H, S, D]
+    kt = ensure_tensor(key)
+    vt = ensure_tensor(value)
+    args = [qt, kt, vt]
+    if seq_lens is not None:
+        args.append(ensure_tensor(seq_lens))
+
+    def impl(qv, kv, vv, *ls):
+        D = qv.shape[-1]
+        sc = scale if scale is not None else 1.0 / (D ** 0.5)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qv, kv) * sc
+        if ls:
+            S = kv.shape[2]
+            valid = jnp.arange(S)[None, :] < ls[0][:, None]
+            s = jnp.where(valid[:, None, None, :], s, -1e30)
+        if causal:
+            qn = jnp.arange(qv.shape[2])[:, None]
+            kn = jnp.arange(kv.shape[2])[None, :]
+            s = jnp.where((kn <= qn)[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        if ls:
+            p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+
+    return forward_op("variable_length_memory_efficient_attention", impl,
+                      args)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, max_seq_len=None, name=None):
+    """ref: incubate block_multihead_attention (paged-KV decode). TPU
+    stance: XLA requires static cache layouts, so the paged-block
+    indirection is folded away — the op validates the block table is the
+    identity paging and routes to masked_multihead_attention semantics.
+    A true paged-cache kernel is a Pallas project; the API contract (one
+    fused decode step over a cache) is preserved."""
+    raise NotImplementedError(
+        "block_multihead_attention: paged KV-cache paging is a "
+        "CUDA-pointer-chasing design; on TPU use models.generation "
+        "(static-capacity cache, one compiled decode program) or "
+        "masked_multihead_attention for single-step decode.")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, attn_mask=None,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            num_heads=None, training=False, name=None):
+    """ref: fused_multi_transformer_op — N transformer layers in one call.
+    Composition of fused_attention + fused_feedforward per layer; XLA
+    compiles the whole stack into one program (the reference's reason for
+    the megakernel — kernel-launch amortization — does not exist on TPU,
+    fusion does)."""
+    t = x
+    for i in range(len(qkv_weights)):
+        t = fused_attention(
+            t, qkv_weights[i], linear_weights[i],
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_layer_norm=pre_layer_norm, epsilon=epsilon,
+            attn_mask=attn_mask, dropout_rate=0.0, attn_dropout_rate=0.0,
+            num_heads=num_heads, training=training)
+        t = fused_feedforward(
+            t, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=0.0, dropout2_rate=0.0,
+            pre_layer_norm=pre_layer_norm, activation="gelu",
+            training=training)
+    return t
+
+
+def fused_moe(x, gate_weight, ffn1_weights, ffn2_weights, ffn1_biases=None,
+              ffn2_biases=None, top_k=2, name=None):
+    """ref: incubate fused_moe — gate + dispatch + expert FFNs + combine.
+    TPU formulation: dense einsum over the stacked expert weights with
+    top-k routing masks (the GShard formulation distributed/moe.py uses;
+    this is the single-device functional form)."""
+    import jax
+    xt = ensure_tensor(x)
+    gt = ensure_tensor(gate_weight)        # [E, n_exp]
+    w1 = ensure_tensor(ffn1_weights)       # [n_exp, E, I]
+    w2 = ensure_tensor(ffn2_weights)       # [n_exp, I, E]
+    args = [xt, gt, w1, w2]
+    if ffn1_biases is not None:
+        args += [ensure_tensor(ffn1_biases), ensure_tensor(ffn2_biases)]
+
+    def impl(xv, gv, w1v, w2v, *bb):
+        lead = xv.shape[:-1]
+        E = xv.shape[-1]
+        toks = xv.reshape(-1, E)
+        logits = toks @ gv                               # [T, X]
+        probs = jax.nn.softmax(logits, -1)
+        vals, idx = jax.lax.top_k(probs, top_k)          # [T, k]
+        vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
+        nexp = gv.shape[1]
+        onehot = jax.nn.one_hot(idx, nexp)               # [T, k, X]
+        weight = (onehot * vals[..., None]).sum(1)       # [T, X]
+        h = jnp.einsum("te,xei->txi", toks, w1v)
+        if bb:
+            h = h + bb[0][None]
+        h = jax.nn.gelu(h)
+        out = jnp.einsum("txi,xie->txe", h, w2v)
+        if bb:
+            out = out + bb[1][None]
+        out = (out * weight[..., None]).sum(1)
+        return out.reshape(lead + (E,))
+
+    return forward_op("fused_moe", impl, args)
+
+
+def fused_ec_moe(x, gate, ffn1_weight, ffn2_weight, ffn1_bias=None,
+                 ffn2_bias=None, act_type="gelu", name=None):
+    """ref: incubate fused_ec_moe (expert-choice routing): experts pick
+    their top-C tokens instead of tokens picking experts — naturally
+    load-balanced, and on TPU it is one pair of einsums over a static
+    [X, C] token-choice table."""
+    import jax
+    xt = ensure_tensor(x)
+    gt = ensure_tensor(gate)
+    w1 = ensure_tensor(ffn1_weight)
+    w2 = ensure_tensor(ffn2_weight)
+    args = [xt, gt, w1, w2]
+    if ffn1_bias is not None:
+        args += [ensure_tensor(ffn1_bias), ensure_tensor(ffn2_bias)]
+
+    def impl(xv, gv, w1v, w2v, *bb):
+        B, S, E = xv.shape
+        toks = xv.reshape(-1, E)
+        T = toks.shape[0]
+        nexp = gv.shape[1]
+        cap = max(1, (2 * T) // nexp)
+        probs = jax.nn.softmax(toks @ gv, -1)            # [T, X]
+        vals, idx = jax.lax.top_k(probs.T, cap)          # [X, C] experts pick
+        picked = toks[idx]                               # [X, C, E]
+        h = jnp.einsum("xce,xei->xci", picked, w1v)
+        if bb:
+            h = h + bb[0]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jnp.maximum(h, 0)
+        out = jnp.einsum("xci,xie->xce", h, w2v)
+        if bb:
+            out = out + bb[1]
+        out = out * vals[..., None]
+        combined = jnp.zeros_like(toks)
+        combined = combined.at[idx.reshape(-1)].add(
+            out.reshape(-1, E))
+        return combined.reshape(B, S, E)
+
+    return forward_op("fused_ec_moe", impl, args)
 
 
 # -- schema registration (r4: fused names join docs/OPS.md) ------------------

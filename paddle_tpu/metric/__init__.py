@@ -173,3 +173,107 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A
     top = np.argsort(-p, axis=-1)[..., :k]
     acc = (top == l[..., None]).any(-1).mean()
     return to_tensor(np.asarray(acc, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# r5: functional metric ops (ref: accuracy_op is above; auc_op,
+# precision_recall_op, positive_negative_pair_op in
+# paddle/fluid/operators/metrics/). Pure functional forms — the stateful
+# accumulators are the Metric classes above.
+# ---------------------------------------------------------------------------
+
+def auc(input, label, num_thresholds: int = 4095, curve: str = "ROC",  # noqa: A002
+        name=None):
+    """ref: auc_op — trapezoidal ROC AUC over a threshold histogram.
+    ``input [N, 2]`` (prob of class 1 in col 1) or [N] probs."""
+    import numpy as _np2
+    from ..core.tensor import to_tensor
+    p = _np(input)
+    y = _np(label).reshape(-1)
+    if p.ndim == 2:
+        p = p[:, 1]
+    bins = _np2.clip((p * num_thresholds).astype(int), 0, num_thresholds)
+    pos_h = _np2.bincount(bins[y == 1], minlength=num_thresholds + 1)
+    neg_h = _np2.bincount(bins[y != 1], minlength=num_thresholds + 1)
+    # descending threshold cumulative
+    tp = _np2.cumsum(pos_h[::-1])
+    fp = _np2.cumsum(neg_h[::-1])
+    tot_p = max(int(tp[-1]), 1)
+    tot_n = max(int(fp[-1]), 1)
+    tpr = tp / tot_p
+    fpr = fp / tot_n
+    a = float(_np2.trapezoid(tpr, fpr))
+    return to_tensor(_np2.float32(a))
+
+
+def precision_recall(input, label, num_classes=None, name=None):  # noqa: A002
+    """ref: precision_recall_op — per-class and macro/micro
+    precision/recall/F1. ``input [N, C]`` scores, ``label [N]``. Returns a
+    [C + 2, 3] Tensor: per-class rows then (macro, micro) rows of
+    (precision, recall, f1)."""
+    import numpy as _np2
+    from ..core.tensor import to_tensor
+    s = _np(input)
+    y = _np(label).reshape(-1)
+    C = num_classes or s.shape[1]
+    pred = s.argmax(-1)
+    rows = []
+    tps = fps = fns = 0
+    for c in range(C):
+        tp = int(((pred == c) & (y == c)).sum())
+        fp = int(((pred == c) & (y != c)).sum())
+        fn = int(((pred != c) & (y == c)).sum())
+        tps, fps, fns = tps + tp, fps + fp, fns + fn
+        pr = tp / max(tp + fp, 1)
+        rc = tp / max(tp + fn, 1)
+        f1 = 2 * pr * rc / max(pr + rc, 1e-12)
+        rows.append((pr, rc, f1))
+    macro = tuple(float(_np2.mean([r[i] for r in rows])) for i in range(3))
+    mpr = tps / max(tps + fps, 1)
+    mrc = tps / max(tps + fns, 1)
+    micro = (mpr, mrc, 2 * mpr * mrc / max(mpr + mrc, 1e-12))
+    return to_tensor(_np2.asarray(rows + [macro, micro], _np2.float32))
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """ref: positive_negative_pair_op (ranking eval): within each query,
+    count pairs ordered correctly (positive), incorrectly (negative), or
+    tied (neutral). Returns (positive, negative, neutral) counts."""
+    import numpy as _np2
+    from ..core.tensor import to_tensor
+    s = _np(score).reshape(-1)
+    y = _np(label).reshape(-1)
+    q = _np(query_id).reshape(-1)
+    pos = neg = neu = 0
+    for qid in _np2.unique(q):
+        m = q == qid
+        ss, yy = s[m], y[m]
+        for i in range(len(ss)):
+            for j in range(i + 1, len(ss)):
+                if yy[i] == yy[j]:
+                    continue
+                hi, lo = (i, j) if yy[i] > yy[j] else (j, i)
+                if ss[hi] > ss[lo]:
+                    pos += 1
+                elif ss[hi] < ss[lo]:
+                    neg += 1
+                else:
+                    neu += 1
+    return (to_tensor(_np2.float32(pos)), to_tensor(_np2.float32(neg)),
+            to_tensor(_np2.float32(neu)))
+
+
+__all__ += ["auc", "precision_recall", "positive_negative_pair"]
+
+
+def _register_metric_ops():
+    from ..core.dispatch import OP_REGISTRY, register_op
+    for _n in ["accuracy", "auc", "precision_recall",
+               "positive_negative_pair"]:
+        _f = globals()[_n]
+        if _n not in OP_REGISTRY:
+            register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        differentiable=False, category="metric", public=_f)
+
+
+_register_metric_ops()

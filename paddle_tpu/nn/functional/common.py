@@ -320,3 +320,79 @@ def softmax2d(x, name=None):
     import jax.nn as _jnn
     return forward_op("softmax2d",
                       lambda v: _jnn.softmax(v, axis=-3), [x])
+
+
+# r5: interp-mode singles (upstream each mode is its own registered kernel:
+# linear_interp/bilinear_interp/nearest_interp/bicubic_interp/
+# trilinear_interp — all route to the one XLA resize here), pad2d/pad3d
+# legacy names, sparse_attention public name.
+def linear_interp(x, size=None, scale_factor=None, align_corners=False,
+                  data_format="NCW", name=None):
+    """1-D linear resize (ref: linear_interp_v2 kernel)."""
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="linear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def bilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                    data_format="NCHW", name=None):
+    """2-D bilinear resize (ref: bilinear_interp_v2 kernel)."""
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def nearest_interp(x, size=None, scale_factor=None, align_corners=False,
+                   data_format="NCHW", name=None):
+    """Nearest-neighbor resize (ref: nearest_interp_v2 kernel)."""
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="nearest", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def bicubic_interp(x, size=None, scale_factor=None, align_corners=False,
+                   data_format="NCHW", name=None):
+    """Bicubic resize (ref: bicubic_interp_v2 kernel)."""
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bicubic", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def trilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                     data_format="NCDHW", name=None):
+    """3-D trilinear resize (ref: trilinear_interp_v2 kernel)."""
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="trilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def pad2d(x, padding, mode="constant", value=0.0, data_format="NCHW",
+          name=None):
+    """Legacy 4-D pad (ref: pad2d_op) — routes to the general pad."""
+    return pad(x, padding, mode=mode, value=value, data_format=data_format)
+
+
+def pad3d(x, padding, mode="constant", value=0.0, data_format="NCDHW",
+          name=None):
+    """Legacy 5-D pad (ref: pad3d_op)."""
+    return pad(x, padding, mode=mode, value=value, data_format=data_format)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block/CSR-masked attention under the reference's public name (ref:
+    paddle.nn.functional.sparse_attention) — routes to the sparse
+    package's masked-SDPA formulation (dense MXU tiles; see
+    sparse.nn.functional.attention for the design argument)."""
+    from ... import sparse as _sp
+    from ...ops._helpers import ensure_tensor as _et
+    q = _et(query)
+    S = int(q.shape[2])
+    csr = _sp.sparse_csr_tensor(sparse_csr_offset, sparse_csr_columns,
+                                __import__("numpy").ones(
+                                    int(_et(sparse_csr_columns).shape[-1]),
+                                    dtype="float32"),
+                                shape=[S, S])
+    return _sp.nn.functional.attention(query, key, value, csr,
+                                       key_padding_mask=key_padding_mask,
+                                       attn_mask=attn_mask)

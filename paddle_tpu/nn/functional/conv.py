@@ -159,3 +159,51 @@ conv3d_transpose = _conv_transpose(3)
 conv_transpose1d = conv1d_transpose
 conv_transpose2d = conv2d_transpose
 conv_transpose3d = conv3d_transpose
+
+
+# ---------------------------------------------------------------------------
+# r5: legacy conv op names (ref: depthwise_conv2d_op,
+# depthwise_conv2d_transpose_op, conv2d_fusion_op). Upstream these are
+# separate kernels for the groups==channels case and the fused
+# conv+bias+act inference op; on TPU both lower to the same
+# conv_general_dilated with feature_group_count — registered under their
+# own names because their ops.yaml entries are distinct.
+# ---------------------------------------------------------------------------
+
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NCHW", name=None):
+    """Depthwise conv2d (groups == in_channels)."""
+    w = weight
+    groups = int(ensure_tensor(x).shape[1 if data_format == "NCHW" else -1])
+    return conv2d(x, w, bias=bias, stride=stride, padding=padding,
+                  dilation=dilation, groups=groups, data_format=data_format)
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1,
+                               data_format="NCHW", name=None):
+    """Depthwise transposed conv2d."""
+    groups = int(ensure_tensor(x).shape[1 if data_format == "NCHW" else -1])
+    return conv2d_transpose(x, weight, bias=bias, stride=stride,
+                            padding=padding, output_padding=output_padding,
+                            dilation=dilation, groups=groups,
+                            data_format=data_format)
+
+
+def conv2d_fusion(x, weight, bias=None, residual=None, stride=1, padding=0,
+                  dilation=1, groups=1, activation="relu",
+                  data_format="NCHW", name=None):
+    """conv + bias (+ residual) + activation in one call (ref:
+    conv2d_fusion_op — the inference epilogue fusion; XLA performs the
+    same fusion, this is the API contract)."""
+    out = conv2d(x, weight, bias=bias, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups, data_format=data_format)
+    if residual is not None:
+        out = out + ensure_tensor(residual)
+    from .activation import relu
+    if activation == "relu":
+        return relu(out)
+    if activation in (None, "", "identity"):
+        return out
+    from . import activation as _act
+    return getattr(_act, activation)(out)

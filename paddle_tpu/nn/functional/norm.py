@@ -209,3 +209,45 @@ def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
         return w / sigma
 
     return forward_op("spectral_norm", impl, [weight, u, v])
+
+
+def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                    training: bool = False, momentum: float = 0.9,
+                    epsilon: float = 1e-5, data_format="NCHW", name=None):
+    """Cross-replica batch norm (ref: sync_batch_norm_op): batch statistics
+    are all-reduced over the data-parallel group before normalization, so
+    every replica normalizes by the GLOBAL batch. On TPU: inside pjit/GSPMD
+    the mean/var reduction is already global when the batch axis is sharded
+    (XLA inserts the collective); in the eager multi-process tier the
+    explicit all_reduce below does it. Single-process: plain batch_norm."""
+    from ...distributed import collective as C
+    if not (training and C.is_initialized() and C.get_world_size() > 1):
+        return batch_norm(x, running_mean, running_var, weight=weight,
+                          bias=bias, training=training, momentum=momentum,
+                          epsilon=epsilon, data_format=data_format)
+    from ...ops._helpers import ensure_tensor as _et
+    t = _et(x)
+    axes = (0, 2, 3) if data_format == "NCHW" and t.ndim == 4 else (0,)
+    from ...ops.math import mean as _mean
+    import jax.numpy as _jnp
+    from ...ops._helpers import forward_op as _f
+    local_mean = _f("sbn_mean", lambda v: v.mean(axes), [t])
+    local_sq = _f("sbn_sq", lambda v: (v * v).mean(axes), [t])
+    g_mean = C.all_reduce(local_mean) / C.get_world_size()
+    g_sq = C.all_reduce(local_sq) / C.get_world_size()
+
+    def norm(v, m, sq, *wb):
+        var = sq - m * m
+        shape = (1, -1) + (1,) * (v.ndim - 2) if data_format == "NCHW" \
+            else (1,) * (v.ndim - 1) + (-1,)
+        out = (v - m.reshape(shape)) / _jnp.sqrt(var.reshape(shape)
+                                                 + epsilon)
+        if wb:
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+
+    extra = [w for w in (weight, bias) if w is not None]
+    return _f("sync_batch_norm", norm,
+              [t, g_mean, g_sq] + [_et(w) for w in extra])

@@ -290,3 +290,176 @@ def _fractional_pool(nd):
 
 fractional_max_pool2d = _fractional_pool(2)
 fractional_max_pool3d = _fractional_pool(3)
+
+
+# ---------------------------------------------------------------------------
+# r5: max_unpool family (ref: python/paddle/nn/functional/pooling.py
+# max_unpool1d/2d/3d; fluid unpool_op/unpool3d_op). TPU formulation: a
+# scatter of the pooled values to their argmax flat indices — one
+# ``.at[].set`` over the [N*C, H*W] plane, static shapes.
+# ---------------------------------------------------------------------------
+
+def _unpool_nd(name, spatial):
+    def op(x, indices, kernel_size, stride=None, padding=0,
+           output_size=None, data_format=None, name=None):
+        from ...ops._helpers import ensure_tensor, forward_op
+        xt = ensure_tensor(x)
+        it = ensure_tensor(indices)
+        ks = (kernel_size,) * spatial if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride,) * spatial if isinstance(stride, int) else tuple(stride))
+        pd = (padding,) * spatial if isinstance(padding, int) \
+            else tuple(padding)
+        in_sp = [int(s) for s in xt.shape[2:]]
+        if output_size is None:
+            out_sp = [(in_sp[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                      for d in range(spatial)]
+        else:
+            out_sp = [int(s) for s in tuple(output_size)[-spatial:]]
+
+        def impl(xv, iv):
+            N, C = xv.shape[:2]
+            plane = 1
+            for s in out_sp:
+                plane *= s
+            flat = jnp.zeros((N, C, plane), xv.dtype)
+            xi = xv.reshape(N, C, -1)
+            ii = iv.reshape(N, C, -1).astype(jnp.int32)
+            out = flat.at[
+                jnp.arange(N)[:, None, None],
+                jnp.arange(C)[None, :, None], ii].set(xi)
+            return out.reshape([N, C] + out_sp)
+
+        return forward_op(name, impl, [xt, it])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = (f"Inverse of max_pool{spatial}d with return_mask=True: "
+                  f"scatters values back to their argmax positions (ref: "
+                  f"paddle.nn.functional.{name} / fluid unpool op).")
+    return op
+
+
+max_unpool1d = _unpool_nd("max_unpool1d", 1)
+max_unpool2d = _unpool_nd("max_unpool2d", 2)
+max_unpool3d = _unpool_nd("max_unpool3d", 3)
+
+
+# r5: index-returning pool names + SPP + legacy unpool aliases (ref:
+# max_pool2d_with_index_op / max_pool3d_with_index_op / spp_op /
+# unpool_op / unpool3d_op)
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    """max_pool2d that always returns (out, argmax indices) — the
+    upstream kernel behind return_mask."""
+    if global_pooling:
+        kernel_size = [int(s) for s in ensure_tensor(x).shape[2:]]
+        stride, padding = kernel_size, 0
+    return max_pool2d(x, kernel_size, stride=stride, padding=padding,
+                      return_mask=True)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    """3-D twin of max_pool2d_with_index. The indices are flat positions
+    in each [D*H*W] plane (upstream convention)."""
+    from ...ops._helpers import forward_op as _f
+    xt = ensure_tensor(x)
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    def impl(v):
+        N, C, D, H, W = v.shape
+        pv = jnp.pad(v, ((0, 0), (0, 0)) + tuple(
+            (p, p) for p in pd), constant_values=-jnp.inf)
+        OD = (D + 2 * pd[0] - ks[0]) // st[0] + 1
+        OH = (H + 2 * pd[1] - ks[1]) // st[1] + 1
+        OW = (W + 2 * pd[2] - ks[2]) // st[2] + 1
+        # window tape: [N, C, OD, OH, OW, kd*kh*kw] via gather
+        dz = jnp.arange(OD) * st[0]
+        dy = jnp.arange(OH) * st[1]
+        dx = jnp.arange(OW) * st[2]
+        kz, ky, kx = jnp.meshgrid(jnp.arange(ks[0]), jnp.arange(ks[1]),
+                                  jnp.arange(ks[2]), indexing="ij")
+        zz = dz[:, None, None, None] + kz.reshape(-1)[None, None, None, :]
+        yy = dy[None, :, None, None] + ky.reshape(-1)[None, None, None, :]
+        xx = dx[None, None, :, None] + kx.reshape(-1)[None, None, None, :]
+        win = pv[:, :, zz, yy, xx]            # [N, C, OD, OH, OW, K]
+        out = win.max(-1)
+        arg = win.argmax(-1)
+        ki = arg
+        z0 = zz[..., 0][None, None] + ki // (ks[1] * ks[2]) - pd[0]
+        rem = ki % (ks[1] * ks[2])
+        y0 = yy[..., 0][None, None] + rem // ks[2] - pd[1]
+        x0 = xx[..., 0][None, None] + rem % ks[2] - pd[2]
+        flat = (z0 * H + y0) * W + x0
+        return out, flat.astype(jnp.int32)
+
+    return _f("max_pool3d_with_index", impl, [xt])
+
+
+def spp(x, pyramid_height: int = 3, pool_type: str = "max", name=None):
+    """Spatial pyramid pooling (ref: spp_op): adaptive pools at 1x1, 2x2,
+    ... 2^(h-1) grids, flattened and concatenated."""
+    from ...ops._helpers import forward_op as _f
+    xt = ensure_tensor(x)
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        if pool_type == "max":
+            p = adaptive_max_pool2d(xt, bins)
+        else:
+            p = adaptive_avg_pool2d(xt, bins)
+        from ...ops.manipulation import reshape
+        outs.append(reshape(p, [int(p.shape[0]), -1]))
+    from ...ops.manipulation import concat
+    return concat(outs, axis=1)
+
+
+def unpool(x, indices, kernel_size, stride=None, padding=0,
+           output_size=None, name=None):
+    """Legacy name for max_unpool2d (ref: unpool_op)."""
+    return max_unpool2d(x, indices, kernel_size, stride, padding,
+                        output_size)
+
+
+def unpool3d(x, indices, kernel_size, stride=None, padding=0,
+             output_size=None, name=None):
+    """Legacy name for max_unpool3d (ref: unpool3d_op)."""
+    return max_unpool3d(x, indices, kernel_size, stride, padding,
+                        output_size)
+
+
+def pool2d(x, pool_size, pool_type: str = "max", pool_stride=1,
+           pool_padding=0, global_pooling: bool = False,
+           ceil_mode: bool = False, exclusive: bool = True, name=None):
+    """Legacy merged pooling op (ref: pool2d_op): max or avg selected by
+    attribute."""
+    if global_pooling:
+        pool_size = [int(s) for s in ensure_tensor(x).shape[2:]]
+        pool_stride, pool_padding = pool_size, 0
+    if pool_type == "max":
+        return max_pool2d(x, pool_size, stride=pool_stride,
+                          padding=pool_padding, ceil_mode=ceil_mode)
+    return avg_pool2d(x, pool_size, stride=pool_stride,
+                      padding=pool_padding, ceil_mode=ceil_mode,
+                      exclusive=exclusive)
+
+
+def pool3d(x, pool_size, pool_type: str = "max", pool_stride=1,
+           pool_padding=0, global_pooling: bool = False,
+           ceil_mode: bool = False, exclusive: bool = True, name=None):
+    """Legacy merged 3-D pooling op (ref: pool3d_op)."""
+    if global_pooling:
+        pool_size = [int(s) for s in ensure_tensor(x).shape[2:]]
+        pool_stride, pool_padding = pool_size, 0
+    if pool_type == "max":
+        return max_pool3d(x, pool_size, stride=pool_stride,
+                          padding=pool_padding, ceil_mode=ceil_mode)
+    return avg_pool3d(x, pool_size, stride=pool_stride,
+                      padding=pool_padding, ceil_mode=ceil_mode,
+                      exclusive=exclusive)

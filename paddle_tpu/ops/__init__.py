@@ -7,8 +7,9 @@ these modules populate it and patch methods onto Tensor (mirroring how the refer
 
 import types as _types
 
-from . import (creation, extended, extras, linalg, logic, manipulation, math,
-               quant, random, search, sequence, sets, special, windows)
+from . import (array, creation, decode, extended, extras, legacy, linalg,
+               logic, manipulation, math, quant, random, search, sequence,
+               sets, special, windows)
 
 _EXCLUDE = {"Tensor", "Parameter", "to_tensor", "ensure_tensor", "forward_op",
             "register_op", "patch_methods", "unary_factory", "binary_factory",
@@ -40,7 +41,8 @@ __all__ = sorted(set(
     _export(creation) + _export(math) + _export(manipulation) + _export(linalg)
     + _export(logic) + _export(search) + _export(random) + _export(extras)
     + _export(extended) + _export(sets) + _export(special)
-    + _export(windows) + _export(sequence) + _export(quant)))
+    + _export(windows) + _export(sequence) + _export(quant)
+    + _export(decode) + _export(legacy) + _export(array)))
 # the inplace generator reads the assembled surface above — import it last
 from . import inplace  # noqa: E402
 __all__ = sorted(set(__all__ + _export(inplace)))

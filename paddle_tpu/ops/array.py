@@ -1,0 +1,67 @@
+"""TensorArray ops (ref: python/paddle/tensor/array.py — create_array /
+array_read / array_write / array_length, plus the
+tensor_array_to_tensor fusion op).
+
+TPU stance: the reference's LoDTensorArray is a dynamic-length list the
+static-graph while_op threads through steps. Under this framework's jit
+tiers, loops are ``lax.scan``/``while_loop`` with stacked carries — so the
+eager TensorArray here is a plain Python list (exactly what the reference's
+dygraph mode does too), and ``tensor_array_to_tensor`` is the bridge that
+stacks/concats it into the static world."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = ["create_array", "array_read", "array_write", "array_length",
+           "tensor_array_to_tensor"]
+
+
+def create_array(dtype="float32", initialized_list=None, name=None):
+    """New TensorArray (a list; ref: paddle.tensor.create_array)."""
+    arr = [ensure_tensor(t) for t in (initialized_list or [])]
+    return arr
+
+
+def array_write(x, i, array=None, name=None):
+    """Write ``x`` at position ``i`` (extends the array as upstream's
+    write-past-end does)."""
+    if array is None:
+        array = []
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._value))
+    t = ensure_tensor(x)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = t
+    return array
+
+
+def array_read(array, i, name=None):
+    """Read position ``i``."""
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._value))
+    return array[idx]
+
+
+def array_length(array, name=None):
+    """Length of the array as a Tensor (ref: paddle.tensor.array_length)."""
+    from ..core.tensor import to_tensor
+    return to_tensor(np.int64(len(array)))
+
+
+def tensor_array_to_tensor(array, axis: int = 0, use_stack: bool = False,
+                           name=None):
+    """Stack or concat the array into one Tensor + per-element sizes (ref:
+    tensor_array_to_tensor_op)."""
+    ts = [ensure_tensor(t) for t in array]
+    from ..core.tensor import to_tensor
+    if use_stack:
+        from .manipulation import stack
+        out = stack(ts, axis=axis)
+        sizes = np.ones(len(ts), np.int64)
+    else:
+        from .manipulation import concat
+        out = concat(ts, axis=axis)
+        sizes = np.asarray([int(t.shape[axis]) for t in ts], np.int64)
+    return out, to_tensor(sizes)

@@ -1,0 +1,337 @@
+"""Structured-prediction / decoding op family.
+
+Parity targets: ``linear_chain_crf_op`` / ``crf_decoding_op`` (paddle
+fluid CRF layers), ``ctc_align_op``, ``warpctc_op``, the seq2seq decode ops
+(``beam_search_op``, ``beam_search_decode_op``, ``gather_tree_op``) and
+``edit_distance_op`` in the reference.
+
+TPU redesign: each dynamic-programming recursion (CRF forward, Viterbi,
+Levenshtein, beam back-tracking) is a ``lax.scan`` over the time axis with
+the whole batch vectorized per step — the upstream per-sequence CPU loops /
+CUDA kernels become one compiled program with static [B, T] shapes and
+length masks. Beam search keeps a static [B, W] beam; finished beams are
+frozen by score masking rather than removed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._helpers import Tensor, ensure_tensor, forward_op
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "ctc_align", "warpctc",
+    "beam_search", "beam_search_decode", "gather_tree", "edit_distance",
+]
+
+
+# ---------------------------------------------------------------------------
+# CRF (reference transition layout: [K+2, K]; row 0 start, row 1 stop)
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(emission, transition, label, length=None, name=None):
+    """Negative log-likelihood of a linear-chain CRF (ref:
+    linear_chain_crf_op). ``emission [B, T, K]``, ``transition [K+2, K]``
+    (row 0 = start, row 1 = stop, rows 2.. = pairwise), ``label [B, T]``.
+    Returns ``log_likelihood [B]`` (logZ - path score, the reference's
+    sign). Forward algorithm = one lax.scan, batch-vectorized."""
+    et = ensure_tensor(emission)
+    tt = ensure_tensor(transition)
+    lt = ensure_tensor(label)
+    args = [et, tt, lt]
+    if length is not None:
+        args.append(ensure_tensor(length))
+
+    def impl(ev, tv, lv, *ln):
+        B, T, K = ev.shape
+        start, stop, trans = tv[0], tv[1], tv[2:]
+        lens = ln[0] if ln else jnp.full((B,), T)
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+
+        # --- partition function: alpha recursion over t
+        def step(alpha, t):
+            # alpha [B, K]; scores [B, K_prev, K_next]
+            s = alpha[:, :, None] + trans[None] + ev[:, t][:, None, :]
+            nxt = jax.scipy.special.logsumexp(s, axis=1)
+            keep = valid[:, t][:, None]
+            return jnp.where(keep, nxt, alpha), None
+
+        alpha0 = start[None] + ev[:, 0]
+        alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+        logZ = jax.scipy.special.logsumexp(alphaT + stop[None], axis=1)
+
+        # --- gold path score
+        b = jnp.arange(B)
+        em_sc = jnp.where(valid,
+                          jnp.take_along_axis(ev, lv[..., None],
+                                              -1)[..., 0], 0.0).sum(1)
+        prev = lv[:, :-1]
+        nxt = lv[:, 1:]
+        tr_sc = jnp.where(valid[:, 1:], trans[prev, nxt], 0.0).sum(1)
+        first = lv[:, 0]
+        last = jnp.take_along_axis(lv, jnp.clip(lens - 1, 0)[:, None],
+                                   1)[:, 0]
+        score = em_sc + tr_sc + start[first] + stop[last]
+        return logZ - score
+
+    return forward_op("linear_chain_crf", impl, args)
+
+
+def crf_decoding(emission, transition, length=None, name=None):
+    """Viterbi decode with the CRF's [K+2, K] transition layout (ref:
+    crf_decoding_op). Returns the argmax path ``[B, T]`` (padding tail 0).
+    Max-product scan forward + back-pointer scan backward."""
+    et = ensure_tensor(emission)
+    tt = ensure_tensor(transition)
+    args = [et, tt]
+    if length is not None:
+        args.append(ensure_tensor(length))
+
+    def impl(ev, tv, *ln):
+        B, T, K = ev.shape
+        start, stop, trans = tv[0], tv[1], tv[2:]
+        lens = ln[0] if ln else jnp.full((B,), T)
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+
+        def fwd(carry, t):
+            alpha = carry
+            s = alpha[:, :, None] + trans[None] + ev[:, t][:, None, :]
+            best = s.max(1)
+            ptr = s.argmax(1)
+            keep = valid[:, t][:, None]
+            return jnp.where(keep, best, alpha), \
+                jnp.where(keep, ptr, jnp.arange(K)[None])
+
+        alpha0 = start[None] + ev[:, 0]
+        alphaT, ptrs = lax.scan(fwd, alpha0, jnp.arange(1, T))
+        # ptrs [T-1, B, K]
+        last = (alphaT + stop[None]).argmax(1)                # [B]
+
+        def bwd(carry, ptr_t):
+            lab = carry
+            prev = jnp.take_along_axis(ptr_t, lab[:, None], 1)[:, 0]
+            return prev, lab
+
+        first_lab, labs = lax.scan(bwd, last, ptrs, reverse=True)
+        path = jnp.concatenate([first_lab[None], labs], 0).T  # [B, T]
+        return jnp.where(valid, path, 0)
+
+    return forward_op("crf_decoding", impl, args, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def ctc_align(input, input_length=None, blank: int = 0, padding_value: int = 0,
+              name=None):
+    """Collapse CTC raw predictions: merge repeats then drop blanks (ref:
+    ctc_align_op). Static compaction: keep-mask + stable sort, fixed [B, T]
+    out + lengths."""
+    it = ensure_tensor(input)
+    args = [it]
+    if input_length is not None:
+        args.append(ensure_tensor(input_length))
+
+    def impl(v, *ln):
+        B, T = v.shape
+        lens = ln[0] if ln else jnp.full((B,), T)
+        j = jnp.arange(T)[None, :]
+        valid = j < lens[:, None]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, v.dtype), v[:, :-1]], 1)
+        keep = valid & (v != blank) & (v != prev)
+        order = jnp.argsort(jnp.where(keep, j, T), axis=1, stable=True)
+        g = jnp.take_along_axis(v, order, 1)
+        new_lens = keep.sum(1)
+        out = jnp.where(j < new_lens[:, None], g, padding_value)
+        return out, new_lens
+
+    return forward_op("ctc_align", impl, args, differentiable=False)
+
+
+def warpctc(logits, label, logits_length, labels_length, blank: int = 0,
+            norm_by_times: bool = False, name=None):
+    """CTC loss under the reference's warpctc entry point (ref:
+    warpctc_op) — routes to the in-graph alpha-recursion CTC
+    (``nn.functional.ctc_loss`` scan formulation). ``logits [T, B, K]``
+    (time-major, the warpctc convention)."""
+    from ..nn import functional as F
+    from .manipulation import transpose
+    lg = ensure_tensor(logits)
+    lg_btk = transpose(lg, [1, 0, 2])
+    loss = F.ctc_loss(lg_btk, label, logits_length, labels_length,
+                      blank=blank, reduction="none")
+    if norm_by_times:
+        from ._helpers import forward_op as _f
+        lt = ensure_tensor(logits_length)
+        return _f("warpctc_norm",
+                  lambda l, n: l / jnp.maximum(n.astype(l.dtype), 1),
+                  [loss, lt])
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size: int,
+                end_id: int, level: int = 0, is_accumulated: bool = True,
+                name=None):
+    """One beam-search expansion step (ref: beam_search_op). ``pre_scores
+    [B, W]`` current beam scores, ``scores [B, W, V]`` next-token
+    (log-prob) scores; picks the global top-W of W*V candidates per batch.
+    Finished beams (last id == end_id) are frozen: they emit only end_id
+    with unchanged score. Returns ``(selected_ids [B, W],
+    selected_scores [B, W], parent_idx [B, W])`` — static shapes."""
+    pit = ensure_tensor(pre_ids)
+    pst = ensure_tensor(pre_scores)
+    st = ensure_tensor(scores)
+
+    def impl(pi, ps, sc):
+        B, W, V = sc.shape
+        fin = pi == end_id                                   # [B, W]
+        total = jnp.where(fin[..., None],
+                          -jnp.inf, ps[..., None] + sc)
+        # frozen beams re-emit end_id at their own score
+        total = total.at[:, :, end_id].set(
+            jnp.where(fin, ps, total[:, :, end_id]))
+        flat = total.reshape(B, W * V)
+        top, idx = lax.top_k(flat, W)
+        parent = idx // V
+        tok = idx % V
+        return tok, top, parent
+
+    return forward_op("beam_search", impl, [pit, pst, st],
+                      differentiable=False)
+
+
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beams from per-step tokens + parent pointers (ref:
+    gather_tree_op; paddle.nn.functional.gather_tree). ``ids/parents
+    [T, B, W]``; a reverse lax.scan walks the pointer chain."""
+    it = ensure_tensor(ids)
+    pt = ensure_tensor(parents)
+
+    def impl(iv, pv):
+        T, B, W = iv.shape
+        b = jnp.arange(B)[:, None]
+
+        def step(beam, t):
+            # beam [B, W]: which slot at step t+1 each final beam occupies
+            tok = iv[t][b, beam]
+            par = pv[t][b, beam]
+            return par, tok
+
+        last = jnp.broadcast_to(jnp.arange(W)[None], (B, W))
+        _, toks = lax.scan(step, last, jnp.arange(T), reverse=True)
+        return toks                                          # [T, B, W]
+
+    return forward_op("gather_tree", impl, [it, pt], differentiable=False)
+
+
+def beam_search_decode(ids, parents, beam_size=None, end_id: int = -1,
+                       name=None):
+    """Full-beam decode (ref: beam_search_decode_op): gather_tree then
+    truncate each beam at its first ``end_id``. Returns ``(sequences
+    [T, B, W], lengths [B, W])``."""
+    full = gather_tree(ids, parents)
+
+    def impl(fv):
+        T = fv.shape[0]
+        hit = fv == end_id
+        any_end = hit.any(0)
+        first = jnp.where(any_end, hit.argmax(0) + 1, T)     # keep end token
+        return fv, first
+
+    return forward_op("beam_search_decode", impl, [full],
+                      differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized: bool = True, name=None):
+    """Levenshtein distance per batch row (ref: edit_distance_op).
+    ``input [B, T1]``, ``label [B, T2]`` id sequences with optional
+    lengths. The DP table rolls forward one column per scan step (static
+    [B, T1+1] carry). Returns ``(distance [B], sequence_num [B])``."""
+    it = ensure_tensor(input)
+    lt = ensure_tensor(label)
+    args = [it, lt]
+    if input_length is not None:
+        args.append(ensure_tensor(input_length))
+        args.append(ensure_tensor(label_length))
+
+    def impl(iv, lv, *ln):
+        B, T1 = iv.shape
+        T2 = lv.shape[1]
+        ilen = ln[0] if ln else jnp.full((B,), T1)
+        llen = ln[1] if ln else jnp.full((B,), T2)
+
+        # dp[i] = distance(input[:i], label[:j]) rolled over j
+        row0 = jnp.broadcast_to(jnp.arange(T1 + 1)[None].astype(jnp.float32),
+                                (B, T1 + 1))
+
+        def col(dp, j):
+            # moving to column j+1 (label token j)
+            sub = dp[:, :-1] + (iv != lv[:, j][:, None]).astype(jnp.float32)
+            base = jnp.concatenate(
+                [jnp.full((B, 1), j + 1, jnp.float32),
+                 jnp.full((B, T1), jnp.inf)], 1)
+            ins = dp + 1.0                                   # from left col
+
+            def inner(prev, i):
+                cur = jnp.minimum(jnp.minimum(ins[:, i + 1], sub[:, i]),
+                                  prev + 1.0)
+                return cur, cur
+
+            first = base[:, 0]
+            _, rest = lax.scan(inner, first, jnp.arange(T1))
+            newdp = jnp.concatenate([first[:, None], rest.T], 1)
+            # columns beyond this row's label length keep the old dp
+            keep = (j < llen)[:, None]
+            return jnp.where(keep, newdp, dp), None
+
+        dpT, _ = lax.scan(col, row0, jnp.arange(T2))
+        dist = jnp.take_along_axis(dpT, ilen[:, None], 1)[:, 0]
+        if normalized:
+            dist = dist / jnp.maximum(llen.astype(jnp.float32), 1)
+        return dist, jnp.ones((B,), jnp.int32)
+
+    return forward_op("edit_distance", impl, args, differentiable=False)
+
+
+def ctc_greedy_decoder(input, blank: int = 0, input_length=None, name=None):  # noqa: A002
+    """Greedy CTC decode: argmax per step then collapse (ref:
+    ctc_greedy_decoder_op) — argmax + the ctc_align compaction, one
+    program. ``input [B, T, K]`` probabilities/logits. Returns
+    ``(out [B, T], out_lens [B])``."""
+    it = ensure_tensor(input)
+    args = [it]
+    if input_length is not None:
+        args.append(ensure_tensor(input_length))
+
+    def impl(v, *ln):
+        ids = jnp.argmax(v, -1)
+        B, T = ids.shape
+        lens = ln[0] if ln else jnp.full((B,), T)
+        j = jnp.arange(T)[None, :]
+        valid = j < lens[:, None]
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]], 1)
+        keep = valid & (ids != blank) & (ids != prev)
+        order = jnp.argsort(jnp.where(keep, j, T), axis=1, stable=True)
+        g = jnp.take_along_axis(ids, order, 1)
+        new_lens = keep.sum(1)
+        return jnp.where(j < new_lens[:, None], g, 0), new_lens
+
+    return forward_op("ctc_greedy_decoder", impl, args,
+                      differentiable=False)
+
+
+__all__ += ["ctc_greedy_decoder"]

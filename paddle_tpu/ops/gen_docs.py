@@ -28,6 +28,10 @@ def generate(out_path: str = "docs/OPS.md") -> str:
     import paddle_tpu.fft  # noqa: F401
     import paddle_tpu.audio  # noqa: F401
     import paddle_tpu.incubate.nn.functional  # noqa: F401
+    import paddle_tpu.distributed.moe_utils  # noqa: F401
+    import paddle_tpu.vision.transforms  # noqa: F401
+    import paddle_tpu.text  # noqa: F401
+    import paddle_tpu.metric  # noqa: F401
     from paddle_tpu.core.dispatch import OP_REGISTRY
     from paddle_tpu.ops.sweep_specs import attach_specs, sweep_coverage
     attach_specs()

@@ -62,6 +62,8 @@ _INPLACE_BASES = [
     "scatter_add", "scatter_reduce", "true_divide", "trunc_divide",
     "divide_no_nan", "bitwise_invert", "masked_scatter",
     "take_along_dim", "narrow", "clip_by_norm",
+    # r5: remaining genuine upstream inplace twins
+    "fill_diagonal_tensor",
 ]
 
 
@@ -86,6 +88,15 @@ def _populate():
 _generated = _populate()
 globals().update(_generated)
 __all__ = sorted(_generated)
+
+# upstream exposes every inplace twin as a Tensor METHOD (x.tanh_(),
+# x.scatter_(...)); mirror that for the generated family (math.py patches
+# its own hand-written subset first — don't shadow those)
+from ..core.tensor import Tensor as _T  # noqa: E402
+
+for _mname, _mfn in _generated.items():
+    if not hasattr(_T, _mname):
+        setattr(_T, _mname, _mfn)
 
 
 def _fill(x, value):

@@ -1,0 +1,706 @@
+"""Legacy / long-tail op cluster.
+
+Parity targets: scattered singles from ``paddle/fluid/operators`` and
+``python/paddle/tensor`` that predate the phi reorganization — batch-size-
+like creation ops, CTR ops (cvm, data_norm, shuffle_batch), per-slot
+batch_fc, partial concat/sum, layout shuffles (space_to_depth), plus newer
+tensor API entries (nonzero_static, fill_diagonal_tensor, pca_lowrank).
+
+TPU notes: everything stays static-shape (nonzero_static exists upstream
+precisely because nonzero's dynamic shape breaks compiled graphs — the op
+IS the TPU formulation); random ops draw from the framework generator
+eagerly; the rest are jnp one-liners or einsums.
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._helpers import Tensor, axes_arg, ensure_tensor, forward_op
+
+__all__ = [
+    "exprel", "multigammaln", "reduce_as", "addbmm", "pca_lowrank",
+    "im2col", "is_integer", "contiguous", "log_normal", "space_to_depth",
+    "depth_to_space", "affine_channel", "data_norm", "fill_any",
+    "fill_any_like", "unique_with_counts", "partial_concat", "partial_sum",
+    "shuffle_batch", "batch_fc", "cvm", "sampling_id",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "fill_constant_batch_size_like", "dropout_nd",
+    "fused_embedding_seq_pool", "nonzero_static", "fill_diagonal_tensor",
+]
+
+
+def exprel(x, name=None):
+    """(e^x - 1) / x, -> 1 at 0 (scipy.special.exprel parity)."""
+    def impl(v):
+        small = jnp.abs(v) < 1e-8
+        safe = jnp.where(small, 1.0, v)
+        return jnp.where(small, 1.0 + v / 2, jnp.expm1(safe) / safe)
+    return forward_op("exprel", impl, [ensure_tensor(x)])
+
+
+def multigammaln(x, p: int, name=None):
+    """Log multivariate gamma (scipy.special.multigammaln parity)."""
+    def impl(v):
+        c = 0.25 * p * (p - 1) * _math.log(_math.pi)
+        return c + sum(jax.scipy.special.gammaln(v - 0.5 * j)
+                       for j in range(p))
+    return forward_op("multigammaln", impl, [ensure_tensor(x)])
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce ``x`` down to ``target``'s shape (ref: paddle.reduce_as)."""
+    xt = ensure_tensor(x)
+    tt = ensure_tensor(target)
+
+    def impl(v, t):
+        extra = v.ndim - t.ndim
+        if extra:
+            v = v.sum(tuple(range(extra)))
+        axes = tuple(i for i in range(v.ndim)
+                     if t.shape[i] == 1 and v.shape[i] != 1)
+        return v.sum(axes, keepdims=True) if axes else v
+
+    return forward_op("reduce_as", impl, [xt, tt])
+
+
+def addbmm(input, x, y, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta*input + alpha*sum_b(x[b] @ y[b]) (torch.addbmm parity)."""
+    return forward_op(
+        "addbmm",
+        lambda i, a, b: beta * i + alpha * jnp.einsum("bik,bkj->ij", a, b),
+        [ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)])
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2, name=None):
+    """Randomized low-rank PCA -> (U, S, V) (torch.pca_lowrank parity;
+    power-iterated randomized range finder, all dense matmuls)."""
+    xt = ensure_tensor(x)
+    m, n = int(xt.shape[-2]), int(xt.shape[-1])
+    q = q if q is not None else min(6, m, n)
+
+    def impl(v):
+        a = v - v.mean(-2, keepdims=True) if center else v
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, (n, q), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.T @ y)
+        Q, _ = jnp.linalg.qr(y)
+        b = Q.T @ a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return Q @ u, s, vt.T
+
+    return forward_op("pca_lowrank", impl, [xt])
+
+
+def im2col(x, kernel_size, stride=1, padding=0, dilation=1, name=None):
+    """Patch extraction [B, C, H, W] -> [B, C*kh*kw, L] (ref: im2col — the
+    unfold kernel; one conv_general_dilated_patches call)."""
+    xt = ensure_tensor(x)
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def impl(v):
+        p = lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw))
+        B, F = p.shape[:2]
+        return p.reshape(B, F, -1)
+
+    return forward_op("im2col", impl, [xt])
+
+
+def is_integer(x, name=None):
+    """dtype predicate (ref: paddle.is_integer)."""
+    t = ensure_tensor(x)
+    return jnp.issubdtype(t._value.dtype, jnp.integer)
+
+
+def contiguous(x, name=None):
+    """Identity on XLA (arrays are always dense row-major; ref:
+    paddle.Tensor.contiguous)."""
+    return forward_op("contiguous", lambda v: v, [ensure_tensor(x)])
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Log-normal sample (ref: paddle.log_normal)."""
+    from .random import standard_normal
+    out = standard_normal(shape if shape is not None else [1])
+    return forward_op(
+        "log_normal", lambda v: jnp.exp(v * std + mean), [out])
+
+
+def space_to_depth(x, blocksize: int, name=None):
+    """[B, C, H, W] -> [B, C*bs*bs, H/bs, W/bs] (ref: space_to_depth_op)."""
+    bs = blocksize
+
+    def impl(v):
+        B, C, H, W = v.shape
+        v = v.reshape(B, C, H // bs, bs, W // bs, bs)
+        return v.transpose(0, 3, 5, 1, 2, 4).reshape(
+            B, C * bs * bs, H // bs, W // bs)
+
+    return forward_op("space_to_depth", impl, [ensure_tensor(x)])
+
+
+def depth_to_space(x, blocksize: int, name=None):
+    """Inverse of space_to_depth (ref: pixel_shuffle's NCHW kernel)."""
+    bs = blocksize
+
+    def impl(v):
+        B, C, H, W = v.shape
+        v = v.reshape(B, bs, bs, C // (bs * bs), H, W)
+        return v.transpose(0, 3, 4, 1, 5, 2).reshape(
+            B, C // (bs * bs), H * bs, W * bs)
+
+    return forward_op("depth_to_space", impl, [ensure_tensor(x)])
+
+
+def affine_channel(x, scale, bias, data_layout: str = "NCHW", name=None):
+    """Per-channel scale + bias (ref: affine_channel_op — the frozen-BN
+    kernel)."""
+    def impl(v, s, b):
+        if data_layout == "NCHW":
+            shape = (1, -1) + (1,) * (v.ndim - 2)
+        else:
+            shape = (1,) * (v.ndim - 1) + (-1,)
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    return forward_op("affine_channel", impl,
+                      [ensure_tensor(x), ensure_tensor(scale),
+                       ensure_tensor(bias)])
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum,
+              epsilon: float = 1e-4, name=None):
+    """CTR data normalization (ref: data_norm_op): normalize by
+    accumulated batch statistics; pure form returns
+    ``(out, new_size, new_sum, new_square_sum)``."""
+    def impl(v, n, s, ss):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(ss - n * mean * mean / n, epsilon))
+        # upstream: scale = sqrt(n / sum((x - mean)^2)) per feature
+        var = ss / n - mean * mean
+        out = (v - mean) / jnp.sqrt(jnp.maximum(var, epsilon))
+        B = v.shape[0]
+        return (out, n + B, s + v.sum(0), ss + (v * v).sum(0))
+
+    return forward_op("data_norm", impl,
+                      [ensure_tensor(x), ensure_tensor(batch_size),
+                       ensure_tensor(batch_sum),
+                       ensure_tensor(batch_square_sum)])
+
+
+def fill_any(x, value, name=None):
+    """Fill with a runtime scalar (ref: fill_any_op)."""
+    vt = ensure_tensor(value)
+    return forward_op(
+        "fill_any",
+        lambda v, val: jnp.full_like(v, val.astype(v.dtype)),
+        [ensure_tensor(x), vt], differentiable=False)
+
+
+def fill_any_like(x, value, dtype=None, name=None):
+    """full_like under the legacy name (ref: fill_any_like_op)."""
+    def impl(v):
+        out = jnp.full_like(v, value)
+        if dtype is not None:
+            from .creation import canonical_dtype
+            out = out.astype(canonical_dtype(dtype))
+        return out
+    return forward_op("fill_any_like", impl, [ensure_tensor(x)],
+                      differentiable=False)
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """(unique values, inverse index, counts) — eager (data-dependent
+    output shape; ref: unique_with_counts_op)."""
+    t = ensure_tensor(x)
+    v, inv, cnt = np.unique(np.asarray(t._value), return_inverse=True,
+                            return_counts=True)
+    from ..core.tensor import to_tensor
+    return to_tensor(v), to_tensor(inv.astype(np.int64)), \
+        to_tensor(cnt.astype(np.int64))
+
+
+def partial_concat(xs, start_index: int = 0, length: int = -1, name=None):
+    """Concat x[:, start:start+length] of each input (ref:
+    partial_concat_op)."""
+    ts = [ensure_tensor(x) for x in xs]
+
+    def impl(*vs):
+        sl = [v[:, start_index:(None if length < 0
+                                else start_index + length)] for v in vs]
+        return jnp.concatenate(sl, -1)
+
+    return forward_op("partial_concat", impl, ts)
+
+
+def partial_sum(xs, start_index: int = 0, length: int = -1, name=None):
+    """Sum of x[:, start:start+length] across inputs (ref:
+    partial_sum_op)."""
+    ts = [ensure_tensor(x) for x in xs]
+
+    def impl(*vs):
+        sl = [v[:, start_index:(None if length < 0
+                                else start_index + length)] for v in vs]
+        return sum(sl[1:], sl[0])
+
+    return forward_op("partial_sum", impl, ts)
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """Random row permutation (ref: shuffle_batch_op). Eager random;
+    returns (shuffled, permutation)."""
+    t = ensure_tensor(x)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(int(t.shape[0]))
+    from ..core.tensor import to_tensor
+    pt = to_tensor(perm.astype(np.int64))
+    out = forward_op("shuffle_batch", lambda v, p: v[p], [t, pt])
+    return out, pt
+
+
+def batch_fc(x, w, bias=None, name=None):
+    """Per-slot FC: x [S, B, I] @ w [S, I, O] + b [S, O] (ref:
+    batch_fc_op — the CTR multi-slot projection, one einsum)."""
+    args = [ensure_tensor(x), ensure_tensor(w)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def impl(xv, wv, *b):
+        out = jnp.einsum("sbi,sio->sbo", xv, wv)
+        return out + b[0][:, None, :] if b else out
+
+    return forward_op("batch_fc", impl, args)
+
+
+def cvm(x, cvm_input, use_cvm: bool = True, name=None):
+    """Continuous-value-model feature transform (ref: cvm_op): the first
+    two columns are (show, click); use_cvm keeps them log-transformed,
+    otherwise they are dropped."""
+    def impl(v, c):
+        show = jnp.log(c[:, 0] + 1)
+        click = jnp.log(c[:, 1] + 1) - show
+        if use_cvm:
+            return jnp.concatenate([show[:, None], click[:, None],
+                                    v[:, 2:]], -1)
+        return v[:, 2:]
+
+    return forward_op("cvm", impl,
+                      [ensure_tensor(x), ensure_tensor(cvm_input)])
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):  # noqa: A002
+    """Sample one category id per row from probability rows (ref:
+    sampling_id_op). Eager random."""
+    t = ensure_tensor(x)
+    p = np.asarray(t._value, np.float64)
+    p = p / p.sum(-1, keepdims=True)
+    rng = np.random.default_rng(seed or None)
+    ids = np.array([rng.choice(p.shape[1], p=row) for row in p])
+    from ..core.tensor import to_tensor
+    return to_tensor(ids.astype(np.int64))
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,  # noqa: A002
+                                   input_dim_idx: int = 0,
+                                   output_dim_idx: int = 0, dtype="float32",
+                                   name=None):
+    """Uniform sample whose dim ``output_dim_idx`` copies the input's batch
+    (ref: uniform_random_batch_size_like_op)."""
+    t = ensure_tensor(input)
+    shape = list(shape)
+    shape[output_dim_idx] = int(t.shape[input_dim_idx])
+    from .random import uniform
+    return uniform(shape, min=min, max=max, dtype=dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,  # noqa: A002
+                                    input_dim_idx: int = 0,
+                                    output_dim_idx: int = 0,
+                                    dtype="float32", name=None):
+    """Gaussian twin of uniform_random_batch_size_like."""
+    t = ensure_tensor(input)
+    shape = list(shape)
+    shape[output_dim_idx] = int(t.shape[input_dim_idx])
+    from .random import normal
+    return normal(mean=mean, std=std, shape=shape)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx: int = 0,
+                                  output_dim_idx: int = 0, name=None):
+    """Constant tensor with the input's batch size (ref:
+    fill_constant_batch_size_like_op)."""
+    t = ensure_tensor(input)
+    shape = list(shape)
+    shape[output_dim_idx] = int(t.shape[input_dim_idx])
+    from .creation import full
+    return full(shape, value, dtype=dtype)
+
+
+def dropout_nd(x, p: float = 0.5, axis=None, training: bool = True,
+               mode: str = "upscale_in_train", name=None):
+    """Dropout with the mask shared over the non-listed axes (ref:
+    incubate dropout_nd)."""
+    from ..nn import functional as F
+    if axis is None:
+        return F.dropout(x, p, training=training, mode=mode)
+    t = ensure_tensor(x)
+    if not training or p == 0.0:
+        return forward_op("dropout_nd", lambda v: v, [t])
+    axes = axes_arg(axis)
+    axes = (axes,) if isinstance(axes, int) else axes
+    from .random import _next_key
+    key = _next_key()
+
+    def impl(v):
+        shape = tuple(v.shape[d] if d in axes else 1 for d in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1 - p), 0)
+        return jnp.where(keep, v, 0)
+
+    return forward_op("dropout_nd", impl, [t])
+
+
+def fused_embedding_seq_pool(table, ids, pool_type: str = "sum",
+                             padding_idx=None, name=None):
+    """Embedding lookup + sequence pool in one op (ref:
+    fused_embedding_seq_pool_op): ids [B, T] -> pooled [B, D]."""
+    tt = ensure_tensor(table)
+    it = ensure_tensor(ids)
+
+    def impl(tv, iv):
+        emb = tv[jnp.clip(iv, 0, tv.shape[0] - 1)]            # [B, T, D]
+        if padding_idx is not None:
+            emb = emb * (iv != padding_idx)[..., None]
+        if pool_type == "sum":
+            return emb.sum(1)
+        if pool_type in ("mean", "average"):
+            n = ((iv != padding_idx).sum(1, keepdims=True)
+                 if padding_idx is not None
+                 else jnp.full((iv.shape[0], 1), iv.shape[1]))
+            return emb.sum(1) / jnp.maximum(n, 1)
+        raise ValueError(f"pool_type {pool_type!r}")
+
+    return forward_op("fused_embedding_seq_pool", impl, [tt, it])
+
+
+def nonzero_static(x, size: int, fill_value: int = -1, name=None):
+    """Static-shape nonzero (ref: paddle.nonzero_static — added upstream
+    exactly because dynamic nonzero can't live in a compiled graph):
+    returns the first ``size`` nonzero coordinates [size, ndim], padded
+    with ``fill_value``."""
+    t = ensure_tensor(x)
+
+    def impl(v):
+        flat = (v != 0).reshape(-1)
+        idx = jnp.argsort(~flat, stable=True)[:size]          # nonzeros first
+        n = flat.sum()
+        coords = jnp.stack(jnp.unravel_index(idx, v.shape), -1)
+        ok = jnp.arange(size) < n
+        return jnp.where(ok[:, None], coords, fill_value)
+
+    return forward_op("nonzero_static", impl, [t], differentiable=False)
+
+
+def fill_diagonal_tensor(x, y, offset: int = 0, dim1: int = 0,
+                         dim2: int = 1, name=None):
+    """Write ``y`` along the (dim1, dim2) diagonal (ref:
+    paddle.Tensor.fill_diagonal_tensor)."""
+    xt = ensure_tensor(x)
+    yt = ensure_tensor(y)
+
+    def impl(v, w):
+        vm = jnp.moveaxis(v, (dim1, dim2), (-2, -1))
+        n = min(vm.shape[-2], vm.shape[-1] - offset) if offset >= 0 \
+            else min(vm.shape[-2] + offset, vm.shape[-1])
+        r = jnp.arange(max(n, 0))
+        rows = r - min(offset, 0)
+        cols = r + max(offset, 0)
+        vm = vm.at[..., rows, cols].set(w)
+        return jnp.moveaxis(vm, (-2, -1), (dim1, dim2))
+
+    return forward_op("fill_diagonal_tensor", impl, [xt, yt])
+
+
+# -- r5 second batch: static-graph-era singles + CTR text matching ----------
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight=None, bias=None,
+       activation=None, name=None):
+    """Static-graph fc layer op (ref: fc_op): flatten trailing dims, one
+    matmul + bias + optional relu."""
+    xt = ensure_tensor(x)
+    lead = [int(s) for s in xt.shape[:num_flatten_dims]]
+    flat_in = 1
+    for s in xt.shape[num_flatten_dims:]:
+        flat_in *= int(s)
+    if weight is None:
+        raise ValueError("fc: pass `weight` explicitly (the layer tier "
+                         "owns parameter creation)")
+    args = [xt, ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def impl(v, w, *b):
+        out = v.reshape(tuple(lead) + (flat_in,)) @ w
+        if b:
+            out = out + b[0]
+        if activation == "relu":
+            out = jnp.maximum(out, 0)
+        return out
+
+    return forward_op("fc", impl, args)
+
+
+def assign_value(shape, dtype, values, name=None):
+    """Materialize a host constant (ref: assign_value_op)."""
+    from .creation import to_tensor as _tt, canonical_dtype
+    arr = np.asarray(values, dtype=canonical_dtype(dtype)).reshape(shape)
+    return _tt(arr)
+
+
+def soft_relu(x, threshold: float = 40.0, name=None):
+    """log(1 + e^x) with clipping (ref: soft_relu_op)."""
+    return forward_op(
+        "soft_relu",
+        lambda v: jnp.log1p(jnp.exp(jnp.clip(v, -threshold, threshold))),
+        [ensure_tensor(x)])
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0, name=None):
+    """Bounded relu (ref: brelu_op)."""
+    return forward_op("brelu", lambda v: jnp.clip(v, t_min, t_max),
+                      [ensure_tensor(x)])
+
+
+def match_matrix_tensor(x, y, w, x_lens=None, y_lens=None, dim_t=None,
+                        name=None):
+    """Bilinear text-match tensor (ref: match_matrix_tensor_op): for each
+    channel t, score[b, t, i, j] = x[b, i] @ w[t] @ y[b, j] — one einsum
+    (the CTR text-matching kernel on dense padded batches)."""
+    xt = ensure_tensor(x)      # [B, Lx, D1]
+    yt = ensure_tensor(y)      # [B, Ly, D2]
+    wt = ensure_tensor(w)      # [D1, T, D2]
+
+    def impl(xv, yv, wv):
+        return jnp.einsum("bid,dte,bje->btij", xv, wv, yv)
+
+    return forward_op("match_matrix_tensor", impl, [xt, yt, wt])
+
+
+def sequence_topk_avg_pooling(x, topks, channel_num: int = 1, name=None):
+    """Top-k average pooling over the last axis per channel/row (ref:
+    sequence_topk_avg_pooling_op): for each k in ``topks``, the mean of
+    the k largest values."""
+    xt = ensure_tensor(x)
+
+    def impl(v):
+        outs = []
+        srt = jnp.sort(v, axis=-1)[..., ::-1]
+        for k in topks:
+            kk = min(k, v.shape[-1])
+            outs.append(srt[..., :kk].mean(-1))
+        return jnp.stack(outs, -1)
+
+    return forward_op("sequence_topk_avg_pooling", impl, [xt])
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank: int = 3,
+                   name=None):
+    """Rank-aware attention projection (ref: rank_attention_op, the CTR
+    position-bias kernel): each row picks the parameter block of its
+    (rank_i, rank_j) pair; one gather + batched matmul."""
+    xt = ensure_tensor(x)              # [B, D]
+    ot = ensure_tensor(rank_offset)    # [B, 1 + 2*max_rank] ins rank + pairs
+    pt = ensure_tensor(rank_param)     # [max_rank*max_rank*D, out]
+
+    def impl(xv, ov, pv):
+        B, D = xv.shape
+        out_dim = pv.shape[1]
+        blocks = pv.reshape(max_rank * max_rank, D, out_dim)
+        ins_rank = jnp.clip(ov[:, 0], 0, max_rank - 1)
+        acc = jnp.zeros((B, out_dim), xv.dtype)
+        cnt = jnp.zeros((B, 1), xv.dtype)
+        for k in range(max_rank):
+            other = ov[:, 1 + 2 * k]
+            valid = other >= 0
+            idx = jnp.clip(ins_rank * max_rank +
+                           jnp.clip(other, 0, max_rank - 1), 0,
+                           max_rank * max_rank - 1).astype(jnp.int32)
+            proj = jnp.einsum("bd,bdo->bo", xv, blocks[idx])
+            acc = acc + jnp.where(valid[:, None], proj, 0)
+            cnt = cnt + valid[:, None].astype(xv.dtype)
+        return acc / jnp.maximum(cnt, 1)
+
+    return forward_op("rank_attention", impl, [xt, ot, pt])
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth: int = 2,
+              name=None):
+    """Tree-based convolution (ref: tree_conv_op, TBCNN): continuous
+    binary-tree position weights over each node's children window. Dense
+    formulation: adjacency as a [N, N] mask, one einsum per weight role
+    (top/left/right)."""
+    nt = ensure_tensor(nodes_vector)   # [B, N, D]
+    et = ensure_tensor(edge_set)       # [B, E, 2] (parent, child)
+    ft = ensure_tensor(filter)         # [D, 3, out]  (top/left/right roles)
+
+    def impl(nv, ev, fv):
+        B, N, D = nv.shape
+        out_dim = fv.shape[-1]
+        par = jnp.clip(ev[..., 0], 0, N - 1)
+        chl = jnp.clip(ev[..., 1], 0, N - 1)
+        valid = (ev[..., 0] >= 0) & (ev[..., 1] >= 0)
+        adj = jnp.zeros((B, N, N), nv.dtype)
+        b = jnp.broadcast_to(jnp.arange(B)[:, None], par.shape)
+        adj = adj.at[b, par, chl].max(jnp.where(valid, 1.0, 0.0))
+        deg = adj.sum(-1, keepdims=True)                    # children count
+        # eta weights: top for self, left/right by child position
+        pos = jnp.cumsum(adj, -1) * adj                     # 1-based pos
+        denom = jnp.maximum(deg - 1, 1)
+        eta_r = (pos - 1) / denom * adj
+        eta_l = (1 - (pos - 1) / denom) * adj
+        self_top = jnp.eye(N, dtype=nv.dtype)[None]
+        h = (jnp.einsum("bnm,bmd,do->bno", self_top,
+                        nv, fv[:, 0]) +
+             jnp.einsum("bnm,bmd,do->bno", eta_l, nv, fv[:, 1]) +
+             jnp.einsum("bnm,bmd,do->bno", eta_r, nv, fv[:, 2]))
+        return jnp.tanh(h)
+
+    return forward_op("tree_conv", impl, [nt, et, ft])
+
+
+def var_conv_2d(x, row_lens, col_lens, w, input_channel: int = 1,
+                output_channel: int = 1, filter_size: int = 3,
+                stride: int = 1, name=None):
+    """Variable-size 2-D conv over per-sample [row, col] shapes (ref:
+    var_conv_2d_op). Dense formulation: conv at full capacity + validity
+    mask from the per-sample sizes."""
+    from jax import lax as _lax
+    xt = ensure_tensor(x)              # [B, C, H, W] padded capacity
+    rt = ensure_tensor(row_lens)
+    ct = ensure_tensor(col_lens)
+    wt = ensure_tensor(w)              # [out, in, k, k]
+
+    def impl(xv, rv, cv, wv):
+        pad = filter_size // 2
+        out = _lax.conv_general_dilated(
+            xv, wv, (stride, stride), [(pad, pad), (pad, pad)])
+        H, W = out.shape[2], out.shape[3]
+        rm = jnp.arange(H)[None, :] < rv[:, None]
+        cm = jnp.arange(W)[None, :] < cv[:, None]
+        return out * (rm[:, None, :, None] & cm[:, None, None, :])
+
+    return forward_op("var_conv_2d", impl, [xt, rt, ct, wt])
+
+
+__all__ += ["fc", "assign_value", "soft_relu", "brelu",
+            "match_matrix_tensor", "sequence_topk_avg_pooling",
+            "rank_attention", "tree_conv", "var_conv_2d"]
+
+
+# -- r5 third batch: remaining genuine singles ------------------------------
+
+def l1_norm(x, name=None):
+    """Sum of absolute values (ref: l1_norm_op)."""
+    return forward_op("l1_norm", lambda v: jnp.sum(jnp.abs(v)),
+                      [ensure_tensor(x)])
+
+
+def share_data(x, name=None):
+    """Alias view of a tensor (ref: share_data_op — buffer sharing is a
+    no-op under XLA's immutable arrays)."""
+    return forward_op("share_data", lambda v: v, [ensure_tensor(x)])
+
+
+def lod_array_length(array, name=None):
+    """Length of a TensorArray (ref: lod_array_length_op)."""
+    from .array import array_length
+    return array_length(array)
+
+
+def set_value(x, value, name=None):
+    """Overwrite a tensor's buffer in place with host data (ref:
+    set_value_op / Tensor.set_value)."""
+    t = ensure_tensor(x)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(
+        np.asarray(value, dtype=np.asarray(t._value).dtype))
+    out = forward_op("set_value", lambda a, b: b.reshape(a.shape),
+                     [t, ensure_tensor(v)])
+    t._rebind(out)
+    return t
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """out[b, k] = x[b] @ W[k] @ y[b] (ref: bilinear_tensor_product_op)."""
+    args = [ensure_tensor(x), ensure_tensor(y), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def impl(xv, yv, wv, *b):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        return out + b[0] if b else out
+
+    return forward_op("bilinear_tensor_product", impl, args)
+
+
+def chunk_eval(input, label, chunk_scheme: str = "IOB",  # noqa: A002
+               num_chunk_types: int = 1, excluded_chunk_types=None,
+               seq_lens=None, name=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (ref:
+    chunk_eval_op, IOB scheme). Eager host metric: returns (precision,
+    recall, f1, num_infer, num_label, num_correct)."""
+    from ..core.tensor import to_tensor
+
+    def extract(seq):
+        chunks = []
+        start = None
+        ctype = None
+        for i, t in enumerate(list(seq) + [-1]):
+            t = int(t)
+            if t < 0 or t % 2 == 0:  # B-* tag (even) or end
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                    start, ctype = None, None
+                if t >= 0 and t % 2 == 0 and t // 2 < num_chunk_types:
+                    start, ctype = i, t // 2
+            # odd tags continue the current chunk (I-*); mismatched I ends
+        return set(chunks)
+
+    iv = np.asarray(ensure_tensor(input)._value)
+    lv = np.asarray(ensure_tensor(label)._value)
+    if iv.ndim == 1:
+        iv, lv = iv[None], lv[None]
+    lens = (np.asarray(ensure_tensor(seq_lens)._value)
+            if seq_lens is not None else
+            np.full(iv.shape[0], iv.shape[1]))
+    ni = nl = nc = 0
+    for b in range(iv.shape[0]):
+        ic = extract(iv[b, :lens[b]])
+        lc = extract(lv[b, :lens[b]])
+        ni += len(ic)
+        nl += len(lc)
+        nc += len(ic & lc)
+    p = nc / max(ni, 1)
+    r = nc / max(nl, 1)
+    f1 = 2 * p * r / max(p + r, 1e-12)
+    return (to_tensor(np.float32(p)), to_tensor(np.float32(r)),
+            to_tensor(np.float32(f1)), to_tensor(np.int64(ni)),
+            to_tensor(np.int64(nl)), to_tensor(np.int64(nc)))
+
+
+__all__ += ["l1_norm", "share_data", "lod_array_length", "set_value",
+            "bilinear_tensor_product", "chunk_eval"]

@@ -357,3 +357,35 @@ def llm_int8_linear(x, weight, scale, threshold: float = 6.0, name=None):
         return out.reshape(lead + (wv.shape[1],))
 
     return forward_op("llm_int8_linear", impl, [xt, wt, st])
+
+
+def fake_dequantize_max_abs(x, scale, max_range: float = 127.0, name=None):
+    """Dequantize by the recorded abs-max scale: ``x * scale / max_range``
+    (ref: fake_dequantize_max_abs_op)."""
+    return forward_op(
+        "fake_dequantize_max_abs",
+        lambda v, s: v.astype(jnp.float32) * s / max_range,
+        [ensure_tensor(x), ensure_tensor(scale)], differentiable=False)
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis: int = 0, name=None):
+    """Per-channel dequantize (ref:
+    fake_channel_wise_dequantize_max_abs_op)."""
+    st = [ensure_tensor(s) for s in
+          (scales if isinstance(scales, (list, tuple)) else [scales])]
+    qmax = float((1 << (quant_bits[0] - 1)) - 1)
+
+    def impl(v, s, *more):
+        shape = [1] * v.ndim
+        shape[quant_axis] = -1
+        out = v.astype(jnp.float32) * s.reshape(shape) / qmax
+        for extra in more:   # second-level (whole-tensor) scale
+            out = out * extra / qmax
+        return out
+
+    return forward_op("fake_channel_wise_dequantize_max_abs", impl,
+                      [ensure_tensor(x)] + st, differentiable=False)
+
+
+__all__ += ["fake_dequantize_max_abs", "fake_channel_wise_dequantize_max_abs"]

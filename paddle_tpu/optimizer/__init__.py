@@ -1,6 +1,7 @@
 """paddle.optimizer namespace (parity: python/paddle/optimizer/__init__.py)."""
 
 from . import lr
+from . import ops as optimizer_ops
 from .optimizer import (ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax,
                         AdamW, Lamb, Momentum, NAdam, Optimizer, RAdam,
                         RMSProp, Rprop)

@@ -354,3 +354,111 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp *= self.exp_gamma ** self.last_epoch
         return self.base_lr + amp
+
+
+# ---------------------------------------------------------------------------
+# r5: the legacy functional decay ops (ref: the *_decay ops the reference
+# keeps in fluid/layers/learning_rate_scheduler + ops.yaml: each computes
+# lr(step) as a graph op). Pure closed forms over a step count — usable
+# inside a compiled train step (the scheduler classes above are the
+# stateful eager tier).
+# ---------------------------------------------------------------------------
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate^(step/decay_steps) (ref: exponential_decay op)."""
+    def at(step):
+        e = step / decay_steps
+        if staircase:
+            e = e // 1
+        return learning_rate * decay_rate ** e
+    return at
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * e^(-decay_rate * step/decay_steps)."""
+    import math
+    def at(step):
+        e = step / decay_steps
+        if staircase:
+            e = e // 1
+        return learning_rate * math.e ** (-decay_rate * e)
+    return at
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step/decay_steps)."""
+    def at(step):
+        e = step / decay_steps
+        if staircase:
+            e = e // 1
+        return learning_rate / (1 + decay_rate * e)
+    return at
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """Polynomial ramp to end_learning_rate (ref: polynomial_decay op)."""
+    def at(step):
+        s = min(step, decay_steps) if not cycle else step % decay_steps
+        frac = (1 - s / decay_steps) ** power
+        return (learning_rate - end_learning_rate) * frac + end_learning_rate
+    return at
+
+
+def piecewise_decay(boundaries, values):
+    """Step function over boundaries (ref: piecewise_decay op)."""
+    def at(step):
+        for b, v in zip(boundaries, values):
+            if step < b:
+                return v
+        return values[len(boundaries)]
+    return at
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """Half-cosine anneal (ref: cosine_decay op)."""
+    import math
+    def at(step):
+        ep = step // step_each_epoch
+        return learning_rate * 0.5 * (math.cos(ep * math.pi / epochs) + 1)
+    return at
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """Transformer Noam schedule (ref: noam_decay op)."""
+    def at(step):
+        step = max(step, 1)
+        return learning_rate * d_model ** -0.5 * min(
+            step ** -0.5, step * warmup_steps ** -1.5)
+    return at
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear warmup wrapper (ref: linear_lr_warmup op); learning_rate may
+    be a constant or another decay callable."""
+    def at(step):
+        if step < warmup_steps:
+            return start_lr + (end_lr - start_lr) * step / warmup_steps
+        return learning_rate(step) if callable(learning_rate) \
+            else learning_rate
+    return at
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]
+
+
+def _register_decay_ops():
+    from ..core.dispatch import OP_REGISTRY, register_op
+    for _n in ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
+               "polynomial_decay", "piecewise_decay", "cosine_decay",
+               "noam_decay", "linear_lr_warmup"]:
+        if _n not in OP_REGISTRY:
+            _f = globals()[_n]
+            register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        differentiable=False, category="lr", public=_f)
+
+
+_register_decay_ops()

@@ -1,0 +1,310 @@
+"""Optimizer update-rule kernels.
+
+Parity target: the optimizer ops in the reference's ops.yaml (adadelta_,
+adamax_, ftrl, lamb_, lars_momentum_, proximal_adagrad, proximal_gd,
+decayed_adagrad, sparse_momentum, dgc_momentum) — upstream each is a CUDA
+kernel mutating param/state in place; here each is a PURE function
+``(param, grad, *state) -> (new_param, *new_state)`` so the whole optimizer
+step fuses into the training XLA program (the optimizer classes in this
+package are built the same way; these ops expose the raw rules under the
+reference's names)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..ops._helpers import ensure_tensor, forward_op
+
+__all__ = [
+    "adadelta_update", "adamax_update", "ftrl_update", "lamb_update",
+    "lars_momentum_update", "proximal_adagrad_update", "proximal_gd_update",
+    "decayed_adagrad_update", "sparse_momentum_update", "dgc_momentum_update",
+]
+
+
+def _op(name, impl, tensors):
+    return forward_op(name, impl, [ensure_tensor(t) for t in tensors],
+                      differentiable=False)
+
+
+def adadelta_update(param, grad, avg_squared_grad, avg_squared_update,
+                    rho: float = 0.95, epsilon: float = 1e-6,
+                    learning_rate: float = 1.0, name=None):
+    """Adadelta rule (ref: adadelta_ op): accumulate E[g^2], scale by
+    RMS(Δx)/RMS(g)."""
+    def impl(p, g, eg, ex):
+        eg2 = rho * eg + (1 - rho) * g * g
+        upd = jnp.sqrt(ex + epsilon) / jnp.sqrt(eg2 + epsilon) * g
+        ex2 = rho * ex + (1 - rho) * upd * upd
+        return p - learning_rate * upd, eg2, ex2
+    return _op("adadelta_update", impl,
+               [param, grad, avg_squared_grad, avg_squared_update])
+
+
+def adamax_update(param, grad, moment, inf_norm, beta1_pow,
+                  learning_rate: float = 0.001, beta1: float = 0.9,
+                  beta2: float = 0.999, epsilon: float = 1e-8, name=None):
+    """Adamax rule (ref: adamax_ op): infinity-norm second moment."""
+    def impl(p, g, m, u, b1p):
+        m2 = beta1 * m + (1 - beta1) * g
+        u2 = jnp.maximum(beta2 * u, jnp.abs(g))
+        step = learning_rate / (1 - b1p)
+        return p - step * m2 / (u2 + epsilon), m2, u2, b1p * beta1
+    return _op("adamax_update", impl,
+               [param, grad, moment, inf_norm, beta1_pow])
+
+
+def ftrl_update(param, grad, squared_accum, linear_accum,
+                learning_rate: float = 0.01, l1: float = 0.0,
+                l2: float = 0.0, lr_power: float = -0.5, name=None):
+    """FTRL-proximal rule (ref: ftrl op, the CTR workhorse)."""
+    def impl(p, g, sq, lin):
+        new_sq = sq + g * g
+        sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / learning_rate
+        new_lin = lin + g - sigma * p
+        pre = jnp.clip(new_lin, -l1, l1) - new_lin
+        denom = new_sq ** (-lr_power) / learning_rate + 2 * l2
+        return pre / denom, new_sq, new_lin
+    return _op("ftrl_update", impl, [param, grad, squared_accum,
+                                     linear_accum])
+
+
+def lamb_update(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                learning_rate: float = 0.001, beta1: float = 0.9,
+                beta2: float = 0.999, epsilon: float = 1e-6,
+                weight_decay: float = 0.01, name=None):
+    """LAMB rule (ref: lamb_ op): Adam direction with layerwise trust
+    ratio."""
+    def impl(p, g, m, v, b1p, b2p):
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        mh = m2 / (1 - b1p)
+        vh = v2 / (1 - b2p)
+        r = mh / (jnp.sqrt(vh) + epsilon) + weight_decay * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p - learning_rate * trust * r, m2, v2,
+                b1p * beta1, b2p * beta2)
+    return _op("lamb_update", impl, [param, grad, moment1, moment2,
+                                     beta1_pow, beta2_pow])
+
+
+def lars_momentum_update(param, grad, velocity, learning_rate: float = 0.001,
+                         mu: float = 0.9, lars_coeff: float = 0.001,
+                         lars_weight_decay: float = 0.0005,
+                         epsilon: float = 0.0, name=None):
+    """LARS rule (ref: lars_momentum_ op): local LR scaled by
+    ||w||/||g||."""
+    def impl(p, g, v):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lars_coeff * w_norm /
+            (g_norm + lars_weight_decay * w_norm + epsilon), 1.0)
+        v2 = mu * v + local * learning_rate * (g + lars_weight_decay * p)
+        return p - v2, v2
+    return _op("lars_momentum_update", impl, [param, grad, velocity])
+
+
+def proximal_adagrad_update(param, grad, moment,
+                            learning_rate: float = 0.01, l1: float = 0.0,
+                            l2: float = 0.0, name=None):
+    """Proximal Adagrad rule (ref: proximal_adagrad op): adagrad step then
+    soft-threshold."""
+    def impl(p, g, m):
+        m2 = m + g * g
+        lr = learning_rate / jnp.sqrt(m2)
+        pro = p - lr * g
+        out = jnp.sign(pro) * jnp.clip(jnp.abs(pro) - lr * l1, 0) / \
+            (1.0 + lr * l2)
+        return out, m2
+    return _op("proximal_adagrad_update", impl, [param, grad, moment])
+
+
+def proximal_gd_update(param, grad, learning_rate: float = 0.01,
+                       l1: float = 0.0, l2: float = 0.0, name=None):
+    """Proximal gradient-descent rule (ref: proximal_gd op)."""
+    def impl(p, g):
+        pro = p - learning_rate * g
+        return jnp.sign(pro) * jnp.clip(
+            jnp.abs(pro) - learning_rate * l1, 0) / \
+            (1.0 + learning_rate * l2)
+    return _op("proximal_gd_update", impl, [param, grad])
+
+
+def decayed_adagrad_update(param, grad, moment, learning_rate: float = 0.01,
+                           decay: float = 0.95, epsilon: float = 1e-6,
+                           name=None):
+    """Decayed Adagrad rule (ref: decayed_adagrad op)."""
+    def impl(p, g, m):
+        m2 = decay * m + (1 - decay) * g * g
+        return p - learning_rate * g / (jnp.sqrt(m2) + epsilon), m2
+    return _op("decayed_adagrad_update", impl, [param, grad, moment])
+
+
+def sparse_momentum_update(param, grad, velocity, index, axis: int = 0,
+                           learning_rate: float = 0.001, mu: float = 0.9,
+                           name=None):
+    """Momentum touching only the rows in ``index`` (ref: sparse_momentum
+    op — the SelectedRows update; the parameter-server embedding path
+    uses exactly this shape of update)."""
+    def impl(p, g, v, idx):
+        v_rows = mu * jnp.take(v, idx, axis) + g
+        new_v = v.at[idx].set(v_rows) if axis == 0 else \
+            jnp.moveaxis(jnp.moveaxis(v, axis, 0).at[idx].set(
+                jnp.moveaxis(v_rows, axis, 0)), 0, axis)
+        p_rows = jnp.take(p, idx, axis) - learning_rate * v_rows
+        new_p = p.at[idx].set(p_rows) if axis == 0 else \
+            jnp.moveaxis(jnp.moveaxis(p, axis, 0).at[idx].set(
+                jnp.moveaxis(p_rows, axis, 0)), 0, axis)
+        return new_p, new_v
+    return _op("sparse_momentum_update", impl,
+               [param, grad, velocity, index])
+
+
+def dgc_momentum_update(param, grad, velocity, accum_grad,
+                        learning_rate: float = 0.001, mu: float = 0.9,
+                        sparsity: float = 0.75, name=None):
+    """Deep-gradient-compression momentum (ref: dgc_momentum_op): momentum
+    correction on the locally-accumulated gradient, top-|sparsity| values
+    sent (here: applied), the rest re-accumulated."""
+    def impl(p, g, v, acc):
+        v2 = mu * v + g
+        u = acc + v2
+        flat = jnp.abs(u).reshape(-1)
+        k = max(1, int(flat.shape[0] * (1 - sparsity)))
+        thresh = jnp.sort(flat)[-k]
+        mask = jnp.abs(u) >= thresh
+        applied = jnp.where(mask, u, 0)
+        return p - learning_rate * applied, v2 * 0.0, jnp.where(mask, 0, u)
+    return _op("dgc_momentum_update", impl,
+               [param, grad, velocity, accum_grad])
+
+
+for _n in __all__:
+    _f = globals()[_n]
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                differentiable=False, category="optimizer", public=_f)
+
+
+# -- r5 batch 2: the mainline update rules as ops too (ref ops.yaml: sgd_,
+# momentum_, adam_, adamw_, rmsprop_, adagrad_, nadam_, radam_) — the
+# optimizer classes implement the same math; these are the raw kernels.
+
+def sgd_update(param, grad, learning_rate: float = 0.01, name=None):
+    """Plain SGD rule (ref: sgd_ op)."""
+    return _op("sgd_update",
+               lambda p, g: p - learning_rate * g, [param, grad])
+
+
+def momentum_update(param, grad, velocity, learning_rate: float = 0.01,
+                    mu: float = 0.9, use_nesterov: bool = False, name=None):
+    """(Nesterov) momentum rule (ref: momentum_ op)."""
+    def impl(p, g, v):
+        v2 = mu * v + g
+        step = g + mu * v2 if use_nesterov else v2
+        return p - learning_rate * step, v2
+    return _op("momentum_update", impl, [param, grad, velocity])
+
+
+def adagrad_update(param, grad, moment, learning_rate: float = 0.01,
+                   epsilon: float = 1e-6, name=None):
+    """Adagrad rule (ref: adagrad_ op)."""
+    def impl(p, g, m):
+        m2 = m + g * g
+        return p - learning_rate * g / (jnp.sqrt(m2) + epsilon), m2
+    return _op("adagrad_update", impl, [param, grad, moment])
+
+
+def rmsprop_update(param, grad, moment, mean_square,
+                   learning_rate: float = 0.01, rho: float = 0.95,
+                   epsilon: float = 1e-6, momentum: float = 0.0, name=None):
+    """RMSProp rule (ref: rmsprop_ op)."""
+    def impl(p, g, m, ms):
+        ms2 = rho * ms + (1 - rho) * g * g
+        m2 = momentum * m + learning_rate * g / jnp.sqrt(ms2 + epsilon)
+        return p - m2, m2, ms2
+    return _op("rmsprop_update", impl, [param, grad, moment, mean_square])
+
+
+def adam_update(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                learning_rate: float = 0.001, beta1: float = 0.9,
+                beta2: float = 0.999, epsilon: float = 1e-8, name=None):
+    """Adam rule (ref: adam_ op)."""
+    def impl(p, g, m, v, b1p, b2p):
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        mh = m2 / (1 - b1p)
+        vh = v2 / (1 - b2p)
+        return (p - learning_rate * mh / (jnp.sqrt(vh) + epsilon),
+                m2, v2, b1p * beta1, b2p * beta2)
+    return _op("adam_update", impl, [param, grad, moment1, moment2,
+                                     beta1_pow, beta2_pow])
+
+
+def adamw_update(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.01, name=None):
+    """AdamW rule (decoupled decay; ref: adamw_ op)."""
+    def impl(p, g, m, v, b1p, b2p):
+        p = p * (1 - learning_rate * weight_decay)
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        mh = m2 / (1 - b1p)
+        vh = v2 / (1 - b2p)
+        return (p - learning_rate * mh / (jnp.sqrt(vh) + epsilon),
+                m2, v2, b1p * beta1, b2p * beta2)
+    return _op("adamw_update", impl, [param, grad, moment1, moment2,
+                                      beta1_pow, beta2_pow])
+
+
+def nadam_update(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, name=None):
+    """NAdam rule (Nesterov Adam; ref: nadam_ op)."""
+    def impl(p, g, m, v, b1p, b2p):
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        mh = (beta1 * m2 + (1 - beta1) * g) / (1 - b1p * beta1)
+        vh = v2 / (1 - b2p)
+        return (p - learning_rate * mh / (jnp.sqrt(vh) + epsilon),
+                m2, v2, b1p * beta1, b2p * beta2)
+    return _op("nadam_update", impl, [param, grad, moment1, moment2,
+                                      beta1_pow, beta2_pow])
+
+
+def radam_update(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 step, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, name=None):
+    """RAdam rule (rectified Adam; ref: radam_ op)."""
+    def impl(p, g, m, v, b1p, b2p, t):
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * g * g
+        mh = m2 / (1 - b1p)
+        rho_inf = 2 / (1 - beta2) - 1
+        rho_t = rho_inf - 2 * t * b2p * beta2 / (1 - b2p * beta2)
+        vh = jnp.sqrt(v2 / (1 - b2p * beta2))
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                     jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                 1e-12))
+        upd = jnp.where(rho_t > 5.0,
+                        r * mh / (vh + epsilon), mh)
+        return (p - learning_rate * upd, m2, v2,
+                b1p * beta1, b2p * beta2)
+    return _op("radam_update", impl, [param, grad, moment1, moment2,
+                                      beta1_pow, beta2_pow, step])
+
+
+__all__ += ["sgd_update", "momentum_update", "adagrad_update",
+            "rmsprop_update", "adam_update", "adamw_update",
+            "nadam_update", "radam_update"]
+for _n in ["sgd_update", "momentum_update", "adagrad_update",
+           "rmsprop_update", "adam_update", "adamw_update",
+           "nadam_update", "radam_update"]:
+    _f = globals()[_n]
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                differentiable=False, category="optimizer", public=_f)

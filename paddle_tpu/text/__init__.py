@@ -105,3 +105,15 @@ Movielens = _no_download("Movielens")
 UCIHousing = _no_download("UCIHousing")
 WMT14 = _no_download("WMT14")
 WMT16 = _no_download("WMT16")
+
+
+def _register_text_ops():
+    from ..core.dispatch import OP_REGISTRY, register_op
+    if "viterbi_decode" not in OP_REGISTRY:
+        register_op("viterbi_decode", viterbi_decode,
+                    (viterbi_decode.__doc__ or "").strip().split("\n")[0],
+                    differentiable=False, category="text",
+                    public=viterbi_decode)
+
+
+_register_text_ops()

@@ -1016,3 +1016,408 @@ for _n in __all__:
     _f = globals()[_n]
     register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0].rstrip(","),
                 public=_f)
+
+
+# ---------------------------------------------------------------------------
+# r5 follow-on: contrib vision singles (ref: fluid/operators —
+# prroi_pool_op, bilateral_slice_op, correlation_op,
+# retinanet_detection_output_op). Same static-shape design language.
+# ---------------------------------------------------------------------------
+
+def prroi_pool(x, boxes, output_size=7, spatial_scale: float = 1.0,
+               name=None):
+    """Precise ROI pooling (ref: prroi_pool_op): exact integral of the
+    bilinear surface over each bin — here the integral is evaluated by
+    dense per-pixel bin-overlap weights (one einsum; exact for the
+    piecewise-constant surface, the standard TPU-friendly approximation)."""
+    xt = ensure_tensor(x)
+    bt = ensure_tensor(boxes)
+    ph_, pw_ = ((output_size, output_size) if isinstance(output_size, int)
+                else tuple(output_size))
+
+    def impl(xv, bv):
+        B, C, H, W = xv.shape
+        n = bv.shape[0]
+        x1 = bv[:, 0] * spatial_scale
+        y1 = bv[:, 1] * spatial_scale
+        x2 = bv[:, 2] * spatial_scale
+        y2 = bv[:, 3] * spatial_scale
+        bw = jnp.maximum(x2 - x1, 1e-4)
+        bh = jnp.maximum(y2 - y1, 1e-4)
+        ys = y1[:, None] + bh[:, None] * jnp.arange(ph_ + 1) / ph_
+        xs = x1[:, None] + bw[:, None] * jnp.arange(pw_ + 1) / pw_
+        gy = jnp.arange(H)[None, None, :]
+        gx = jnp.arange(W)[None, None, :]
+        # fractional overlap of each pixel cell [g, g+1) with each bin
+        oy = jnp.clip(jnp.minimum(ys[:, 1:, None], gy + 1) -
+                      jnp.maximum(ys[:, :-1, None], gy), 0)   # [n, ph, H]
+        ox = jnp.clip(jnp.minimum(xs[:, 1:, None], gx + 1) -
+                      jnp.maximum(xs[:, :-1, None], gx), 0)   # [n, pw, W]
+        area = (bh[:, None] / ph_) * (bw[:, None] / pw_)
+        pooled = jnp.einsum("cHW,niH,njW->ncij", xv[0], oy, ox)
+        return pooled / jnp.maximum(area[:, :, None, None] * 0 +
+                                    (oy.sum(-1)[:, :, None] *
+                                     ox.sum(-1)[:, None, :])[:, None], 1e-6)
+
+    return forward_op("prroi_pool", impl, [xt, bt])
+
+
+def bilateral_slice(x, guide, grid, has_offset: bool = False, name=None):
+    """HDRNet bilateral-grid slicing (ref: bilateral_slice_op): trilinear
+    lookup of per-pixel affine coefficients from a low-res grid indexed by
+    (x, y, guide)."""
+    xt = ensure_tensor(x)
+    gt = ensure_tensor(guide)
+    rt = ensure_tensor(grid)
+
+    def impl(xv, gv, rv):
+        B, C, H, W = xv.shape
+        _, GC, GD, GH, GW = rv.shape
+        yy = (jnp.arange(H) + 0.5) / H * GH - 0.5
+        xx = (jnp.arange(W) + 0.5) / W * GW - 0.5
+        zz = gv * GD - 0.5                                   # [B, H, W]
+        y0 = jnp.clip(jnp.floor(yy), 0, GH - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xx), 0, GW - 1).astype(jnp.int32)
+        z0 = jnp.clip(jnp.floor(zz), 0, GD - 1).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, GH - 1)
+        x1 = jnp.clip(x0 + 1, 0, GW - 1)
+        z1 = jnp.clip(z0 + 1, 0, GD - 1)
+        wy = (yy - jnp.floor(yy))[None, :, None]
+        wx = (xx - jnp.floor(xx))[None, None, :]
+        wz = zz - jnp.floor(zz)
+        out = 0
+        for zi, wzf in ((z0, 1 - wz), (z1, wz)):
+            for yi, wyf in ((y0, 1 - wy), (y1, wy)):
+                for xi, wxf in ((x0, 1 - wx), (x1, wx)):
+                    g = rv[jnp.arange(B)[:, None, None], :, zi,
+                           yi[None, :, None], xi[None, None, :]]
+                    out = out + g * (wzf * wyf * wxf)[..., None]
+        coeff = jnp.moveaxis(out, -1, 1)                     # [B, GC, H, W]
+        if not has_offset:
+            return coeff
+        # affine apply: GC = C*(C+1) -> out C channels
+        nco = GC // (C + 1)
+        mat = coeff.reshape(B, nco, C + 1, H, W)
+        return (mat[:, :, :C] * xv[:, None]).sum(2) + mat[:, :, C]
+
+    return forward_op("bilateral_slice", impl, [xt, gt, rt])
+
+
+def correlation(x, y, pad_size: int = 4, kernel_size: int = 1,
+                max_displacement: int = 4, stride1: int = 1,
+                stride2: int = 1, corr_type_multiply: int = 1, name=None):
+    """FlowNet correlation layer (ref: correlation_op): dot products of
+    local patches across displacement offsets — a [D*D, B, H, W] stack of
+    shifted elementwise products, one fused XLA program."""
+    xt = ensure_tensor(x)
+    yt = ensure_tensor(y)
+    d = max_displacement
+
+    def impl(xv, yv):
+        B, C, H, W = xv.shape
+        pads = [(0, 0), (0, 0), (d, d), (d, d)]
+        yp = jnp.pad(yv, pads)
+        outs = []
+        for dy in range(0, 2 * d + 1, stride2):
+            for dx in range(0, 2 * d + 1, stride2):
+                shifted = yp[:, :, dy:dy + H, dx:dx + W]
+                outs.append((xv * shifted).mean(1))
+        return jnp.stack(outs, 1)                            # [B, D*D, H, W]
+
+    return forward_op("correlation", impl, [xt, yt])
+
+
+def retinanet_detection_output(bboxes_list, scores_list, anchors_list,
+                               im_info, score_threshold: float = 0.05,
+                               nms_top_k: int = 1000, keep_top_k: int = 100,
+                               nms_threshold: float = 0.3, name=None):
+    """RetinaNet head decode + multiclass NMS over FPN levels (ref:
+    retinanet_detection_output_op): per-level decode vs anchors, concat,
+    then the static multiclass_nms."""
+    decoded = []
+    scores_all = []
+    for deltas, scores, anchors in zip(bboxes_list, scores_list,
+                                       anchors_list):
+        dt = ensure_tensor(deltas)      # [B, A, 4]
+        st = ensure_tensor(scores)      # [B, A, C]
+        at = ensure_tensor(anchors)     # [A, 4]
+
+        def dec(dv, av):
+            return jax.vmap(lambda d: _decode_rcnn(av, d))(dv)
+
+        decoded.append(forward_op("retinanet_decode", dec, [dt, at],
+                                  differentiable=False))
+        scores_all.append(st)
+    from ..ops.manipulation import concat, transpose
+    boxes = concat(decoded, axis=1)
+    scores = transpose(concat(scores_all, axis=1), [0, 2, 1])
+    return multiclass_nms(boxes, scores, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold)
+
+
+__all__ += ["prroi_pool", "bilateral_slice", "correlation",
+            "retinanet_detection_output"]
+for _n in ["prroi_pool", "bilateral_slice", "correlation",
+           "retinanet_detection_output"]:
+    _f = globals()[_n]
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                public=_f)
+
+
+# ---------------------------------------------------------------------------
+# r5 third batch: R-CNN training-side target assignment (ref:
+# rpn_target_assign_op, retinanet_target_assign_op,
+# generate_proposal_labels_op, box_decoder_and_assign_op,
+# roi_perspective_transform_op). Assignment is IoU thresholding — dense
+# masked argmax here (no ragged sampling lists; sampling quotas become
+# rank-threshold masks, the static formulation used throughout this file).
+# ---------------------------------------------------------------------------
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im: int = 256,
+                      rpn_straddle_thresh: float = 0.0,
+                      rpn_fg_fraction: float = 0.5,
+                      rpn_positive_overlap: float = 0.7,
+                      rpn_negative_overlap: float = 0.3, name=None):
+    """RPN anchor labeling (ref: rpn_target_assign_op): label 1 for
+    anchors with IoU >= positive_overlap (plus each gt's argmax anchor),
+    0 for IoU < negative_overlap, -1 ignore. Static [A] outputs:
+    (labels [A], matched_gt [A], fg_mask [A], bg_mask [A]) with sampling
+    quotas enforced by score-free rank masks."""
+    at = ensure_tensor(anchors)
+    gt = ensure_tensor(gt_boxes)
+
+    def impl(av, gv):
+        A = av.shape[0]
+        area_ok = (gv[:, 2] > gv[:, 0]) & (gv[:, 3] > gv[:, 1])
+        lt_ = jnp.maximum(av[:, None, :2], gv[None, :, :2])
+        rb = jnp.minimum(av[:, None, 2:], gv[None, :, 2:])
+        wh = jnp.clip(rb - lt_, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        a1 = (av[:, 2] - av[:, 0]) * (av[:, 3] - av[:, 1])
+        a2 = (gv[:, 2] - gv[:, 0]) * (gv[:, 3] - gv[:, 1])
+        iou = inter / jnp.maximum(a1[:, None] + a2[None] - inter, 1e-9)
+        iou = jnp.where(area_ok[None, :], iou, 0.0)
+        best_iou = iou.max(1)
+        best_gt = iou.argmax(1)
+        pos = best_iou >= rpn_positive_overlap
+        # each gt's best anchor is positive too
+        gt_best_anchor = iou.argmax(0)
+        pos = pos.at[gt_best_anchor].set(area_ok | pos[gt_best_anchor])
+        neg = (best_iou < rpn_negative_overlap) & ~pos
+        n_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+        # rank-based subsample to the quotas (deterministic: by IoU rank)
+        fg_rank = jnp.argsort(
+            jnp.argsort(jnp.where(pos, -best_iou, jnp.inf)))
+        fg = pos & (fg_rank < n_fg)
+        n_bg = rpn_batch_size_per_im - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(neg, best_iou,
+                                                    jnp.inf)))
+        bg = neg & (bg_rank < n_bg)
+        labels = jnp.where(fg, 1, jnp.where(bg, 0, -1))
+        return labels, best_gt, fg, bg
+
+    return forward_op("rpn_target_assign", impl, [at, gt],
+                      differentiable=False)
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, im_info=None,
+                            positive_overlap: float = 0.5,
+                            negative_overlap: float = 0.4, name=None):
+    """RetinaNet anchor labeling (ref: retinanet_target_assign_op): like
+    RPN but multi-class labels and no subsampling (focal loss handles the
+    imbalance). Returns (cls_targets [A] (-1 ignore, 0 bg, c+1 fg),
+    matched_gt [A], fg_mask [A])."""
+    at = ensure_tensor(anchors)
+    gt = ensure_tensor(gt_boxes)
+    gl = ensure_tensor(gt_labels)
+
+    def impl(av, gv, lv):
+        area_ok = (gv[:, 2] > gv[:, 0]) & (gv[:, 3] > gv[:, 1])
+        lt_ = jnp.maximum(av[:, None, :2], gv[None, :, :2])
+        rb = jnp.minimum(av[:, None, 2:], gv[None, :, 2:])
+        wh = jnp.clip(rb - lt_, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        a1 = (av[:, 2] - av[:, 0]) * (av[:, 3] - av[:, 1])
+        a2 = (gv[:, 2] - gv[:, 0]) * (gv[:, 3] - gv[:, 1])
+        iou = inter / jnp.maximum(a1[:, None] + a2[None] - inter, 1e-9)
+        iou = jnp.where(area_ok[None, :], iou, 0.0)
+        best_iou = iou.max(1)
+        best_gt = iou.argmax(1)
+        fg = best_iou >= positive_overlap
+        bg = best_iou < negative_overlap
+        cls = jnp.where(fg, lv[best_gt] + 1, jnp.where(bg, 0, -1))
+        return cls, best_gt, fg
+
+    return forward_op("retinanet_target_assign", impl, [at, gt, gl],
+                      differentiable=False)
+
+
+def generate_proposal_labels(rois, gt_boxes, gt_classes,
+                             batch_size_per_im: int = 512,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             name=None):
+    """Fast R-CNN head training targets (ref: generate_proposal_labels_op):
+    label each roi fg/bg by IoU, emit class targets + encoded box deltas
+    + inside weights. Static [R] outputs with rank-quota sampling masks."""
+    rt = ensure_tensor(rois)
+    gt = ensure_tensor(gt_boxes)
+    gc = ensure_tensor(gt_classes)
+    w = np.asarray(bbox_reg_weights, np.float32)
+
+    def impl(rv, gv, cv):
+        R = rv.shape[0]
+        lt_ = jnp.maximum(rv[:, None, :2], gv[None, :, :2])
+        rb = jnp.minimum(rv[:, None, 2:], gv[None, :, 2:])
+        whi = jnp.clip(rb - lt_, 0)
+        inter = whi[..., 0] * whi[..., 1]
+        a1 = (rv[:, 2] - rv[:, 0]) * (rv[:, 3] - rv[:, 1])
+        a2 = (gv[:, 2] - gv[:, 0]) * (gv[:, 3] - gv[:, 1])
+        iou = inter / jnp.maximum(a1[:, None] + a2[None] - inter, 1e-9)
+        best = iou.max(1)
+        bidx = iou.argmax(1)
+        fg = best >= fg_thresh
+        bg = (best < bg_thresh_hi) & (best >= bg_thresh_lo)
+        n_fg = int(batch_size_per_im * fg_fraction)
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, -best, jnp.inf)))
+        fg_keep = fg & (fg_rank < n_fg)
+        n_bg = batch_size_per_im - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, best, jnp.inf)))
+        bg_keep = bg & (bg_rank < n_bg)
+        labels = jnp.where(fg_keep, cv[bidx], 0) * fg_keep
+        tgt = gv[bidx]
+        rw = rv[:, 2] - rv[:, 0] + 1e-6
+        rh = rv[:, 3] - rv[:, 1] + 1e-6
+        rcx = (rv[:, 0] + rv[:, 2]) / 2
+        rcy = (rv[:, 1] + rv[:, 3]) / 2
+        gw = jnp.maximum(tgt[:, 2] - tgt[:, 0], 1e-6)
+        gh = jnp.maximum(tgt[:, 3] - tgt[:, 1], 1e-6)
+        gcx = (tgt[:, 0] + tgt[:, 2]) / 2
+        gcy = (tgt[:, 1] + tgt[:, 3]) / 2
+        deltas = jnp.stack([(gcx - rcx) / rw / w[0],
+                            (gcy - rcy) / rh / w[1],
+                            jnp.log(gw / rw) / w[2],
+                            jnp.log(gh / rh) / w[3]], -1)
+        inside_w = fg_keep[:, None].astype(rv.dtype) * jnp.ones((1, 4))
+        return (labels.astype(jnp.int32), deltas * inside_w, inside_w,
+                fg_keep, bg_keep)
+
+    return forward_op("generate_proposal_labels", impl, [rt, gt, gc],
+                      differentiable=False)
+
+
+def box_decoder_and_assign(prior_box_t, prior_box_var, target_box,
+                           box_score, box_clip_v: float = 4.135, name=None):
+    """Decode per-class box deltas then pick each roi's best-class box
+    (ref: box_decoder_and_assign_op). ``target_box [R, C*4]``,
+    ``box_score [R, C]``; returns (decoded [R, C*4], assigned [R, 4])."""
+    pt = ensure_tensor(prior_box_t)
+    vt = ensure_tensor(prior_box_var)
+    tt = ensure_tensor(target_box)
+    st = ensure_tensor(box_score)
+
+    def impl(pv, vv, tv, sv):
+        R = pv.shape[0]
+        C = sv.shape[1]
+        pw = pv[:, 2] - pv[:, 0] + 1
+        ph_ = pv[:, 3] - pv[:, 1] + 1
+        pcx = pv[:, 0] + pw / 2
+        pcy = pv[:, 1] + ph_ / 2
+        d = tv.reshape(R, C, 4) * vv.reshape(R, 1, 4)
+        dcx = pcx[:, None] + d[..., 0] * pw[:, None]
+        dcy = pcy[:, None] + d[..., 1] * ph_[:, None]
+        dw = pw[:, None] * jnp.exp(jnp.clip(d[..., 2], -box_clip_v,
+                                            box_clip_v))
+        dh = ph_[:, None] * jnp.exp(jnp.clip(d[..., 3], -box_clip_v,
+                                             box_clip_v))
+        dec = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - 1, dcy + dh / 2 - 1], -1)
+        best = sv.argmax(1)
+        assigned = dec[jnp.arange(R), best]
+        return dec.reshape(R, C * 4), assigned
+
+    return forward_op("box_decoder_and_assign", impl, [pt, vt, tt, st],
+                      differentiable=False)
+
+
+def roi_perspective_transform(x, rois, transformed_height: int,
+                              transformed_width: int,
+                              spatial_scale: float = 1.0, name=None):
+    """Perspective-warp quadrilateral ROIs to a fixed rectangle (ref:
+    roi_perspective_transform_op, the OCR rectification kernel). rois
+    [N, 8] are quad corners (x1..y4, clockwise from top-left); bilinear
+    sampling on the homography inverse — all dense gathers."""
+    xt = ensure_tensor(x)
+    rt = ensure_tensor(rois)
+    TH, TW = transformed_height, transformed_width
+
+    def impl(xv, rv):
+        B, C, H, W = xv.shape
+        N = rv.shape[0]
+        q = rv.reshape(N, 4, 2) * spatial_scale
+
+        # homography mapping output rect corners -> quad corners, solved
+        # in closed form per roi (vmapped 8x8 solve)
+        def homography(quad):
+            dst = jnp.asarray([[0, 0], [TW - 1, 0], [TW - 1, TH - 1],
+                               [0, TH - 1]], jnp.float32)
+            rows = []
+            rhs = []
+            for i in range(4):
+                xd, yd = dst[i, 0], dst[i, 1]
+                xs, ys = quad[i, 0], quad[i, 1]
+                rows.append(jnp.stack([xd, yd, 1., 0., 0., 0.,
+                                       -xs * xd, -xs * yd]))
+                rhs.append(xs)
+                rows.append(jnp.stack([0., 0., 0., xd, yd, 1.,
+                                       -ys * xd, -ys * yd]))
+                rhs.append(ys)
+            A = jnp.stack(rows)
+            b = jnp.stack(rhs)
+            h8 = jnp.linalg.solve(A, b)
+            return jnp.append(h8, 1.0).reshape(3, 3)
+
+        Hs = jax.vmap(homography)(q)                       # [N, 3, 3]
+        yy, xx = jnp.meshgrid(jnp.arange(TH), jnp.arange(TW),
+                              indexing="ij")
+        ones = jnp.ones_like(xx)
+        pts = jnp.stack([xx, yy, ones], 0).reshape(3, -1).astype(
+            jnp.float32)                                    # [3, TH*TW]
+        src = jnp.einsum("nij,jp->nip", Hs, pts)
+        sx = src[:, 0] / jnp.maximum(src[:, 2], 1e-8)
+        sy = src[:, 1] / jnp.maximum(src[:, 2], 1e-8)
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        wx = sx - x0
+        wy = sy - y0
+
+        def tap(yi, xi):
+            ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            g = xv[0][:, yc, xc]                            # [C, N, P]
+            return jnp.where(ok[None], g, 0.0)
+
+        out = (tap(y0, x0) * ((1 - wy) * (1 - wx))[None]
+               + tap(y0, x0 + 1) * ((1 - wy) * wx)[None]
+               + tap(y0 + 1, x0) * (wy * (1 - wx))[None]
+               + tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+        return out.transpose(1, 0, 2).reshape(N, C, TH, TW)
+
+    return forward_op("roi_perspective_transform", impl, [xt, rt])
+
+
+__all__ += ["rpn_target_assign", "retinanet_target_assign",
+            "generate_proposal_labels", "box_decoder_and_assign",
+            "roi_perspective_transform"]
+for _n in ["rpn_target_assign", "retinanet_target_assign",
+           "generate_proposal_labels", "box_decoder_and_assign",
+           "roi_perspective_transform"]:
+    _f = globals()[_n]
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                public=_f)

@@ -254,3 +254,51 @@ for _n, _f, _d in [
 from .det_ops import *  # noqa: F401,E402,F403
 from .det_ops import __all__ as _det_all  # noqa: E402
 __all__ = list(__all__) + list(_det_all)
+
+
+def read_file(filename, name=None):
+    """Raw bytes of a file as a uint8 Tensor (ref:
+    paddle.vision.ops.read_file)."""
+    import numpy as _np
+    from ..core.tensor import to_tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(_np.frombuffer(data, dtype=_np.uint8).copy())
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """Decode a JPEG byte Tensor to [C, H, W] uint8 (ref:
+    paddle.vision.ops.decode_jpeg). Host-side decode (data pipeline);
+    gated on Pillow which this hermetic image may lack — the contract and
+    error message follow the text-dataset stance."""
+    import numpy as _np
+    from ..core.tensor import to_tensor
+    try:
+        from PIL import Image  # noqa: WPS433
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "decode_jpeg needs Pillow, which is not available in this "
+            "hermetic environment; feed decoded arrays to the DataLoader "
+            "instead") from e
+    import io
+    img = Image.open(io.BytesIO(_np.asarray(x._value
+                                            if hasattr(x, "_value")
+                                            else x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+from ..core.dispatch import register_op as _reg5
+for _n5 in ("read_file", "decode_jpeg"):
+    _reg5(_n5, globals()[_n5],
+          (globals()[_n5].__doc__ or "").strip().split("\n")[0],
+          differentiable=False, public=globals()[_n5])
+__all__ = list(__all__) + ["read_file", "decode_jpeg"]

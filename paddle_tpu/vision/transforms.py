@@ -314,3 +314,288 @@ class Grayscale(BaseTransform):
         if np.issubdtype(np.asarray(img).dtype, np.integer):
             return np.clip(out, 0, 255).astype(np.uint8)
         return out
+
+
+# ---------------------------------------------------------------------------
+# r5: the remaining functional transform surface (ref:
+# python/paddle/vision/transforms/functional.py). Host-side numpy by design
+# — augmentation runs in the DataLoader workers; the TPU sees the batched
+# result (SURVEY §2.3 vision row). All take HWC or CHW arrays and preserve
+# layout/dtype conventions of the existing functionals above.
+# ---------------------------------------------------------------------------
+
+def _apply_hwc(img, fn):
+    a = np.asarray(img)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3)
+    h = a.transpose(1, 2, 0) if chw else a
+    out = fn(h.astype(np.float32))
+    if chw:
+        out = out.transpose(2, 0, 1)
+    if np.issubdtype(a.dtype, np.integer):
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(a.dtype)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    """Scale pixel intensities (ref: F.adjust_brightness)."""
+    return _apply_hwc(img, lambda a: a * brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    """Interpolate toward the grayscale mean (ref: F.adjust_contrast)."""
+    def f(a):
+        gray = 0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2]
+        mean = gray.mean()
+        return (a - mean) * contrast_factor + mean
+    return _apply_hwc(img, f)
+
+
+def adjust_saturation(img, saturation_factor: float):
+    """Interpolate toward the per-pixel grayscale (ref:
+    F.adjust_saturation)."""
+    def f(a):
+        gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+                + 0.114 * a[..., 2])[..., None]
+        return (a - gray) * saturation_factor + gray
+    return _apply_hwc(img, f)
+
+
+def adjust_hue(img, hue_factor: float):
+    """Rotate hue by ``hue_factor`` (in [-0.5, 0.5] turns; ref:
+    F.adjust_hue) via RGB->HSV->RGB."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+
+    def f(a):
+        scale = 255.0 if a.max() > 1.5 else 1.0
+        x = a / scale
+        mx = x.max(-1)
+        mn = x.min(-1)
+        diff = mx - mn + 1e-12
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        h = np.where(mx == r, ((g - b) / diff) % 6,
+                     np.where(mx == g, (b - r) / diff + 2,
+                              (r - g) / diff + 4)) / 6.0
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+        v = mx
+        h = (h + hue_factor) % 1.0
+        i = np.floor(h * 6)
+        fpart = h * 6 - i
+        p = v * (1 - s)
+        q = v * (1 - fpart * s)
+        t = v * (1 - (1 - fpart) * s)
+        i = i.astype(np.int32) % 6
+        rgb = np.stack([
+            np.choose(i, [v, q, p, p, t, v]),
+            np.choose(i, [t, v, v, q, p, p]),
+            np.choose(i, [p, p, t, v, v, q]),
+        ], -1)
+        return rgb * scale
+    return _apply_hwc(img, f)
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    """Luma grayscale (ref: F.to_grayscale)."""
+    def f(a):
+        gray = 0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2]
+        return np.repeat(gray[..., None], num_output_channels, -1)
+    return _apply_hwc(img, f)
+
+
+def rotate(img, angle: float, interpolation: str = "nearest",
+           expand: bool = False, center=None, fill=0):
+    """Rotate about the center (ref: F.rotate). Inverse-map + nearest or
+    bilinear sampling, numpy only."""
+    def f(a):
+        H, W = a.shape[:2]
+        cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None \
+            else (center[1], center[0])
+        th = np.deg2rad(angle)
+        cos, sin = np.cos(th), np.sin(th)
+        if expand:
+            corners = np.array([[-cx, -cy], [W - 1 - cx, -cy],
+                                [-cx, H - 1 - cy], [W - 1 - cx, H - 1 - cy]])
+            rot = corners @ np.array([[cos, -sin], [sin, cos]]).T
+            OW = int(np.ceil(rot[:, 0].max() - rot[:, 0].min() + 1))
+            OH = int(np.ceil(rot[:, 1].max() - rot[:, 1].min() + 1))
+            ocx, ocy = (OW - 1) / 2.0, (OH - 1) / 2.0
+        else:
+            OH, OW, ocx, ocy = H, W, cx, cy
+        yy, xx = np.meshgrid(np.arange(OH), np.arange(OW), indexing="ij")
+        dx = xx - ocx
+        dy = yy - ocy
+        sx = cos * dx + sin * dy + cx
+        sy = -sin * dx + cos * dy + cy
+        if interpolation == "bilinear":
+            x0 = np.floor(sx).astype(int)
+            y0 = np.floor(sy).astype(int)
+            wx = sx - x0
+            wy = sy - y0
+            out = np.zeros((OH, OW, a.shape[2]), np.float32)
+            for (yi, xi, w) in ((y0, x0, (1 - wy) * (1 - wx)),
+                                (y0, x0 + 1, (1 - wy) * wx),
+                                (y0 + 1, x0, wy * (1 - wx)),
+                                (y0 + 1, x0 + 1, wy * wx)):
+                ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                yc = np.clip(yi, 0, H - 1)
+                xc = np.clip(xi, 0, W - 1)
+                out += np.where(ok[..., None],
+                                a[yc, xc] * w[..., None], 0)
+            ok_any = (sy >= -0.5) & (sy <= H - 0.5) & \
+                (sx >= -0.5) & (sx <= W - 0.5)
+            return np.where(ok_any[..., None], out, fill)
+        xi = np.round(sx).astype(int)
+        yi = np.round(sy).astype(int)
+        ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        return np.where(ok[..., None],
+                        a[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)],
+                        fill)
+    return _apply_hwc(img, f)
+
+
+def perspective(img, startpoints, endpoints, interpolation: str = "nearest",
+                fill=0):
+    """Perspective warp mapping ``startpoints`` -> ``endpoints`` (ref:
+    F.perspective); solves the 8-dof homography then inverse-samples."""
+    sp = np.asarray(startpoints, np.float64)
+    ep = np.asarray(endpoints, np.float64)
+    # solve homography from endpoints back to startpoints (inverse map)
+    A, bvec = [], []
+    for (xs, ys), (xd, yd) in zip(sp, ep):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        bvec.append(xs)
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        bvec.append(ys)
+    hcoef = np.linalg.solve(np.asarray(A), np.asarray(bvec))
+    Hm = np.append(hcoef, 1.0).reshape(3, 3)
+
+    def f(a):
+        H, W = a.shape[:2]
+        yy, xx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+        den = Hm[2, 0] * xx + Hm[2, 1] * yy + Hm[2, 2]
+        sx = (Hm[0, 0] * xx + Hm[0, 1] * yy + Hm[0, 2]) / den
+        sy = (Hm[1, 0] * xx + Hm[1, 1] * yy + Hm[1, 2]) / den
+        xi = np.round(sx).astype(int)
+        yi = np.round(sy).astype(int)
+        ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        return np.where(ok[..., None],
+                        a[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)],
+                        fill)
+    return _apply_hwc(img, f)
+
+
+def erase(img, i: int, j: int, h: int, w: int, v, inplace: bool = False):
+    """Erase the rectangle [i:i+h, j:j+w] with value ``v`` (ref: F.erase).
+    Follows the input's layout (CHW erases [:, i:i+h, j:j+w])."""
+    a = np.asarray(img)
+    out = a if inplace else a.copy()
+    chw = a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3)
+    if chw:
+        out[:, i:i + h, j:j + w] = v
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
+
+
+def solarize(img, threshold: float = 128.0):
+    """Invert pixels above ``threshold`` (ref: F.solarize)."""
+    def f(a):
+        top = 255.0 if a.max() > 1.5 else 1.0
+        return np.where(a >= threshold, top - a, a)
+    return _apply_hwc(img, f)
+
+
+def posterize(img, bits: int = 4):
+    """Keep the top ``bits`` bits of each (uint8-range) channel (ref:
+    F.posterize)."""
+    def f(a):
+        mask = 256 - (1 << (8 - bits))
+        return (a.astype(np.int32) & mask).astype(np.float32)
+    return _apply_hwc(img, f)
+
+
+def equalize(img):
+    """Per-channel histogram equalization over uint8 range (ref:
+    F.equalize)."""
+    def f(a):
+        out = np.empty_like(a)
+        for c in range(a.shape[-1]):
+            ch = a[..., c].astype(np.uint8)
+            hist = np.bincount(ch.reshape(-1), minlength=256)
+            nz = hist[hist > 0]
+            if nz.size <= 1:
+                out[..., c] = ch
+                continue
+            step = (hist.sum() - nz[-1]) // 255
+            if step == 0:
+                out[..., c] = ch
+                continue
+            lut = (np.cumsum(hist) - hist // 2) // step
+            out[..., c] = np.clip(lut, 0, 255)[ch]
+        return out.astype(np.float32)
+    return _apply_hwc(img, f)
+
+
+def autocontrast(img):
+    """Stretch each channel to the full range (ref: F.autocontrast)."""
+    def f(a):
+        top = 255.0 if a.max() > 1.5 else 1.0
+        mn = a.min((0, 1), keepdims=True)
+        mx = a.max((0, 1), keepdims=True)
+        scale = np.where(mx > mn, top / np.maximum(mx - mn, 1e-12), 1.0)
+        return np.where(mx > mn, (a - mn) * scale, a)
+    return _apply_hwc(img, f)
+
+
+def gaussian_blur(img, kernel_size, sigma=None):
+    """Separable gaussian blur (ref: F.gaussian_blur)."""
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+    if sigma is None:
+        sigma = 0.3 * ((kh - 1) * 0.5 - 1) + 0.8
+    sy = sx = sigma if np.isscalar(sigma) else None
+    if sy is None:
+        sy, sx = sigma
+
+    def kern(k, s):
+        r = np.arange(k) - (k - 1) / 2.0
+        w = np.exp(-(r ** 2) / (2 * s * s))
+        return w / w.sum()
+
+    ky = kern(kh, sy)
+    kx = kern(kw, sx)
+
+    def f(a):
+        pad_y = kh // 2
+        pad_x = kw // 2
+        p = np.pad(a, ((pad_y, pad_y), (0, 0), (0, 0)), mode="edge")
+        out = sum(p[i:i + a.shape[0]] * ky[i] for i in range(kh))
+        p = np.pad(out, ((0, 0), (pad_x, pad_x), (0, 0)), mode="edge")
+        return sum(p[:, i:i + a.shape[1]] * kx[i] for i in range(kw))
+    return _apply_hwc(img, f)
+
+
+__all__ += ["adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue", "to_grayscale", "rotate", "perspective", "erase",
+            "solarize", "posterize", "equalize", "autocontrast",
+            "gaussian_blur"]
+
+
+def _register_transforms():
+    """The functional transforms join the schema registry (they are ops in
+    the reference's ops.yaml sense — host-side preprocessing kernels)."""
+    from ..core.dispatch import OP_REGISTRY, register_op
+    for _n in ["to_tensor", "normalize", "resize", "center_crop", "hflip",
+               "vflip", "crop", "pad", "adjust_brightness", "adjust_contrast",
+               "adjust_saturation", "adjust_hue", "to_grayscale", "rotate",
+               "perspective", "erase", "solarize", "posterize", "equalize",
+               "autocontrast", "gaussian_blur"]:
+        _f = globals()[_n]
+        key = _n if _n not in OP_REGISTRY else "img_" + _n
+        if key not in OP_REGISTRY:
+            register_op(key, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                        differentiable=False, category="vision_transform",
+                        public=_f)
+
+
+_register_transforms()

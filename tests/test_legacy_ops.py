@@ -376,3 +376,289 @@ class TestDetectionOps:
                               output_size=2)
         np.testing.assert_allclose(out.numpy()[0, 0],
                                    [[1, 2], [3, 4]], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# r5 batch 2: decode/CRF/beam, MoE infra, fused incubate, optimizer kernels,
+# misc legacy singles
+# ---------------------------------------------------------------------------
+
+class TestDecodeOps:
+    def test_edit_distance_oracle(self):
+        d, n = paddle.edit_distance(np.array([[1, 2, 3]]),
+                                    np.array([[1, 3, 3]]), normalized=False)
+        assert d.numpy().tolist() == [1.0]
+        d2, _ = paddle.edit_distance(np.array([[1, 2, 3, 4]]),
+                                     np.array([[2, 3]]), normalized=False)
+        assert d2.numpy().tolist() == [2.0]
+
+    def test_ctc_align_and_greedy(self):
+        out, lens = paddle.ctc_align(np.array([[0, 1, 1, 0, 2, 2, 0]]),
+                                     blank=0)
+        np.testing.assert_array_equal(out.numpy()[0][:2], [1, 2])
+        assert lens.numpy().tolist() == [2]
+        logits = np.zeros((1, 4, 3), np.float32)
+        logits[0, :, 0] = -10  # never blank
+        logits[0, 0, 1] = 5; logits[0, 1, 1] = 5
+        logits[0, 2, 2] = 5; logits[0, 3, 2] = 5
+        o, l = paddle.ctc_greedy_decoder(logits, blank=0)
+        np.testing.assert_array_equal(o.numpy()[0][:2], [1, 2])
+
+    def test_crf_vs_brute_force(self):
+        import itertools
+        rng = np.random.default_rng(0)
+        K, T = 3, 4
+        em = rng.standard_normal((1, T, K)).astype(np.float32)
+        tr = rng.standard_normal((K + 2, K)).astype(np.float32)
+        path = paddle.crf_decoding(em, tr).numpy()[0]
+        best, bs = None, -1e9
+        alls = []
+        for p in itertools.product(range(K), repeat=T):
+            s = (tr[0, p[0]] + tr[1, p[-1]]
+                 + sum(em[0, t, p[t]] for t in range(T))
+                 + sum(tr[2 + p[t], p[t + 1]] for t in range(T - 1)))
+            alls.append(s)
+            if s > bs:
+                bs, best = s, p
+        assert path.tolist() == list(best)
+        nll = float(paddle.linear_chain_crf(
+            em, tr, np.array([list(best)])).numpy()[0])
+        m = max(alls)
+        logZ = float(np.log(np.sum(np.exp(np.array(alls) - m))) + m)
+        assert nll == pytest.approx(logZ - bs, abs=1e-4)
+
+    def test_beam_search_and_gather_tree(self):
+        pre_ids = np.array([[1, 2]])  # end_id = 2: beam 1 finished
+        pre_sc = np.array([[0.0, -1.0]], np.float32)
+        sc = np.log(np.array([[[0.05, 0.9, 0.05],
+                               [0.3, 0.3, 0.4]]], np.float32))
+        tok, top, par = paddle.beam_search(pre_ids, pre_sc, None, sc, 2,
+                                           end_id=2)
+        # best: beam0 emits tok1 (~-0.105); second: frozen beam1 re-emits
+        # end at -1.0 (beats beam0's other options)
+        assert tok.numpy()[0].tolist() == [1, 2]
+        assert par.numpy()[0].tolist() == [0, 1]
+        ids = np.array([[[1, 2]], [[3, 4]]])
+        parents = np.array([[[0, 0]], [[1, 0]]])
+        full = paddle.gather_tree(ids, parents)
+        assert full.numpy()[:, 0, :].tolist() == [[2, 1], [3, 4]]
+
+    def test_rnnt_loss_matches_brute_force(self):
+        import paddle_tpu.nn.functional as F
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.default_rng(3)
+        logits = rng.standard_normal((1, 2, 2, 3)).astype(np.float32)
+        ll = F.rnnt_loss(logits, np.array([[1]]), np.array([2]),
+                         np.array([1]), reduction="none")
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+        p1 = lp[0, 0, 0, 1] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+        p2 = lp[0, 0, 0, 0] + lp[0, 1, 0, 1] + lp[0, 1, 1, 0]
+        assert float(ll.numpy()[0]) == pytest.approx(
+            float(-np.logaddexp(p1, p2)), abs=1e-5)
+
+
+class TestMoEInfraOps:
+    def test_counting_and_positions(self):
+        import paddle_tpu.distributed as dist
+        nc = dist.number_count(np.array([0, 1, 1, 3]), 4)
+        assert nc.numpy().tolist() == [1, 2, 0, 1]
+        ec = dist.expert_count(np.array([0, 1, -1, 1]), 2)
+        assert ec.numpy().tolist() == [1, 2]
+        pos = dist.assign_pos(np.array([1, 0, 1, 0]), np.array([2, 4]))
+        assert pos.numpy().tolist() == [1, 3, 0, 2]
+
+    def test_capacity_enforcement(self):
+        import paddle_tpu.distributed as dist
+        lc = dist.limit_by_capacity(np.array([5, 1]), np.array([2, 2]))
+        assert lc.numpy().tolist() == [2, 1]
+        pg = dist.prune_gate_by_capacity(np.array([0, 0, 0, 1]),
+                                         np.array([2, 2]), 2)
+        assert pg.numpy().tolist() == [0, 0, -1, 1]
+
+    def test_random_routing(self):
+        import paddle_tpu.distributed as dist
+        rr = dist.random_routing(
+            np.array([[0, 1], [2, 3]]),
+            np.array([[0.6, 0.4], [0.9, 0.05]], np.float32),
+            np.array([0.5, 0.5], np.float32))
+        assert rr.numpy().tolist() == [[0, 1], [2, -1]]
+
+
+class TestIncubateFused:
+    def test_fused_feedforward_and_attention(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = np.random.randn(2, 4, 8).astype(np.float32)
+        out = IF.fused_feedforward(
+            x, np.random.randn(8, 16).astype(np.float32),
+            np.random.randn(16, 8).astype(np.float32),
+            dropout1_rate=0.0, dropout2_rate=0.0)
+        assert out.shape == [2, 4, 8]
+        qkvw = np.random.randn(3, 2, 4, 8).astype(np.float32)
+        ow = np.random.randn(8, 8).astype(np.float32)
+        out2 = IF.fused_attention(x, qkvw, ow, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0, pre_layer_norm=True)
+        assert out2.shape == [2, 4, 8]
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        s = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        p = IF.softmax_mask_fuse_upper_triangle(s).numpy()
+        assert p[0, 0, 0, 1] == 0  # causal
+        np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-5)
+
+    def test_fused_moe_runs_and_mixes(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        out = IF.fused_moe(x, np.random.randn(8, 4).astype(np.float32),
+                           np.random.randn(4, 8, 16).astype(np.float32),
+                           np.random.randn(4, 16, 8).astype(np.float32))
+        assert out.shape == [2, 3, 8]
+
+    def test_masked_multihead_attention_updates_cache(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        B, H, C, D = 2, 2, 4, 4
+        x = np.random.randn(B, 3 * H * D).astype(np.float32)
+        cache = np.zeros((2, B, H, C, D), np.float32)
+        out, new_cache = IF.masked_multihead_attention(
+            x, cache, seq_lens=np.array([0, 0]))
+        assert out.shape == [B, H * D]
+        assert (new_cache.numpy()[0][:, :, 0] != 0).any()
+
+    def test_fusion_rnn_shapes(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.randn(2, 5, 3).astype(np.float32)
+        h = F.fusion_gru(x, np.random.randn(3, 12).astype(np.float32),
+                         np.random.randn(4, 12).astype(np.float32))
+        assert h.shape == [2, 5, 4]
+        hs, cs = F.fusion_lstm(x, np.random.randn(3, 16).astype(np.float32),
+                               np.random.randn(4, 16).astype(np.float32))
+        assert hs.shape == [2, 5, 4] and cs.shape == [2, 5, 4]
+
+
+class TestOptimizerKernels:
+    def test_sgd_and_momentum(self):
+        from paddle_tpu.optimizer import ops as O
+        p = np.ones(4, np.float32)
+        g = np.full(4, 0.1, np.float32)
+        np.testing.assert_allclose(O.sgd_update(p, g, 0.1).numpy(),
+                                   p - 0.01, rtol=1e-6)
+        p2, v2 = O.momentum_update(p, g, np.zeros(4, np.float32), 0.1)
+        np.testing.assert_allclose(p2.numpy(), p - 0.01, rtol=1e-6)
+
+    def test_adam_matches_optimizer_class_math(self):
+        from paddle_tpu.optimizer import ops as O
+        p = np.ones(3, np.float32)
+        g = np.array([0.1, -0.2, 0.3], np.float32)
+        out, m, v, b1, b2 = O.adam_update(
+            p, g, np.zeros(3, np.float32), np.zeros(3, np.float32),
+            np.float32(0.9), np.float32(0.999), learning_rate=0.01)
+        # beta-pow inputs are beta^t at the CURRENT step (t=1 here), the
+        # reference op convention
+        mh = 0.1 * g / (1 - 0.9)
+        vh = 0.001 * g * g / (1 - 0.999)
+        ref = p - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_sparse_momentum_touches_only_indexed_rows(self):
+        from paddle_tpu.optimizer import ops as O
+        p, v = O.sparse_momentum_update(
+            np.ones((5, 3), np.float32), np.ones((2, 3), np.float32),
+            np.zeros((5, 3), np.float32), np.array([1, 3]))
+        assert p.numpy()[0, 0] == 1.0
+        assert p.numpy()[1, 0] != 1.0 and p.numpy()[3, 0] != 1.0
+        assert p.numpy()[2, 0] == 1.0
+
+
+class TestLegacySingles:
+    def test_space_depth_roundtrip(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rt = paddle.depth_to_space(paddle.space_to_depth(x, 2), 2)
+        np.testing.assert_array_equal(rt.numpy(), x)
+
+    def test_nonzero_static(self):
+        out = paddle.nonzero_static(np.array([[0, 5], [3, 0]], np.float32),
+                                    size=3)
+        assert out.numpy().tolist() == [[0, 1], [1, 0], [-1, -1]]
+
+    def test_exprel_vs_scipy(self):
+        import scipy.special as sp
+        x = np.array([0.0, 0.5, -1.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.exprel(x).numpy(), sp.exprel(x),
+                                   rtol=1e-5)
+
+    def test_multigammaln_vs_scipy(self):
+        import scipy.special as sp
+        np.testing.assert_allclose(
+            paddle.multigammaln(np.array([3.0], np.float32), 2).numpy(),
+            sp.multigammaln(3.0, 2), rtol=1e-4)
+
+    def test_bilinear_tensor_product(self):
+        x = np.random.randn(2, 3).astype(np.float32)
+        y = np.random.randn(2, 4).astype(np.float32)
+        w = np.random.randn(5, 3, 4).astype(np.float32)
+        out = paddle.bilinear_tensor_product(x, y, w)
+        ref = np.einsum("bi,kij,bj->bk", x, w, y)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fill_diagonal_tensor_and_inplace(self):
+        fd = paddle.fill_diagonal_tensor(np.zeros((3, 3), np.float32),
+                                         np.array([1., 2., 3.], np.float32))
+        np.testing.assert_array_equal(fd.numpy().diagonal(), [1, 2, 3])
+        t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        t.fill_diagonal_tensor_(
+            paddle.to_tensor(np.array([1., 2., 3.], np.float32)))
+        np.testing.assert_array_equal(t.numpy().diagonal(), [1, 2, 3])
+
+    def test_sequence_topk_and_batch_fc(self):
+        tk = paddle.sequence_topk_avg_pooling(
+            np.array([[4., 1., 3., 2.]], np.float32), [1, 3])
+        np.testing.assert_allclose(tk.numpy()[0], [4.0, 3.0], rtol=1e-6)
+        bf = paddle.batch_fc(np.ones((2, 3, 4), np.float32),
+                             np.ones((2, 4, 5), np.float32))
+        assert float(bf.numpy()[0, 0, 0]) == 4.0
+
+    def test_chunk_eval_perfect_and_partial(self):
+        pr, rc, f1, ni, nl, nc = paddle.chunk_eval(
+            np.array([0, 1, 1, 2]), np.array([0, 1, 1, 2]),
+            num_chunk_types=2)
+        assert float(f1.numpy()) == 1.0
+        pr2, *_ = paddle.chunk_eval(np.array([0, 1, 0, 1]),
+                                    np.array([0, 1, 1, 1]),
+                                    num_chunk_types=1)
+        assert float(pr2.numpy()) < 1.0
+
+
+class TestGraphSampling:
+    def test_sample_neighbors_static_padding(self):
+        import paddle_tpu.geometric as G
+        row = np.array([1, 2, 0])
+        colptr = np.array([0, 2, 3, 3])
+        nbrs, cnt = G.sample_neighbors(row, colptr, np.array([0, 1, 2]), 2)
+        assert cnt.numpy().tolist() == [2, 1, 0]
+        assert nbrs.numpy()[2].tolist() == [-1, -1]
+
+    def test_reindex_graph_compacts(self):
+        import paddle_tpu.geometric as G
+        row = np.array([1, 2, 0])
+        colptr = np.array([0, 2, 3, 3])
+        nbrs, cnt = G.sample_neighbors(row, colptr, np.array([0, 1]), 2)
+        src, dst, nodes = G.reindex_graph(np.array([0, 1]), nbrs, cnt)
+        assert int(src.numpy().max()) < len(nodes.numpy())
+
+
+class TestMetricOps:
+    def test_auc_perfect(self):
+        import paddle_tpu.metric as M
+        a = M.auc(np.array([0.1, 0.9, 0.8, 0.3], np.float32),
+                  np.array([0, 1, 1, 0]))
+        assert float(a.numpy()) == pytest.approx(1.0)
+
+    def test_precision_recall_rows(self):
+        import paddle_tpu.metric as M
+        pr = M.precision_recall(
+            np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32),
+            np.array([0, 1, 1]))
+        assert pr.shape == [4, 3]
+        # micro-averaged accuracy: 2/3 correct
+        assert pr.numpy()[3, 0] == pytest.approx(2 / 3, abs=1e-6)
