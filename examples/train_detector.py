@@ -42,17 +42,26 @@ def main():
         opt.clear_grad()
         print(f"step {step}: loss {float(loss.numpy()):.4f}")
 
-    # inference: per-image class-agnostic static NMS (fixed K, validity
-    # flags instead of dynamic shapes — runs inside jit)
+    # inference: per-class static multiclass NMS (the reference's
+    # multiclass_nms contract — suppression runs within each class via a
+    # vmapped greedy kernel, then one global keep_top_k; all shapes fixed,
+    # runs inside jit)
     cls, boxes = det(x)
     import jax.nn
-    scores = paddle.to_tensor(
-        np.asarray(jax.nn.sigmoid(cls._value).max(-1))[0])
-    kb, ks, keep = static_nms(paddle.to_tensor(
-        np.asarray(boxes._value)[0]), scores, top_k=8)
-    kept = np.asarray(keep._value)
-    print("detections kept:", int(kept.sum()), "of", kept.size)
-    print("top boxes:", np.asarray(kb._value)[kept][:3].round(1))
+    from paddle_tpu.vision.ops import multiclass_nms
+    scores_cm = paddle.to_tensor(
+        np.asarray(jax.nn.sigmoid(cls._value)).transpose(0, 2, 1))  # [B,C,A]
+    out, idx, count = multiclass_nms(boxes, scores_cm,
+                                     score_threshold=0.05,
+                                     nms_top_k=32, keep_top_k=8,
+                                     nms_threshold=0.6)
+    n = int(count.numpy()[0])
+    print("detections kept:", n, "of", out.shape[1])
+    det_rows = out.numpy()[0][:max(n, 1)]
+    print("top (label, score, box):")
+    for row in det_rows[:3]:
+        print(f"  class {int(row[0])} score {row[1]:.3f} "
+              f"box {row[2:].round(1)}")
 
 
 if __name__ == "__main__":

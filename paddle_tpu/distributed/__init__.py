@@ -26,6 +26,9 @@ from .checkpoint import load_state_dict, save_state_dict
 from .context_parallel import sep_parallel_attention
 from .moe import MoELayer
 from . import moe_utils
+from . import ps
+from .ps import (SelectedRows, SparseEmbedding, DistributedSparseEmbedding,
+                 SparseSGD, SparseAdagrad, SparseAdam, AsyncLookup)
 from .moe_utils import (number_count, expert_count, assign_pos,
                         limit_by_capacity, prune_gate_by_capacity,
                         random_routing, global_scatter, global_gather)

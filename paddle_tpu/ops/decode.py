@@ -157,12 +157,10 @@ def warpctc(logits, label, logits_length, labels_length, blank: int = 0,
     """CTC loss under the reference's warpctc entry point (ref:
     warpctc_op) — routes to the in-graph alpha-recursion CTC
     (``nn.functional.ctc_loss`` scan formulation). ``logits [T, B, K]``
-    (time-major, the warpctc convention)."""
+    (time-major — both entry points share the warpctc convention)."""
     from ..nn import functional as F
-    from .manipulation import transpose
     lg = ensure_tensor(logits)
-    lg_btk = transpose(lg, [1, 0, 2])
-    loss = F.ctc_loss(lg_btk, label, logits_length, labels_length,
+    loss = F.ctc_loss(lg, label, logits_length, labels_length,
                       blank=blank, reduction="none")
     if norm_by_times:
         from ._helpers import forward_op as _f

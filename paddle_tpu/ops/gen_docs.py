@@ -29,6 +29,8 @@ def generate(out_path: str = "docs/OPS.md") -> str:
     import paddle_tpu.audio  # noqa: F401
     import paddle_tpu.incubate.nn.functional  # noqa: F401
     import paddle_tpu.distributed.moe_utils  # noqa: F401
+    import paddle_tpu.optimizer  # noqa: F401
+    import paddle_tpu.distributed.ps  # noqa: F401
     import paddle_tpu.vision.transforms  # noqa: F401
     import paddle_tpu.text  # noqa: F401
     import paddle_tpu.metric  # noqa: F401

@@ -333,6 +333,7 @@ _AUTO_EXCLUDE = {
     "clip", "logit", "cholesky", "det", "inv", "eig", "eigh", "eigvals",
     "eigvalsh", "slogdet", "matrix_exp", "std", "var", "concatenate",
     "ravel_multi_index", "interpolate", "upsample",
+    "read_file", "decode_jpeg", "sampling_id",
 }
 
 
@@ -370,6 +371,7 @@ def attach_specs():
     explicit.update(_bulk_specs())
 
     attached = 0
+    explicit.update(_r5_specs())
     for name, spec in explicit.items():
         d = OP_REGISTRY.get(name)
         if d is not None:
@@ -389,6 +391,19 @@ def attach_specs():
         spec = _auto_spec(name, d.public)
         if spec is not None:
             d.sweep = spec
+            attached += 1
+    # r5: the in-place `_` family is swept for ALIASING semantics (the
+    # result must be rebound onto the caller's tensor and match the base
+    # op's value) by tests/test_op_sweep.py::test_inplace_aliasing_sweep.
+    # Mark each twin whose base is itself swept: the marker tuple keeps
+    # them out of the composite (callable-spec) sweep.
+    for name, d in OP_REGISTRY.items():
+        if not name.endswith("_") or d.sweep is not None:
+            continue
+        base = OP_REGISTRY.get(name[:-1])
+        if base is not None and (base.category in ("unary", "binary")
+                                 or callable(base.sweep)):
+            d.sweep = ("inplace", name[:-1])
             attached += 1
     return attached
 
@@ -786,3 +801,703 @@ def _np_fill_diag(a, v):
     out = a.copy()
     np.fill_diagonal(out, v)
     return out
+
+
+def _r5_specs():
+    """r5: specs for the round-5 op families (sequence/quant/detection/
+    decode/fused/optimizer/transforms/moe-infra) plus older unswept nn
+    composites. Oracle = numpy where the math is a one-liner; run-only
+    (finiteness + shape) where the op has its own hand-written domain test
+    in tests/ (every family here does)."""
+    sp = {}
+
+    def add(name, spec):
+        sp[name] = spec
+
+    i64 = np.int64
+
+    def _lens(*v):
+        return np.asarray(v, i64)
+
+    # ---- sequence family ----
+    add("sequence_pad", lambda rng: [((
+        _x(rng, (6, 2)), 0.0, 4, _lens(2, 4)), {}, None)])
+    add("sequence_reverse", lambda rng: [((
+        _x(rng, (2, 4, 2)), _lens(3, 4)), {}, None)])
+    add("sequence_softmax", lambda rng: [((
+        _x(rng, (2, 4)), _lens(2, 4)), {}, None)])
+    add("sequence_pool", lambda rng: [((
+        _x(rng, (2, 4)), "mean", _lens(2, 3)), {}, None)])
+    add("sequence_first_step", lambda rng: [((
+        _x(rng, (2, 4)), _lens(2, 3)), {},
+        lambda x, l, **k: x[:, 0])])
+    add("sequence_last_step", lambda rng: [((
+        _x(rng, (2, 4)), _lens(2, 3)), {}, None)])
+    add("sequence_expand", lambda rng: [((
+        _x(rng, (2, 3)), _lens(1, 2)), {}, None)])
+    add("sequence_expand_as", lambda rng: [((
+        _x(rng, (2, 3)), _x(rng, (2, 4, 3))), {}, None)])
+    add("sequence_conv", lambda rng: [((
+        _x(rng, (1, 5, 3)), _x(rng, (9, 4)), 3), {}, None)])
+    add("sequence_slice", lambda rng: [((
+        _x(rng, (2, 6)), _lens(1, 2), _lens(2, 3)), {}, None)])
+    add("sequence_concat", lambda rng: [((
+        [_x(rng, (2, 2)), _x(rng, (2, 3))],
+        [_lens(1, 2), _lens(2, 1)]), {}, None)])
+    add("sequence_enumerate", lambda rng: [((
+        rng.integers(0, 9, (2, 5)).astype(i64), 2), {}, None)])
+    add("sequence_erase", lambda rng: [((
+        rng.integers(0, 4, (2, 5)).astype(i64), [1], _lens(5, 4)),
+        {}, None)])
+    add("sequence_reshape", lambda rng: [((
+        _x(rng, (1, 2, 4)), 2, _lens(2)), {}, None)])
+    add("sequence_scatter", lambda rng: [((
+        np.zeros((2, 5), np.float32),
+        rng.integers(0, 5, (2, 2)).astype(i64), _x(rng, (2, 2))),
+        {}, None)])
+    add("lod_reset", lambda rng: [((
+        _x(rng, (2, 3)), _lens(1, 3)), {}, None)])
+    add("im2sequence", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2), {"stride": 2}, None)])
+    add("row_conv", lambda rng: [((
+        _x(rng, (1, 4, 3)), _x(rng, (2, 3))), {}, None)])
+
+    # ---- quant family ----
+    add("fake_quantize_abs_max", lambda rng: [((_x(rng),), {}, None)])
+    add("fake_quantize_dequantize_abs_max",
+        lambda rng: [((_x(rng),), {}, None)])
+    add("fake_channel_wise_quantize_abs_max",
+        lambda rng: [((_x(rng, (4, 3)),), {"quant_axis": 1}, None)])
+    add("fake_channel_wise_quantize_dequantize_abs_max",
+        lambda rng: [((_x(rng, (4, 3)),), {"quant_axis": 1}, None)])
+    add("fake_quantize_range_abs_max", lambda rng: [((
+        _x(rng), np.float32(0.5)), {}, None)])
+    add("fake_quantize_moving_average_abs_max", lambda rng: [((
+        _x(rng), np.float32(0.0), np.float32(0.0)), {}, None)])
+    add("fake_quantize_dequantize_moving_average_abs_max", lambda rng: [((
+        _x(rng), np.float32(0.0), np.float32(0.0)), {}, None)])
+    add("moving_average_abs_max_scale", lambda rng: [((
+        _x(rng), np.float32(0.0), np.float32(0.0)), {}, None)])
+    add("quantize_linear", lambda rng: [((
+        _x(rng), np.float32(0.05)), {}, None)])
+    add("dequantize_linear", lambda rng: [((
+        rng.integers(-127, 127, (3, 4)).astype(np.int32),
+        np.float32(0.05)), {},
+        lambda q, s, **k: q.astype(np.float32) * s)])
+    add("fake_dequantize_max_abs", lambda rng: [((
+        _x(rng), np.float32(127.0)), {},
+        lambda x, s, **k: x * s / 127.0)])
+    add("fake_channel_wise_dequantize_max_abs", lambda rng: [((
+        _x(rng, (3, 4)), _pos(rng, (3,))), {}, None)])
+    add("weight_quantize", lambda rng: [((_x(rng, (8, 4)),), {}, None)])
+    add("weight_dequantize", lambda rng: [((
+        rng.integers(-127, 127, (8, 4)).astype(np.int8),
+        _pos(rng, (4,))), {},
+        lambda w, s, **k: w.astype(np.float32) * s[None, :])])
+    add("weight_only_linear", lambda rng: [((
+        _x(rng, (3, 8)), rng.integers(-127, 127, (8, 4)).astype(np.int8),
+        _pos(rng, (4,))), {}, None)])
+    add("llm_int8_linear", lambda rng: [((
+        _x(rng, (3, 8)), rng.integers(-127, 127, (8, 4)).astype(np.int8),
+        _pos(rng, (4,))), {}, None)])
+
+    # ---- detection family (static in-graph ops; run-only, domain tests
+    # in tests/test_legacy_ops.py carry the semantics) ----
+    def boxes4(rng, n=6):
+        lo = rng.random((n, 2)).astype(np.float32) * 10
+        wh = rng.random((n, 2)).astype(np.float32) * 10 + 1
+        return np.concatenate([lo, lo + wh], -1)
+
+    add("deform_conv2d", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), np.zeros((1, 18, 4, 4), np.float32),
+        _x(rng, (3, 2, 3, 3))), {"padding": 1}, None)])
+    add("psroi_pool", lambda rng: [((
+        _x(rng, (1, 8, 4, 4)), np.array([[0, 0, 4, 4]], np.float32)),
+        {"output_size": 2}, None)])
+    add("prroi_pool", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), np.array([[0, 0, 4, 4]], np.float32)),
+        {"output_size": 2}, None)])
+    add("prior_box", lambda rng: [((
+        np.zeros((1, 2, 2, 2), np.float32),
+        np.zeros((1, 3, 16, 16), np.float32), [4.0]), {}, None)])
+    add("density_prior_box", lambda rng: [((
+        np.zeros((1, 2, 2, 2), np.float32),
+        np.zeros((1, 3, 16, 16), np.float32), [2], [4.0], [1.0]),
+        {}, None)])
+    add("anchor_generator", lambda rng: [((
+        np.zeros((1, 2, 2, 2), np.float32), [8.0], [1.0]), {}, None)])
+    add("yolo_box", lambda rng: [((
+        _x(rng, (1, 21, 2, 2)), np.array([[32, 32]], i64),
+        [4, 4, 8, 8, 16, 16], 2), {}, None)])
+    add("yolo_loss", lambda rng: [((
+        _x(rng, (1, 21, 2, 2)),
+        np.abs(_x(rng, (1, 2, 4))) % 0.8 + 0.1,
+        rng.integers(0, 2, (1, 2)).astype(i64),
+        [4, 4, 8, 8, 16, 16], [0, 1, 2], 2), {}, None)])
+    add("matrix_nms", lambda rng: [((
+        boxes4(rng)[None], rng.random((1, 2, 6)).astype(np.float32)),
+        {}, None)])
+    add("multiclass_nms", lambda rng: [((
+        boxes4(rng)[None], rng.random((1, 2, 6)).astype(np.float32)),
+        {}, None)])
+    add("generate_proposals", lambda rng: [((
+        rng.random((1, 2, 2, 2)).astype(np.float32),
+        _x(rng, (1, 8, 2, 2)), np.array([[16.0, 16.0]], np.float32),
+        rng.random((2, 2, 2, 4)).astype(np.float32) * 8),
+        {"pre_nms_top_n": 6, "post_nms_top_n": 3}, None)])
+    add("collect_fpn_proposals", lambda rng: [((
+        [boxes4(rng, 3), boxes4(rng, 3)],
+        [rng.random(3).astype(np.float32),
+         rng.random(3).astype(np.float32)], 4), {}, None)])
+    add("box_clip", lambda rng: [((
+        boxes4(rng), np.array([[16.0, 16.0, 1.0]], np.float32)),
+        {}, None)])
+    add("iou_similarity", lambda rng: [((
+        boxes4(rng, 3), boxes4(rng, 4)), {}, None)])
+    add("target_assign", lambda rng: [((
+        _x(rng, (3, 2)), np.array([0, -1, 2, 1], i64)), {}, None)])
+    add("mine_hard_examples", lambda rng: [((
+        rng.random(8).astype(np.float32),
+        np.array([0, -1, -1, 1, -1, -1, -1, -1], i64)), {}, None)])
+    add("ssd_loss", lambda rng: [((
+        _x(rng, (6, 4)) * 0.1, _x(rng, (6, 3)),
+        np.array([[0, 0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]], np.float32),
+        np.array([1, 2], i64), rng.random((6, 4)).astype(np.float32)),
+        {}, None)])
+    add("detection_output", lambda rng: [((
+        _x(rng, (1, 6, 4)) * 0.1, rng.random((1, 6, 3)).astype(np.float32),
+        rng.random((6, 4)).astype(np.float32)), {}, None)])
+    add("polygon_box_transform", lambda rng: [((
+        np.ones((1, 8, 2, 2), np.float32),), {}, None)])
+    add("rpn_target_assign", lambda rng: [((
+        boxes4(rng), boxes4(rng, 2)), {}, None)])
+    add("retinanet_target_assign", lambda rng: [((
+        boxes4(rng), boxes4(rng, 2), np.array([1, 3], i64)), {}, None)])
+    add("generate_proposal_labels", lambda rng: [((
+        boxes4(rng), boxes4(rng, 2), np.array([1, 2], i64)), {}, None)])
+    add("box_decoder_and_assign", lambda rng: [((
+        boxes4(rng, 4), np.tile(np.asarray(
+            [[0.1, 0.1, 0.2, 0.2]], np.float32), (4, 1)),
+        _x(rng, (4, 8)) * 0.1, rng.random((4, 2)).astype(np.float32)),
+        {}, None)])
+    add("roi_perspective_transform", lambda rng: [((
+        _x(rng, (1, 2, 8, 8)),
+        np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32), 4, 4),
+        {}, None)])
+    add("correlation", lambda rng: [((
+        _x(rng, (1, 2, 5, 5)), _x(rng, (1, 2, 5, 5))),
+        {"max_displacement": 1}, None)])
+    add("bilateral_slice", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), rng.random((1, 4, 4)).astype(np.float32),
+        _x(rng, (1, 12, 2, 2, 2))), {"has_offset": True}, None)])
+    add("retinanet_detection_output", lambda rng: [((
+        [_x(rng, (1, 4, 4)) * 0.1],
+        [rng.random((1, 4, 3)).astype(np.float32)],
+        [boxes4(rng, 4)], None), {}, None)])
+
+    # ---- decode family ----
+    add("linear_chain_crf", lambda rng: [((
+        _x(rng, (2, 4, 3)), _x(rng, (5, 3)),
+        rng.integers(0, 3, (2, 4)).astype(i64)), {}, None)])
+    add("crf_decoding", lambda rng: [((
+        _x(rng, (2, 4, 3)), _x(rng, (5, 3))), {}, None)])
+    add("ctc_align", lambda rng: [((
+        rng.integers(0, 3, (2, 6)).astype(i64),), {}, None)])
+    add("ctc_greedy_decoder", lambda rng: [((
+        _x(rng, (2, 5, 4)),), {}, None)])
+    add("warpctc", lambda rng: [((
+        _x(rng, (6, 2, 5)), rng.integers(1, 5, (2, 2)).astype(i64),
+        _lens(6, 6), _lens(2, 2)), {}, None)])  # [T, B, K] time-major
+    add("beam_search", lambda rng: [((
+        rng.integers(0, 3, (1, 2)).astype(i64),
+        _x(rng, (1, 2)), None,
+        np.log(rng.random((1, 2, 4)).astype(np.float32) + 0.1), 2, 3),
+        {}, None)])
+    add("gather_tree", lambda rng: [((
+        rng.integers(0, 5, (3, 1, 2)).astype(i64),
+        rng.integers(0, 2, (3, 1, 2)).astype(i64)), {}, None)])
+    add("beam_search_decode", lambda rng: [((
+        rng.integers(0, 5, (3, 1, 2)).astype(i64),
+        rng.integers(0, 2, (3, 1, 2)).astype(i64)), {}, None)])
+    add("edit_distance", lambda rng: [((
+        rng.integers(0, 5, (2, 4)).astype(i64),
+        rng.integers(0, 5, (2, 3)).astype(i64)), {}, None)])
+    add("rnnt_loss", lambda rng: [((
+        _x(rng, (1, 3, 2, 4)), np.array([[1]], i64),
+        _lens(3), _lens(1)), {}, None)])
+    add("viterbi_decode", lambda rng: [((
+        _x(rng, (1, 4, 3)), _x(rng, (3, 3)), _lens(4)), {}, None)])
+
+    # ---- MoE infra ----
+    add("number_count", lambda rng: [((
+        rng.integers(0, 4, 8).astype(i64), 4), {},
+        lambda v, n, **k: np.bincount(v, minlength=n))])
+    add("expert_count", lambda rng: [((
+        rng.integers(0, 3, 8).astype(i64), 3), {},
+        lambda v, n, **k: np.bincount(v, minlength=n))])
+    add("assign_pos", lambda rng: [((
+        rng.integers(0, 3, 6).astype(i64), _lens(2, 4, 6)), {}, None)])
+    add("limit_by_capacity", lambda rng: [((
+        np.array([5, 1, 3], i64), np.array([2, 2, 2], i64)), {},
+        lambda e, c, **k: np.minimum(e, c))])
+    add("prune_gate_by_capacity", lambda rng: [((
+        rng.integers(0, 2, 6).astype(i64), np.array([3, 3], i64), 2),
+        {}, None)])
+    add("random_routing", lambda rng: [((
+        rng.integers(0, 4, (4, 2)).astype(i64),
+        rng.random((4, 2)).astype(np.float32),
+        rng.random(4).astype(np.float32)), {}, None)])
+
+    # ---- fused surface ----
+    add("fused_rms_norm", lambda rng: [((
+        _x(rng, (2, 4, 8)), _pos(rng, (8,))), {}, None)])
+    add("fused_layer_norm", lambda rng: [((
+        _x(rng, (2, 4, 8)), _pos(rng, (8,)), _x(rng, (8,))), {}, None)])
+    add("fused_linear", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (4, 5))), {}, None)])
+    add("fused_matmul_bias", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (4, 5)), _x(rng, (5,))), {},
+        lambda a, b, c, **k: a @ b + c)])
+    add("fused_gemm_epilogue", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (4, 5)), _x(rng, (5,))),
+        {"activation": "relu"},
+        lambda a, b, c, **k: np.maximum(a @ b + c, 0))])
+    add("fused_linear_activation", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (4, 5)), _x(rng, (5,))),
+        {"activation": "relu"}, None)])
+    add("fused_bias_act", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (4,))), {"act_method": "relu"},
+        lambda x, b, **k: np.maximum(x + b, 0))])
+    add("fused_dropout_add", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (3, 4))), {"p": 0.0},
+        lambda x, y, **k: x + y)])
+    add("fused_feedforward", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (8, 16)), _x(rng, (16, 8))),
+        {"dropout1_rate": 0.0, "dropout2_rate": 0.0}, None)])
+    add("fused_attention", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (3, 2, 4, 8)), _x(rng, (8, 8))),
+        {"dropout_rate": 0.0, "attn_dropout_rate": 0.0,
+         "pre_layer_norm": True}, None)])
+    add("fused_gate_attention", lambda rng: [((
+        _x(rng, (2, 3, 8)),),
+        {"qkv_weight": _x(rng, (3, 2, 4, 8)), "merge_qkv": True,
+         "has_gating": False}, None)])
+    add("fused_bias_dropout_residual_layer_norm", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (2, 3, 8))),
+        {"dropout_rate": 0.0}, None)])
+    add("fused_bn_add_act", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), _x(rng, (1, 3, 4, 4)),
+        np.zeros(3, np.float32), np.ones(3, np.float32),
+        np.ones(3, np.float32), np.zeros(3, np.float32)), {}, None)])
+    add("resnet_unit", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), _x(rng, (3, 2, 3, 3)),
+        np.ones(3, np.float32), np.zeros(3, np.float32),
+        np.zeros(3, np.float32), np.ones(3, np.float32)), {}, None)])
+    add("masked_multihead_attention", lambda rng: [((
+        _x(rng, (2, 24)), np.zeros((2, 2, 2, 4, 4), np.float32)),
+        {"seq_lens": np.zeros(2, i64)}, None)])
+    add("variable_length_memory_efficient_attention", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), _x(rng, (1, 2, 4, 4)),
+        _x(rng, (1, 2, 4, 4))), {"seq_lens": _lens(3)}, None)])
+    add("fused_moe", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (8, 4)), _x(rng, (4, 8, 16)),
+        _x(rng, (4, 16, 8))), {}, None)])
+    add("fused_ec_moe", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (8, 4)), _x(rng, (4, 8, 16)),
+        _x(rng, (4, 16, 8))), {}, None)])
+    add("softmax_mask_fuse", lambda rng: [((
+        _x(rng, (1, 2, 3, 4)), np.zeros((1, 1, 3, 4), np.float32)),
+        {}, None)])
+    add("softmax_mask_fuse_upper_triangle", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),), {}, None)])
+    add("fused_multi_head_attention", lambda rng: [((
+        _x(rng, (2, 3, 8)), _x(rng, (8, 24))), {"num_heads": 2}, None)])
+    add("fused_rotary_position_embedding", lambda rng: [((
+        _x(rng, (1, 4, 2, 8)),), {}, None)])
+    add("fusion_gru", lambda rng: [((
+        _x(rng, (2, 4, 3)), _x(rng, (3, 12)), _x(rng, (4, 12))),
+        {}, None)])
+    add("fusion_lstm", lambda rng: [((
+        _x(rng, (2, 4, 3)), _x(rng, (3, 16)), _x(rng, (4, 16))),
+        {}, None)])
+    add("multi_gru", lambda rng: [((
+        _x(rng, (2, 4, 3)),
+        [_x(rng, (3, 12)), _x(rng, (3, 12))],
+        [_x(rng, (4, 12)), _x(rng, (4, 12))]), {}, None)])
+    add("gru_unit", lambda rng: [((
+        _x(rng, (2, 12)), _x(rng, (2, 4)), _x(rng, (4, 12))), {}, None)])
+    add("lstm_unit", lambda rng: [((
+        _x(rng, (2, 16)), _x(rng, (2, 4))), {}, None)])
+
+    # ---- optimizer update kernels ----
+    z4 = lambda: np.zeros(4, np.float32)
+    g4 = lambda rng: (_x(rng, (4,)) * 0.1).astype(np.float32)
+    p4 = lambda rng: _pos(rng, (4,))
+    add("sgd_update", lambda rng: [((p4(rng), g4(rng)), {}, None)])
+    add("momentum_update", lambda rng: [((p4(rng), g4(rng), z4()),
+                                         {}, None)])
+    add("adagrad_update", lambda rng: [((p4(rng), g4(rng), z4()),
+                                        {}, None)])
+    add("decayed_adagrad_update", lambda rng: [((p4(rng), g4(rng), z4()),
+                                                {}, None)])
+    add("proximal_adagrad_update", lambda rng: [((
+        p4(rng), g4(rng), p4(rng)), {}, None)])
+    add("proximal_gd_update", lambda rng: [((p4(rng), g4(rng)), {}, None)])
+    add("adadelta_update", lambda rng: [((p4(rng), g4(rng), z4(), z4()),
+                                         {}, None)])
+    add("rmsprop_update", lambda rng: [((p4(rng), g4(rng), z4(), z4()),
+                                        {}, None)])
+    add("adamax_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9)), {}, None)])
+    add("ftrl_update", lambda rng: [((
+        p4(rng), g4(rng), p4(rng), z4()), {}, None)])
+    add("adam_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9),
+        np.float32(0.999)), {}, None)])
+    add("adamw_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9),
+        np.float32(0.999)), {}, None)])
+    add("nadam_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9),
+        np.float32(0.999)), {}, None)])
+    add("radam_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9),
+        np.float32(0.999), np.float32(1.0)), {}, None)])
+    add("lamb_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4(), np.float32(0.9),
+        np.float32(0.999)), {}, None)])
+    add("lars_momentum_update", lambda rng: [((p4(rng), g4(rng), z4()),
+                                              {}, None)])
+    add("sparse_momentum_update", lambda rng: [((
+        _pos(rng, (5, 3)), _x(rng, (2, 3)), np.zeros((5, 3), np.float32),
+        np.array([1, 3], i64)), {}, None)])
+    add("dgc_momentum_update", lambda rng: [((
+        p4(rng), g4(rng), z4(), z4()), {}, None)])
+
+    # ---- image transforms (host numpy kernels) ----
+    def img(rng):
+        return (rng.random((6, 6, 3)) * 255).astype(np.uint8)
+
+    add("adjust_brightness", lambda rng: [((img(rng), 1.2), {}, None)])
+    add("adjust_contrast", lambda rng: [((img(rng), 0.8), {}, None)])
+    add("adjust_saturation", lambda rng: [((img(rng), 1.5), {}, None)])
+    add("adjust_hue", lambda rng: [((img(rng), 0.1), {}, None)])
+    add("to_grayscale", lambda rng: [((img(rng),), {}, None)])
+    add("rotate", lambda rng: [((img(rng), 30.0), {}, None)])
+    add("perspective", lambda rng: [((
+        img(rng), [[0, 0], [5, 0], [5, 5], [0, 5]],
+        [[0, 0], [5, 1], [5, 5], [0, 4]]), {}, None)])
+    add("erase", lambda rng: [((img(rng), 1, 1, 2, 2, 0), {}, None)])
+    add("solarize", lambda rng: [((img(rng),), {}, None)])
+    add("posterize", lambda rng: [((img(rng), 4), {}, None)])
+    add("equalize", lambda rng: [((img(rng),), {}, None)])
+    add("autocontrast", lambda rng: [((img(rng),), {}, None)])
+    add("gaussian_blur", lambda rng: [((img(rng), 3), {}, None)])
+    add("img_crop", lambda rng: [((img(rng), 1, 1, 3, 3), {}, None)])
+    add("img_normalize", lambda rng: [((
+        img(rng).astype(np.float32), [0.5] * 3, [0.5] * 3), {}, None)])
+    add("img_pad", lambda rng: [((img(rng), 2), {}, None)])
+    add("center_crop", lambda rng: [((img(rng), 4), {}, None)])
+    add("resize", lambda rng: [((img(rng), 4), {}, None)])
+
+    # ---- legacy singles ----
+    add("addbmm", lambda rng: [((
+        np.zeros((3, 2), np.float32), _x(rng, (2, 3, 4)),
+        _x(rng, (2, 4, 2))), {},
+        lambda i, a, b, **k: i + np.einsum("bik,bkj->ij", a, b))])
+    add("reduce_as", lambda rng: [((
+        _x(rng, (2, 3, 4)), np.zeros((3, 1), np.float32)), {},
+        lambda x, t, **k: x.sum(0).sum(-1, keepdims=True))])
+    add("pca_lowrank", lambda rng: [((_x(rng, (8, 5)),), {"q": 3}, None)])
+    add("im2col", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2), {"stride": 2}, None)])
+    add("space_to_depth", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2), {}, None)])
+    add("depth_to_space", lambda rng: [((
+        _x(rng, (1, 8, 2, 2)), 2), {}, None)])
+    add("affine_channel", lambda rng: [((
+        _x(rng, (1, 3, 2, 2)), _pos(rng, (3,)), _x(rng, (3,))),
+        {}, None)])
+    add("data_norm", lambda rng: [((
+        _x(rng, (4, 3)), np.full(3, 10.0, np.float32),
+        np.zeros(3, np.float32), np.full(3, 20.0, np.float32)),
+        {}, None)])
+    add("fill_any", lambda rng: [((
+        np.zeros((2, 2), np.float32), np.float32(7.0)), {},
+        lambda x, v, **k: np.full_like(x, 7.0))])
+    add("fill_any_like", lambda rng: [((
+        np.zeros((2, 2), np.float32), 3.0), {},
+        lambda x, v, **k: np.full_like(x, 3.0))])
+    add("partial_concat", lambda rng: [((
+        [_x(rng, (2, 4)), _x(rng, (2, 4))], 1, 2), {}, None)])
+    add("partial_sum", lambda rng: [((
+        [_x(rng, (2, 4)), _x(rng, (2, 4))], 0, 2), {}, None)])
+    add("batch_fc", lambda rng: [((
+        _x(rng, (2, 3, 4)), _x(rng, (2, 4, 5))), {},
+        lambda x, w, **k: np.einsum("sbi,sio->sbo", x, w))])
+    add("cvm", lambda rng: [((
+        _x(rng, (3, 5)), np.abs(_x(rng, (3, 2)))), {}, None)])
+    add("sampling_id", lambda rng: [((
+        rng.random((3, 4)).astype(np.float32) + 0.1,), {}, None)])
+    add("uniform_random_batch_size_like", lambda rng: [((
+        np.zeros((5, 2), np.float32), [1, 3]), {}, None)])
+    add("gaussian_random_batch_size_like", lambda rng: [((
+        np.zeros((5, 2), np.float32), [1, 3]), {}, None)])
+    add("fill_constant_batch_size_like", lambda rng: [((
+        np.zeros((5, 2), np.float32), [1, 3], "float32", 2.0), {},
+        lambda x, s, d, v, **k: np.full((5, 3), 2.0, np.float32))])
+    add("dropout_nd", lambda rng: [((
+        np.ones((2, 3), np.float32), 0.0), {"axis": 0},
+        lambda x, p, **k: x)])
+    add("fused_embedding_seq_pool", lambda rng: [((
+        np.eye(4, dtype=np.float32),
+        rng.integers(0, 4, (2, 3)).astype(i64)), {}, None)])
+    add("nonzero_static", lambda rng: [((
+        (np.abs(_x(rng, (3, 3))) > 0.5).astype(np.float32), 4),
+        {}, None)])
+    add("fill_diagonal_tensor", lambda rng: [((
+        np.zeros((3, 3), np.float32), _x(rng, (3,))), {}, None)])
+    add("l1_norm", lambda rng: [((_x(rng),), {},
+                                 lambda x, **k: np.abs(x).sum())])
+    add("share_data", lambda rng: [((_x(rng),), {}, lambda x, **k: x)])
+    add("bilinear_tensor_product", lambda rng: [((
+        _x(rng, (2, 3)), _x(rng, (2, 4)), _x(rng, (5, 3, 4))), {},
+        lambda x, y, w, **k: np.einsum("bi,kij,bj->bk", x, w, y))])
+    add("fc", lambda rng: [((
+        _x(rng, (2, 4)), 3), {"weight": _x(rng, (4, 3))}, None)])
+    add("match_matrix_tensor", lambda rng: [((
+        _x(rng, (2, 3, 4)), _x(rng, (2, 5, 6)), _x(rng, (4, 2, 6))),
+        {}, None)])
+    add("sequence_topk_avg_pooling", lambda rng: [((
+        _x(rng, (2, 6)), [1, 3]), {}, None)])
+    add("rank_attention", lambda rng: [((
+        _x(rng, (3, 4)),
+        np.array([[0, 1, -1, 0, -1], [1, 0, -1, -1, -1],
+                  [2, 2, 0, 1, 0]], i64),
+        _x(rng, (36, 5))), {"max_rank": 3}, None)])
+    add("tree_conv", lambda rng: [((
+        _x(rng, (1, 4, 3)), np.array([[[0, 1], [0, 2], [1, 3]]], i64),
+        _x(rng, (3, 3, 6))), {}, None)])
+    add("var_conv_2d", lambda rng: [((
+        _x(rng, (2, 1, 4, 4)), _lens(3, 4), _lens(4, 2),
+        _x(rng, (2, 1, 3, 3))), {}, None)])
+    add("exprel", lambda rng: [((_x(rng),), {}, None)])
+    add("multigammaln", lambda rng: [((_pos(rng) + 1.0, 2), {}, None)])
+    add("contiguous", lambda rng: [((_x(rng),), {}, lambda x, **k: x)])
+    add("soft_relu", lambda rng: [((_x(rng),), {},
+                                   lambda x, **k: np.log1p(np.exp(x)))])
+    add("brelu", lambda rng: [((_x(rng) * 10,), {},
+                               lambda x, **k: np.clip(x, 0, 24))])
+
+    # ---- metric functionals ----
+    add("accuracy", lambda rng: [((
+        rng.random((6, 3)).astype(np.float32),
+        rng.integers(0, 3, (6, 1)).astype(i64)), {}, None)])
+    add("auc", lambda rng: [((
+        rng.random(8).astype(np.float32),
+        rng.integers(0, 2, 8).astype(i64)), {}, None)])
+    add("precision_recall", lambda rng: [((
+        rng.random((6, 3)).astype(np.float32),
+        rng.integers(0, 3, 6).astype(i64)), {}, None)])
+    add("positive_negative_pair", lambda rng: [((
+        rng.random(6).astype(np.float32),
+        rng.integers(0, 2, 6).astype(i64),
+        np.zeros(6, i64)), {}, None)])
+
+    # ---- older unswept nn composites ----
+    add("conv2d_transpose", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), _x(rng, (3, 2, 3, 3))), {}, None)])
+    add("conv1d_transpose", lambda rng: [((
+        _x(rng, (1, 3, 6)), _x(rng, (3, 2, 3))), {}, None)])
+    add("conv3d_transpose", lambda rng: [((
+        _x(rng, (1, 2, 3, 3, 3)), _x(rng, (2, 2, 2, 2, 2))), {}, None)])
+    add("depthwise_conv2d", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), _x(rng, (3, 1, 3, 3))),
+        {"padding": 1}, None)])
+    add("depthwise_conv2d_transpose", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), _x(rng, (3, 1, 3, 3))), {}, None)])
+    add("conv2d_fusion", lambda rng: [((
+        _x(rng, (1, 3, 4, 4)), _x(rng, (4, 3, 3, 3))),
+        {"padding": 1}, None)])
+    add("batch_norm", lambda rng: [((
+        _x(rng, (2, 3, 4, 4)), np.zeros(3, np.float32),
+        np.ones(3, np.float32)), {}, None)])
+    add("sync_batch_norm", lambda rng: [((
+        _x(rng, (2, 3, 4, 4)), np.zeros(3, np.float32),
+        np.ones(3, np.float32)), {}, None)])
+    add("layer_norm", lambda rng: [((
+        _x(rng, (2, 6)), [6]), {}, None)])
+    add("group_norm", lambda rng: [((
+        _x(rng, (2, 4, 3, 3)), 2), {}, None)])
+    add("local_response_norm", lambda rng: [((
+        _x(rng, (1, 4, 4, 4)), 3), {}, None)])
+    add("pool2d", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2, "avg"), {}, None)])
+    add("pool3d", lambda rng: [((
+        _x(rng, (1, 2, 4, 4, 4)), 2, "max"), {}, None)])
+    add("lp_pool2d", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2.0, 2), {}, None)])
+    add("max_pool2d_with_index", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2), {}, None)])
+    add("max_pool3d_with_index", lambda rng: [((
+        _x(rng, (1, 2, 4, 4, 4)), 2), {}, None)])
+    add("maxout", lambda rng: [((
+        _x(rng, (1, 4, 3, 3)), 2), {}, None)])
+    add("prelu", lambda rng: [((
+        _x(rng, (2, 4)), np.float32(0.2)), {},
+        lambda x, a, **k: np.where(x >= 0, x, a * x))])
+    add("pad2d", lambda rng: [((
+        _x(rng, (1, 2, 3, 3)), [1, 1, 1, 1]), {}, None)])
+    add("pad3d", lambda rng: [((
+        _x(rng, (1, 2, 3, 3, 3)), [1, 1, 1, 1, 1, 1]), {}, None)])
+    add("pixel_shuffle", lambda rng: [((
+        _x(rng, (1, 8, 2, 2)), 2), {}, None)])
+    add("pixel_unshuffle", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)), 2), {}, None)])
+    add("channel_shuffle", lambda rng: [((
+        _x(rng, (1, 4, 3, 3)), 2), {}, None)])
+    add("grid_sample", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),
+        (rng.random((1, 3, 3, 2)).astype(np.float32) * 2 - 1)),
+        {}, None)])
+    add("affine_grid", lambda rng: [((
+        _x(rng, (1, 2, 3)), [1, 2, 4, 4]), {}, None)])
+    add("fold", lambda rng: [((
+        _x(rng, (1, 8, 4)), [4, 4], [2, 2]), {"strides": 2}, None)])
+    add("bilinear", lambda rng: [((
+        _x(rng, (2, 3)), _x(rng, (2, 4)), _x(rng, (5, 3, 4))),
+        {}, None)])
+    add("flash_attention", lambda rng: [((
+        _x(rng, (1, 4, 2, 8)), _x(rng, (1, 4, 2, 8)),
+        _x(rng, (1, 4, 2, 8))), {}, None)])
+    add("attention_probs", lambda rng: [((
+        _x(rng, (1, 2, 3, 4)), _x(rng, (1, 2, 3, 4))), {}, None)])
+    add("ctc_loss", lambda rng: [((
+        _x(rng, (6, 2, 5)), rng.integers(1, 5, (2, 2)).astype(i64),
+        _lens(6, 6), _lens(2, 2)), {}, None)])  # [T, B, C] paddle layout
+    add("dice_loss", lambda rng: [((
+        rng.random((2, 4, 1)).astype(np.float32),
+        rng.integers(0, 2, (2, 4, 1)).astype(i64)), {}, None)])
+    add("gaussian_nll_loss", lambda rng: [((
+        _x(rng, (4,)), _x(rng, (4,)), _pos(rng, (4,))), {}, None)])
+    add("hinge_embedding_loss", lambda rng: [((
+        _x(rng, (4,)),
+        (rng.integers(0, 2, 4) * 2 - 1).astype(np.float32)), {}, None)])
+    add("cosine_embedding_loss", lambda rng: [((
+        _x(rng, (2, 4)), _x(rng, (2, 4)),
+        (rng.integers(0, 2, 2) * 2 - 1).astype(np.float32)), {}, None)])
+    add("margin_ranking_loss", lambda rng: [((
+        _x(rng, (4,)), _x(rng, (4,)),
+        (rng.integers(0, 2, 4) * 2 - 1).astype(np.float32)), {}, None)])
+    add("multi_label_soft_margin_loss", lambda rng: [((
+        _x(rng, (2, 4)), rng.integers(0, 2, (2, 4)).astype(np.float32)),
+        {}, None)])
+    add("poisson_nll_loss", lambda rng: [((
+        _x(rng, (4,)), _pos(rng, (4,))), {}, None)])
+    add("max_unpool1d", lambda rng: [((
+        _x(rng, (1, 2, 2)), np.array([[[0, 2]]], i64) *
+        np.ones((1, 2, 2), i64), 2), {}, None)])
+    add("max_unpool2d", lambda rng: [((
+        _x(rng, (1, 2, 2, 2)),
+        rng.integers(0, 4, (1, 2, 2, 2)).astype(i64), 2), {}, None)])
+    add("max_unpool3d", lambda rng: [((
+        _x(rng, (1, 1, 2, 2, 2)),
+        rng.integers(0, 8, (1, 1, 2, 2, 2)).astype(i64), 2), {}, None)])
+    add("npair_loss", lambda rng: [((
+        _x(rng, (4, 8)), _x(rng, (4, 8)),
+        rng.integers(0, 2, 4).astype(i64)), {}, None)])
+    add("margin_cross_entropy", lambda rng: [((
+        (rng.random((4, 6)).astype(np.float32) * 2 - 1) * 0.9,
+        rng.integers(0, 6, 4).astype(i64)), {}, None)])
+    add("rank_loss", lambda rng: [((
+        rng.integers(0, 2, 4).astype(np.float32), _x(rng, (4,)),
+        _x(rng, (4,))), {}, None)])
+    add("multi_margin_loss", lambda rng: [((
+        _x(rng, (4, 5)), rng.integers(0, 5, 4).astype(i64)), {}, None)])
+    add("triplet_margin_with_distance_loss", lambda rng: [((
+        _x(rng, (4, 8)), _x(rng, (4, 8)), _x(rng, (4, 8))), {}, None)])
+    add("adaptive_log_softmax_with_loss", lambda rng: [((
+        _x(rng, (4, 8)), rng.integers(0, 5, 4).astype(i64),
+        _x(rng, (8, 3)),
+        [(_x(rng, (8, 4)), _x(rng, (4, 3)))], [2, 5]), {}, None)])
+    add("center_loss", lambda rng: [((
+        _x(rng, (4, 8)), rng.integers(0, 3, 4).astype(i64),
+        np.zeros((3, 8), np.float32)), {}, None)])
+    add("teacher_student_sigmoid_loss", lambda rng: [((
+        _x(rng, (4,)), rng.random(4).astype(np.float32)), {}, None)])
+    add("bpr_loss", lambda rng: [((
+        _x(rng, (3, 5)), rng.integers(0, 5, 3).astype(i64)), {}, None)])
+    add("cos_sim", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (3, 4))), {}, None)])
+    add("squared_l2_norm", lambda rng: [((_x(rng),), {},
+                                         lambda x, **k: (x * x).sum())])
+    add("squared_l2_distance", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (3, 4))), {},
+        lambda x, y, **k: ((x - y) ** 2).sum(-1))])
+    add("modified_huber_loss", lambda rng: [((
+        _x(rng, (4,)), rng.integers(0, 2, 4).astype(np.float32)),
+        {}, None)])
+    add("identity_loss", lambda rng: [((_x(rng),), {"reduction": "sum"},
+                                       lambda x, **k: x.sum())])
+    add("hsigmoid_loss", lambda rng: [((
+        _x(rng, (3, 6)), rng.integers(0, 4, 3).astype(i64), 4,
+        _x(rng, (3, 6))), {}, None)])
+    add("chunk_eval", lambda rng: [((
+        rng.integers(0, 2, (1, 6)).astype(i64),
+        rng.integers(0, 2, (1, 6)).astype(i64)), {}, None)])
+    add("cdist", lambda rng: [((
+        _x(rng, (3, 4)), _x(rng, (5, 4))), {}, None)])
+    add("histogramdd", lambda rng: [((_x(rng, (8, 2)),), {"bins": 3},
+                                     None)])
+    add("householder_product", lambda rng: [((
+        _x(rng, (4, 3)), _x(rng, (3,))), {}, None)])
+    add("ormqr", lambda rng: [((
+        _x(rng, (4, 3)), _x(rng, (3,)), _x(rng, (4, 4))), {}, None)])
+    add("orgqr", lambda rng: [((
+        _x(rng, (4, 3)), _x(rng, (3,))), {}, None)])
+    add("polar", lambda rng: [((
+        _pos(rng), _x(rng)), {},
+        lambda a, t, **k: a * np.exp(1j * t))])
+    add("as_strided", lambda rng: [((
+        _x(rng, (8,)), [2, 3], [3, 1]), {}, None)])
+    add("masked_select", lambda rng: [((
+        _x(rng, (3, 3)), _x(rng, (3, 3)) > 0), {}, None)])
+    add("clip_by_global_norm", lambda rng: [((
+        [_x(rng, (3,)), _x(rng, (2, 2))], 1.0), {}, None)])
+    add("create_dct", lambda rng: [((4, 8), {}, None)])
+    add("fft_frequencies", lambda rng: [((16, 8), {}, None)])
+    add("mel_frequencies", lambda rng: [((8,), {}, None)])
+    add("compute_fbank_matrix", lambda rng: [((16, 8), {}, None)])
+    add("send_uv", lambda rng: [((
+        _x(rng, (4, 3)), _x(rng, (4, 3)),
+        np.array([0, 1], i64), np.array([1, 2], i64)), {}, None)])
+    add("interpolate", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),), {"size": [2, 2], "mode": "bilinear"},
+        None)])
+    add("upsample", lambda rng: [((
+        _x(rng, (1, 2, 2, 2)),), {"scale_factor": 2}, None)])
+    add("linear_interp", lambda rng: [((
+        _x(rng, (1, 2, 6)),), {"size": [3]}, None)])
+    add("bilinear_interp", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),), {"size": [2, 2]}, None)])
+    add("nearest_interp", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),), {"scale_factor": 2}, None)])
+    add("bicubic_interp", lambda rng: [((
+        _x(rng, (1, 2, 4, 4)),), {"size": [2, 2]}, None)])
+    add("trilinear_interp", lambda rng: [((
+        _x(rng, (1, 2, 4, 4, 4)),), {"size": [2, 2, 2]}, None)])
+    add("spp", lambda rng: [((_x(rng, (1, 2, 4, 4)),), {}, None)])
+    add("unpool", lambda rng: [((
+        _x(rng, (1, 2, 2, 2)),
+        rng.integers(0, 4, (1, 2, 2, 2)).astype(i64), 2), {}, None)])
+    add("unpool3d", lambda rng: [((
+        _x(rng, (1, 1, 2, 2, 2)),
+        rng.integers(0, 8, (1, 1, 2, 2, 2)).astype(i64), 2), {}, None)])
+    add("log_mel_spectrogram", lambda rng: [((
+        _x(rng, (1, 512)),), {"n_fft": 128, "n_mels": 8}, None)])
+    add("c_embedding", lambda rng: [((
+        _x(rng, (4, 3)), rng.integers(0, 6, (2, 3)).astype(i64)),
+        {}, None)])
+    add("c_softmax_with_cross_entropy", lambda rng: [((
+        _x(rng, (3, 5)), rng.integers(0, 5, 3).astype(i64)), {}, None)])
+    return sp

@@ -1,0 +1,266 @@
+"""Parameter-server equivalent (distributed/ps.py): SelectedRows sparse
+gradients, sparse optimizers touching only gathered rows, host-resident
+tables, vocab-sharded distributed lookup (SURVEY §2.5 Parameter server;
+VERDICT r4 missing #1 / next #3)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.ps import (AsyncLookup, SelectedRows,
+                                       SparseAdagrad, SparseAdam,
+                                       SparseEmbedding, SparseSGD)
+
+
+class TestSelectedRows:
+    def test_merge_accumulates_duplicates(self):
+        sel = SelectedRows([3, 1, 3], np.array([[1.0], [2.0], [4.0]]),
+                           height=5)
+        m = sel.merge()
+        assert m.ids.tolist() == [1, 3]
+        np.testing.assert_allclose(m.rows, [[2.0], [5.0]])
+
+    def test_to_dense(self):
+        sel = SelectedRows([0, 2], np.array([[1.0, 1.0], [2.0, 2.0]]),
+                           height=4)
+        d = sel.to_dense()
+        assert d.shape == (4, 2)
+        assert d[1].tolist() == [0, 0] and d[2].tolist() == [2, 2]
+
+
+class TestSparseEmbedding:
+    @pytest.mark.parametrize("host", [True, False])
+    def test_sparse_grad_matches_dense_oracle(self, host):
+        V, D = 200, 6
+        emb = SparseEmbedding(V, D, host=host, seed=3)
+        dense = nn.Embedding(V, D)
+        dense.weight.set_value(emb.weight.copy())
+
+        ids = paddle.to_tensor(np.array([[5, 9, 5], [150, 0, 9]]))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy(), dense(ids).numpy(),
+                                   rtol=1e-6)
+
+        (out * out).sum().backward()
+        sparse_dense = emb.sparse_grad().merge().to_dense()
+
+        out_d = dense(ids)
+        (out_d * out_d).sum().backward()
+        np.testing.assert_allclose(sparse_dense, dense.weight.grad.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_dense_gradient_never_materialized(self):
+        """The rows-gradient has O(batch) shape, not O(vocab)."""
+        emb = SparseEmbedding(100000, 16, host=True)
+        out = emb(paddle.to_tensor(np.array([1, 2, 3])))
+        out.sum().backward()
+        sel = emb.sparse_grad()
+        assert sel.rows.shape == (3, 16)
+        assert emb.device_bytes() == 0   # host mode: nothing device-resident
+
+    def test_padding_free_forward_shapes(self):
+        emb = SparseEmbedding(10, 4)
+        out = emb(paddle.to_tensor(np.array([[1, 2], [3, 4], [5, 6]])))
+        assert out.shape == [3, 2, 4]
+
+
+class TestSparseOptimizers:
+    def _loss_and_step(self, opt_cls, **kw):
+        emb = SparseEmbedding(50, 4, host=True, seed=5)
+        before = emb.weight.copy()
+        ids = paddle.to_tensor(np.array([2, 7, 2]))
+        out = emb(ids)
+        (out * out).sum().backward()
+        opt = opt_cls(emb, **kw)
+        opt.step()
+        return before, emb.weight
+
+    @pytest.mark.parametrize("opt_cls,kw", [
+        (SparseSGD, {"learning_rate": 0.1}),
+        (SparseAdagrad, {"learning_rate": 0.1}),
+        (SparseAdam, {"learning_rate": 0.1}),
+    ])
+    def test_only_touched_rows_change(self, opt_cls, kw):
+        before, after = self._loss_and_step(opt_cls, **kw)
+        diff = np.abs(after - before).sum(1)
+        changed = set(np.where(diff > 0)[0].tolist())
+        assert changed == {2, 7}
+
+    def test_sgd_matches_dense_oracle(self):
+        V, D, lr = 30, 4, 0.05
+        emb = SparseEmbedding(V, D, host=True, seed=9)
+        dense = nn.Embedding(V, D)
+        dense.weight.set_value(emb.weight.copy())
+        opt_d = paddle.optimizer.SGD(learning_rate=lr,
+                                     parameters=dense.parameters())
+        ids = paddle.to_tensor(np.array([1, 4, 1, 9]))
+        for _ in range(3):
+            out = emb(ids)
+            (out * out).sum().backward()
+            SparseSGD(emb, lr).step()
+
+            out_d = dense(ids)
+            loss = (out_d * out_d).sum()
+            loss.backward()
+            opt_d.step()
+            opt_d.clear_grad()
+        np.testing.assert_allclose(emb.weight, dense.weight.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_adam_lazy_rows_advance_independently(self):
+        """A row touched twice has a different effective step count than a
+        row touched once (the lazy-Adam contract)."""
+        emb = SparseEmbedding(10, 2, host=True, seed=0)
+        opt = SparseAdam(emb, learning_rate=0.1)
+        for ids in ([1, 2], [1]):
+            out = emb(paddle.to_tensor(np.array(ids)))
+            out.sum().backward()
+            opt.step()
+        assert opt._t[1] == 2 and opt._t[2] == 1 and opt._t[3] == 0
+
+
+class TestAsyncLookup:
+    def test_prefetch_roundtrip(self):
+        emb = SparseEmbedding(20, 3, host=True, seed=2)
+        al = AsyncLookup(emb)
+        al.prefetch(np.array([4, 5]))
+        ids, rows = al.take()
+        np.testing.assert_allclose(rows.numpy(), emb.weight[[4, 5]],
+                                   rtol=1e-6)
+
+
+class TestRecsysEndToEnd:
+    def test_wide_vocab_model_trains_and_matches_dense_oracle(self):
+        """The VERDICT done-bar: a recsys model (sparse embedding + dense
+        tower) trains with loss parity vs the dense-embedding oracle on a
+        small vocab."""
+        V, D, H = 64, 8, 16
+        rng = np.random.default_rng(0)
+        xs = rng.integers(0, V, (20, 3)).astype(np.int64)
+        ys = rng.random((20, 1)).astype(np.float32)
+
+        def tower():
+            paddle.seed(42)
+            return nn.Sequential(nn.Linear(3 * D, H), nn.ReLU(),
+                                 nn.Linear(H, 1))
+
+        # sparse path
+        emb_s = SparseEmbedding(V, D, host=True, seed=11)
+        tower_s = tower()
+        opt_s = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=tower_s.parameters())
+        emb_opt = SparseSGD(emb_s, 0.1)
+        # dense oracle
+        emb_d = nn.Embedding(V, D)
+        emb_d.set_state_dict({"weight": paddle.to_tensor(
+            emb_s.weight.copy())}) if hasattr(emb_d, "set_state_dict") \
+            else emb_d.weight.set_value(emb_s.weight.copy())
+        emb_d.weight.set_value(emb_s.weight.copy())
+        tower_d = tower()
+        opt_d = paddle.optimizer.SGD(
+            learning_rate=0.1,
+            parameters=list(tower_d.parameters()) + [emb_d.weight])
+
+        losses_s, losses_d = [], []
+        for step in range(5):
+            xb = paddle.to_tensor(xs)
+            yb = paddle.to_tensor(ys)
+
+            e = emb_s(xb)
+            flat = paddle.reshape(e, [20, 3 * D])
+            pred = tower_s(flat)
+            loss = ((pred - yb) ** 2).mean()
+            loss.backward()
+            emb_opt.step()
+            opt_s.step()
+            opt_s.clear_grad()
+            losses_s.append(float(loss.numpy()))
+
+            e2 = emb_d(xb)
+            flat2 = paddle.reshape(e2, [20, 3 * D])
+            pred2 = tower_d(flat2)
+            loss2 = ((pred2 - yb) ** 2).mean()
+            loss2.backward()
+            opt_d.step()
+            opt_d.clear_grad()
+            losses_d.append(float(loss2.numpy()))
+
+        np.testing.assert_allclose(losses_s, losses_d, rtol=1e-4,
+                                   atol=1e-6)
+        assert losses_s[-1] < losses_s[0]   # it actually learns
+
+
+class TestDistributedSparseEmbedding:
+    def test_single_process_fallback_matches_local(self):
+        from paddle_tpu.distributed.ps import DistributedSparseEmbedding
+        d = DistributedSparseEmbedding(32, 4, host=True, seed=3)
+        local = SparseEmbedding(32, 4, host=True, seed=3)
+        # same seeding path: the distributed table's shard 0 covers all
+        rng = np.random.default_rng(3)
+        full = (rng.standard_normal((32, 4)) * 0.01).astype(np.float32)
+        np.testing.assert_allclose(d.local.weight, full, rtol=1e-6)
+        ids = paddle.to_tensor(np.array([1, 31, 5]))
+        np.testing.assert_allclose(d(ids).numpy(), full[[1, 31, 5]],
+                                   rtol=1e-6)
+
+    @pytest.mark.slow
+    def test_two_process_sharded_lookup_and_push(self, tmp_path):
+        """2-proc e2e via the launcher: vocab sharded across ranks, lookup
+        combines via all_reduce, each rank pushes only its own rows, and
+        the trained table matches the single-process oracle."""
+        import os
+        import textwrap
+        from paddle_tpu.distributed.launch.main import _parse, launch_procs
+        script = tmp_path / "ps_train.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            from paddle_tpu.distributed import init_parallel_env
+            init_parallel_env()
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed.ps import (
+                DistributedSparseEmbedding, SparseSGD,
+                distributed_push_sparse)
+
+            V, D, LR = 16, 4, 0.1
+            table = DistributedSparseEmbedding(V, D, host=True, seed=21)
+            ids = paddle.to_tensor(np.array([1, 9, 1, 14]))
+            for _ in range(3):
+                out = table(ids)
+                (out * out).sum().backward()
+                opt = SparseSGD(table.local, LR)
+                distributed_push_sparse(table, opt)
+
+            got = table.weight_full()
+
+            # single-process oracle with the same seed + schedule
+            rng = np.random.default_rng(21)
+            w = (rng.standard_normal((V, D)) * 0.01).astype(np.float32)
+            idn = np.array([1, 9, 1, 14])
+            for _ in range(3):
+                g = np.zeros_like(w)
+                np.add.at(g, idn, 2 * w[idn])
+                w = w - LR * g
+            np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-7)
+            print("PS_PARITY_OK rank", jax.process_index())
+        """))
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)
+        try:
+            rc = launch_procs(_parse([
+                "--nproc_per_node", "2", "--log_dir",
+                str(tmp_path / "log"), str(script)]))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        logs = [(tmp_path / "log" / f"workerlog.{r}").read_text()
+                for r in range(2)]
+        assert rc == 0, logs
+        for r in range(2):
+            assert "PS_PARITY_OK" in logs[r], logs[r]
