@@ -1,0 +1,354 @@
+"""Sparse (point-cloud) convolution family.
+
+Parity target: ``python/paddle/sparse/nn/layer/conv.py`` +
+``paddle/phi/kernels/sparse/gpu/conv*`` in the reference — Conv3D,
+SubmConv3D, BatchNorm, MaxPool3D over COO voxel grids (the SECOND/
+sparse-CNN workload the paddle.sparse package exists for).
+
+TPU redesign (VERDICT r4 next #5): the reference builds a GPU "rulebook"
+(per-kernel-offset gather/scatter pair lists) with hash tables and atomic
+counters, then runs one implicit-gemm per offset. The structure survives
+the port; the substrate changes:
+
+* rulebook construction is HOST-side (eager, like the sparse set ops —
+  the active-site set is data-dependent by definition; this matches the
+  framework's documented eager contract for COO structure changes);
+* per-offset compute on device is a dense ``[n_pairs_k, Cin] @
+  [Cin, Cout]`` matmul + one scatter-add — MXU-shaped, no atomics
+  (duplicate outputs accumulate via ``.at[].add``);
+* every offset's pair list is padded to the max pair count across
+  offsets, so the whole kernel loop is ONE stacked
+  ``[K, P, Cin] x [K, Cin, Cout]`` einsum with validity masks — static
+  shapes once the rulebook is built (a traced/jit step can reuse it for
+  a fixed voxelization).
+
+Gradients flow through values (the gather/matmul/scatter chain is
+tape-differentiable); structure (indices) carries none, as upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, to_tensor
+from ..ops._helpers import ensure_tensor, forward_op
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "batch_norm",
+           "Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D"]
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _build_rulebook(coords: np.ndarray, shape, kernel, stride, padding,
+                    subm: bool):
+    """Host rulebook: for each kernel offset, the (input_row, output_row)
+    pairs. Returns (out_coords [M, 4], pairs_in [K, P], pairs_out [K, P],
+    valid [K, P]) with P = max pairs per offset (padding contract)."""
+    kd, kh, kw = kernel
+    sd, sh, sw = stride
+    pd, ph, pw = padding
+    D, H, W = shape
+
+    key = {}
+    if subm:
+        out_coords = coords.copy()
+        for r, c in enumerate(out_coords):
+            key[tuple(c)] = r
+    else:
+        out_set = {}
+        for b, z, y, x in coords:
+            for dz in range(kd):
+                for dy in range(kh):
+                    for dx in range(kw):
+                        oz, r1 = divmod(z + pd - dz, sd)
+                        oy, r2 = divmod(y + ph - dy, sh)
+                        ox, r3 = divmod(x + pw - dx, sw)
+                        if r1 or r2 or r3:
+                            continue
+                        if 0 <= oz < (D + 2 * pd - kd) // sd + 1 and \
+                                0 <= oy < (H + 2 * ph - kh) // sh + 1 and \
+                                0 <= ox < (W + 2 * pw - kw) // sw + 1:
+                            out_set.setdefault((b, oz, oy, ox),
+                                               len(out_set))
+        out_coords = np.asarray(sorted(out_set, key=out_set.get),
+                                np.int32).reshape(-1, 4)
+        key = {tuple(c): r for r, c in enumerate(out_coords)}
+
+    K = kd * kh * kw
+    pairs = [[] for _ in range(K)]
+    in_key = {tuple(c): r for r, c in enumerate(coords)}
+    for oc, orow in key.items():
+        b, oz, oy, ox = oc
+        for dz in range(kd):
+            for dy in range(kh):
+                for dx in range(kw):
+                    iz = oz * sd - pd + dz
+                    iy = oy * sh - ph + dy
+                    ix = ox * sw - pw + dx
+                    irow = in_key.get((b, iz, iy, ix))
+                    if irow is not None:
+                        kidx = (dz * kh + dy) * kw + dx
+                        pairs[kidx].append((irow, orow))
+
+    P = max(1, max(len(p) for p in pairs))
+    pin = np.zeros((K, P), np.int32)
+    pout = np.zeros((K, P), np.int32)
+    valid = np.zeros((K, P), bool)
+    for kidx, p in enumerate(pairs):
+        for j, (i, o) in enumerate(p):
+            pin[kidx, j] = i
+            pout[kidx, j] = o
+            valid[kidx, j] = True
+    return out_coords, pin, pout, valid
+
+
+def _sparse_conv(x, weight, bias, kernel, stride, padding, subm):
+    from . import SparseCooTensor, sparse_coo_tensor
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse conv expects a SparseCooTensor")
+    coords = np.asarray(x.indices().numpy()).T.astype(np.int64)  # [nnz, 4]
+    B, D, H, W, Cin = x.shape
+    wt = ensure_tensor(weight)          # [kd, kh, kw, Cin, Cout]
+    Cout = int(wt.shape[-1])
+    out_coords, pin, pout, valid = _build_rulebook(
+        coords, (D, H, W), kernel, stride, padding, subm)
+    M = out_coords.shape[0]
+    vals = x.values()
+    args = [vals, wt] + ([ensure_tensor(bias)] if bias is not None else [])
+    pin_j = jnp.asarray(pin)
+    pout_j = jnp.asarray(pout)
+    valid_j = jnp.asarray(valid)
+
+    def impl(v, w, *b):
+        K = pin_j.shape[0]
+        wk = w.reshape(K, v.shape[-1], -1)               # [K, Cin, Cout]
+        gathered = v[pin_j] * valid_j[..., None]          # [K, P, Cin]
+        contrib = jnp.einsum("kpc,kco->kpo", gathered, wk)
+        out = jnp.zeros((M, contrib.shape[-1]), v.dtype)
+        out = out.at[pout_j.reshape(-1)].add(
+            (contrib * valid_j[..., None]).reshape(-1, contrib.shape[-1]))
+        if b:
+            out = out + b[0]
+        return out
+
+    out_vals = forward_op("sparse_conv3d" if not subm else
+                          "sparse_subm_conv3d", impl, args)
+    if subm:
+        od, oh, ow = D, H, W
+    else:
+        kd, kh, kw = kernel
+        sd, sh, sw = stride
+        pd, ph, pw = padding
+        od = (D + 2 * pd - kd) // sd + 1
+        oh = (H + 2 * ph - kh) // sh + 1
+        ow = (W + 2 * pw - kw) // sw + 1
+    return sparse_coo_tensor(to_tensor(out_coords.T.astype(np.int64)),
+                             out_vals, [B, od, oh, ow, Cout])
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format="NDHWC", name=None):
+    """Sparse 3-D convolution over a COO voxel grid (ref:
+    paddle.sparse.nn.functional.conv3d). The active-output set is every
+    site any kernel tap reaches."""
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv3d: dilation/groups TBD")
+    return _sparse_conv(x, weight, bias, _triple(
+        tuple(int(s) for s in ensure_tensor(weight).shape[:3])),
+        _triple(stride), _triple(padding), subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=None, dilation=1,
+                groups: int = 1, data_format="NDHWC", name=None):
+    """Submanifold sparse conv (ref: paddle.sparse.nn.functional.
+    subm_conv3d): output sites == input sites, which stops the dilation
+    of the active set — the point-cloud workhorse. ``padding`` defaults
+    to same-center (k//2)."""
+    k = tuple(int(s) for s in ensure_tensor(weight).shape[:3])
+    if padding is None:
+        padding = tuple(s // 2 for s in k)
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 (the submanifold "
+                         "contract)")
+    return _sparse_conv(x, weight, bias, k, (1, 1, 1), _triple(padding),
+                        subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling: max over each output site's populated taps
+    (ref: paddle.sparse.nn.functional.max_pool3d)."""
+    from . import SparseCooTensor, sparse_coo_tensor
+    k = _triple(kernel_size)
+    s = _triple(stride) if stride is not None else k
+    p = _triple(padding)
+    coords = np.asarray(x.indices().numpy()).T.astype(np.int64)
+    B, D, H, W, C = x.shape
+    out_coords, pin, pout, valid = _build_rulebook(
+        coords, (D, H, W), k, s, p, subm=False)
+    M = out_coords.shape[0]
+    pin_j = jnp.asarray(pin)
+    pout_j = jnp.asarray(pout)
+    valid_j = jnp.asarray(valid)
+
+    def impl(v):
+        NEG = jnp.asarray(-jnp.inf, v.dtype)
+        gathered = jnp.where(valid_j[..., None], v[pin_j], NEG)
+        out = jnp.full((M, v.shape[-1]), NEG, v.dtype)
+        out = out.at[pout_j.reshape(-1)].max(
+            gathered.reshape(-1, v.shape[-1]))
+        return jnp.where(jnp.isfinite(out), out, 0)
+
+    vals = forward_op("sparse_max_pool3d", impl, [x.values()])
+    kd, kh, kw = k
+    sd, sh, sw = s
+    pd, ph, pw = p
+    od = (D + 2 * pd - kd) // sd + 1
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    return sparse_coo_tensor(to_tensor(out_coords.T.astype(np.int64)),
+                             vals, [B, od, oh, ow, C])
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, name=None):
+    """BatchNorm over the VALUES of a COO tensor (ref:
+    paddle.sparse.nn.BatchNorm — statistics over active sites only, the
+    sparse-CNN convention). Pure in-graph compute; running stats are
+    returned updated when training."""
+    from . import SparseCooTensor, sparse_coo_tensor
+    vals = x.values()
+    args = [vals, ensure_tensor(running_mean), ensure_tensor(running_var)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def impl(v, rm, rv, *wb):
+        if training:
+            mean = v.mean(0)
+            var = v.var(0)
+            new_rm = momentum * rm + (1 - momentum) * mean
+            new_rv = momentum * rv + (1 - momentum) * var
+        else:
+            mean, var = rm, rv
+            new_rm, new_rv = rm, rv
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out, new_rm, new_rv
+
+    out_vals, nrm, nrv = forward_op("sparse_batch_norm", impl, args)
+    out = sparse_coo_tensor(x.indices(), out_vals, x.shape)
+    return out, nrm, nrv
+
+
+# ---------------------------------------------------------------------------
+# layer tier (paddle.sparse.nn classes)
+# ---------------------------------------------------------------------------
+
+class _SparseConvBase:
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, bias_attr=True, seed: int = 0):
+        k = _triple(kernel_size)
+        rng = np.random.default_rng(seed)
+        fan_in = in_channels * k[0] * k[1] * k[2]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = to_tensor((rng.uniform(
+            -bound, bound, k + (in_channels, out_channels))
+        ).astype(np.float32))
+        self.weight.stop_gradient = False
+        self.bias = None
+        if bias_attr:
+            self.bias = to_tensor(np.zeros(out_channels, np.float32))
+            self.bias.stop_gradient = False
+        self.stride = stride
+        self.padding = padding
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None
+                                else [])
+
+
+class Conv3D(_SparseConvBase):
+    """ref: paddle.sparse.nn.Conv3D."""
+
+    def __call__(self, x):
+        return conv3d(x, self.weight, self.bias, self.stride, self.padding)
+
+
+class SubmConv3D(_SparseConvBase):
+    """ref: paddle.sparse.nn.SubmConv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=None, bias_attr=True, seed: int = 0):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, bias_attr, seed)
+
+    def __call__(self, x):
+        return subm_conv3d(x, self.weight, self.bias, self.stride,
+                           self.padding)
+
+
+class BatchNorm:
+    """ref: paddle.sparse.nn.BatchNorm (stateful running stats)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        self.weight = to_tensor(np.ones(num_features, np.float32))
+        self.bias = to_tensor(np.zeros(num_features, np.float32))
+        self.weight.stop_gradient = False
+        self.bias.stop_gradient = False
+        self._mean = np.zeros(num_features, np.float32)
+        self._var = np.ones(num_features, np.float32)
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.training = True
+
+    def parameters(self):
+        return [self.weight, self.bias]
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def __call__(self, x):
+        out, nrm, nrv = batch_norm(
+            x, self._mean, self._var, self.weight, self.bias,
+            training=self.training, momentum=self.momentum,
+            epsilon=self.epsilon)
+        if self.training:
+            self._mean = np.asarray(nrm._value)
+            self._var = np.asarray(nrv._value)
+        return out
+
+
+class MaxPool3D:
+    """ref: paddle.sparse.nn.MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def __call__(self, x):
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+for _n, _f in (("sparse_conv3d", conv3d),
+               ("sparse_subm_conv3d", subm_conv3d),
+               ("sparse_max_pool3d", max_pool3d),
+               ("sparse_batch_norm", batch_norm)):
+    register_op(_n, _f, (_f.__doc__ or "").strip().split("\n")[0],
+                category="sparse", public=_f)

@@ -1,0 +1,156 @@
+"""Sparse conv family (sparse/nn_conv.py): rulebook gather->matmul->scatter
+formulation vs the dense conv oracle (VERDICT r4 next #5)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _random_cloud(rng, B=1, D=4, H=4, W=4, C=2, n=5):
+    dense = np.zeros((B, D, H, W, C), np.float32)
+    seen = set()
+    pts = []
+    while len(pts) < n:
+        c = (int(rng.integers(B)), int(rng.integers(D)),
+             int(rng.integers(H)), int(rng.integers(W)))
+        if c in seen:
+            continue
+        seen.add(c)
+        pts.append(c)
+        dense[c] = rng.standard_normal(C)
+    idx = np.asarray(pts, np.int64).T
+    vals = np.stack([dense[c] for c in pts]).astype(np.float32)
+    return dense, sparse.sparse_coo_tensor(idx, vals, [B, D, H, W, C])
+
+
+def _dense_conv(dense, w, padding):
+    x = jnp.asarray(dense.transpose(0, 4, 1, 2, 3))       # NCDHW
+    wk = jnp.asarray(w.transpose(4, 3, 0, 1, 2))          # OIDHW
+    out = lax.conv_general_dilated(x, wk, (1, 1, 1),
+                                   [(padding, padding)] * 3)
+    return np.asarray(out).transpose(0, 2, 3, 4, 1)       # NDHWC
+
+
+class TestSparseConv:
+    def test_subm_conv_matches_dense_oracle_at_active_sites(self):
+        rng = np.random.default_rng(0)
+        dense, sp = _random_cloud(rng)
+        w = rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32)
+        out = sparse.nn.functional.subm_conv3d(sp, w)
+        ref = _dense_conv(dense, w, 1)
+        # submanifold: output sites == input sites
+        in_sites = {tuple(c) for c in
+                    np.asarray(sp.indices().numpy()).T}
+        oc = np.asarray(out.indices().numpy()).T
+        assert {tuple(c) for c in oc} == in_sites
+        for row, c in enumerate(oc):
+            np.testing.assert_allclose(out.values().numpy()[row],
+                                       ref[tuple(c)], rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_full_conv_covers_and_matches_dense(self):
+        rng = np.random.default_rng(1)
+        dense, sp = _random_cloud(rng, n=4)
+        w = rng.standard_normal((3, 3, 3, 2, 2)).astype(np.float32)
+        out = sparse.nn.functional.conv3d(sp, w, padding=1)
+        ref = _dense_conv(dense, w, 1)
+        oc = np.asarray(out.indices().numpy()).T
+        for row, c in enumerate(oc):
+            np.testing.assert_allclose(out.values().numpy()[row],
+                                       ref[tuple(c)], rtol=1e-4,
+                                       atol=1e-5)
+        # every nonzero dense output site is in the active set
+        covered = {tuple(c) for c in oc}
+        for c in np.argwhere(np.abs(ref).sum(-1) > 1e-6):
+            assert tuple(c) in covered
+
+    def test_strided_conv_output_shape(self):
+        rng = np.random.default_rng(2)
+        _, sp = _random_cloud(rng, D=4, H=4, W=4)
+        w = rng.standard_normal((2, 2, 2, 2, 3)).astype(np.float32)
+        out = sparse.nn.functional.conv3d(sp, w, stride=2)
+        assert out.shape == [1, 2, 2, 2, 3]
+
+    def test_bias_and_gradients(self):
+        rng = np.random.default_rng(3)
+        _, sp = _random_cloud(rng)
+        layer = sparse.nn.SubmConv3D(2, 4, 3)
+        out = layer(sp)
+        (out.values() * out.values()).sum().backward()
+        assert layer.weight.grad is not None
+        assert layer.bias.grad is not None
+        assert layer.weight.grad.shape == [3, 3, 3, 2, 4]
+
+    def test_max_pool_matches_dense_on_active(self):
+        rng = np.random.default_rng(4)
+        dense, sp = _random_cloud(rng, n=6)
+        out = sparse.nn.MaxPool3D(2, 2)(sp)
+        x = jnp.asarray(dense.transpose(0, 4, 1, 2, 3))
+        # dense max-pool oracle but only over ACTIVE taps: emulate by
+        # replacing empty sites with -inf then pooling
+        occ = (np.abs(dense).sum(-1, keepdims=True) > 0)
+        masked = np.where(occ, dense, -np.inf)
+        ref = masked.reshape(1, 2, 2, 2, 2, 2, 2, -1).max((2, 4, 6))
+        oc = np.asarray(out.indices().numpy()).T
+        for row, c in enumerate(oc):
+            np.testing.assert_allclose(out.values().numpy()[row],
+                                       ref[tuple(c)], rtol=1e-5)
+
+    def test_batch_norm_normalizes_active_values(self):
+        rng = np.random.default_rng(5)
+        _, sp = _random_cloud(rng, n=8, C=3)
+        bn = sparse.nn.BatchNorm(3)
+        out = bn(sp)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=0.05)
+        # eval mode uses running stats without updating them
+        bn.eval()
+        m_before = bn._mean.copy()
+        bn(sp)
+        np.testing.assert_array_equal(bn._mean, m_before)
+
+    def test_pointcloud_classifier_trains(self):
+        """Minimal point-cloud classification: SubmConv -> BN -> pooled
+        logits; the loss on a 2-class toy set decreases (the VERDICT
+        done-bar: 'a minimal point-cloud classification example
+        trains')."""
+        rng = np.random.default_rng(6)
+        conv = sparse.nn.SubmConv3D(1, 8, 3, seed=1)
+        head_w = paddle.to_tensor(
+            (rng.standard_normal((8, 2)) * 0.1).astype(np.float32))
+        head_w.stop_gradient = False
+        params = conv.parameters() + [head_w]
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=params)
+
+        def make_cloud(label):
+            # class 0: points along a line; class 1: points in a corner
+            if label == 0:
+                pts = [(0, i, i, i) for i in range(4)]
+            else:
+                pts = [(0, 0, i, j) for i in range(2) for j in range(2)]
+            idx = np.asarray(pts, np.int64).T
+            vals = np.ones((len(pts), 1), np.float32)
+            return sparse.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 1])
+
+        clouds = [(make_cloud(0), 0), (make_cloud(1), 1)]
+        losses = []
+        for _ in range(12):
+            total = None
+            for sp_x, y in clouds:
+                feat = conv(sp_x)
+                pooled = feat.values().mean(axis=0)         # global mean
+                logits = paddle.matmul(
+                    paddle.reshape(pooled, [1, 8]), head_w)
+                loss = paddle.nn.functional.cross_entropy(
+                    logits, paddle.to_tensor(np.array([y])))
+                total = loss if total is None else total + loss
+            total.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(total.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses
