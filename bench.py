@@ -573,7 +573,8 @@ def bench_roofline(backend, steps=10):
     return out
 
 
-def bench_decode(backend, prompt=128, new_tokens=128, batches=(1, 8)):
+def bench_decode(backend, prompt=128, new_tokens=128, batches=(1, 8),
+                 int8: bool = False):
     """KV-cache decode throughput on the flagship config (BASELINE.md decode
     row): prefill + the whole greedy decode loop is ONE compiled program
     (models/generation.py); reports decode tokens/s at each batch size."""
@@ -586,6 +587,9 @@ def bench_decode(backend, prompt=128, new_tokens=128, batches=(1, 8)):
     # decode is HBM-bandwidth bound, not MXU bound: flash kernel + remat are
     # training knobs; the cache path uses plain jnp attention
     params = init_params(cfg, jax.random.PRNGKey(0))
+    if int8:
+        from paddle_tpu.models.llama import quantize_params
+        params = quantize_params(params)
     rng = np.random.default_rng(0)
     out = {}
     short = max(2, new_tokens // 16)
@@ -642,6 +646,9 @@ _R2_ANCHORS = {
     # both effects (_median_fresh).
     # round-4 anchors for the new metrics (first recorded round)
     "llama_decode_tok_s_b8": 2500.0,  # tok/s (r4; 2000-2530 observed)
+    "llama_decode_int8_tok_s_b8": 2500.0,  # tok/s (first recorded r5:
+    # weight-only-int8 decode via quantize_params + the Pallas stream-
+    # dequant kernel; anchored at the fp16 rate until measured)
     "ppyoloe_mbv3_throughput": 400.0,  # img/s (r4)
     "llama_train_mfu_tuned": 56.4,    # % (r4)
 }
@@ -679,6 +686,7 @@ def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
 def main():
     ap = argparse.ArgumentParser()
     _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode",
+                 "int8",
                  "tuned", "detect", "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
@@ -735,10 +743,11 @@ def main():
     except OSError:
         _warm = False
     _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
-                  "sdxl": 25.0, "decode": 45.0, "tuned": 35.0,
+                  "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
-                  "sdxl": 45.0, "decode": 90.0, "tuned": 60.0, "detect": 240.0})
+                  "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
+                  "int8": 90.0, "detect": 240.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -868,6 +877,15 @@ def main():
             _emit("llama_decode_tok_s_b8", d["decode_b8_tok_s"], "tok/s",
                   d["decode_b8_tok_s"] / _R2_ANCHORS["llama_decode_tok_s_b8"])
         section("decode", _decode)
+    if want("int8"):
+        def _int8():
+            d = bench_decode(backend, batches=(8,), int8=True)
+            print(json.dumps({"int8_" + k: v for k, v in d.items()}),
+                  file=sys.stderr)
+            _emit("llama_decode_int8_tok_s_b8", d["decode_b8_tok_s"],
+                  "tok/s", d["decode_b8_tok_s"] /
+                  _R2_ANCHORS["llama_decode_int8_tok_s_b8"])
+        section("int8", _int8)
     if want("wide"):
         def _wide():
             mfu = _llama_point(backend, peak, args.steps, wide=True,

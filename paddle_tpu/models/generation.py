@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import LlamaConfig, _moe_ffn, _rms_norm, _rope
+from .llama import LlamaConfig, _mm, _moe_ffn, _rms_norm, _rope
 
 __all__ = ["init_cache", "prefill", "decode_step", "make_generate_fn",
            "generate", "DecodeSession"]
@@ -80,9 +80,9 @@ def _cached_layer(lp: Dict, x, ck, cv, cos, sin, kv_mask, write_idx,
     dt = cfg.dtype
 
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, T, H, D)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, T, Hk, D)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, T, Hk, D)
+    q = _mm(h, lp, "wq", dt).reshape(B, T, H, D)
+    k = _mm(h, lp, "wk", dt).reshape(B, T, Hk, D)
+    v = _mm(h, lp, "wv", dt).reshape(B, T, Hk, D)
     q = _rope(q, cos, sin, False)
     k = _rope(k, cos, sin, False)
 
@@ -100,14 +100,14 @@ def _cached_layer(lp: Dict, x, ck, cv, cos, sin, kv_mask, write_idx,
     s = jnp.where(kv_mask[:, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhtj,bjhd->bthd", p.astype(vv.dtype), vv)
-    x = x + o.reshape(B, T, H * D).astype(dt) @ lp["wo"].astype(dt)
+    x = x + _mm(o.reshape(B, T, H * D).astype(dt), lp, "wo", dt)
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
     if cfg.moe_num_experts:
         y, _ = _moe_ffn(lp, h, cfg)
         return x + y, ck, cv
-    g = jax.nn.silu(h @ lp["w_gate"].astype(dt)) * (h @ lp["w_up"].astype(dt))
-    return x + g @ lp["w_down"].astype(dt), ck, cv
+    g = jax.nn.silu(_mm(h, lp, "w_gate", dt)) * _mm(h, lp, "w_up", dt)
+    return x + _mm(g, lp, "w_down", dt), ck, cv
 
 
 def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
@@ -127,9 +127,10 @@ def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
                                      cache["v"]))
     x = _rms_norm(x[:, -1:], params["ln_f"], cfg.rms_norm_eps,
                   cfg.use_fused_norm)
-    head = (params["embed"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    logits = (x @ head.astype(cfg.dtype))[:, 0]
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(cfg.dtype))[:, 0]
+    else:
+        logits = _mm(x, params, "lm_head", cfg.dtype)[:, 0]
     return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
 

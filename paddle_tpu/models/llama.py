@@ -354,6 +354,50 @@ def _moe_ffn(lp: Dict, h, cfg: LlamaConfig):
     return y.reshape(B, S, M), aux
 
 
+def _mm(h, lp, name, dt):
+    """Weight matmul with the optional weight-only-int8 path (r5, VERDICT
+    r4 next #6b): when ``quantize_params`` has replaced ``lp[name]`` with
+    int8 and added ``lp[name + "_s"]`` scales, route through the Pallas
+    stream-dequant kernel on TPU (HBM reads stay int8 — the decode win) /
+    an XLA dequant-matmul elsewhere; otherwise the plain bf16 matmul."""
+    w = lp[name]
+    s = lp.get(name + "_s")
+    if s is None:
+        return h @ w.astype(dt)
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, h.shape[-1])
+    if jax.default_backend() == "tpu":
+        from ..kernels.quant_matmul import weight_only_matmul
+        out = weight_only_matmul(h2, w, s, out_dtype=dt)
+    else:
+        out = h2 @ (w.astype(dt) * s.astype(dt)[None, :])
+    return out.reshape(lead + (w.shape[-1],)).astype(dt)
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Per-output-channel symmetric int8 quantization of every dense
+    projection ([L, K, N] stacked layer weights + lm_head); scales join
+    the pytree as ``<name>_s`` leaves so the scan threads them alongside
+    (ref capability: paddle.nn.quant weight_only path / Paddle Inference
+    int8; the embed stays fp — it is a gather, not a matmul)."""
+    from ..kernels.quant_matmul import quantize_weights
+    qp = dict(params)
+    layers = dict(params["layers"])
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        if name not in layers:
+            continue
+        w = layers[name]                       # [L, K, N]
+        q, s = jax.vmap(quantize_weights)(w)   # [L, K, N] i8, [L, N]
+        layers[name] = q
+        layers[name + "_s"] = s
+        qp["layers"] = layers
+    if "lm_head" in params:
+        q, s = quantize_weights(params["lm_head"])
+        qp["lm_head"] = q
+        qp["lm_head_s"] = s
+    return qp
+
+
 def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
                   segment_ids=None):
     """One pre-norm decoder block on un-stacked layer params ``lp``.
@@ -366,22 +410,22 @@ def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
 
     from jax.ad_checkpoint import checkpoint_name
     h = _rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, D)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, S, Hk, D)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, S, Hk, D)
+    q = _mm(h, lp, "wq", dt).reshape(B, S, H, D)
+    k = _mm(h, lp, "wk", dt).reshape(B, S, Hk, D)
+    v = _mm(h, lp, "wv", dt).reshape(B, S, Hk, D)
     q = checkpoint_name(_rope(q, cos, sin, cfg.use_fused_norm), "qk")
     k = checkpoint_name(_rope(k, cos, sin, cfg.use_fused_norm), "qk")
     v = checkpoint_name(v, "v_proj")
     o = _attention(q, k, v, cfg, segment_ids).reshape(B, S, H * D)
     o = checkpoint_name(o, "attn_out")
-    x = x + o @ lp["wo"].astype(dt)
+    x = x + _mm(o, lp, "wo", dt)
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
     if cfg.moe_num_experts:
         y, aux = _moe_ffn(lp, h, cfg)
         return x + y, aux
-    g = jax.nn.silu(h @ lp["w_gate"].astype(dt)) * (h @ lp["w_up"].astype(dt))
-    return x + g @ lp["w_down"].astype(dt)
+    g = jax.nn.silu(_mm(h, lp, "w_gate", dt)) * _mm(h, lp, "w_up", dt)
+    return x + _mm(g, lp, "w_down", dt)
 
 
 def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
@@ -430,9 +474,10 @@ def forward(params: Dict, input_ids, cfg: LlamaConfig, segment_ids=None,
     x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_fused_norm)
     if return_hidden:   # chunked-CE path computes the head itself
         return x
-    head = (params["embed"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    logits = x @ head.astype(cfg.dtype)
+    if cfg.tie_word_embeddings:
+        logits = x @ params["embed"].T.astype(cfg.dtype)
+    else:
+        logits = _mm(x, params, "lm_head", cfg.dtype)
     if return_aux:  # dense configs report aux 0.0 — callers get a 2-tuple
         aux = jnp.mean(auxes) if cfg.moe_num_experts else jnp.float32(0.0)
         return logits, aux

@@ -36,3 +36,5 @@ from .layers.transformer import (MultiHeadAttention, Transformer, TransformerDec
 
 # paddle.nn.utils
 from . import utils  # noqa: E402
+
+from . import quant  # noqa: E402

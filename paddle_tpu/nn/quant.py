@@ -1,0 +1,74 @@
+"""paddle.nn.quant parity: the weight-only quantized inference surface.
+
+Parity target: ``python/paddle/nn/quant/quantized_linear.py``
+(weight_only_linear / WeightOnlyLinear, llm_int8_linear) — the user-facing
+knob that turns trained fp Linears into int8-weight inference layers.
+
+TPU lowering: the Pallas stream-dequant kernel (``kernels/quant_matmul``)
+on TPU backends — HBM traffic for weights drops 2x vs bf16 and the dequant
+happens in VMEM — with an XLA dequant-matmul fallback elsewhere (identical
+numerics). ``quantize_linears`` walks a model and swaps every ``nn.Linear``
+in place, the one-call migration path the reference's
+``paddle.nn.quant.weight_quantize`` workflow provides.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import to_tensor
+from ..ops.quant import weight_only_linear, weight_quantize
+from .layer import Layer
+
+__all__ = ["WeightOnlyLinear", "quantize_linears"]
+
+
+class WeightOnlyLinear(Layer):
+    """Inference Linear with int8 weights + per-output-channel scales
+    (ref: paddle.nn.quant.WeightOnlyLinear)."""
+
+    def __init__(self, weight, scale, bias=None, weight_dtype="int8"):
+        super().__init__()
+        from ..ops._helpers import ensure_tensor
+        self.weight = ensure_tensor(weight)
+        self.weight_scale = ensure_tensor(scale)
+        self.bias = ensure_tensor(bias) if bias is not None else None
+        self.weight_dtype = weight_dtype
+        self.in_features = int(self.weight.shape[0])
+        self.out_features = int(self.weight.shape[1])
+
+    @classmethod
+    def from_linear(cls, linear) -> "WeightOnlyLinear":
+        q, s = weight_quantize(linear.weight)
+        return cls(q, s, linear.bias)
+
+    def forward(self, x):
+        return weight_only_linear(x, self.weight, self.weight_scale,
+                                  bias=self.bias,
+                                  weight_dtype=self.weight_dtype)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8")
+
+
+def quantize_linears(model: Layer, min_features: int = 1) -> int:
+    """Swap every ``nn.Linear`` sublayer for a :class:`WeightOnlyLinear`
+    in place; returns the count swapped. ``min_features`` skips tiny
+    projections where the int8 stream buys nothing."""
+    from .layers.common import Linear
+    swapped = 0
+
+    # walk the sublayer tree via the Layer registry
+    def walk(layer):
+        nonlocal swapped
+        for key, sub in list(getattr(layer, "_sub_layers", {}).items()):
+            if isinstance(sub, Linear) and \
+                    sub.in_features >= min_features:
+                layer._sub_layers[key] = WeightOnlyLinear.from_linear(sub)
+                setattr(layer, key, layer._sub_layers[key])
+                swapped += 1
+            else:
+                walk(sub)
+    walk(model)
+    return swapped
