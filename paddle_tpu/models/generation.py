@@ -104,10 +104,10 @@ def _cached_layer(lp: Dict, x, ck, cv, cos, sin, kv_mask, write_idx,
 
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
     if cfg.moe_num_experts:
-        y, _ = _moe_ffn(lp, h, cfg)
-        return x + y, ck, cv
+        y, _, drops = _moe_ffn(lp, h, cfg)
+        return x + y, ck, cv, drops
     g = jax.nn.silu(_mm(h, lp, "w_gate", dt)) * _mm(h, lp, "w_up", dt)
-    return x + _mm(g, lp, "w_down", dt), ck, cv
+    return x + _mm(g, lp, "w_down", dt), ck, cv, jnp.float32(0.0)
 
 
 def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
@@ -119,19 +119,19 @@ def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
 
     def body(h, xs):
         lp, ck, cv = xs
-        h, ck, cv = _cached_layer(lp, h, ck, cv, cos, sin, kv_mask,
-                                  write_idx, cfg)
-        return h, (ck, cv)
+        h, ck, cv, drops = _cached_layer(lp, h, ck, cv, cos, sin, kv_mask,
+                                         write_idx, cfg)
+        return h, (ck, cv, drops)
 
-    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"],
-                                     cache["v"]))
+    x, (ck, cv, drops) = lax.scan(body, x, (params["layers"], cache["k"],
+                                            cache["v"]))
     x = _rms_norm(x[:, -1:], params["ln_f"], cfg.rms_norm_eps,
                   cfg.use_fused_norm)
     if cfg.tie_word_embeddings:
         logits = (x @ params["embed"].T.astype(cfg.dtype))[:, 0]
     else:
         logits = _mm(x, params, "lm_head", cfg.dtype)[:, 0]
-    return logits.astype(jnp.float32), {"k": ck, "v": cv}
+    return logits.astype(jnp.float32), {"k": ck, "v": cv}, drops.sum()
 
 
 def _row_tables(cfg: LlamaConfig, pos):
@@ -159,7 +159,9 @@ def prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens, cache: Dict,
     ``ids [B, S]`` is RIGHT-padded ragged (the public convention) unless
     ``left_padded=True``; rows are left-aligned internally so every row's
     last prompt token sits at index S-1 (see module docstring). Returns
-    (next-token logits [B, V], cache).
+    (next-token logits [B, V], cache, dropped_tokens) — the last is the
+    in-graph MoE capacity-drop count (0.0 for dense configs; r4 VERDICT
+    next #10).
     """
     if not left_padded:
         ids = left_align(ids, prompt_lens)
@@ -172,14 +174,16 @@ def prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens, cache: Dict,
     causal = jnp.arange(C)[None, :] <= jnp.arange(S)[:, None]  # [S, C]
     valid_k = jnp.pad(valid, ((0, 0), (0, C - S)))             # [B, C]
     kv_mask = causal[None] & valid_k[:, None, :]
-    return _fwd_cached(params, cfg, ids, cache, cos, sin, kv_mask, 0)
+    logits, cache, drops = _fwd_cached(params, cfg, ids, cache, cos, sin,
+                                       kv_mask, 0)
+    return logits, cache, drops
 
 
 def decode_step(params: Dict, cfg: LlamaConfig, token, t, prompt_lens,
                 prompt_pad, cache: Dict):
     """One decode step: ``token [B]`` at step ``t`` (0-based), writing cache
     position ``S + t`` (``prompt_pad = S`` the left-padded prompt length).
-    Returns (logits [B, V], cache)."""
+    Returns (logits [B, V], cache, dropped_tokens)."""
     C = cache["k"].shape[2]
     pos = (prompt_lens + t)[:, None]                         # [B, 1]
     cos, sin = _row_tables(cfg, pos)
@@ -227,7 +231,7 @@ def make_generate_fn(cfg: LlamaConfig, *, max_new_tokens: int,
                      temperature: float = 0.0, top_k: Optional[int] = None,
                      top_p: Optional[float] = None,
                      eos_token_id: Optional[int] = None,
-                     pad_token_id: int = 0):
+                     pad_token_id: int = 0, return_drops: bool = False):
     """Build ``gen(params, ids [B,S], prompt_lens [B], key) -> tokens
     [B, max_new_tokens]`` — jit it once, every call is one device program.
 
@@ -242,8 +246,8 @@ def make_generate_fn(cfg: LlamaConfig, *, max_new_tokens: int,
         ids_l = left_align(ids, prompt_lens, pad_token_id)
 
         cache = init_cache(cfg, B, C)
-        logits, cache = prefill(params, cfg, ids_l, prompt_lens, cache,
-                                left_padded=True)
+        logits, cache, drops0 = prefill(params, cfg, ids_l, prompt_lens,
+                                        cache, left_padded=True)
 
         # first token comes from the prefill logits; subsequent tokens from
         # decode steps 0..max_new-2 (eos itself is emitted, pad thereafter)
@@ -253,25 +257,28 @@ def make_generate_fn(cfg: LlamaConfig, *, max_new_tokens: int,
                  else tok0 == eos_token_id)
 
         def body(carry, t):
-            tok, cache, done, key = carry
-            logits, cache = decode_step(params, cfg, tok, t, prompt_lens,
-                                        jnp.int32(S), cache)
+            tok, cache, done, key, drops = carry
+            logits, cache, d = decode_step(params, cfg, tok, t, prompt_lens,
+                                           jnp.int32(S), cache)
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p)
             nxt = jnp.where(done, pad_token_id, nxt)
             ndone = done if eos_token_id is None else \
                 done | (nxt == eos_token_id)
-            return (nxt.astype(ids.dtype), cache, ndone, key), \
+            return (nxt.astype(ids.dtype), cache, ndone, key, drops + d), \
                 nxt.astype(ids.dtype)
 
         if max_new_tokens > 1:
-            carry = (tok0.astype(ids.dtype), cache, done0, key)
-            _, rest = lax.scan(body, carry,
-                               jnp.arange(max_new_tokens - 1))
+            carry = (tok0.astype(ids.dtype), cache, done0, key, drops0)
+            (_, _, _, _, drops), rest = lax.scan(
+                body, carry, jnp.arange(max_new_tokens - 1))
             out = jnp.concatenate([tok0[:, None].astype(ids.dtype),
                                    rest.T], axis=1)
         else:
+            drops = drops0
             out = tok0[:, None].astype(ids.dtype)
+        if return_drops:
+            return out, drops
         return out
 
     return gen
@@ -345,6 +352,7 @@ class DecodeSession:
 
         self._jpre = jax.jit(_prefill, donate_argnums=(3,))
         self._jstep = jax.jit(_step, donate_argnums=(5,))
+        self._dropped = None
 
     def prefill(self, ids, prompt_lens=None):
         ids = jnp.asarray(ids)
@@ -356,7 +364,9 @@ class DecodeSession:
         self._ppad = jnp.int32(S)
         self._t = 0
         cache = init_cache(self.cfg, B, self.capacity)
-        logits, self._cache = self._jpre(self.params, ids, self._plens, cache)
+        logits, self._cache, drops = self._jpre(self.params, ids,
+                                                self._plens, cache)
+        self._dropped = drops
         return logits
 
     def step(self, token):
@@ -364,8 +374,17 @@ class DecodeSession:
             raise RuntimeError("call prefill() first")
         if int(self._ppad) + self._t >= self.capacity:
             raise RuntimeError(f"capacity {self.capacity} exhausted")
-        logits, self._cache = self._jstep(
+        logits, self._cache, drops = self._jstep(
             self.params, jnp.asarray(token), jnp.int32(self._t),
             self._plens, self._ppad, self._cache)
+        self._dropped = self._dropped + drops
         self._t += 1
         return logits
+
+    @property
+    def dropped_tokens(self) -> float:
+        """Cumulative in-graph MoE capacity-drop count for this session
+        (always 0.0 for dense configs; nonzero means decode may diverge
+        from the full-forward oracle — the checkable form of the module
+        docstring's MoE caveat; r4 VERDICT next #10)."""
+        return float(self._dropped) if self._dropped is not None else 0.0

@@ -187,3 +187,47 @@ class TestWrappers:
         np.testing.assert_array_equal(pred.generate(ids), oracle)
         streamed = np.stack(list(pred.stream(ids)), 1)
         np.testing.assert_array_equal(streamed, oracle)
+
+
+class TestMoeDropDetection:
+    def _moe_cfg(self, capacity_factor):
+        from paddle_tpu.models.llama import LlamaConfig
+        import jax.numpy as jnp
+        return LlamaConfig(hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           vocab_size=61, max_position_embeddings=64,
+                           dtype=jnp.float32, remat=False,
+                           moe_num_experts=4, moe_top_k=2,
+                           moe_capacity_factor=capacity_factor)
+
+    def test_no_drops_in_normal_regime_and_session_exposes_zero(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models.generation import (DecodeSession,
+                                                  make_generate_fn)
+        from paddle_tpu.models.llama import init_params
+        cfg = self._moe_cfg(capacity_factor=4.0)   # generous capacity
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        gen = make_generate_fn(cfg, max_new_tokens=4, return_drops=True)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 61)
+        toks, drops = gen(params, ids, jnp.array([6, 6]),
+                          jax.random.PRNGKey(2))
+        assert float(drops) == 0.0
+        sess = DecodeSession(params, cfg, capacity=16)
+        sess.prefill(jnp.asarray(ids))
+        sess.step(jnp.asarray([1, 2]))
+        assert sess.dropped_tokens == 0.0
+
+    def test_drops_detected_under_tiny_capacity(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models.generation import make_generate_fn
+        from paddle_tpu.models.llama import init_params
+        # capacity_factor so small the prefill MUST overflow experts
+        cfg = self._moe_cfg(capacity_factor=0.05)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        gen = make_generate_fn(cfg, max_new_tokens=2, return_drops=True)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 61)
+        toks, drops = gen(params, ids, jnp.array([16, 16]),
+                          jax.random.PRNGKey(2))
+        assert float(drops) > 0.0
