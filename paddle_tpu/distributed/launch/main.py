@@ -66,6 +66,16 @@ def _parse(argv: Optional[List[str]] = None):
                         "Note: a native call holding the GIL longer than "
                         "the timeout starves the stamping thread — size the "
                         "timeout above your longest compile")
+    p.add_argument("--elastic_rejoin_file", default=None,
+                   help="path the infrastructure touches (optionally "
+                        "writing a worker count) when capacity RETURNS; "
+                        "the watcher notices mid-round, gracefully "
+                        "restarts, and the next round re-rendezvouses "
+                        "LARGER (scale-out; ref: fleet/elastic/manager.py "
+                        "watching etcd for rejoined nodes)")
+    p.add_argument("--elastic_max_nprocs", type=int, default=0,
+                   help="upper bound for elastic scale-out (0 = the "
+                        "original --nproc_per_node)")
     p.add_argument("--elastic_min_nprocs", type=int, default=0,
                    help="scale-in floor: when > 0, a restart after a crash "
                         "or hang RE-RENDEZVOUSES WITH THE SURVIVING WORLD "
@@ -132,7 +142,8 @@ def _spawn(args, restart_round: int,
     return procs
 
 
-HUNG_RC = 98  # job rc when a rank was killed for missing heartbeats
+HUNG_RC = 98     # job rc when a rank was killed for missing heartbeats
+RESCALE_RC = 97  # internal rc: healthy round interrupted to scale OUT
 
 
 def _kill_all(procs: List[_Proc], grace: float = 10.0,
@@ -154,7 +165,21 @@ def _kill_all(procs: List[_Proc], grace: float = 10.0,
             q.popen.kill()
 
 
-def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
+def _check_rejoin(path) -> int:
+    """Worker count offered by a rejoin signal file (0 = no signal). The
+    file may be empty (means "capacity is back, take what you need") or
+    hold an integer count."""
+    if not path or not os.path.exists(path):
+        return 0
+    try:
+        txt = open(path).read().strip()
+        return int(txt) if txt else 10 ** 9
+    except (OSError, ValueError):
+        return 10 ** 9
+
+
+def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0,
+           rejoin_file=None, want_more: bool = False) -> int:
     """Wait for all children; on any nonzero exit kill the rest (the
     reference's kill-all-on-one-failure policy). With a heartbeat
     ``monitor``, a rank whose liveness stamp goes stale for ``ttl`` seconds
@@ -181,6 +206,15 @@ def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0) -> int:
                     return rc, dead
             if alive == 0:
                 return 0, []
+            if want_more and _check_rejoin(rejoin_file):
+                # capacity returned: gracefully interrupt the (healthy)
+                # round; the caller re-rendezvouses with a larger world and
+                # every script resumes from its checkpoint (the same
+                # reshard-on-load contract scale-in uses)
+                print("elastic: rejoin signal observed — interrupting the "
+                      "round to scale out", file=sys.stderr)
+                _kill_all(procs, grace=5.0)
+                return RESCALE_RC, []
             if monitor is not None and ttl > 0 and \
                     time.time() - last_hb_check > min(1.0, ttl / 3):
                 last_hb_check = time.time()
@@ -219,6 +253,9 @@ def launch_procs(args) -> int:
             print(f"elastic: heartbeat monitor unavailable ({e}); "
                   f"exit-code watching only", file=sys.stderr)
     min_nprocs = int(getattr(args, "elastic_min_nprocs", 0) or 0)
+    max_nprocs = int(getattr(args, "elastic_max_nprocs", 0) or 0) \
+        or args.nproc_per_node
+    rejoin_file = getattr(args, "elastic_rejoin_file", None)
     cur_nproc = args.nproc_per_node
     rc = 1
     try:
@@ -228,11 +265,31 @@ def launch_procs(args) -> int:
             procs = _spawn(args, attempt,
                            elastic_store=monitor.addr if monitor else None,
                            nproc_override=cur_nproc)
-            rc, bad = _watch(procs, monitor=monitor, ttl=ttl)
+            rc, bad = _watch(procs, monitor=monitor, ttl=ttl,
+                             rejoin_file=rejoin_file,
+                             want_more=cur_nproc < max_nprocs)
             if rc == 0 or rc == 130:
                 return rc
             if attempt < rounds - 1:
-                if min_nprocs > 0 and bad:
+                if rc == RESCALE_RC or (rejoin_file and
+                                        _check_rejoin(rejoin_file)):
+                    # scale-out: capacity is back — re-rendezvous with the
+                    # larger world (mirror of scale-in; ref:
+                    # fleet/elastic/manager.py rejoin handling)
+                    offered = _check_rejoin(rejoin_file)
+                    new_nproc = min(max_nprocs,
+                                    max(cur_nproc, min(offered,
+                                                       max_nprocs)))
+                    if new_nproc != cur_nproc:
+                        print(f"elastic: scale-out {cur_nproc} -> "
+                              f"{new_nproc} procs (rejoin signal)",
+                              file=sys.stderr)
+                        cur_nproc = new_nproc
+                    try:            # consume the signal
+                        os.remove(rejoin_file)
+                    except OSError:
+                        pass
+                elif min_nprocs > 0 and bad:
                     # scale-in: drop the failed/hung ranks from the world
                     # (ref: elastic manager's scale event -> rendezvous
                     # re-init with the surviving node set); the script

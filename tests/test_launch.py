@@ -402,3 +402,83 @@ class TestMultiProcessTrainingParity:
         serial = json.loads((out_dir / "serial.json").read_text())
         np.testing.assert_allclose(dp, serial, rtol=1e-5, atol=1e-6)
         assert dp[-1] < dp[0]    # and it actually trains
+
+
+class TestElasticScaleOut:
+    @pytest.mark.slow
+    def test_1proc_scales_back_to_2proc_on_rejoin(self, tmp_path):
+        """r4 VERDICT next #8, the mirror of scale-in: a job running BELOW
+        its full world (here: started at 1 proc with
+        --elastic_max_nprocs 2, i.e. capacity was short at launch) sees
+        the rejoin signal, gracefully restarts, re-rendezvouses at 2
+        procs, and RESUMES from the checkpoint across the topology change
+        (reshard-on-load; ref: fleet/elastic/manager.py rejoin event)."""
+        import numpy as np
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        rejoin = tmp_path / "rejoin.signal"
+        script = _script(tmp_path, f"""
+            import os, sys, time
+            sys.path.insert(0, "/root/repo")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            rnd = int(os.environ["PADDLE_RESTART_ROUND"])
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                           save_state_dict)
+            ck = {str(ckpt_dir)!r}
+            state = {{"w": paddle.to_tensor(np.zeros((3, 1), np.float32)),
+                      "step": paddle.to_tensor(np.zeros((), np.float32))}}
+            if os.path.exists(os.path.join(ck, "metadata.pkl")):
+                load_state_dict(state, ck)
+                open(os.path.join(ck, "resumed.w%d.r%d" % (world, rank)),
+                     "w").write(str(float(state["step"])))
+            start = int(float(state["step"]))
+            rng = np.random.RandomState(0)
+            Xall = rng.randn(32, 3).astype("float32")
+            X = paddle.to_tensor(Xall[rank::world])
+            y = X.matmul(paddle.to_tensor(
+                np.array([[1.5], [-2.0], [0.5]], np.float32)))
+            wt = paddle.Parameter(state["w"].numpy())
+            for step in range(start, 10):
+                loss = ((X.matmul(wt) - y) ** 2).mean()
+                loss.backward()
+                wt.set_value(wt.numpy() - 0.1 * wt.grad.numpy())
+                wt.clear_grad()
+                if rank == 0:
+                    save_state_dict(
+                        {{"w": paddle.to_tensor(wt.numpy()),
+                          "step": paddle.to_tensor(np.float32(step + 1))}},
+                        ck)
+                if rnd == 0 and step == 3:
+                    # capacity "returns": the infrastructure raises the
+                    # rejoin signal; the WATCHER must interrupt this round
+                    open({str(rejoin)!r}, "w").write("2")
+                if rnd == 0:
+                    time.sleep(0.3)    # stay mid-training so the watcher's
+                    # graceful interrupt lands before the loop finishes
+            final = float(((X.matmul(wt) - y) ** 2).mean())
+            open(os.path.join(ck, "final.w%d.r%d" % (world, rank)),
+                 "w").write(str(final))
+        """)
+        env_bak = dict(os.environ)
+        os.environ.pop("PYTHONPATH", None)
+        try:
+            rc = launch_procs(_args(tmp_path, script, "--nproc_per_node",
+                                    "1", "--max_restart", "2",
+                                    "--elastic_max_nprocs", "2",
+                                    "--elastic_rejoin_file", str(rejoin)))
+        finally:
+            os.environ.clear()
+            os.environ.update(env_bak)
+        log0 = (tmp_path / "log" / "workerlog.0").read_text()
+        assert rc == 0, log0
+        # round 1 ran at world=2 and RESUMED from the 1-proc checkpoint
+        resumed = [p for p in ckpt_dir.glob("resumed.w2.r*")]
+        assert len(resumed) == 2, list(ckpt_dir.iterdir())
+        assert all(float(p.read_text()) >= 3 for p in resumed)
+        for r in range(2):
+            final = float((ckpt_dir / f"final.w2.r{r}").read_text())
+            assert np.isfinite(final) and final < 0.5, final
