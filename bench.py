@@ -173,8 +173,13 @@ def _median_fresh(grad_fn, q, k, v, iters, executables=3):
                 qq = q + (carry * 1e-24).astype(q.dtype)
                 g = grad_fn(qq, k, v)
                 gs = g if isinstance(g, (tuple, list)) else (g,)
+                # the salt must survive into the traced program as a
+                # DISTINCT literal per executable, or every "fresh"
+                # executable shares one cache key and this degenerates to
+                # timing a single binary three times: embed it as a
+                # value-irrelevant (1e-38-scaled) constant in the carry
                 return sum(gg.ravel()[0].astype(jnp.float32)
-                           for gg in gs) + 0.0 * _salt
+                           for gg in gs) + jnp.float32(_salt) * 1e-38
             return lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
         f = jax.jit(run)
@@ -631,12 +636,14 @@ _R2_ANCHORS = {
     "flash_attn_speedup": 1.0,        # COLOR ONLY: the composed-SDPA ref
     # executable varies 1.0-1.75x run to run (XLA autotuning); the tracked
     # kernel metric is flash_attn_ms below (r5: VERDICT r4 weak #4)
-    "flash_attn_ms": 10.7,            # ms fwd+bwd causal S=2048 B4 H16 D64,
-    # median-of-3-fresh-executables (10.3-13.8 observed), DCE-proof
-    # (first recorded r5)
+    "flash_attn_ms": 11.7,            # ms fwd+bwd causal S=2048 B4 H16 D64,
+    # median of 3 genuinely-distinct executables (11.3-15.6 spread — the
+    # median absorbs the occasional bad-autotune executable), DCE-proof
+    # (recorded r5; an earlier 10.7 reading predated the salt fix that
+    # actually diversifies the executables)
     "resnet50_throughput": 964.0,     # img/s (round 2)
     "bert_base_throughput": 605.0,    # ex/s (round 2)
-    "sdxl_attn_64x64": 11.4,          # ms, lower is better. RE-ANCHORED r5
+    "sdxl_attn_64x64": 12.0,          # ms, lower is better. RE-ANCHORED r5
     # from the r3 value of 10.5 with a measured cause (VERDICT r4 next #2):
     # (a) r3's loop consumed only the q-grad, so XLA DCE'd the entire dkv
     # backward kernel -> 10.5 under-measured the true fwd+bwd; (b) the r4

@@ -53,11 +53,10 @@ def main():
         return ids, y
 
     ids_np, y_np = batch()
-    prefetch.prefetch(ids_np)           # warm the pipeline
     for step in range(STEPS):
         ids_next, y_next = batch()
+        prefetch.prefetch(ids_next)     # next batch's host gather overlaps
         out = emb(paddle.to_tensor(ids_np))            # gathers hot rows
-        prefetch.prefetch(ids_next)                    # overlap next gather
         flat = paddle.reshape(out, [BATCH, SLOTS * DIM])
         pred = tower(flat)
         loss = ((pred - paddle.to_tensor(y_np)) ** 2).mean()
@@ -71,8 +70,8 @@ def main():
             print(f"step {step:3d} loss {float(loss.numpy()):.5f} "
                   f"sparse-grad rows {sel.merge().ids.shape[0]} "
                   f"(of {VOCAB})")
+        prefetch.take()                 # join the overlap for step t+1
         ids_np, y_np = ids_next, y_next
-        prefetch.take()
 
     print("done: dense [vocab, dim] gradients were never materialized; "
           f"device embedding bytes stayed {emb.device_bytes()}")

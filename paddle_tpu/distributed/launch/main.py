@@ -265,9 +265,12 @@ def launch_procs(args) -> int:
             procs = _spawn(args, attempt,
                            elastic_store=monitor.addr if monitor else None,
                            nproc_override=cur_nproc)
+            # only interrupt a healthy round for scale-out when a
+            # restart round remains to actually perform it
             rc, bad = _watch(procs, monitor=monitor, ttl=ttl,
                              rejoin_file=rejoin_file,
-                             want_more=cur_nproc < max_nprocs)
+                             want_more=(cur_nproc < max_nprocs
+                                        and attempt < rounds - 1))
             if rc == 0 or rc == 130:
                 return rc
             if attempt < rounds - 1:

@@ -305,15 +305,18 @@ class DistributedSparseEmbedding:
         self.num_embeddings = int(num_embeddings)
         self.shard = (num_embeddings + self.world - 1) // self.world
         self.start = self.rank * self.shard
-        rows = min(self.shard, max(0, num_embeddings - self.start))
-        # every rank seeds ITS shard from the global table's rows so the
-        # sharded model equals the single-process oracle
+        # every shard is padded to the SAME row count (uniform shapes for
+        # the allgather; pad rows are zero and never addressed — the mine
+        # mask below bounds ids by num_embeddings)
         rng = np.random.default_rng(seed)
         full = (rng.standard_normal(
             (num_embeddings, embedding_dim)) * 0.01).astype(np.float32)
-        self.local = SparseEmbedding(max(rows, 1), embedding_dim,
+        padded = np.zeros((self.shard, embedding_dim), np.float32)
+        real = full[self.start:self.start + self.shard]
+        padded[:real.shape[0]] = real
+        self.local = SparseEmbedding(self.shard, embedding_dim,
                                      host=host, seed=seed)
-        self.local.set_weight(full[self.start:self.start + max(rows, 1)])
+        self.local.set_weight(padded)
 
     def __call__(self, ids):
         it = ensure_tensor(ids)
@@ -321,7 +324,8 @@ class DistributedSparseEmbedding:
         local_ids = np.clip(ids_np - self.start, 0,
                             self.local.num_embeddings - 1)
         mine = ((ids_np >= self.start) &
-                (ids_np < self.start + self.local.num_embeddings))
+                (ids_np < min(self.start + self.shard,
+                              self.num_embeddings)))
         out = self.local(to_tensor(local_ids))
         from ..ops._helpers import forward_op as _f
         mask = to_tensor(mine.astype(np.float32))
@@ -386,35 +390,52 @@ def distributed_push_sparse(table: DistributedSparseEmbedding,
 class AsyncLookup:
     """Double-buffered host->device row prefetch: while the device computes
     step t, the host gathers step t+1's rows on a worker thread (ref: the
-    async PsClient pull pipeline). Use with ``host=True`` embeddings."""
+    async PsClient pull pipeline). Use with ``host=True`` embeddings.
+
+    One prefetch may be in flight at a time (issuing a second before
+    ``take()`` raises — silently dropping an un-taken batch would feed
+    stale rows); worker-thread failures re-raise from ``take()``."""
 
     def __init__(self, embedding: SparseEmbedding):
         self.emb = embedding
         self._thread: Optional[threading.Thread] = None
         self._next = None
+        self._error: Optional[BaseException] = None
 
     def prefetch(self, ids) -> None:
+        if self._thread is not None:
+            raise RuntimeError(
+                "prefetch() while a prefetch is already in flight — "
+                "take() the previous batch first")
         ids_np = np.asarray(ensure_tensor(ids)._value).astype(np.int64)
+        self._error = None
 
         def work():
-            flat = ids_np.reshape(-1)
-            rows = self.emb.weight[np.clip(flat, 0,
-                                           self.emb.num_embeddings - 1)]
-            # device transfer happens on the worker so the main thread
-            # never blocks on H2D for embedding rows
-            self._next = (ids_np, jnp.asarray(rows))
+            try:
+                flat = ids_np.reshape(-1)
+                rows = self.emb.weight[np.clip(
+                    flat, 0, self.emb.num_embeddings - 1)]
+                # device transfer happens on the worker so the main thread
+                # never blocks on H2D for embedding rows
+                self._next = (ids_np, jnp.asarray(rows))
+            except BaseException as e:   # surfaced by take()
+                self._error = e
 
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
 
     def take(self):
         """Rows prefetched by the last :meth:`prefetch` (blocks if the
-        gather is still in flight)."""
+        gather is still in flight; re-raises the worker's exception)."""
         if self._thread is None:
             raise RuntimeError("take() before prefetch()")
         self._thread.join()
+        self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
         ids_np, rows = self._next
-        self._thread, self._next = None, None
+        self._next = None
         return ids_np, Tensor(rows)
 
 

@@ -162,8 +162,11 @@ def _guard_digest(v):
         return ("seq", type(v).__name__,
                 tuple(_guard_digest(x) for x in v))
     if isinstance(v, dict):
-        return ("map", tuple(sorted((k, _guard_digest(x))
-                                    for k, x in v.items())))
+        # keys may mix int/str (both admitted by _guardable): sort by a
+        # type-tagged repr so the sort never compares across types
+        return ("map", tuple(sorted(
+            ((type(k).__name__, repr(k), _guard_digest(x))
+             for k, x in v.items()))))
     if isinstance(v, np.ndarray):
         return ("nd", v.shape, str(v.dtype), v.tobytes())
     return v
@@ -197,6 +200,39 @@ _SIDE_EFFECT_OPS = {"STORE_GLOBAL", "DELETE_GLOBAL", "STORE_ATTR",
 _MUTATING_METHODS = {"append", "extend", "insert", "update", "setdefault",
                      "pop", "popitem", "remove", "clear", "add", "discard",
                      "write", "sort", "reverse"}
+
+
+def _container_mutated_names(code) -> set:
+    """Names of GLOBAL/CLOSURE variables the code mutates through
+    subscript stores or mutating method calls — a short-window bytecode
+    heuristic: a LOAD_GLOBAL/LOAD_DEREF of the name followed within a few
+    instructions by STORE_SUBSCR / DELETE_SUBSCR / a mutating method load
+    marks the name. Local-variable mutations (LOAD_FAST ...) do NOT mark
+    anything, so building a local list does not disable guards on an
+    unrelated global config (r5 review fix)."""
+    names = set()
+    stack = [code]
+    WINDOW = 12
+    while stack:
+        c = stack.pop()
+        ins_list = list(dis.get_instructions(c))
+        for i, ins in enumerate(ins_list):
+            if ins.opname in ("LOAD_GLOBAL", "LOAD_DEREF",
+                              "LOAD_CLASSDEREF"):
+                for j in range(i + 1, min(i + 1 + WINDOW, len(ins_list))):
+                    nxt = ins_list[j]
+                    if nxt.opname in ("STORE_SUBSCR", "DELETE_SUBSCR"):
+                        names.add(ins.argval)
+                        break
+                    if nxt.opname in ("LOAD_METHOD", "LOAD_ATTR") and                             nxt.argval in _MUTATING_METHODS and j == i + 1:
+                        names.add(ins.argval)
+                        break
+            elif ins.opname in ("STORE_GLOBAL", "DELETE_GLOBAL"):
+                names.add(ins.argval)
+        for const in c.co_consts:
+            if hasattr(const, "co_code"):
+                stack.append(const)
+    return names
 
 
 def _detect_side_effects(fn: Callable) -> Optional[str]:
@@ -239,7 +275,12 @@ def _code_guard_snapshot(fn: Callable) -> Dict[str, Any]:
     code = getattr(fn, "__code__", None)
     if code is None:
         return {}
-    guard_containers = _detect_side_effects(fn) is None
+    # container guards are skipped ONLY for the specific global/closure
+    # names the code itself mutates (a step counter, an appended log) —
+    # other container guards stay live even in functions with local
+    # mutations (r5 review fix: the previous all-or-nothing switch
+    # disabled stale-path protection for most real functions)
+    mutated = _container_mutated_names(code)
     globals_read, derefs_read = _scan_code_reads(code)
     snap: Dict[str, Any] = {}
     g = getattr(fn, "__globals__", {})
@@ -247,7 +288,7 @@ def _code_guard_snapshot(fn: Callable) -> Dict[str, Any]:
         v = g.get(name, _MISSING)
         if v is _MISSING or not _guardable(v):
             continue
-        if not guard_containers and not isinstance(
+        if name in mutated and not isinstance(
                 v, (bool, int, float, str, bytes, tuple, type(None))):
             continue
         snap[f"g:{name}"] = _guard_digest(v)
@@ -261,7 +302,7 @@ def _code_guard_snapshot(fn: Callable) -> Dict[str, Any]:
                 continue
             if not _guardable(v):
                 continue
-            if not guard_containers and not isinstance(
+            if name in mutated and not isinstance(
                     v, (bool, int, float, str, bytes, tuple, type(None))):
                 continue
             snap[f"c:{name}"] = _guard_digest(v)

@@ -73,7 +73,12 @@ def measured_counts() -> dict:
 
 def latest_bench() -> dict:
     """Newest BENCH_r*.json -> {metric: value}."""
-    files = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    def round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    files = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")),
+                   key=round_no)
     if not files:
         return {}
     rows = {}
