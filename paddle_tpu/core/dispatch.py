@@ -29,6 +29,11 @@ from .autograd import Edge, GradNode
 
 __all__ = ["forward_op", "register_op", "OP_REGISTRY", "OpDef"]
 
+# paddle.static Program recording (static/program.py): while a Program is
+# under construction, every dispatched op appends to its tape — the
+# single-dispatcher funnel doubling as the ProgramDesc builder
+_static_recorder = None  # set by static.program; None = no recording
+
 
 @dataclass
 class OpDef:
@@ -106,7 +111,11 @@ def forward_op(name: str, fn: Callable, args: Sequence[Any],
             from .enforce import translate_op_error
             raise translate_op_error(e, name, vals) from e
         _maybe_check_nan(name, out_vals)
-        return _wrap_outputs(out_vals, None)
+        out = _wrap_outputs(out_vals, None)
+        if _static_recorder is not None:
+            _static_recorder.record(name, fn, args, kwargs, out,
+                                    differentiable)
+        return out
 
     def diff_fn(*dvals):
         full = list(vals)
@@ -131,7 +140,11 @@ def forward_op(name: str, fn: Callable, args: Sequence[Any],
 
     node = GradNode(name, vjp_fn, edges, avals,
                     replay=(pure_fn, edges, diff_idx, vals))
-    return _wrap_outputs(out_vals, node)
+    out = _wrap_outputs(out_vals, node)
+    if _static_recorder is not None:
+        _static_recorder.record(name, fn, args, kwargs, out,
+                                differentiable)
+    return out
 
 
 def _wrap_outputs(out_vals, node):

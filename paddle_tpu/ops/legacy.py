@@ -443,10 +443,6 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight=None, bias=None,
     """Static-graph fc layer op (ref: fc_op): flatten trailing dims, one
     matmul + bias + optional relu."""
     xt = ensure_tensor(x)
-    lead = [int(s) for s in xt.shape[:num_flatten_dims]]
-    flat_in = 1
-    for s in xt.shape[num_flatten_dims:]:
-        flat_in *= int(s)
     if weight is None:
         raise ValueError("fc: pass `weight` explicitly (the layer tier "
                          "owns parameter creation)")
@@ -455,6 +451,12 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight=None, bias=None,
         args.append(ensure_tensor(bias))
 
     def impl(v, w, *b):
+        # shapes read from the runtime operand (shape-polymorphic across
+        # re-traces: the static Executor replays with real batch sizes)
+        lead = v.shape[:num_flatten_dims]
+        flat_in = 1
+        for s in v.shape[num_flatten_dims:]:
+            flat_in *= int(s)
         out = v.reshape(tuple(lead) + (flat_in,)) @ w
         if b:
             out = out + b[0]

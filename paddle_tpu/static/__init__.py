@@ -8,10 +8,12 @@ the static graph. This module keeps the load-bearing names working:
 * ``InputSpec`` — real (shared with jit).
 * ``save_inference_model`` / ``load_inference_model`` — map onto
   ``jit.save`` / ``jit.load`` (StableHLO artifact).
-* ``enable_static`` — warns and keeps eager+jit semantics (imperative code
-  under this framework is already compiled via to_static).
-* Program/Executor-class APIs raise with a pointer to the jit equivalent
-  rather than silently half-working.
+* ``enable_static`` — enters static mode: a Program records every
+  dispatched op (r5, static/program.py — the single-dispatcher funnel IS
+  the ProgramDesc builder) and ``Executor.run(feed, fetch_list)`` replays
+  the tape as a pure function of the feeds. The classic
+  data/program_guard/Executor workflow WORKS, landing on the same
+  compiled-XLA substrate as to_static.
 """
 
 from __future__ import annotations
@@ -19,63 +21,61 @@ from __future__ import annotations
 import warnings
 
 from ..jit.api import InputSpec
+from .program import (Executor, Program, append_backward, data,
+                      default_main_program, default_startup_program,
+                      program_guard)
 
 __all__ = ["InputSpec", "enable_static", "disable_static", "Program",
-           "Executor", "default_main_program", "default_startup_program",
-           "program_guard", "save_inference_model", "load_inference_model",
-           "name_scope", "device_guard"]
+           "Executor", "data", "append_backward", "default_main_program",
+           "default_startup_program", "program_guard",
+           "save_inference_model", "load_inference_model",
+           "name_scope", "device_guard", "nn"]
 
 _static_mode = False
 
 
 def enable_static():
+    """Enter static mode: the default main Program starts recording every
+    dispatched op (construction still executes eagerly on placeholder
+    data — that is the shape-inference pass)."""
     global _static_mode
-    if not _static_mode:
-        warnings.warn(
-            "paddle.static: static graph mode maps onto the jit stack on "
-            "this framework — code keeps eager semantics and is compiled "
-            "via paddle.jit.to_static; Program/Executor APIs are not "
-            "available", stacklevel=2)
+    from ..core import dispatch as _d
     _static_mode = True
+    _d._static_recorder = default_main_program()
 
 
 def disable_static():
     global _static_mode
+    from ..core import dispatch as _d
     _static_mode = False
+    _d._static_recorder = None
 
 
 def in_static_mode() -> bool:
     return _static_mode
 
 
-def _unsupported(name: str):
-    raise NotImplementedError(
-        f"paddle.static.{name}: the ProgramDesc/Executor machinery is "
-        f"replaced by XLA compilation — use @paddle.jit.to_static for "
-        f"compiled training steps and paddle.jit.save/load for artifacts "
-        f"(SURVEY §7 design stance)")
+class nn:
+    """paddle.static.nn namespace: the layer-op surface the static
+    workflow uses (fc + the functional layers; everything records into
+    the active Program through the dispatcher)."""
+    from ..ops.legacy import fc  # noqa: F401
+    fc = staticmethod(fc)
 
+    @staticmethod
+    def batch_norm(x, *a, **k):
+        from ..nn import functional as F
+        return F.batch_norm(x, *a, **k)
 
-class Program:
-    def __init__(self, *a, **k):
-        _unsupported("Program")
+    @staticmethod
+    def conv2d(x, *a, **k):
+        from ..nn import functional as F
+        return F.conv2d(x, *a, **k)
 
-
-class Executor:
-    def __init__(self, *a, **k):
-        _unsupported("Executor")
-
-
-def default_main_program():
-    _unsupported("default_main_program")
-
-
-def default_startup_program():
-    _unsupported("default_startup_program")
-
-
-def program_guard(*a, **k):
-    _unsupported("program_guard")
+    @staticmethod
+    def sequence_pool(x, pool_type, lens):
+        from ..ops.sequence import sequence_pool
+        return sequence_pool(x, pool_type, lens)
 
 
 def name_scope(prefix=None):

@@ -1,0 +1,273 @@
+"""Working Program/Executor tier for ``paddle.static``.
+
+Parity target: the reference's static-graph workflow
+(``paddle/fluid/framework.py`` Program + ``executor.py`` Executor):
+
+    paddle.enable_static()
+    x = paddle.static.data("x", [None, 4], "float32")
+    out = my_layers(x)
+    loss = paddle.mean(out)
+    opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    exe.run(feed={"x": arr}, fetch_list=[loss])
+
+TPU redesign: the reference's Program is a ProgramDesc protobuf built by
+every layer call appending OpDescs. Here the SAME single-dispatcher funnel
+the SOT tier uses (``core.dispatch.forward_op``) gives the recording for
+free: while a Program is being constructed (static mode, program_guard),
+every dispatched op appends ``(kernel_fn, input-refs, kwargs, output-refs)``
+to the active Program's tape — the tape IS the ProgramDesc, with Python
+object identity as SSA names (outputs pinned per record so ids stay
+unique). Construction still executes eagerly on the placeholder batch
+(shape inference comes out as real shapes, exactly what InferMeta provides
+upstream). ``Executor.run`` replays the tape as a pure function of the
+feeds + live Parameters.
+
+``Optimizer.minimize(loss)`` marks the program as a TRAINING program: the
+replay runs under the autograd tape, appends backward, and applies the
+optimizer — the ``append_backward`` + optimizer-op-append contract without
+a second graph IR.
+
+v1 scope (documented limits): replay re-dispatches the tape eagerly (each
+op is a jit-compiled XLA kernel; the whole-program fusion tier remains
+``to_static``, which this module intentionally shares its substrate with);
+ops that close over construction-time state (dropout keys, BN running
+stats) replay that state — a warning fires at record time and the
+stochastic-training path is ``to_static``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Program", "Executor", "data", "default_main_program",
+           "default_startup_program", "program_guard", "append_backward"]
+
+
+_main_program: Optional["Program"] = None
+_startup_program: Optional["Program"] = None
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "arg_ids", "raw_args", "kwargs", "out_ids",
+                 "raw_outs", "differentiable")
+
+    def __init__(self, name, fn, arg_ids, raw_args, kwargs, out_ids,
+                 raw_outs, differentiable):
+        self.name = name
+        self.fn = fn
+        self.arg_ids = arg_ids      # per-arg: ("var", id) | ("const", val)
+        self.raw_args = raw_args
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+        # outputs are PINNED for the program's lifetime: env keys are
+        # id()s, so a GC'd output would let an unrelated later tensor
+        # reuse its id and alias its env slot during replay
+        self.raw_outs = raw_outs
+        self.differentiable = differentiable
+
+
+class Program:
+    """The op-tape program (ProgramDesc equivalent)."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.feeds: Dict[str, Any] = {}       # name -> placeholder Tensor
+        self.train_spec = None                 # (optimizer, loss_tensor)
+        self._warned_stateful = False
+
+    _STATEFUL_MARKERS = ("dropout", "bernoulli", "uniform", "normal",
+                         "rand", "batch_norm", "rrelu", "multinomial",
+                         "gumbel", "alpha_dropout")
+
+    # -- recording hook (called from core.dispatch.forward_op) -------------
+    def record(self, name, fn, args, kwargs, outs, differentiable):
+        from ..core.tensor import Tensor
+        if not self._warned_stateful and any(
+                m in (name or "") for m in self._STATEFUL_MARKERS):
+            self._warned_stateful = True
+            import warnings
+            warnings.warn(
+                f"paddle.static: op '{name}' closes over construction-time "
+                "state (an RNG key / running statistics); Executor.run "
+                "replays the SAME state every call — random masks freeze "
+                "and BN running stats do not advance. Use "
+                "paddle.jit.to_static for stochastic/stateful training "
+                "steps (the jit tier re-keys per call).", stacklevel=4)
+        arg_ids = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_ids.append(("var", id(a)))
+            else:
+                arg_ids.append(("const", a))
+        out_list = outs if isinstance(outs, (tuple, list)) else (outs,)
+        raw_outs = [o for o in out_list if isinstance(o, Tensor)]
+        self.ops.append(_OpRecord(
+            name, fn, arg_ids, list(args), dict(kwargs),
+            [id(o) for o in raw_outs], raw_outs, differentiable))
+
+    def global_block(self):
+        return self
+
+    @property
+    def var_names(self):
+        return list(self.feeds)
+
+    def __repr__(self):
+        kind = "train" if self.train_spec else "inference"
+        return (f"Program(ops={len(self.ops)}, feeds={list(self.feeds)}, "
+                f"{kind})")
+
+
+def default_main_program() -> Program:
+    global _main_program
+    if _main_program is None:
+        _main_program = Program()
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    """Parameter initialization happens eagerly at layer construction on
+    this framework (the reference's startup program runs initializer ops);
+    an empty Program keeps the exe.run(startup) idiom working."""
+    global _startup_program
+    if _startup_program is None:
+        _startup_program = Program()
+    return _startup_program
+
+
+class _ProgramGuard:
+    def __init__(self, main: Program, startup: Optional[Program]):
+        self.main = main
+        self.startup = startup
+
+    def __enter__(self):
+        global _main_program
+        from ..core import dispatch as _d
+        self._prev = _main_program
+        self._prev_rec = _d._static_recorder
+        _main_program = self.main
+        _d._static_recorder = self.main
+        return self.main
+
+    def __exit__(self, *exc):
+        global _main_program
+        from ..core import dispatch as _d
+        _d._static_recorder = self._prev_rec
+        _main_program = self._prev
+        return False
+
+
+def program_guard(main_program: Program, startup_program: Optional[Program]
+                  = None):
+    return _ProgramGuard(main_program, startup_program)
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level=0):
+    """Feed placeholder (ref: paddle.static.data). Dynamic dims (None/-1)
+    materialize as 1 for construction-time shape inference; Executor.run
+    re-traces per concrete feed shape (symbolic batch the jit way)."""
+    from ..core.tensor import to_tensor
+    from ..ops.creation import canonical_dtype
+    concrete = tuple(1 if (s is None or int(s) < 0) else int(s)
+                     for s in shape)
+    ph = to_tensor(np.zeros(concrete, canonical_dtype(dtype)))
+    ph.stop_gradient = True
+    prog = default_main_program()
+    prog.feeds[name] = ph
+    return ph
+
+
+def append_backward(loss, parameter_list=None):
+    """Mark the program for backward+update replay (ref: append_backward).
+    Returns the (param, grad-slot) pairs lazily — grads exist after an
+    Executor.run of the training program."""
+    prog = default_main_program()
+    prog.train_spec = (prog.train_spec[0] if prog.train_spec else None,
+                       loss)
+    return []
+
+
+class Executor:
+    """Replays a Program as a pure function of its feeds (ref:
+    paddle.static.Executor). ``place`` is accepted and ignored — device
+    placement is XLA's."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        prog = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if prog is _startup_program:
+            return []          # startup: initialization already happened
+
+        from ..core.tensor import Tensor, to_tensor
+
+        # map feed names -> placeholder ids -> fed values
+        env: Dict[int, Any] = {}
+        for name, ph in prog.feeds.items():
+            if name in feed:
+                v = feed[name]
+                env[id(ph)] = v if isinstance(v, Tensor) else to_tensor(
+                    np.asarray(v))
+            else:
+                env[id(ph)] = ph
+
+        from ..core import dispatch as _d
+        saved_rec, _d._static_recorder = _d._static_recorder, None
+        try:
+            outs = self._replay(prog, env)
+        finally:
+            _d._static_recorder = saved_rec
+
+        if prog.train_spec and prog.train_spec[0] is not None:
+            opt, loss = prog.train_spec
+            lt = outs.get(id(loss), loss)
+            lt.backward()
+            opt.step()
+            opt.clear_grad()
+
+        results = []
+        for f in fetch_list:
+            t = outs.get(id(f), f)
+            results.append(np.asarray(t.numpy()) if return_numpy else t)
+        return results
+
+    def _replay(self, prog: Program, env: Dict[int, Any]) -> Dict[int, Any]:
+        """Walk the tape; every op re-dispatches through forward_op with
+        feeds/intermediates substituted (Parameters read their LIVE values,
+        so optimizer updates persist across run() calls — the reference's
+        scope semantics)."""
+        from ..core.dispatch import forward_op
+        from ..core.tensor import Tensor
+        for rec in prog.ops:
+            args = []
+            for (kind, ref), raw in zip(rec.arg_ids, rec.raw_args):
+                if kind == "var" and ref in env:
+                    args.append(env[ref])
+                else:
+                    args.append(raw)
+            out = forward_op(rec.name, rec.fn, args, rec.kwargs,
+                             differentiable=rec.differentiable)
+            out_list = out if isinstance(out, (tuple, list)) else (out,)
+            for oid, o in zip(rec.out_ids,
+                              [o for o in out_list
+                               if isinstance(o, Tensor)]):
+                env[oid] = o
+        return env
+
+    def close(self):
+        pass
+
+
+def reset_programs():
+    """Test hook: drop the module-level default programs."""
+    global _main_program, _startup_program
+    _main_program = None
+    _startup_program = None

@@ -1,0 +1,109 @@
+"""paddle.static Program/Executor tier (r5): the classic static-graph
+workflow — data placeholders, op-tape recording through the dispatcher,
+Executor replay with feeds, minimize-based training."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    from paddle_tpu.static.program import reset_programs
+    reset_programs()
+    paddle.static.enable_static()
+    yield
+    paddle.static.disable_static()
+    reset_programs()
+
+
+class TestStaticWorkflow:
+    def test_inference_program_replays_with_feeds(self):
+        x = paddle.static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        out = paddle.matmul(x, w)
+        out2 = out + 1.0
+
+        exe = paddle.static.Executor()
+        exe.run(paddle.static.default_startup_program())
+        xv = np.random.randn(5, 4).astype(np.float32)   # batch 5 != 1
+        (res,) = exe.run(feed={"x": xv}, fetch_list=[out2])
+        np.testing.assert_allclose(res, xv @ w.numpy() + 1.0, rtol=1e-5)
+
+    def test_layers_record_and_params_update_across_runs(self):
+        paddle.seed(0)
+        x = paddle.static.data("x", [None, 3], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        lin = nn.Linear(3, 1)
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+
+        exe = paddle.static.Executor()
+        exe.run(paddle.static.default_startup_program())
+        rng = np.random.RandomState(0)
+        Xv = rng.randn(16, 3).astype(np.float32)
+        Yv = (Xv @ np.array([[1.0], [-1.0], [0.5]], np.float32))
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(feed={"x": Xv, "y": Yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+    def test_program_guard_isolation(self):
+        from paddle_tpu.static import Program, program_guard
+        main2 = Program()
+        with program_guard(main2):
+            a = paddle.to_tensor(np.ones(2, np.float32))
+            b = a * 3.0
+        assert len(main2.ops) >= 1
+        # the default program did not absorb the guarded ops
+        assert paddle.static.default_main_program() is not main2
+
+    def test_fetch_intermediate(self):
+        x = paddle.static.data("x", [2, 2], "float32")
+        mid = x * 2.0
+        out = mid + 1.0
+        exe = paddle.static.Executor()
+        xv = np.ones((2, 2), np.float32)
+        m, o = exe.run(feed={"x": xv}, fetch_list=[mid, out])
+        np.testing.assert_allclose(m, 2 * xv)
+        np.testing.assert_allclose(o, 2 * xv + 1)
+
+    def test_static_nn_fc(self):
+        x = paddle.static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+        out = paddle.static.nn.fc(x, 3, weight=w)
+        exe = paddle.static.Executor()
+        xv = np.random.randn(6, 4).astype(np.float32)
+        (res,) = exe.run(feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(res, xv @ w.numpy(), rtol=1e-5)
+
+    def test_eager_mode_unaffected_after_disable(self):
+        paddle.static.disable_static()
+        n_before = len(paddle.static.default_main_program().ops)
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        out = t + 1.0
+        np.testing.assert_allclose(out.numpy(), [2, 2, 2])
+        # eager ops must NOT keep recording after disable_static
+        assert len(paddle.static.default_main_program().ops) == n_before
+
+    def test_passthrough_fetch_of_fed_placeholder(self):
+        x = paddle.static.data("x", [2], "float32")
+        exe = paddle.static.Executor()
+        (res,) = exe.run(feed={"x": np.array([3.0, 4.0], np.float32)},
+                         fetch_list=[x])
+        np.testing.assert_allclose(res, [3.0, 4.0])
+
+    def test_stateful_op_warns(self):
+        import warnings as _w
+        import paddle_tpu.nn.functional as F
+        x = paddle.static.data("x", [4, 4], "float32")
+        with _w.catch_warnings(record=True) as w:
+            _w.simplefilter("always")
+            F.dropout(x, 0.5, training=True)
+        assert any("construction-time state" in str(m.message) for m in w)
