@@ -247,8 +247,11 @@ def _to_call_args(args):
 
 def _leaves(x):
     from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.sparse import SparseCooTensor, SparseCsrTensor
     if isinstance(x, Tensor):
         return [np.asarray(x._value)]
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return [np.asarray(x.values()._value)]
     if isinstance(x, (tuple, list)):
         return [l for e in x for l in _leaves(e)]
     return [np.asarray(x)]
