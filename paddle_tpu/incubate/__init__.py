@@ -9,8 +9,11 @@ both paths alive); MoE lives under ``incubate.distributed.models.moe``
 
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["nn", "distributed", "softmax_mask_fuse"]
+__all__ = ["nn", "distributed", "optimizer", "LookAhead", "ModelAverage",
+           "softmax_mask_fuse"]
 
 
 def softmax_mask_fuse(x, mask, name=None):

@@ -1578,14 +1578,17 @@ def _r5b_specs():
               "sparse_relu6", "sparse_leaky_relu", "sparse_sin",
               "sparse_sinh", "sparse_sqrt", "sparse_square", "sparse_tan",
               "sparse_tanh", "sparse_softmax", "sparse_coalesce"]:
-        base = n[len("sparse_"):]
 
         def mk():
             def spec(rng):
                 t = coo(rng)
-                # domain-safe values for sqrt/log1p/asin...
-                vals = np.abs(np.asarray(t.values()._value)) * 0.5 + 0.1
-                t.values_._value = __import__("jax").numpy.asarray(vals)
+                # domain-safe for EVERY member (sqrt/log1p/asin/atanh...):
+                # squash into (0.05, 0.95) — seed-proof, not
+                # luck-of-the-draw
+                vals = np.tanh(np.abs(np.asarray(
+                    t.values()._value))) * 0.9 + 0.05
+                t.values_._value = __import__("jax").numpy.asarray(
+                    vals.astype(np.float32))
                 return [((t,), {}, None)]
             return spec
         add(n, mk())

@@ -160,3 +160,38 @@ def test_sample_top_k_clamped_to_vocab():
     logits = jnp.array([[0.0, 1.0, 2.0]])
     tok = _sample(logits, jax.random.PRNGKey(0), 1.0, top_k=10, top_p=None)
     assert int(tok[0]) in (0, 1, 2)
+
+
+def test_lookahead_optimizer():
+    from paddle_tpu.incubate import LookAhead
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(8, 1).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate import ModelAverage
+    lin = nn.Linear(3, 1)
+    ma = ModelAverage(0.15, parameters=lin.parameters())
+    vals = []
+    for v in (1.0, 2.0, 3.0):
+        lin.weight.set_value(np.full((3, 1), v, np.float32))
+        ma.step()
+        vals.append(v)
+    before = lin.weight.numpy().copy()
+    ma.apply()
+    np.testing.assert_allclose(lin.weight.numpy(), np.mean(vals), rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(), before)
