@@ -403,6 +403,81 @@ def bench_detect(batch=8, steps=8, image=320):
             "warmup_s": warm_s, "compile_s": compile_s, "loss": final}
 
 
+def bench_checkpoint(backend, steps=10):
+    """Fault-tolerance cost tracking (docs/FAULT_TOLERANCE.md): (a) the
+    async-save OVERLAP — per-step overhead while a checkpoint is in flight
+    vs steady state on the llama preset (acceptance bound: < 15%); (b) the
+    blocking device->host snapshot cost; (c) restore-verify latency (walk
+    to newest committed, re-hash every shard, assemble + device_put)."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+    from paddle_tpu.models import llama
+
+    cfg, batch, seq = _presets(backend, wide=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, step_fn = llama.make_train_step(cfg, lr=1e-4)
+    opt = init_opt(params)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    params, opt, loss = jstep(params, opt, ids, ids)
+    float(loss)                          # compile + drain
+    for _ in range(2):
+        params, opt, loss = jstep(params, opt, ids, ids)
+    float(loss)
+
+    it = max(steps, 10)
+    t0 = time.time()
+    for _ in range(it):
+        params, opt, loss = jstep(params, opt, ids, ids)
+    float(loss)
+    steady = (time.time() - t0) / it
+
+    # leaves snapshot specs BEFORE the overlap loop donates the buffers
+    leaves = jax.tree_util.tree_leaves(params)
+    specs = [(a.shape, a.dtype) for a in leaves]
+    state = {f"p{i}": a for i, a in enumerate(leaves)}
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        ck = AsyncCheckpointer(root, keep_last_k=2)
+        t0 = time.time()
+        ck.save(state, 0)                # sync device->host + async write
+        snapshot_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(it):              # the save drains UNDER this loop
+            params, opt, loss = jstep(params, opt, ids, ids)
+        float(loss)
+        during = (time.time() - t0) / it
+        in_flight_after = ck.is_saving   # False = write finished early
+        ck.wait()
+        overhead_pct = 100.0 * (during - steady) / steady
+
+        dst = {f"p{i}": jnp.zeros(sh, dt) for i, (sh, dt)
+               in enumerate(specs)}
+        t0 = time.time()
+        got = ck.restore(dst)            # verify checksums + assemble
+        restore_s = time.time() - t0
+        assert got == 0, got
+        ckpt_bytes = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(root) for f in fs)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {"steady_step_s": round(steady, 4),
+            "during_save_step_s": round(during, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "snapshot_block_s": round(snapshot_s, 4),
+            "save_outlived_loop": bool(in_flight_after),
+            "restore_verify_ms": round(restore_s * 1e3, 1),
+            "ckpt_mb": round(ckpt_bytes / 2**20, 1)}
+
+
 def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
     """The memory-tuned LLaMA-ratio point (secondary; the headline keeps the
     reference-parity numerics): remat_policy="save_flash" (flash residuals +
@@ -658,6 +733,12 @@ _R2_ANCHORS = {
     # dequant kernel; anchored at the fp16 rate until measured)
     "ppyoloe_mbv3_throughput": 400.0,  # img/s (r4)
     "llama_train_mfu_tuned": 56.4,    # % (r4)
+    # fault-tolerance cost rows (first recorded this round; lower is
+    # better for both). The overhead anchor IS the acceptance bound from
+    # the robustness issue (<15% step overhead while a save is in flight);
+    # restore-verify anchored provisionally until measured on the driver.
+    "ckpt_async_overhead_pct": 15.0,   # % step-time overhead bound
+    "ckpt_restore_verify_ms": 500.0,   # ms, provisional anchor
 }
 
 
@@ -694,7 +775,7 @@ def main():
     ap = argparse.ArgumentParser()
     _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode",
                  "int8",
-                 "tuned", "detect", "roofline")
+                 "tuned", "detect", "checkpoint", "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
@@ -751,10 +832,10 @@ def main():
         _warm = False
     _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
-                  "detect": 150.0} if _warm else
+                  "detect": 150.0, "checkpoint": 30.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
-                  "int8": 90.0, "detect": 240.0})
+                  "int8": 90.0, "detect": 240.0, "checkpoint": 50.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -864,6 +945,19 @@ def main():
                   "img/s", dt["images_per_s"] /
                   _R2_ANCHORS["ppyoloe_mbv3_throughput"])
         section("detect", _detect)
+    if want("checkpoint"):
+        def _ckpt():
+            c = bench_checkpoint(backend, steps=args.steps)
+            print(json.dumps({"checkpoint": c}), file=sys.stderr)
+            # both rows: LOWER is better -> vs_baseline = anchor / value
+            # (clamped so a near-zero overhead doesn't explode the ratio)
+            v = c["overhead_pct"]
+            _emit("ckpt_async_overhead_pct", v, "%",
+                  _R2_ANCHORS["ckpt_async_overhead_pct"] / max(v, 1.0))
+            r = c["restore_verify_ms"]
+            _emit("ckpt_restore_verify_ms", r, "ms",
+                  _R2_ANCHORS["ckpt_restore_verify_ms"] / max(r, 1.0))
+        section("checkpoint", _ckpt)
     if "roofline" in chosen:   # explicit-only: a diagnostic, not a metric
         def _roof():
             r = bench_roofline(backend, steps=args.steps)

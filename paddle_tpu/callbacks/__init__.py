@@ -103,19 +103,71 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+    """Epoch checkpoints with optional async writing + retention.
+
+    ``async_save=True`` routes the save through the shared background
+    checkpoint writer (framework.io.async_save) so the next epoch's compute
+    overlaps the disk write; ``on_train_end`` drains pending writes.
+    ``keep_last_k`` prunes older epoch checkpoints (the newest K and the
+    ``final`` save are kept — docs/FAULT_TOLERANCE.md retention policy)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None,
+                 keep_last_k: Optional[int] = None, async_save: bool = False):
         super().__init__()
         self.save_freq = int(save_freq)
         self.save_dir = save_dir
+        self.keep_last_k = keep_last_k if keep_last_k is None \
+            else max(1, int(keep_last_k))
+        self.async_save = bool(async_save)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and self.model is not None and \
                 (epoch + 1) % self.save_freq == 0:
-            self.model.save(f"{self.save_dir}/{epoch}")
+            self.model.save(f"{self.save_dir}/{epoch}",
+                            async_save=self.async_save)
+            if self.keep_last_k is not None:
+                if self.async_save:
+                    # ride the writer queue BEHIND the save jobs (queue
+                    # order guarantees the new files landed) — draining
+                    # here would serialize the save and defeat the overlap
+                    from ..framework.async_writer import default_writer
+                    default_writer().submit(self._prune, label="ckpt-prune")
+                else:
+                    self._prune()
 
     def on_train_end(self, logs=None):
         if self.save_dir and self.model is not None:
+            if self.async_save:
+                from ..framework import io as fio
+                fio.wait_save()   # drain epoch saves before the final one
             self.model.save(f"{self.save_dir}/final")
+
+    def _prune(self):
+        """Runs inline (sync mode) or ON the writer thread behind the save
+        jobs (async mode) — either way every finished save is on disk and
+        none is mid-write when we enumerate/unlink."""
+        import os
+        import re
+        keep = set()
+        epochs = []
+        try:
+            names = os.listdir(self.save_dir)
+        except OSError:
+            return
+        for n in names:
+            m = re.match(r"^(\d+)\.pdparams$", n)
+            if m:
+                epochs.append(int(m.group(1)))
+        for e in sorted(epochs)[-self.keep_last_k:]:
+            keep.add(e)
+        for e in epochs:
+            if e in keep:
+                continue
+            for suffix in (".pdparams", ".pdopt"):
+                try:
+                    os.remove(os.path.join(self.save_dir, f"{e}{suffix}"))
+                except OSError:
+                    pass
 
 
 class EarlyStopping(Callback):

@@ -1,0 +1,39 @@
+"""Compat shims for jax API drift.
+
+The framework targets current jax, where ``shard_map`` is a top-level
+export and its replication-check kwarg is ``check_vma``; older releases
+only ship ``jax.experimental.shard_map.shard_map`` with ``check_rep``.
+Everything in-repo imports :func:`shard_map` from here so version skew is
+absorbed in one place.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: pre-promotion spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    import inspect
+    _KW = set(inspect.signature(_shard_map).parameters)
+except (ValueError, TypeError):  # C-accel / wrapper without a signature
+    _KW = set()
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` with a fallback for jax versions predating it
+    (``psum(1, axis)`` is the classic static-axis-size idiom)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, **kwargs):
+    if _KW:
+        if "check_vma" in kwargs and "check_vma" not in _KW:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        elif "check_rep" in kwargs and "check_rep" not in _KW:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)

@@ -19,10 +19,12 @@ from .topology import (HybridCommunicateGroup, build_mesh,
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 from . import checkpoint
+from . import elastic
 from . import fleet
 from . import rpc
 from . import sharding
-from .checkpoint import load_state_dict, save_state_dict
+from .checkpoint import AsyncCheckpointer, load_state_dict, save_state_dict
+from .elastic import install_preemption_handler, preempted, start_heartbeat
 from .context_parallel import sep_parallel_attention
 from .moe import MoELayer
 from . import moe_utils
@@ -43,7 +45,8 @@ __all__ = [
     "is_initialized", "reduce", "reduce_scatter", "scatter", "DataParallel",
     "ParallelEnv", "group_sharded_parallel", "HybridCommunicateGroup",
     "build_mesh", "get_hybrid_communicate_group", "fleet", "sharding",
-    "checkpoint", "save_state_dict", "load_state_dict",
+    "checkpoint", "save_state_dict", "load_state_dict", "AsyncCheckpointer",
+    "elastic", "install_preemption_handler", "preempted", "start_heartbeat",
     "sep_parallel_attention", "MoELayer", "PipelineLayer", "LayerDesc",
     "SharedLayerDesc", "PipelineParallel", "pipeline_scan",
     "spawn", "launch",

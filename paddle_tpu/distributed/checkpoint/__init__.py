@@ -12,6 +12,23 @@ run can resume under a DIFFERENT parallel topology). TPU redesign:
 * Load reads the metadata, assembles each logical tensor from shard slices,
   and ``jax.device_put``s onto the DESTINATION tensor's current sharding —
   reshard-on-load is exactly one device_put (SURVEY §5 checkpoint tier 3).
+
+Fault tolerance (docs/FAULT_TOLERANCE.md):
+
+* every file is written tmp+fsync+rename; each rank records per-file
+  SHA-256 in ``manifest_<rank>.json``, and the coordinator drops a
+  ``COMMITTED`` marker LAST (manifest.py) — a kill at ANY point leaves
+  either the previous consistent view or a marker-less torn dir;
+* ``load_state_dict`` verifies checksums on committed checkpoints (flag
+  ``FLAGS_checkpoint_verify``) and raises
+  :class:`CheckpointCorruptionError` on truncation/bit-flips instead of
+  unpickling garbage; marker-less/legacy dirs load tolerantly (a mid-save
+  kill must not brick the old same-dir resume contract);
+* ``save_state_dict(..., async_save=True)`` snapshots shards to host
+  synchronously and performs ALL file I/O on the shared background writer
+  (``wait()`` / ``is_saving()``), overlapping the save with compute;
+* :class:`AsyncCheckpointer` (async_save.py) manages a step_<n> SERIES
+  with keep-last-K retention and last-good ``restore()``.
 """
 
 from __future__ import annotations
@@ -24,8 +41,16 @@ import numpy as np
 import jax
 
 from ...core.tensor import Tensor
+from ...framework.async_writer import default_writer
+from ...framework.integrity import CheckpointCorruptionError, verify_enabled
+from . import manifest
+from .manifest import (latest_committed, list_checkpoints, prune_uncommitted,
+                       retain_last_k)
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "load_latest", "wait",
+           "is_saving", "AsyncCheckpointer", "CheckpointCorruptionError",
+           "manifest", "latest_committed", "list_checkpoints",
+           "prune_uncommitted", "retain_last_k"]
 
 _META = "metadata.pkl"
 
@@ -55,17 +80,13 @@ def _index_tuple(index) -> tuple:
     return tuple(out)
 
 
-def save_state_dict(state_dict: Dict, path: str, process_group=None,
-                    coordinator_rank: int = 0, unique_id=None,
-                    async_save: bool = False) -> None:
-    """Write ``state_dict`` (nested dicts of Tensors/arrays/scalars) as a
-    sharded checkpoint directory."""
-    os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+def _collect(state_dict: Dict, rank: int):
+    """Snapshot every shard to HOST memory (the synchronous part of a save:
+    after this returns, the device arrays are free to be overwritten by the
+    next train step) and build the metadata records."""
     flat = _flatten(state_dict)
     meta: Dict[str, Dict] = {}
     payload: Dict[str, list] = {}
-
     for name, v in flat.items():
         arr = _raw(v)
         if not hasattr(arr, "shape"):  # python scalar / misc metadata
@@ -82,20 +103,33 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             if idx in seen_indices:
                 continue  # replicated copy — unique-owner dedup
             seen_indices.add(idx)
-            shards.append((idx, np.asarray(sh.data)))
+            shards.append((idx, np.asarray(sh.data)))  # device -> host read
             entry["shards"].append({"file": f"data_{rank}.pkl", "index": idx})
         meta[name] = entry
         payload[name] = shards
+    return meta, payload
 
-    # All files are written tmp+rename (atomic on POSIX): an elastic restart
-    # can SIGKILL a rank mid-save, and the resume contract depends on every
-    # *.pkl in the directory being either the old or the new version — never
-    # torn (concurrent readers during the same round see the same guarantee).
+
+def _write_files(path: str, rank: int, meta: Dict, payload: Dict,
+                 coordinator: bool, world: int = 1) -> None:
+    """The file-I/O half of a save (runs on the background writer when
+    async). Protocol: invalidate the marker, write data -> per-rank
+    manifest -> (coordinator) global metadata -> COMMITTED. All files
+    tmp+fsync+rename (atomic on POSIX): an elastic restart can SIGKILL a
+    rank mid-save and every *.pkl is either the old or the new version —
+    never torn — while the marker tells readers whether the SET of files
+    is a completed save."""
+    os.makedirs(path, exist_ok=True)
+    if coordinator:
+        try:  # a re-save into the same dir is uncommitted until it finishes
+            os.remove(os.path.join(path, manifest.COMMITTED_MARKER))
+        except OSError:
+            pass
+
     def _atomic_dump(obj, fname):
-        tmp = os.path.join(path, f".{fname}.tmp.{os.getpid()}")
-        with open(tmp, "wb") as f:
-            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, os.path.join(path, fname))
+        from ...framework.integrity import atomic_write_bytes
+        atomic_write_bytes(os.path.join(path, fname),
+                           pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
     _atomic_dump(payload, f"data_{rank}.pkl")
     # Multi-host: each rank records its OWN shard index so the global
@@ -105,8 +139,56 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     rank_records = {name: e["shards"] for name, e in meta.items()
                     if e.get("kind") == "array"}
     _atomic_dump(rank_records, f"meta_{rank}.pkl")
-    if rank == coordinator_rank:
+    rank_files = [f"data_{rank}.pkl", f"meta_{rank}.pkl"]
+    if coordinator:
         _atomic_dump(meta, _META)
+        rank_files.append(_META)
+    manifest.write_manifest(path, rank_files, rank=rank)
+    if coordinator:
+        # NOTE: on a true multi-host job the coordinator should barrier
+        # before this so peer ranks' files are on (shared) disk first; the
+        # single-controller TPU path and the CPU simulation are one process
+        # per save call, where this ordering is exact.
+        # "world" SCOPES the commit: a same-dir re-save from fewer ranks
+        # (elastic scale-in) leaves stale higher-rank files behind, and
+        # readers must not union them in (manifest.committed_world)
+        manifest.mark_committed(path, extra={"rank_files": rank_files,
+                                             "world": int(world)})
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    async_save: bool = False):
+    """Write ``state_dict`` (nested dicts of Tensors/arrays/scalars) as a
+    sharded checkpoint directory with per-shard SHA-256 manifests and a
+    commit marker.
+
+    With ``async_save=True`` the device->host snapshot happens NOW (cheap)
+    and all file I/O runs on the shared background writer thread; returns
+    the pending job — overlap it with compute and call :func:`wait` (or
+    ``job.wait()``) before relying on the checkpoint."""
+    rank = jax.process_index()
+    world = jax.process_count()
+    meta, payload = _collect(state_dict, rank)
+    coordinator = rank == coordinator_rank
+    if async_save:
+        return default_writer().submit(
+            lambda: _write_files(path, rank, meta, payload, coordinator,
+                                 world),
+            label=path)
+    _write_files(path, rank, meta, payload, coordinator, world)
+    return None
+
+
+def wait(timeout: Optional[float] = None) -> None:
+    """Drain every pending async checkpoint write; re-raises the first
+    background-writer error (a failed async save must never be silent)."""
+    default_writer().wait_all(timeout)
+
+
+def is_saving() -> bool:
+    """True while an async checkpoint write is still in flight."""
+    return default_writer().busy
 
 
 def _assemble(entry: Dict, files: Dict[str, Dict], name: str) -> np.ndarray:
@@ -128,7 +210,9 @@ def _assemble(entry: Dict, files: Dict[str, Dict], name: str) -> np.ndarray:
                 if filled is not None:
                     filled[sl] = True
     if filled is not None and not filled.all():
-        raise RuntimeError(
+        # CheckpointCorruptionError subclasses RuntimeError, so callers
+        # catching the old type still work
+        raise CheckpointCorruptionError(
             f"checkpoint shard coverage incomplete for {name!r} — missing "
             f"{int((~filled).sum())} elements (corrupt or partial save)")
     return out
@@ -136,21 +220,40 @@ def _assemble(entry: Dict, files: Dict[str, Dict], name: str) -> np.ndarray:
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
-                    offload: bool = False) -> None:
+                    offload: bool = False,
+                    verify: Optional[bool] = None) -> None:
     """Fill ``state_dict``'s tensors IN PLACE from the checkpoint at
     ``path``, resharding each value onto the destination tensor's current
-    sharding (so the target topology may differ from the saving one)."""
+    sharding (so the target topology may differ from the saving one).
+
+    Integrity: committed checkpoints (COMMITTED marker present) are
+    checksum-verified before any unpickling (``verify=None`` follows
+    ``FLAGS_checkpoint_verify``); corruption raises
+    :class:`CheckpointCorruptionError` — use :func:`load_latest` /
+    :meth:`AsyncCheckpointer.restore` to fall back to last-good instead.
+    Marker-less directories (legacy checkpoints, or the same-dir overwrite
+    pattern killed mid-save) load with the old tolerant behavior."""
+    if verify is None:
+        verify = verify_enabled()
+    if verify and manifest.is_committed(path):
+        manifest.verify(path)
+    # scope reads to the committed world: a smaller-world re-save into the
+    # same dir (elastic scale-in) leaves stale higher-rank files behind
+    # that hash-match their stale manifests — they must not be unioned in
+    world = manifest.committed_world(path)
     with open(os.path.join(path, _META), "rb") as f:
         meta = pickle.load(f)
     files: Dict[str, Dict] = {}
     for fname in sorted(os.listdir(path)):
-        if fname.startswith("data_") and fname.endswith(".pkl"):
+        if fname.startswith("data_") and fname.endswith(".pkl") \
+                and manifest.in_committed_world(fname, world):
             with open(os.path.join(path, fname), "rb") as f:
                 files[fname] = pickle.load(f)
     # union per-rank shard records (multi-host saves: the coordinator's
     # metadata only lists its own addressable shards)
     for fname in sorted(os.listdir(path)):
-        if fname.startswith("meta_") and fname.endswith(".pkl"):
+        if fname.startswith("meta_") and fname.endswith(".pkl") \
+                and manifest.in_committed_world(fname, world):
             with open(os.path.join(path, fname), "rb") as f:
                 records = pickle.load(f)
             for name, recs in records.items():
@@ -193,8 +296,38 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             state_dict_set(state_dict, name, new.astype(cur.dtype))
 
 
+def load_latest(state_dict: Dict, root: str) -> Optional[int]:
+    """Restore from the NEWEST committed checkpoint under ``root`` that
+    passes verification, walking back to older committed checkpoints when
+    the newest is corrupt (last-good auto-recovery). Returns the restored
+    step, or None when no loadable checkpoint exists."""
+    do_verify = verify_enabled()
+    for step, path in reversed(list_checkpoints(root)):
+        if not manifest.is_committed(path):
+            continue
+        try:
+            if do_verify:  # FLAGS_checkpoint_verify=False = tolerant (and
+                manifest.verify(path)  # skips the full re-hash cost)
+            load_state_dict(state_dict, path, verify=False)
+            return step
+        except (CheckpointCorruptionError, pickle.UnpicklingError,
+                EOFError) as e:
+            # ONLY corruption-shaped failures trigger the walk-back;
+            # environmental errors (EACCES, device/mesh mismatch, ...)
+            # propagate — silently restarting from scratch on those would
+            # eventually GC the good checkpoints via retention
+            import sys
+            print(f"checkpoint: {path} unusable ({type(e).__name__}: "
+                  f"{e}); falling back to an older committed checkpoint",
+                  file=sys.stderr)
+    return None
+
+
 def state_dict_set(d: Dict, dotted: str, value) -> None:
     keys = dotted.split(".")
     for k in keys[:-1]:
         d = d[k]
     d[keys[-1]] = value
+
+
+from .async_save import AsyncCheckpointer  # noqa: E402  (uses the above)

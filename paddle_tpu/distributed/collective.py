@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..core.jax_compat import shard_map
 
 from ..core.tensor import Tensor, _wrap_value
 from ..ops._helpers import ensure_tensor, forward_op

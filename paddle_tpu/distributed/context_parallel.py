@@ -32,7 +32,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..core.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.tensor import Tensor
@@ -86,7 +88,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     (flash kernel), alltoall back. Requires H % axis_size == 0.
     """
     H = q.shape[2]
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if H % n:
         raise ValueError(f"ulysses_attention: heads {H} not divisible by "
                          f"sep degree {n}")
@@ -101,7 +103,7 @@ def ring_flash_attention(q, k, v, axis_name: str = "sep",
                          causal: bool = False, use_kernels: bool = True):
     """Ring attention over seq-sharded q/k/v [B, S/n, H, D] (inside
     shard_map). O(S/n) memory per rank; K/V travel the ring via ppermute."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = lax.axis_index(axis_name)
     B, L, H, D = q.shape
     perm = [(r, (r + 1) % n) for r in range(n)]

@@ -1,4 +1,4 @@
-"""Elastic training: liveness heartbeats + failure detection.
+"""Elastic training: liveness heartbeats + failure detection + preemption.
 
 Parity target: ``python/paddle/distributed/fleet/elastic/manager.py`` in the
 reference (etcd-backed node heartbeats, watchdog that detects dead/hung
@@ -15,18 +15,31 @@ training script resumes from its own (distributed) checkpoint.
 Worker side is automatic: ``init_parallel_env`` (and thus ``fleet.init``)
 calls :func:`start_heartbeat` when the launcher exported
 ``PADDLE_ELASTIC_STORE``; scripts that skip those can call it directly.
+
+Preemption (docs/FAULT_TOLERANCE.md): the launcher forwards SIGTERM to the
+workers with a bounded grace window; a worker that installed
+:func:`install_preemption_handler` runs an EMERGENCY checkpoint save under a
+deadline and exits, so the next round (or the rescheduled job) resumes from
+a commit at most one step old. MaxText-style goodput engineering: the save
+deadline must sit inside the infrastructure's kill grace.
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
-__all__ = ["start_heartbeat", "stop_heartbeat", "HeartbeatMonitor"]
+__all__ = ["start_heartbeat", "stop_heartbeat", "HeartbeatMonitor",
+           "install_preemption_handler", "uninstall_preemption_handler",
+           "preempted", "EMERGENCY_EXIT_RC"]
 
-_worker = {"thread": None, "stop": None}
+_worker = {"thread": None, "stop": None, "pause": None}
+_worker_lock = threading.Lock()
+
+EMERGENCY_EXIT_RC = 87  # worker exit code after a preemption-triggered save
 
 
 def start_heartbeat(store_addr: Optional[str] = None,
@@ -39,8 +52,9 @@ def start_heartbeat(store_addr: Optional[str] = None,
     GIL, OOM freeze) stops stamping, which is exactly the signal the
     launcher's monitor consumes."""
     addr = store_addr or os.environ.get("PADDLE_ELASTIC_STORE")
-    if not addr or _worker["thread"] is not None:
-        return None
+    with _worker_lock:
+        if not addr or _worker["thread"] is not None:
+            return None
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None \
         else int(rank)
     interval = interval if interval is not None else float(
@@ -60,26 +74,56 @@ def start_heartbeat(store_addr: Optional[str] = None,
         return None
     key = f"hb/{job}/{rank}"
     stop = threading.Event()
+    pause = threading.Event()  # chaos harness: stall stamping past the TTL
 
     def beat():
-        while not stop.is_set():
-            try:
-                store.set(key, f"{time.time():.3f}")
+        try:
+            while not stop.is_set():
+                if not pause.is_set():
+                    try:
+                        store.set(key, f"{time.time():.3f}")
+                    except Exception:
+                        pass  # store may be gone during teardown — no crash
+                stop.wait(interval)
+        finally:
+            try:  # the beat thread owns its socket: close on ANY exit path
+                store.close()
             except Exception:
-                pass  # the store may be gone during teardown — never crash
-            stop.wait(interval)
+                pass
 
     t = threading.Thread(target=beat, daemon=True, name="elastic-heartbeat")
+    with _worker_lock:
+        if _worker["thread"] is not None:  # raced with another caller
+            stop.set()
+            try:
+                store.close()
+            except Exception:
+                pass
+            return _worker["thread"]
+        _worker["thread"], _worker["stop"] = t, stop
+        _worker["pause"] = pause
     t.start()
-    _worker["thread"], _worker["stop"] = t, stop
     return t
 
 
-def stop_heartbeat():
-    if _worker["stop"] is not None:
-        _worker["stop"].set()
-        _worker["thread"] = None
-        _worker["stop"] = None
+def stop_heartbeat(join_timeout: float = 2.0):
+    """Stop the stamping thread. Idempotent (extra calls are no-ops) and
+    JOINS the thread (bounded) so a subsequent :func:`start_heartbeat`
+    cannot race a stale stamp from the dying thread — the beat thread is a
+    daemon, so even a missed join cannot outlive the process."""
+    with _worker_lock:
+        t, stop = _worker["thread"], _worker["stop"]
+        _worker["thread"] = _worker["stop"] = _worker["pause"] = None
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=join_timeout)
+
+
+def _pause_event() -> Optional[threading.Event]:
+    """Internal hook for the chaos harness (stall_heartbeat)."""
+    with _worker_lock:
+        return _worker["pause"]
 
 
 class HeartbeatMonitor:
@@ -118,3 +162,74 @@ class HeartbeatMonitor:
 
     def close(self):
         self.store.close()
+
+
+# ---------------------------------------------------------------------------
+# preemption (SIGTERM) handling — worker side
+# ---------------------------------------------------------------------------
+
+_preempt = {"flag": False, "prev": None, "installed": False}
+
+
+def preempted() -> bool:
+    """True once SIGTERM was observed — train loops poll this per step to
+    break out cleanly when no emergency-save callback was installed."""
+    return _preempt["flag"]
+
+
+def install_preemption_handler(save_fn: Optional[Callable[[], None]] = None,
+                               deadline: Optional[float] = None,
+                               exit_code: Optional[int] = EMERGENCY_EXIT_RC):
+    """Install a SIGTERM handler that runs ``save_fn`` (an emergency
+    checkpoint — e.g. ``lambda: ckpt.save_sync(state, step)``) bounded by
+    ``deadline`` seconds, then exits with ``exit_code``.
+
+    * ``deadline`` defaults to ``PADDLE_PREEMPT_GRACE`` (exported by the
+      launcher) minus a safety margin, else ``FLAGS_emergency_ckpt_deadline_s``.
+    * ``exit_code=None`` = do NOT exit: only set the :func:`preempted` flag
+      and run ``save_fn``; the train loop finishes the step and exits itself.
+
+    The save runs on a helper thread joined with the deadline: a save that
+    cannot commit in time is abandoned (its step dir stays uncommitted and
+    the restore walker ignores it) rather than riding the job into the
+    infrastructure's SIGKILL."""
+    if deadline is None:
+        grace = os.environ.get("PADDLE_PREEMPT_GRACE")
+        if grace is not None:
+            deadline = max(1.0, float(grace) - 2.0)
+        else:
+            try:
+                from ..flags import flag
+                deadline = float(flag("FLAGS_emergency_ckpt_deadline_s"))
+            except Exception:
+                deadline = 10.0
+
+    def _handler(signum, frame):
+        _preempt["flag"] = True
+        if save_fn is not None:
+            t = threading.Thread(target=save_fn, daemon=True,
+                                 name="emergency-ckpt")
+            t.start()
+            t.join(deadline)
+        if exit_code is not None:
+            os._exit(exit_code)
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread — caller must poll preempted()
+        return None
+    if not _preempt["installed"]:
+        _preempt["prev"] = prev
+        _preempt["installed"] = True
+    return _handler
+
+
+def uninstall_preemption_handler():
+    if _preempt["installed"]:
+        try:
+            signal.signal(signal.SIGTERM, _preempt["prev"] or signal.SIG_DFL)
+        except ValueError:
+            pass
+        _preempt["installed"] = False
+    _preempt["flag"] = False
+    _preempt["prev"] = None

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .....core.jax_compat import axis_size
+
 from .....core.tensor import Parameter, Tensor
 from .....nn import functional as F
 from .....nn import initializer as I
@@ -58,7 +60,7 @@ def _local_shard(t, axis: str, full: int, dim: int):
     if t.shape[dim] != full:
         return t  # already a local shard
     def f(v):
-        n = lax.axis_size(axis)
+        n = axis_size(axis)
         per = full // n
         start = lax.axis_index(axis) * per
         return lax.dynamic_slice_in_dim(v, start, per, axis=dim)
@@ -97,7 +99,7 @@ class VocabParallelEmbedding(Layer):
 
             def local_lookup(ids, wv):
                 # wv is this rank's vocab shard [V/n, D]
-                n = lax.axis_size(self.axis)
+                n = axis_size(self.axis)
                 per = self.num_embeddings // n
                 start = lax.axis_index(self.axis) * per
                 local = ids - start
@@ -236,7 +238,7 @@ class ParallelCrossEntropy(Layer):
             axis = self.axis
 
             def local_ce(lg, lb):
-                n = lax.axis_size(axis)
+                n = axis_size(axis)
                 vocab_local = lg.shape[-1]
                 start = lax.axis_index(axis) * vocab_local
                 # stop_gradient on the INPUT: the max shift cancels in the CE

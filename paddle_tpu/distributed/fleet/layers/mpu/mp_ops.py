@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .....core.jax_compat import axis_size
+
 from .....core.tensor import Tensor
 from .....ops._helpers import ensure_tensor, forward_op
 from ....collective import _axis_bound
@@ -110,7 +112,7 @@ _psum_identity_bwd.defvjp(_pib_fwd, _pib_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _split_dim(x, axis, dim):
     """Slice this rank's chunk along ``dim`` / backward all-gather."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     me = lax.axis_index(axis)
     piece = x.shape[dim] // n
     return lax.dynamic_slice_in_dim(x, me * piece, piece, axis=dim)
@@ -138,7 +140,7 @@ def _concat_fwd(x, axis, dim):
 
 
 def _concat_bwd(axis, dim, _, g):
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     me = lax.axis_index(axis)
     piece = g.shape[dim] // n
     return (lax.dynamic_slice_in_dim(g, me * piece, piece, axis=dim),)

@@ -76,6 +76,18 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--elastic_max_nprocs", type=int, default=0,
                    help="upper bound for elastic scale-out (0 = the "
                         "original --nproc_per_node)")
+    p.add_argument("--ckpt_dir", default=None,
+                   help="checkpoint-series root exported to workers as "
+                        "PADDLE_CHECKPOINT_DIR (AsyncCheckpointer's "
+                        "default root). When set, each restart round first "
+                        "prunes torn (uncommitted) step dirs so every "
+                        "resume — even a naive pick-the-newest — lands on "
+                        "the last-known-good commit")
+    p.add_argument("--preempt_grace", type=float, default=15.0,
+                   help="seconds between forwarding SIGTERM to the workers "
+                        "(their emergency-checkpoint window; exported as "
+                        "PADDLE_PREEMPT_GRACE) and SIGKILL, when the "
+                        "LAUNCHER itself is preempted with SIGTERM")
     p.add_argument("--elastic_min_nprocs", type=int, default=0,
                    help="scale-in floor: when > 0, a restart after a crash "
                         "or hang RE-RENDEZVOUSES WITH THE SURVIVING WORLD "
@@ -125,6 +137,10 @@ def _spawn(args, restart_round: int,
         })
         if elastic_store:
             env["PADDLE_ELASTIC_STORE"] = elastic_store
+        if getattr(args, "ckpt_dir", None):
+            env["PADDLE_CHECKPOINT_DIR"] = args.ckpt_dir
+        env["PADDLE_PREEMPT_GRACE"] = str(
+            getattr(args, "preempt_grace", 15.0))
         if args.devices is not None:
             env["TPU_VISIBLE_DEVICES"] = args.devices
         if world > 1 and nproc > 1:
@@ -144,6 +160,20 @@ def _spawn(args, restart_round: int,
 
 HUNG_RC = 98     # job rc when a rank was killed for missing heartbeats
 RESCALE_RC = 97  # internal rc: healthy round interrupted to scale OUT
+PREEMPT_RC = 96  # the launcher was SIGTERMed (preemption): workers were
+#                  given --preempt_grace to emergency-checkpoint, then the
+#                  job exited WITHOUT burning a restart round (the host is
+#                  going away; the rescheduled job resumes from last-good)
+
+# a worker that exits with elastic.EMERGENCY_EXIT_RC ran its preemption
+# handler (the infrastructure SIGTERMed the WORKERS directly, bypassing the
+# launcher): treat it as a preemption, not a crash — restarting on a host
+# being reclaimed would just burn every restart round
+from ..elastic import EMERGENCY_EXIT_RC  # noqa: E402 (lightweight module)
+
+# set by the launcher's SIGTERM handler, polled by the watch loop (a signal
+# can land while _watch is mid-poll; a bare flag is async-signal-safe)
+_preempt_flag = {"v": False}
 
 
 def _kill_all(procs: List[_Proc], grace: float = 10.0,
@@ -179,7 +209,8 @@ def _check_rejoin(path) -> int:
 
 
 def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0,
-           rejoin_file=None, want_more: bool = False) -> int:
+           rejoin_file=None, want_more: bool = False,
+           preempt_grace: float = 15.0) -> int:
     """Wait for all children; on any nonzero exit kill the rest (the
     reference's kill-all-on-one-failure policy). With a heartbeat
     ``monitor``, a rank whose liveness stamp goes stale for ``ttl`` seconds
@@ -188,11 +219,31 @@ def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0,
     try:
         last_hb_check = 0.0
         while True:
+            if _preempt_flag["v"]:
+                # preemption: forward SIGTERM (the workers' emergency-
+                # checkpoint trigger — see elastic.install_preemption_
+                # handler), give them the bounded grace window to commit,
+                # then make sure nothing survives the host going away
+                print(f"launch: SIGTERM received — forwarding to workers "
+                      f"with {preempt_grace}s emergency-checkpoint grace",
+                      file=sys.stderr)
+                _kill_all(procs, grace=preempt_grace)
+                return PREEMPT_RC, []
             alive = 0
             for p in procs:
                 rc = p.popen.poll()
                 if rc is None:
                     alive += 1
+                elif rc == EMERGENCY_EXIT_RC:
+                    # the infrastructure preempted the WORKERS directly:
+                    # this rank already committed its emergency checkpoint
+                    # and exited; give its peers the same grace window
+                    print(f"rank {p.rank} exited after an emergency "
+                          f"checkpoint (preempted); forwarding SIGTERM to "
+                          f"peers with {preempt_grace}s grace",
+                          file=sys.stderr)
+                    _kill_all(procs, grace=preempt_grace)
+                    return PREEMPT_RC, []
                 elif rc != 0:
                     # Collect every rank already dead BEFORE killing peers
                     # (post-kill, terminated peers also report nonzero) so a
@@ -256,10 +307,38 @@ def launch_procs(args) -> int:
     max_nprocs = int(getattr(args, "elastic_max_nprocs", 0) or 0) \
         or args.nproc_per_node
     rejoin_file = getattr(args, "elastic_rejoin_file", None)
+    ckpt_dir = getattr(args, "ckpt_dir", None)
+    preempt_grace = float(getattr(args, "preempt_grace", 15.0) or 15.0)
     cur_nproc = args.nproc_per_node
     rc = 1
+
+    # Preemption watch: SIGTERM to the LAUNCHER (the infrastructure's
+    # eviction notice) must become an emergency-checkpoint window for the
+    # workers, not an instant job kill. Handler only flips a flag; the
+    # watch loop does the forwarding (async-signal-safe).
+    _preempt_flag["v"] = False
+    prev_term = None
+    try:
+        prev_term = signal.signal(
+            signal.SIGTERM, lambda s, f: _preempt_flag.__setitem__("v", True))
+    except ValueError:
+        pass  # not the main thread (embedded use): no preemption watch
     try:
         for attempt in range(rounds):
+            if attempt > 0 and ckpt_dir:
+                # resume-from-last-good contract: physically drop torn
+                # (uncommitted) step dirs before the next round so ANY
+                # resume policy in the script lands on a committed save
+                try:
+                    from ..checkpoint.manifest import prune_uncommitted
+                    removed = prune_uncommitted(ckpt_dir)
+                    if removed:
+                        print(f"elastic: pruned {len(removed)} torn "
+                              f"checkpoint dir(s) under {ckpt_dir}",
+                              file=sys.stderr)
+                except Exception as e:   # pruning is best-effort
+                    print(f"elastic: checkpoint prune skipped ({e})",
+                          file=sys.stderr)
             if monitor is not None:
                 monitor.clear(args.nnodes * cur_nproc)  # stale stamps
             procs = _spawn(args, attempt,
@@ -270,8 +349,14 @@ def launch_procs(args) -> int:
             rc, bad = _watch(procs, monitor=monitor, ttl=ttl,
                              rejoin_file=rejoin_file,
                              want_more=(cur_nproc < max_nprocs
-                                        and attempt < rounds - 1))
+                                        and attempt < rounds - 1),
+                             preempt_grace=preempt_grace)
             if rc == 0 or rc == 130:
+                return rc
+            if rc == PREEMPT_RC:
+                # the host is being reclaimed: no restart round could run
+                # here — the RESCHEDULED job resumes from the emergency
+                # commit (or last-good) in ckpt_dir
                 return rc
             if attempt < rounds - 1:
                 if rc == RESCALE_RC or (rejoin_file and
@@ -307,6 +392,11 @@ def launch_procs(args) -> int:
                 print(f"elastic: restarting job "
                       f"(attempt {attempt + 2}/{rounds})", file=sys.stderr)
     finally:
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
         if monitor is not None:
             monitor.close()
     return rc

@@ -119,3 +119,13 @@ define_flag("FLAGS_allocator_strategy", "auto_growth", "API parity; PJRT owns de
             "memory (ref: auto_growth_best_fit_allocator).", str)
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "API parity; unused on TPU.", float)
 define_flag("FLAGS_log_level", 0, "Framework VLOG level (ref: GLOG_v).", int)
+define_flag("FLAGS_checkpoint_verify", True,
+            "Verify SHA-256 integrity (tier-1 footer, tier-3 shard manifests) "
+            "on paddle.load / distributed checkpoint load; corruption raises "
+            "CheckpointCorruptionError instead of unpickling garbage "
+            "(docs/FAULT_TOLERANCE.md).", bool)
+define_flag("FLAGS_emergency_ckpt_deadline_s", 10.0,
+            "Default deadline (s) for the SIGTERM emergency checkpoint in "
+            "elastic.install_preemption_handler when the launcher's "
+            "PADDLE_PREEMPT_GRACE is not set; must sit inside the "
+            "infrastructure's kill grace.", float)

@@ -1,1 +1,2 @@
-from .io import load, save  # noqa: F401
+from .integrity import CheckpointCorruptionError  # noqa: F401
+from .io import async_save, is_saving, load, save, wait_save  # noqa: F401

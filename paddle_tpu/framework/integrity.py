@@ -1,0 +1,85 @@
+"""Checkpoint integrity primitives shared by every checkpoint tier.
+
+The tier-1 pickle file (``framework/io.py``) and the tier-3 sharded
+directory (``distributed/checkpoint/``) both record SHA-256 digests at save
+time and verify them at load time, so a torn write, a truncated shard, or a
+bit-flip is DETECTED instead of unpickled into garbage (Orbax-style
+integrity; SURVEY §5 robustness stance). Kept dependency-free (no jax, no
+framework imports) so the launcher and the chaos harness can use it without
+pulling a backend into the parent process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["CheckpointCorruptionError", "sha256_bytes", "sha256_file",
+           "atomic_write_bytes", "fsync_dir", "verify_enabled"]
+
+
+def verify_enabled() -> bool:
+    """The single FLAGS_checkpoint_verify lookup shared by every tier
+    (default True when the flag registry is unavailable)."""
+    try:
+        from ..flags import flag
+        return bool(flag("FLAGS_checkpoint_verify"))
+    except Exception:
+        return True
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    truncated shard, or missing commit marker where one is required)."""
+
+
+def sha256_bytes(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename inside it is durable (POSIX requires
+    syncing the parent dir for the new name to survive a crash)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, blob: bytes) -> None:
+    """Write ``blob`` to ``path`` atomically: temp file in the SAME
+    directory, flush + fsync, ``os.replace``, fsync the directory. A crash
+    at any point leaves either the old file or the new one — never a torn
+    mix (the load-bearing fix for non-atomic ``paddle.save``)."""
+    d = os.path.dirname(path) or "."
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d)

@@ -4,10 +4,26 @@ Parity target: ``python/paddle/framework/io.py`` in the reference — pickle con
 with tensors converted to numpy, nested state dicts supported; ``paddle.load``
 returns Tensors again. (Tier 3, sharded distributed checkpoint, lives in
 distributed/checkpoint.py.)
+
+Fault tolerance (docs/FAULT_TOLERANCE.md):
+
+* ``save`` is ATOMIC — temp file in the same directory, flush + fsync,
+  ``os.replace`` — so a crash mid-save never clobbers the previous
+  checkpoint, and it appends a SHA-256 integrity footer (digest + magic
+  trailer; ``pickle.load`` ignores trailing bytes, so files stay readable
+  by plain pickle and pre-footer files stay loadable here).
+* ``load`` verifies the footer (when present and ``FLAGS_checkpoint_verify``
+  is on) and raises :class:`CheckpointCorruptionError` on a truncated or
+  bit-flipped file instead of unpickling garbage.
+* ``async_save`` snapshots device arrays to host SYNCHRONOUSLY (cheap),
+  then pickles + writes on the shared background writer thread —
+  ``wait_save()`` / ``is_saving()`` let a train loop overlap the disk write
+  with compute.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 from typing import Any
@@ -15,10 +31,15 @@ from typing import Any
 import numpy as np
 
 from ..core.tensor import Parameter, Tensor
-
+from .async_writer import default_writer
+from .integrity import CheckpointCorruptionError, verify_enabled
 
 _SENTINEL = "__paddle_tpu_tensor__"
 _PARAM_SENTINEL = "__paddle_tpu_param__"
+
+# integrity footer: <pickle payload><32-byte sha256 digest><8-byte magic>
+_FOOTER_MAGIC = b"PTCKSM1\n"
+_FOOTER_LEN = 32 + len(_FOOTER_MAGIC)
 
 
 def _encode(obj):
@@ -53,12 +74,66 @@ def _decode(obj):
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+class _HashingWriter:
+    """File-object tee: pickle streams through it while the SHA-256 of the
+    payload accumulates — no second full-size buffer for large states."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+
+    def write(self, b):
+        self.sha.update(b)
+        return self._f.write(b)
+
+
+def _dump_atomic(encoded, path: str, protocol: int) -> None:
+    """Stream-pickle into a same-dir temp file (hashing as it goes), append
+    the integrity footer, fsync, os.replace — atomic AND single-copy."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_encode(obj), f, protocol=protocol)
+    from .integrity import fsync_dir
+    tmp = os.path.join(d or ".",
+                       f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            hw = _HashingWriter(f)
+            pickle.dump(encoded, hw, protocol=protocol)
+            f.write(hw.sha.digest() + _FOOTER_MAGIC)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d or ".")
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    _dump_atomic(_encode(obj), path, protocol)
+
+
+def async_save(obj: Any, path: str, protocol: int = 4, **configs):
+    """Snapshot ``obj`` now (device -> host), write it in the background.
+    Returns the pending job; ``wait_save()`` drains all pending writes and
+    re-raises any writer error."""
+    encoded = _encode(obj)  # .numpy() above = the synchronous device read
+    return default_writer().submit(
+        lambda: _dump_atomic(encoded, path, protocol), label=path)
+
+
+def wait_save(timeout=None) -> None:
+    """Block until every pending ``async_save`` landed on disk; re-raises
+    the first background-writer error."""
+    default_writer().wait_all(timeout)
+
+
+def is_saving() -> bool:
+    return default_writer().busy
 
 
 def _decode_numpy(obj):
@@ -72,8 +147,48 @@ def _decode_numpy(obj):
 
 
 def load(path: str, **configs) -> Any:
+    verify = configs.get("verify")
+    if verify is None:
+        verify = verify_enabled()
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
-        data = pickle.load(f)
+        digest = None
+        if size > _FOOTER_LEN:
+            f.seek(size - len(_FOOTER_MAGIC))
+            if f.read(len(_FOOTER_MAGIC)) == _FOOTER_MAGIC:
+                f.seek(size - _FOOTER_LEN)
+                digest = f.read(32)
+        if digest is not None and verify:
+            # stream-hash the payload (everything before the footer): no
+            # whole-file buffer even for multi-GB checkpoints
+            f.seek(0)
+            h = hashlib.sha256()
+            remaining = size - _FOOTER_LEN
+            while remaining > 0:
+                chunk = f.read(min(1 << 20, remaining))
+                if not chunk:
+                    break
+                h.update(chunk)
+                remaining -= len(chunk)
+            if remaining != 0 or h.digest() != digest:
+                raise CheckpointCorruptionError(
+                    f"checkpoint {path!r} failed SHA-256 verification — "
+                    f"the file is truncated or corrupted (expected "
+                    f"{digest.hex()[:16]}..., got {h.hexdigest()[:16]}...)")
+        f.seek(0)
+        try:
+            # pickle streams to the STOP opcode; the footer bytes after it
+            # are simply never read (legacy files have no footer at all)
+            data = pickle.load(f)
+        except Exception as e:
+            # a corrupt pickle stream surfaces as almost any exception type
+            # (UnpicklingError, EOFError, KeyError on a bad opcode arg,
+            # UnicodeDecodeError, MemoryError from a garbage length, ...);
+            # this is the one failure domain of pickle.load here, so wrap
+            # uniformly — callers fall back to last-good on this type
+            raise CheckpointCorruptionError(
+                f"checkpoint {path!r} is unreadable (truncated or "
+                f"corrupted): {type(e).__name__}: {e}") from e
     if configs.get("return_numpy"):
         return _decode_numpy(data)
     return _decode(data)

@@ -245,13 +245,15 @@ class Model:
         return logs
 
     # -- persistence ----------------------------------------------------------
-    def save(self, path: str, training: bool = True):
+    def save(self, path: str, training: bool = True,
+             async_save: bool = False):
         from ..framework import io as fio
         if training:
-            fio.save(self.network.state_dict(), path + ".pdparams")
+            _save = fio.async_save if async_save else fio.save
+            _save(self.network.state_dict(), path + ".pdparams")
             if self._optimizer is not None and hasattr(self._optimizer,
                                                        "state_dict"):
-                fio.save(self._optimizer.state_dict(), path + ".pdopt")
+                _save(self._optimizer.state_dict(), path + ".pdopt")
         else:
             from ..jit import api as jit_api
             jit_api.save(self.network, path, input_spec=self._inputs)

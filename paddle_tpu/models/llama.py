@@ -33,7 +33,9 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..core.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "param_specs",
@@ -282,7 +284,7 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
         # Ulysses attention as an explicit shard_map region inside the
         # compiled program (composes with dp GSPMD; mp must be 1 here)
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ..core.jax_compat import shard_map
         from ..distributed.context_parallel import (ring_flash_attention,
                                                     ulysses_attention)
         from ..distributed.topology import get_hybrid_communicate_group

@@ -1,0 +1,6 @@
+"""Testing utilities — the fault-injection (chaos) harness lives in
+``paddle_tpu.testing.chaos``."""
+
+from . import chaos  # noqa: F401
+
+__all__ = ["chaos"]

@@ -1,0 +1,156 @@
+"""Chaos / fault-injection harness (docs/FAULT_TOLERANCE.md).
+
+Composable injectors that drive the checkpoint + launch + elastic stack
+through the failure modes a production TPU job actually sees, so the
+robustness machinery is EXERCISED, not just written:
+
+=====================  ====================================================
+injector               fault it models
+=====================  ====================================================
+``truncate_file``      a shard cut short by a crash / full disk
+``flip_bits``          silent data corruption (bad DMA, bit rot)
+``fail_nth``           the Nth ``os.rename``/``os.replace``/``write`` in a
+                       region raising (quota, I/O error) — syscall shim
+``async_writer_fault`` an exception inside the background checkpoint
+                       writer thread
+``stall_heartbeat``    an alive-but-frozen worker (stops stamping past the
+                       launcher's TTL without exiting)
+``kill_self``          a rank dying mid-step (preemption without grace,
+                       OOM kill)
+=====================  ====================================================
+
+File injectors are plain functions; process/region injectors are context
+managers and compose by nesting. The chaos test suite
+(``tests/test_chaos.py``) asserts that under every one of these the job
+resumes from a committed checkpoint and converges to the unfaulted loss.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import signal
+from typing import Optional
+
+__all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
+           "stall_heartbeat", "kill_self", "INJECTORS"]
+
+
+def truncate_file(path: str, frac: float = 0.5,
+                  keep_bytes: Optional[int] = None) -> int:
+    """Cut ``path`` short (a crash mid-write / disk-full artifact).
+    Keeps ``keep_bytes`` bytes when given, else ``frac`` of the file.
+    Returns the new size."""
+    size = os.path.getsize(path)
+    keep = keep_bytes if keep_bytes is not None else int(size * frac)
+    keep = max(0, min(size, keep))
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep
+
+
+def flip_bits(path: str, offset: Optional[int] = None, nbits: int = 8,
+              seed: int = 0) -> int:
+    """XOR-corrupt ``nbits`` bits at ``offset`` (random position when None)
+    — silent corruption a checksum must catch. Returns the offset hit."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot bit-flip empty file {path!r}")
+    rng = random.Random(seed)
+    if offset is None:
+        offset = rng.randrange(size)
+    offset = min(offset, size - 1)
+    with open(path, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)[0]
+        mask = 0
+        for _ in range(max(1, nbits)):
+            mask |= 1 << rng.randrange(8)
+        f.seek(offset)
+        f.write(bytes([b ^ (mask or 0x01)]))
+    return offset
+
+
+@contextlib.contextmanager
+def fail_nth(module, name: str, n: int = 1, exc: Optional[BaseException] = None):
+    """Monkeypatched syscall shim: the Nth call (1-based) of
+    ``module.name`` inside the region raises (default ``OSError``). Models
+    quota/EIO failures at exact protocol positions, e.g.::
+
+        with chaos.fail_nth(os, "replace", n=2):
+            save_state_dict(state, path)   # 2nd atomic rename dies
+    """
+    real = getattr(module, name)
+    err = exc if exc is not None else OSError(
+        f"chaos: injected failure on call #{n} of {module.__name__}.{name}")
+    state = {"calls": 0}
+
+    def shim(*args, **kwargs):
+        state["calls"] += 1
+        if state["calls"] == n:
+            raise err
+        return real(*args, **kwargs)
+
+    setattr(module, name, shim)
+    try:
+        yield state
+    finally:
+        setattr(module, name, real)
+
+
+@contextlib.contextmanager
+def async_writer_fault(exc: Optional[BaseException] = None):
+    """Every job the background checkpoint writer picks up inside the
+    region fails with ``exc`` (stored on the job, surfaced by
+    ``wait()``/the next save — the error-propagation contract under
+    test)."""
+    from ..framework import async_writer
+    err = exc if exc is not None else RuntimeError(
+        "chaos: injected async-writer fault")
+    async_writer.set_fault(err)
+    try:
+        yield err
+    finally:
+        async_writer.set_fault(None)
+
+
+class stall_heartbeat:
+    """Freeze the worker's liveness stamping (the thread keeps running but
+    stops SETting) — to the launcher's monitor this process is
+    alive-but-hung, and past ``--elastic_timeout`` it gets killed and the
+    round restarts. Models a native deadlock / swap storm.
+
+    A plain class (NOT a generator contextmanager) on purpose: a stall is
+    often armed fire-and-forget right before the process freezes, and a
+    GC'd generator CM would run its ``finally`` and silently un-pause."""
+
+    def __enter__(self):
+        from ..distributed import elastic
+        self._ev = elastic._pause_event()
+        if self._ev is not None:
+            self._ev.set()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ev is not None:
+            self._ev.clear()
+        return False
+
+
+def kill_self(sig: int = signal.SIGKILL) -> None:
+    """Die mid-step with no cleanup (default SIGKILL: no atexit, no flush
+    — exactly what a preemption without grace or an OOM kill looks like)."""
+    os.kill(os.getpid(), sig)
+
+
+# name -> injector; docs/FAULT_TOLERANCE.md's generated injector count
+# (tools/refresh_docs.py) reads this registry
+INJECTORS = {
+    "truncate_file": truncate_file,
+    "flip_bits": flip_bits,
+    "fail_nth": fail_nth,
+    "async_writer_fault": async_writer_fault,
+    "stall_heartbeat": stall_heartbeat,
+    "kill_self": kill_self,
+}

@@ -60,6 +60,7 @@ def measured_counts() -> dict:
            if isinstance(getattr(lrmod, n, None), type)
            and issubclass(getattr(lrmod, n), base)
            and n != "LRScheduler"]
+    from paddle_tpu.testing.chaos import INJECTORS
     return {
         "ops": total,
         "swept": covered,
@@ -68,6 +69,7 @@ def measured_counts() -> dict:
         "functional": len(fnames),
         "optimizers": len(optimizers),
         "lr_schedulers": len(lrs),
+        "chaos_injectors": len(INJECTORS),
     }
 
 
@@ -126,7 +128,7 @@ def refresh(check: bool = False) -> int:
     counts = measured_counts()
     bench = latest_bench()
     drift = []
-    for rel in ("README.md",):
+    for rel in ("README.md", "docs/FAULT_TOLERANCE.md"):
         path = os.path.join(ROOT, rel)
         src = open(path).read()
 

@@ -141,10 +141,110 @@ class TestShardedRoundTrip:
             pickle.dump({"w": kept_recs}, f)
         with open(ck / "meta_1.pkl", "wb") as f:
             pickle.dump({"w": moved_recs}, f)
+        # the hand-split rewrote manifested files: re-record each rank's
+        # integrity manifest and re-commit, as the two ranks would have
+        from paddle_tpu.distributed.checkpoint import manifest as M
+        M.write_manifest(str(ck), ["data_0.pkl", "meta_0.pkl",
+                                   "metadata.pkl"], rank=0)
+        M.write_manifest(str(ck), ["data_1.pkl", "meta_1.pkl"], rank=1)
+        M.mark_committed(str(ck))
 
         dst = {"w": paddle.to_tensor(np.zeros((16, 4), np.float32))}
         load_state_dict(dst, str(ck))
         np.testing.assert_array_equal(dst["w"].numpy(), w)
+
+    def test_empty_state_dict_roundtrip(self, tmp_path):
+        """Degenerate but legal: a checkpoint of nothing commits and loads."""
+        save_state_dict({}, str(tmp_path / "c9"))
+        from paddle_tpu.distributed.checkpoint import manifest as M
+        assert M.is_committed(str(tmp_path / "c9"))
+        load_state_dict({}, str(tmp_path / "c9"))   # no-op, no raise
+
+    def test_zero_dim_tensor_roundtrip(self, tmp_path):
+        """0-d tensors (step counters, scalars-as-tensors): the shard index
+        is the empty tuple and assembly must handle shape ()."""
+        src = {"step": paddle.to_tensor(np.float32(41.0)),
+               "count": paddle.to_tensor(np.int64(7))}
+        save_state_dict(src, str(tmp_path / "c10"))
+        dst = {"step": paddle.to_tensor(np.float32(0)),
+               "count": paddle.to_tensor(np.int64(0))}
+        load_state_dict(dst, str(tmp_path / "c10"))
+        assert float(dst["step"]) == 41.0
+        assert int(dst["count"]) == 7
+
+    def test_dtype_mixed_roundtrip(self, tmp_path):
+        """bf16 + int8 + fp32 + bool entries in ONE state dict (quantized
+        weights alongside master weights) survive the round-trip with
+        dtypes intact."""
+        src = {
+            "bf16": paddle.to_tensor(jnp.arange(6, dtype=jnp.bfloat16)),
+            "int8": paddle.to_tensor(
+                np.array([-128, 0, 127], np.int8)),
+            "fp32": paddle.to_tensor(np.linspace(0, 1, 5, dtype=np.float32)),
+            "mask": paddle.to_tensor(np.array([True, False, True])),
+        }
+        save_state_dict(src, str(tmp_path / "c11"))
+        dst = {
+            "bf16": paddle.to_tensor(jnp.zeros(6, jnp.bfloat16)),
+            "int8": paddle.to_tensor(np.zeros(3, np.int8)),
+            "fp32": paddle.to_tensor(np.zeros(5, np.float32)),
+            "mask": paddle.to_tensor(np.zeros(3, bool)),
+        }
+        load_state_dict(dst, str(tmp_path / "c11"))
+        assert dst["bf16"]._value.dtype == jnp.bfloat16
+        assert str(dst["int8"]._value.dtype) == "int8"
+        np.testing.assert_array_equal(
+            np.asarray(dst["bf16"]._value, np.float32), np.arange(6))
+        np.testing.assert_array_equal(dst["int8"].numpy(),
+                                      [-128, 0, 127])
+        np.testing.assert_allclose(dst["fp32"].numpy(),
+                                   np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(dst["mask"].numpy(),
+                                      [True, False, True])
+
+    def test_reshard_save_4way_load_2way(self, tmp_path):
+        """Save on an N-way layout, load on an M-way one (N != M, neither
+        replicated): the reshard-on-load contract under an uneven-feeling
+        but divisible topology change."""
+        mesh_a = build_mesh({"sharding": 4}, jax.devices()[:4])
+        w = np.random.randn(8, 6).astype("float32")
+        src = {"w": paddle.to_tensor(jax.device_put(
+            jnp.asarray(w),
+            NamedSharding(mesh_a, P("sharding", None))))}
+        save_state_dict(src, str(tmp_path / "c12"))
+        mesh_b = build_mesh({"sharding": 2}, jax.devices()[:2])
+        dst = {"w": paddle.to_tensor(jax.device_put(
+            jnp.zeros((8, 6), jnp.float32),
+            NamedSharding(mesh_b, P(None, "sharding"))))}
+        load_state_dict(dst, str(tmp_path / "c12"))
+        np.testing.assert_array_equal(dst["w"].numpy(), w)
+        assert tuple(dst["w"]._value.sharding.spec) == (None, "sharding")
+
+    def test_smaller_world_resave_ignores_stale_rank_files(self, tmp_path):
+        """Elastic scale-in re-save into the SAME dir: the old larger-world
+        save's higher-rank files (which still hash-match their stale
+        manifests) must not be unioned into the assembled tensors — the
+        COMMITTED marker scopes the rank set."""
+        import pickle
+        from paddle_tpu.distributed.checkpoint import manifest as M
+        ck = tmp_path / "c13"
+        w_old = np.zeros((8,), np.float32)
+        save_state_dict({"w": paddle.to_tensor(w_old)}, str(ck))
+        # forge the previous 2-rank era: a stale rank-1 shard overwriting
+        # the upper half, with a consistent (hash-matching) manifest
+        stale = {"w": [(((4, 8, 1),), np.full(4, 99.0, np.float32))]}
+        with open(ck / "data_1.pkl", "wb") as f:
+            pickle.dump(stale, f)
+        with open(ck / "meta_1.pkl", "wb") as f:
+            pickle.dump({"w": [{"file": "data_1.pkl",
+                                "index": ((4, 8, 1),)}]}, f)
+        M.write_manifest(str(ck), ["data_1.pkl", "meta_1.pkl"], rank=1)
+        # the NEW commit covers world=1 (what save_state_dict recorded)
+        assert M.committed_world(str(ck)) == 1
+        M.verify(str(ck))     # stale-but-consistent files must not trip it
+        dst = {"w": paddle.to_tensor(np.full((8,), -1.0, np.float32))}
+        load_state_dict(dst, str(ck))
+        np.testing.assert_array_equal(dst["w"].numpy(), w_old)  # not 99s
 
     def test_optimizer_state_roundtrip(self, tmp_path):
         """Full train-state save/load with the flagship model (fsdp->mp)."""

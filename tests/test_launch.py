@@ -117,6 +117,59 @@ class TestElasticDetection:
             os.environ.pop("PADDLE_JOB_ID", None)
             mon.close()
 
+    def test_stop_heartbeat_idempotent_and_joins(self):
+        """Lifecycle contract: stop_heartbeat is idempotent, JOINS the
+        beat thread (no stale stamp can race a restart), and a fresh
+        start_heartbeat afterwards works."""
+        import time
+        from paddle_tpu.distributed import elastic
+        mon = elastic.HeartbeatMonitor("jobLC")
+        try:
+            os.environ["PADDLE_JOB_ID"] = "jobLC"
+            t = elastic.start_heartbeat(store_addr=mon.addr, rank=0,
+                                        interval=0.1)
+            assert t is not None and t.daemon  # cannot outlive the process
+            # idempotent second start: no duplicate beat thread spawned
+            assert elastic.start_heartbeat(store_addr=mon.addr) is None
+            import threading as _th
+            beats = [x for x in _th.enumerate()
+                     if x.name == "elastic-heartbeat"]
+            assert beats == [t], beats
+            time.sleep(0.3)
+            assert mon.last_beat(0) is not None
+            elastic.stop_heartbeat()
+            assert not t.is_alive()            # joined, not just signaled
+            elastic.stop_heartbeat()           # idempotent: no raise
+            elastic.stop_heartbeat()
+            t2 = elastic.start_heartbeat(store_addr=mon.addr, rank=0,
+                                         interval=0.1)
+            assert t2 is not None and t2 is not t
+            time.sleep(0.3)
+            assert mon.last_beat(0) is not None
+        finally:
+            elastic.stop_heartbeat()
+            os.environ.pop("PADDLE_JOB_ID", None)
+            mon.close()
+
+    def test_preemption_handler_flag_and_save_fn(self):
+        """SIGTERM -> preempted() flips and the emergency save_fn runs
+        (exit_code=None: poll-mode, the handler must NOT exit)."""
+        import signal as sig
+        import time
+        from paddle_tpu.distributed import elastic
+        ran = []
+        try:
+            elastic.install_preemption_handler(
+                save_fn=lambda: ran.append(1), deadline=5.0, exit_code=None)
+            assert not elastic.preempted()
+            os.kill(os.getpid(), sig.SIGTERM)
+            time.sleep(0.2)
+            assert elastic.preempted()
+            assert ran == [1]
+        finally:
+            elastic.uninstall_preemption_handler()
+        assert not elastic.preempted()
+
     def test_hung_worker_detected_job_restarts_and_resumes(self, tmp_path):
         """The SURVEY §5 elastic contract end to end: rank 1 FREEZES (not
         crashes) mid-training; the launcher's heartbeat watchdog declares it
@@ -274,7 +327,14 @@ class TestElasticScaleIn:
                         {{"w": paddle.to_tensor(wt.numpy()),
                           "step": paddle.to_tensor(np.float32(step + 1))}},
                         ck)
+                    open(os.path.join(ck, "saved.%d" % (step + 1)),
+                         "w").write("1")
                 if rnd == 0 and rank == 1 and step == 3:
+                    # die only once rank 0 has durably saved step >= 4, so
+                    # the restart provably resumes mid-training (a plain
+                    # step-3 exit races rank 0's save cadence)
+                    while not os.path.exists(os.path.join(ck, "saved.4")):
+                        time.sleep(0.05)
                     os._exit(17)          # rank 1 dies -> scale-in event
                 if rnd == 0:
                     time.sleep(0.2)       # keep rank 0 mid-training so the
