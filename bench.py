@@ -95,7 +95,8 @@ def bench_train(cfg, batch, seq, steps, lr=1e-4):
     opt = init_opt(params)
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    from paddle_tpu.jit.train_step import jit_step
+    jstep = jit_step(step_fn, donate_argnums=(0, 1))
 
     # Timing protocol: the axon PJRT tunnel acks dispatch from
     # block_until_ready before remote completion, so the only reliable sync
@@ -224,34 +225,30 @@ def bench_attention(seq=2048, batch=4, heads=16, head_dim=64, steps=10):
     return results
 
 
-def bench_resnet(batch=32, steps=8, image=224):
-    """ResNet-50 train step through the framework's own eager->to_static
-    path (BASELINE.md ResNet-50 images/sec row)."""
-    import jax
+def bench_resnet(batch=32, steps=8, image=224, nhwc=False):
+    """ResNet-50 train step through the fused donation-aware path
+    (jit.train_step.make_train_step — forward+backward+Momentum update as
+    one donated XLA program). ``nhwc=True`` runs the channels-last layout
+    pass (nn.ChannelsLast) — the TPU-native conv layout; the delta vs the
+    NCHW row is the tracked layout win (BASELINE.md ResNet-50 row)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu import amp
-    from paddle_tpu.jit import to_static
+    from paddle_tpu.jit.train_step import make_train_step
     from paddle_tpu.optimizer import Momentum
     from paddle_tpu.vision.models import resnet50
 
     net = resnet50(num_classes=1000)
+    if nhwc:
+        net = nn.ChannelsLast(net)
     opt = Momentum(learning_rate=0.1, momentum=0.9,
                    parameters=net.parameters())
-    loss_fn = nn.CrossEntropyLoss()
+    # amp=True keeps the bf16 matmul/conv cast of the previous to_static
+    # harness; donation is auto (on for TPU, off for the CPU smoke run)
+    train_step = make_train_step(net, opt, nn.CrossEntropyLoss(), amp=True)
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(rng.standard_normal(
         (batch, 3, image, image)).astype("float32"))
     y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
-
-    @to_static
-    def train_step(x, y):
-        with amp.auto_cast():  # bf16 matmuls/convs
-            loss = loss_fn(net(x), y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
 
     # state-discovery warmup runs EAGERLY (the tape retains every
     # activation — no XLA buffer reuse), so do it on a tiny batch; the
@@ -261,7 +258,7 @@ def bench_resnet(batch=32, steps=8, image=224):
     yw = paddle.to_tensor(rng.integers(0, 1000, (2,)).astype("int64"))
     t0 = time.time()
     float(train_step(xw, yw))  # warmup eager pass (state discovery)
-    compile_s0 = time.time() - t0
+    warm_s = time.time() - t0
     t0 = time.time()
     float(train_step(x, y))  # compile at the timed batch size
     compile_s = time.time() - t0
@@ -273,7 +270,7 @@ def bench_resnet(batch=32, steps=8, image=224):
     per_step = (time.time() - t0) / steps
     assert np.isfinite(final)
     return {"images_per_s": batch / per_step, "step_time_s": per_step,
-            "compile_s": compile_s, "loss": final}
+            "warmup_s": warm_s, "compile_s": compile_s, "loss": final}
 
 
 def bench_bert(batch=32, seq=128, steps=8):
@@ -347,13 +344,11 @@ def bench_sdxl_attention(steps=10):
 
 def bench_detect(batch=8, steps=8, image=320):
     """PP-YOLOE-style detector train step (MobileNetV3-small + FPN +
-    decoupled head + center-assigned loss) through eager->to_static
-    (BASELINE.json configs[2] detection capability target)."""
-    import jax
+    decoupled head + center-assigned loss) through the fused
+    donation-aware path (BASELINE.json configs[2] detection target)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn  # noqa: F401
-    from paddle_tpu import amp
-    from paddle_tpu.jit import to_static
+    from paddle_tpu.jit.train_step import make_train_step
     from paddle_tpu.optimizer import Momentum
     from paddle_tpu.vision.detection import detection_loss, ppyoloe_mbv3
 
@@ -364,15 +359,14 @@ def bench_detect(batch=8, steps=8, image=320):
     pts, strides = det.anchor_points()
     rng = np.random.default_rng(0)
 
-    @to_static
+    step = make_train_step(
+        det, opt,
+        lambda cls, boxes, gt_b, gt_l: detection_loss(
+            cls, boxes, gt_b, gt_l, pts, strides, 80),
+        amp=True)
+
     def train_step(x, gt_b, gt_l):
-        with amp.auto_cast():
-            cls, boxes = det(x)
-        loss = detection_loss(cls, boxes, gt_b, gt_l, pts, strides, 80)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+        return step([x], [gt_b, gt_l])
 
     def mk(b):
         x = paddle.to_tensor(rng.standard_normal(
@@ -425,7 +419,8 @@ def bench_checkpoint(backend, steps=10):
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                       jnp.int32)
-    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    from paddle_tpu.jit.train_step import jit_step
+    jstep = jit_step(step_fn, donate_argnums=(0, 1))
     params, opt, loss = jstep(params, opt, ids, ids)
     float(loss)                          # compile + drain
     for _ in range(2):
@@ -478,6 +473,70 @@ def bench_checkpoint(backend, steps=10):
             "ckpt_mb": round(ckpt_bytes / 2**20, 1)}
 
 
+def bench_input(backend, batch=32, image=224, nbatches=16):
+    """Input-pipeline bench (docs/PERFORMANCE.md): (a) H2D transfer cost
+    per batch (blocking device_put of an imagenet-shaped batch), (b) the
+    overlap won by ``prefetch_to_device`` — serial (transfer, then step)
+    vs pipelined (transfers in flight under the running step) over the
+    same synthetic batches and a fixed device workload. ``overlap_pct`` is
+    the fraction of total H2D time hidden by the pipeline; on CPU (no real
+    transfer, single-buffer fallback) it is ~0 by design."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.io.dataloader import prefetch_to_device
+
+    if backend != "tpu":
+        batch, image, nbatches = 8, 64, 8   # CPU smoke: keep it instant
+    rng = np.random.default_rng(0)
+    batches = [rng.standard_normal((batch, 3, image, image))
+               .astype(np.float32) for _ in range(nbatches)]
+
+    # fixed device workload standing in for a train step (a few chained
+    # matmuls over the flattened batch — enough device time to hide
+    # transfers behind)
+    k = image * image * 3
+    w = jnp.asarray(rng.standard_normal((k, 256)).astype(np.float32))
+
+    def stepfn(x, w):
+        h = x.reshape(x.shape[0], -1) @ w
+        for _ in range(4):
+            h = jnp.tanh(h) @ (w[:256, :256] if w.shape[0] >= 256 else w.T @ w)
+        return h.sum()
+    jstep = jax.jit(stepfn)
+    x0 = jax.device_put(batches[0])
+    float(jstep(x0, w))                      # compile + warm
+
+    # (a) blocking H2D per batch
+    t0 = time.time()
+    for b in batches:
+        jax.block_until_ready(jax.device_put(b))
+    h2d_ms = (time.time() - t0) / nbatches * 1e3
+
+    # (b) serial: transfer then step, one batch at a time
+    t0 = time.time()
+    for b in batches:
+        xb = jax.device_put(b)
+        r = jstep(xb, w)
+    float(r)
+    serial_s = time.time() - t0
+
+    # (c) pipelined: prefetch_to_device keeps transfers in flight
+    t0 = time.time()
+    for tb in prefetch_to_device(batches, size=2):
+        r = jstep(tb._raw, w)
+    float(r)
+    overlap_s = time.time() - t0
+
+    h2d_total = h2d_ms / 1e3 * nbatches
+    hidden = max(0.0, serial_s - overlap_s)
+    overlap_pct = 100.0 * min(hidden / h2d_total, 1.0) if h2d_total else 0.0
+    return {"h2d_ms_per_batch": round(h2d_ms, 3),
+            "serial_s": round(serial_s, 4),
+            "pipelined_s": round(overlap_s, 4),
+            "overlap_pct": round(overlap_pct, 1),
+            "batch": batch, "image": image}
+
+
 def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
     """The memory-tuned LLaMA-ratio point (secondary; the headline keeps the
     reference-parity numerics): remat_policy="save_flash" (flash residuals +
@@ -504,7 +563,8 @@ def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                       jnp.int32)
-    jstep = jax.jit(step_fn, donate_argnums=(0, 1))
+    from paddle_tpu.jit.train_step import jit_step
+    jstep = jit_step(step_fn, donate_argnums=(0, 1))
     params, opt, loss = jstep(params, opt, ids, ids)
     float(loss)
     for _ in range(2):
@@ -739,6 +799,12 @@ _R2_ANCHORS = {
     # restore-verify anchored provisionally until measured on the driver.
     "ckpt_async_overhead_pct": 15.0,   # % step-time overhead bound
     "ckpt_restore_verify_ms": 500.0,   # ms, provisional anchor
+    # perf-layer rows (first recorded this round). resnet_nhwc shares the
+    # NCHW row's r2 anchor on purpose: its vs_baseline directly reads as
+    # the layout win against the 0.523-regressed NCHW number.
+    "resnet_nhwc_throughput": 964.0,   # img/s, anchored to the NCHW row
+    "input_overlap_pct": 50.0,         # % of H2D hidden, provisional
+    "input_h2d_ms_per_batch": 10.0,    # ms, lower is better, provisional
 }
 
 
@@ -773,9 +839,9 @@ def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
 
 def main():
     ap = argparse.ArgumentParser()
-    _SECTIONS = ("llama", "wide", "attn", "resnet", "bert", "sdxl", "decode",
-                 "int8",
-                 "tuned", "detect", "checkpoint", "roofline")
+    _SECTIONS = ("llama", "wide", "attn", "resnet", "resnet_nhwc", "bert",
+                 "sdxl", "decode", "int8",
+                 "tuned", "detect", "checkpoint", "input", "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
@@ -802,9 +868,11 @@ def main():
         "BENCH_CACHE_DIR", os.path.join(os.path.dirname(
             os.path.abspath(__file__)), ".jax_cache"))
     try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # the framework's own wiring (FLAGS_compile_cache_dir ->
+        # jax_compilation_cache_dir; flags.py) — the same path every
+        # training script gets, exercised here so bench catches breakage
+        import paddle_tpu
+        paddle_tpu.set_flags({"FLAGS_compile_cache_dir": cache_dir})
     except Exception as e:  # cache is an optimization, never a hard fail
         print(json.dumps({"compile_cache": f"disabled: {e}"}), file=sys.stderr)
     backend = jax.default_backend()
@@ -830,12 +898,16 @@ def main():
         _warm = len(os.listdir(cache_dir)) > 20
     except OSError:
         _warm = False
-    _est_cost = ({"bert": 90.0, "resnet": 150.0, "wide": 40.0, "attn": 30.0,
+    _est_cost = ({"bert": 90.0, "resnet": 150.0, "resnet_nhwc": 150.0,
+                  "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
-                  "detect": 150.0, "checkpoint": 30.0} if _warm else
-                 {"bert": 280.0, "resnet": 260.0, "wide": 90.0, "attn": 60.0,
+                  "detect": 150.0, "checkpoint": 30.0,
+                  "input": 20.0} if _warm else
+                 {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
+                  "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
-                  "int8": 90.0, "detect": 240.0, "checkpoint": 50.0})
+                  "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
+                  "input": 30.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -945,6 +1017,16 @@ def main():
                   "img/s", dt["images_per_s"] /
                   _R2_ANCHORS["ppyoloe_mbv3_throughput"])
         section("detect", _detect)
+    if want("input"):
+        def _input():
+            r = bench_input(backend)
+            print(json.dumps({"input": r}), file=sys.stderr)
+            _emit("input_h2d_ms_per_batch", r["h2d_ms_per_batch"], "ms",
+                  _R2_ANCHORS["input_h2d_ms_per_batch"] /
+                  max(r["h2d_ms_per_batch"], 1e-3))   # lower is better
+            _emit("input_overlap_pct", r["overlap_pct"], "%",
+                  r["overlap_pct"] / _R2_ANCHORS["input_overlap_pct"])
+        section("input", _input)
     if want("checkpoint"):
         def _ckpt():
             c = bench_checkpoint(backend, steps=args.steps)
@@ -998,12 +1080,25 @@ def main():
         def _resnet():
             rn = bench_resnet(steps=args.steps)
             print(json.dumps({"resnet50_step_s": round(rn["step_time_s"], 4),
+                              "resnet50_warmup_s": round(rn["warmup_s"], 1),
                               "resnet50_compile_s": round(rn["compile_s"], 1),
                               "loss": round(rn["loss"], 3)}), file=sys.stderr)
             v = rn["images_per_s"]
             _emit("resnet50_throughput", round(v), "img/s",
                   v / _R2_ANCHORS["resnet50_throughput"])
         section("resnet", _resnet)
+    if want("resnet_nhwc"):
+        def _resnet_nhwc():
+            rn = bench_resnet(steps=args.steps, nhwc=True)
+            print(json.dumps(
+                {"resnet_nhwc_step_s": round(rn["step_time_s"], 4),
+                 "resnet_nhwc_warmup_s": round(rn["warmup_s"], 1),
+                 "resnet_nhwc_compile_s": round(rn["compile_s"], 1),
+                 "loss": round(rn["loss"], 3)}), file=sys.stderr)
+            v = rn["images_per_s"]
+            _emit("resnet_nhwc_throughput", round(v), "img/s",
+                  v / _R2_ANCHORS["resnet_nhwc_throughput"])
+        section("resnet_nhwc", _resnet_nhwc)
 
     # re-emit the headline LAST: honest LLaMA-ratio config vs the 50% MFU
     # north star (the driver parses the final metric line)

@@ -1,7 +1,9 @@
-"""Train ResNet-18 on synthetic images through the eager->to_static path
-with bf16 AMP and the DataLoader (native shm transport when available).
+"""Train ResNet-18 on synthetic images through the PERF LAYER
+(docs/PERFORMANCE.md): channels-last layout pass + fused donation-aware
+train step + device-prefetched DataLoader, with bf16 AMP.
 
     python examples/train_resnet.py --steps 10
+    python examples/train_resnet.py --steps 10 --nchw   # layout pass off
 """
 
 import argparse
@@ -14,37 +16,30 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--image", type=int, default=64)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--nchw", action="store_true",
+                    help="skip the NHWC layout pass (compare layouts)")
     args = ap.parse_args()
 
-    import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu import amp
     from paddle_tpu.io import DataLoader
-    from paddle_tpu.jit import to_static
+    from paddle_tpu.jit import make_train_step
     from paddle_tpu.optimizer import Momentum
     from paddle_tpu.vision.datasets import FakeImageDataset
     from paddle_tpu.vision.models import resnet18
 
     net = resnet18(num_classes=100)
+    if not args.nchw:
+        net = nn.ChannelsLast(net)  # TPU-native conv layout, NCHW contract
     opt = Momentum(learning_rate=0.1, momentum=0.9,
                    parameters=net.parameters())
-    loss_fn = nn.CrossEntropyLoss()
+    # fwd + loss + bwd + momentum update as ONE donated XLA program; the
+    # DataLoader's buffered reader keeps H2D transfers in flight under it
+    train_step = make_train_step(net, opt, nn.CrossEntropyLoss(), amp=True)
     data = DataLoader(
         FakeImageDataset(args.steps * args.batch * 2,
                          (3, args.image, args.image), 100),
         batch_size=args.batch, num_workers=args.workers,
         use_shared_memory=True)
-    scaler = amp.GradScaler(enable=False)  # bf16 needs no loss scaling
-
-    @to_static
-    def train_step(x, y):
-        with amp.auto_cast():
-            loss = loss_fn(net(x), y)
-        scaler.scale(loss).backward()
-        scaler.step(opt)
-        scaler.update()
-        opt.clear_grad()
-        return loss
 
     t0 = time.time()
     for step, (x, y) in enumerate(data):

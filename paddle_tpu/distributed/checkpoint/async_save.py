@@ -50,10 +50,13 @@ class AsyncCheckpointer:
         error if it failed."""
         self.wait()
         import jax
+
+        from ...profiler import annotate
         from . import _collect, _write_files
         rank = jax.process_index()
         world = jax.process_count()
-        meta, payload = _collect(state_dict, rank)
+        with annotate("ckpt"):  # the blocking device->host snapshot
+            meta, payload = _collect(state_dict, rank)
         path = os.path.join(self.root, manifest.step_dir_name(step))
         coordinator = rank == 0
 

@@ -129,3 +129,40 @@ define_flag("FLAGS_emergency_ckpt_deadline_s", 10.0,
             "elastic.install_preemption_handler when the launcher's "
             "PADDLE_PREEMPT_GRACE is not set; must sit inside the "
             "infrastructure's kill grace.", float)
+
+
+def _wire_compile_cache(path) -> None:
+    """Persistent XLA compilation cache: executables survive process
+    restarts, cutting the multi-second recompile every training script and
+    bench section pays on startup (docs/PERFORMANCE.md). An empty path
+    disables the cache again (jax_compilation_cache_dir=None)."""
+    import jax
+    try:
+        if not path:
+            jax.config.update("jax_compilation_cache_dir", None)
+            return
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        # cache even fast compiles: the win is warm restarts, not dedup of
+        # slow compiles only
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # the cache is an optimization, never a hard failure
+
+
+define_flag("FLAGS_compile_cache_dir", "",
+            "Directory for the persistent XLA compilation cache "
+            "(jax_compilation_cache_dir). Empty = disabled. Settable from "
+            "the environment (FLAGS_compile_cache_dir=...) or at runtime "
+            "via paddle.set_flags (docs/PERFORMANCE.md).", str,
+            on_change=_wire_compile_cache)
+# define_flag applies env overrides without firing on_change — wire the
+# env-provided value now so `FLAGS_compile_cache_dir=... python train.py`
+# works with zero code changes
+_wire_compile_cache(flag("FLAGS_compile_cache_dir"))
+
+define_flag("FLAGS_profile_annotations", False,
+            "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
+            "'ckpt') around the input pipeline, the fused train step, and "
+            "checkpoint writes so XPlane traces attribute host time "
+            "(profiler.annotate; docs/PERFORMANCE.md).", bool)

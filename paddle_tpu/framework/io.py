@@ -121,7 +121,9 @@ def async_save(obj: Any, path: str, protocol: int = 4, **configs):
     """Snapshot ``obj`` now (device -> host), write it in the background.
     Returns the pending job; ``wait_save()`` drains all pending writes and
     re-raises any writer error."""
-    encoded = _encode(obj)  # .numpy() above = the synchronous device read
+    from ..profiler import annotate
+    with annotate("ckpt"):  # the synchronous device->host read
+        encoded = _encode(obj)  # .numpy() above = the device read
     return default_writer().submit(
         lambda: _dump_atomic(encoded, path, protocol), label=path)
 

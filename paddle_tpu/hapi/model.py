@@ -32,12 +32,31 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._jit = False
+        self._amp_level = None
+        self._train_step = None
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, jit: bool = False):
+        """``jit=True`` fuses forward+backward+optimizer-update into one
+        donation-aware XLA program per input signature (jit.train_step) —
+        the fast path for TPU training loops. ``amp_configs`` takes the
+        reference's level string ("O1"/"O2") or a dict with a "level" key;
+        it applies to both the fused and the eager batch paths."""
         self._optimizer = optimizer
         self._loss = loss
+        self._jit = bool(jit)
+        self._train_step = None
+        if amp_configs is None:
+            self._amp_level = None
+        elif isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        else:
+            raise TypeError(f"amp_configs must be a level string or dict, "
+                            f"got {type(amp_configs)}")
         ms = _as_list(metrics)
         for m in ms:
             if not isinstance(m, Metric):
@@ -67,8 +86,26 @@ class Model:
                for t in _as_list(inputs)]
         labs = [t if isinstance(t, Tensor) else to_tensor(t)
                 for t in _as_list(labels)]
-        outputs = self.network(*ins)
-        loss = self._compute_loss(outputs, labs)
+        if self._jit and update and self._loss is not None:
+            # fused donation-aware path: one compiled program per signature
+            if self._train_step is None:
+                from ..jit.train_step import TrainStep
+                self._train_step = TrainStep(
+                    self.network, self._optimizer, self._loss,
+                    amp=self._amp_level is not None,
+                    amp_level=self._amp_level or "O1",
+                    return_outputs=True)
+            loss, outputs = self._train_step(ins, labs)
+            metrics = self._update_metrics(outputs, labs)
+            return ([float(loss)], metrics) if metrics else [float(loss)]
+        import contextlib
+
+        from .. import amp as amp_mod
+        cm = (amp_mod.auto_cast(level=self._amp_level)
+              if self._amp_level else contextlib.nullcontext())
+        with cm:
+            outputs = self.network(*ins)
+            loss = self._compute_loss(outputs, labs)
         loss.backward()
         if update:
             self._optimizer.step()
@@ -115,6 +152,16 @@ class Model:
                               num_workers=num_workers)
         return data  # any iterable of batches
 
+    @staticmethod
+    def _prefetched(loader):
+        """Overlap host batch prep + H2D transfer with the running step.
+        DataLoader already runs its own buffered reader; plain iterables get
+        wrapped in prefetch_to_device (single-buffer passthrough on CPU)."""
+        if isinstance(loader, DataLoader):
+            return loader
+        from ..io.dataloader import prefetch_to_device
+        return prefetch_to_device(loader)
+
     def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, save_freq: int = 1,
@@ -146,7 +193,7 @@ class Model:
                 m.reset()
             logs = {}
             epoch_losses = []
-            for step, batch in enumerate(train_loader):
+            for step, batch in enumerate(self._prefetched(train_loader)):
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 res = self.train_batch(ins, labs)

@@ -9,7 +9,8 @@ from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)
 from .dataloader import (DataLoader, WorkerInfo, default_collate_fn,
-                         default_convert_fn, get_worker_info)
+                         default_convert_fn, get_worker_info,
+                         prefetch_to_device)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -17,5 +18,5 @@ __all__ = [
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "WorkerInfo", "get_worker_info", "default_collate_fn",
-    "default_convert_fn",
+    "default_convert_fn", "prefetch_to_device",
 ]

@@ -3,8 +3,10 @@
 from .api import (InputSpec, StaticFunction, TranslatedLayer, enable_to_static,
                   ignore_module, load, not_to_static, save, to_static)
 from .control_flow import cond, fori_loop, scan, while_loop
+from .train_step import TrainStep, donation_supported, jit_step, make_train_step
 from . import dy2static
 
 __all__ = ["InputSpec", "StaticFunction", "TranslatedLayer", "enable_to_static",
            "ignore_module", "load", "not_to_static", "save", "to_static",
-           "cond", "fori_loop", "scan", "while_loop"]
+           "cond", "fori_loop", "scan", "while_loop",
+           "TrainStep", "make_train_step", "jit_step", "donation_supported"]

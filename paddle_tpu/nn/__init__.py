@@ -33,6 +33,7 @@ from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
 from .layers.transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                                  TransformerDecoderLayer, TransformerEncoder,
                                  TransformerEncoderLayer)
+from .layout import ChannelsLast, to_channels_first, to_channels_last
 
 # paddle.nn.utils
 from . import utils  # noqa: E402

@@ -75,7 +75,11 @@ class _AdaptivePool(Layer):
     def forward(self, x):
         fn = getattr(F, self._fn)
         if self._fn.startswith("adaptive_max"):
-            return fn(x, self.output_size, return_mask=self.return_mask)
+            # data_format is not part of the reference AdaptiveMaxPool API,
+            # but the layout pass (nn/layout.py) sets it on the layer — the
+            # functional accepts it, so it must flow through here too
+            return fn(x, self.output_size, return_mask=self.return_mask,
+                      data_format=self.data_format)
         return fn(x, self.output_size, data_format=self.data_format)
 
 

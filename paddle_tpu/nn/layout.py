@@ -1,0 +1,127 @@
+"""NHWC (channels-last) layout pass for conv nets.
+
+Parity/perf target: TPU convolutions want channels-last — the channel dim
+maps onto the 128-wide lane dimension of the MXU, and XLA inserts transposes
+around every conv when fed NCHW (the reference keeps NCHW because cuDNN
+prefers it; on TPU that default is the wrong one and costs real throughput —
+the ResNet-50 bench row). The pass converts a model to run channels-last
+internally while keeping the user-facing NCHW contract:
+
+* every layout-bearing layer's ``data_format`` attribute is flipped in place
+  (``NCL``→``NLC``, ``NCHW``→``NHWC``, ``NCDHW``→``NDHWC``) — conv/norm
+  weights are NOT permuted: conv weights keep paddle's ``[O, I/groups, *k]``
+  storage layout and the conv functional transposes per ``data_format`` at
+  trace time, where XLA folds the transpose into the executable's weight
+  layout assignment (zero per-step cost, and state_dicts stay
+  NCHW-compatible for checkpoint round-trips);
+* :class:`ChannelsLast` wraps the converted net, transposing 4-D inputs
+  NCHW→NHWC once at the boundary and 4-D outputs back, so callers (and
+  DataLoaders) keep feeding NCHW.
+
+Scope: safe for nets whose cross-layout dataflow is per-channel (conv, norm,
+pooling, activations, elementwise) and whose flattens happen after global
+pooling (spatial 1x1 — identical element order in both layouts): the ResNet/
+VGG-classifier-free/MobileNet families. Nets that reshape spatial maps
+mid-network (detection heads) need their reshapes made layout-aware first.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["ChannelsLast", "to_channels_last", "to_channels_first"]
+
+_TO_LAST = {"NCL": "NLC", "NCHW": "NHWC", "NCDHW": "NDHWC"}
+_TO_FIRST = {v: k for k, v in _TO_LAST.items()}
+# adaptive pools default data_format=None (meaning channels-first); infer
+# the rank from the functional they dispatch to
+_RANK_LAST = {"1d": "NLC", "2d": "NHWC", "3d": "NDHWC"}
+
+
+def _flip(layer: Layer, table) -> int:
+    n = 0
+    for sub in layer.sublayers(include_self=True):
+        df = getattr(sub, "data_format", "missing")
+        if df == "missing":
+            continue
+        if isinstance(df, str) and df in table:
+            sub.data_format = table[df]
+            n += 1
+        elif df is None and table is _TO_LAST:
+            fn = getattr(sub, "_fn", "") or ""
+            for suffix, fmt in _RANK_LAST.items():
+                if fn.endswith(suffix):
+                    sub.data_format = fmt
+                    n += 1
+                    break
+    return n
+
+
+def to_channels_last(layer: Layer) -> Layer:
+    """In-place: flip every layout-bearing sublayer to channels-last.
+    Returns the same layer (conversion count is not exposed — a net with no
+    layout-bearing layers converts to itself)."""
+    _flip(layer, _TO_LAST)
+    return layer
+
+
+def to_channels_first(layer: Layer) -> Layer:
+    """Inverse of :func:`to_channels_last` (undo, e.g. before jit.save of an
+    NCHW inference artifact)."""
+    _flip(layer, _TO_FIRST)
+    return layer
+
+
+def _nhwc(x):
+    from ..ops.manipulation import transpose
+    return transpose(x, [0, 2, 3, 1])
+
+
+def _nchw(x):
+    from ..ops.manipulation import transpose
+    return transpose(x, [0, 3, 1, 2])
+
+
+def _map_spatial(obj: Any, fn):
+    if isinstance(obj, Tensor):
+        return fn(obj) if obj.ndim == 4 else obj
+    if isinstance(obj, dict):
+        return {k: _map_spatial(v, fn) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_spatial(v, fn) for v in obj)
+    return obj
+
+
+class ChannelsLast(Layer):
+    """Boundary wrapper: NCHW in, NCHW out, channels-last inside.
+
+        net = ChannelsLast(resnet50())      # converts in place and wraps
+        loss = loss_fn(net(x_nchw), y)      # convs run NHWC on the MXU
+
+    4-D inputs are transposed to NHWC once per step; 4-D outputs (feature
+    maps from ``feature_only`` backbones) are transposed back — under jit
+    both boundary transposes fuse with their neighbors. Non-4-D outputs
+    (logits) pass through. ``state_dict``/``set_state_dict`` delegate to the
+    wrapped net so checkpoints interchange with the NCHW model.
+    """
+
+    def __init__(self, net: Layer):
+        super().__init__()
+        self.net = to_channels_last(net)
+
+    def forward(self, *inputs):
+        ins = [_map_spatial(x, _nhwc) for x in inputs]
+        return _map_spatial(self.net(*ins), _nchw)
+
+    # checkpoint interchange with the unwrapped NCHW model
+    def state_dict(self, *args, **kwargs):
+        return self.net.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self.net.set_state_dict(state_dict, *args, **kwargs)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict

@@ -160,6 +160,15 @@ class Optimizer:
         self.step()
         return None, [(p, p.grad) for p in self._params()]
 
+    def fuse(self, model, loss_fn, **kwargs):
+        """Optimizer-side spelling of the fused donation-aware train step
+        (jit.train_step.make_train_step): forward + loss + backward + THIS
+        optimizer's update compile into one XLA program with the state
+        (params + accumulators) donated. Returns a callable
+        ``step(inputs, labels) -> loss``."""
+        from ..jit.train_step import TrainStep
+        return TrainStep(model, self, loss_fn, **kwargs)
+
     # -- state dict ----------------------------------------------------------
     def state_dict(self) -> Dict:
         out = {}

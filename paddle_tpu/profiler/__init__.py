@@ -20,7 +20,27 @@ from typing import Callable, Iterable, Optional
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView"]
+           "SummaryView", "annotate"]
+
+
+def annotate(name: str):
+    """Zero-overhead-when-off profiling span, gated by
+    ``FLAGS_profile_annotations``.
+
+    The perf layer (fused train step, prefetch_to_device, async checkpoint)
+    wraps its stages in ``annotate("step")`` / ``annotate("data")`` /
+    ``annotate("h2d")`` / ``annotate("ckpt")`` so an XPlane capture shows
+    where host time goes without any code changes — flip the flag on and
+    trace. Off (the default) this returns a nullcontext and never imports
+    jax.profiler."""
+    from ..flags import flag
+    try:
+        if not flag("FLAGS_profile_annotations"):
+            return contextlib.nullcontext()
+    except KeyError:
+        return contextlib.nullcontext()
+    import jax.profiler
+    return jax.profiler.TraceAnnotation(name)
 
 
 class ProfilerTarget(enum.Enum):

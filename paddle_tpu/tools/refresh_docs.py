@@ -102,13 +102,27 @@ def latest_bench() -> dict:
                 for d in data:
                     if isinstance(d, dict) and "metric" in d:
                         rows[d["metric"]] = d
+            elif isinstance(data, dict) and isinstance(data.get("tail"), str):
+                # driver format: one object whose "tail" holds the bench
+                # stdout (JSON lines) — parse the embedded metric lines
+                for line in data["tail"].splitlines():
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(d, dict) and "metric" in d:
+                        rows[d["metric"]] = d
         except json.JSONDecodeError:
             pass
     return rows
 
 
 # every generated span sits between these markers in the docs
-_GEN = re.compile(r"<!--gen:(?P<key>[a-z_]+)-->(?P<body>.*?)"
+# (digits allowed: bench metric keys like resnet50_throughput / h2d carry them)
+_GEN = re.compile(r"<!--gen:(?P<key>[a-z0-9_]+)-->(?P<body>.*?)"
                   r"<!--/gen-->", re.S)
 
 
@@ -128,7 +142,8 @@ def refresh(check: bool = False) -> int:
     counts = measured_counts()
     bench = latest_bench()
     drift = []
-    for rel in ("README.md", "docs/FAULT_TOLERANCE.md"):
+    for rel in ("README.md", "docs/FAULT_TOLERANCE.md",
+                "docs/PERFORMANCE.md"):
         path = os.path.join(ROOT, rel)
         src = open(path).read()
 

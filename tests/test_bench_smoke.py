@@ -1,0 +1,56 @@
+"""Bench entry-point smoke (ISSUE 2 satellite): `python bench.py --<sec>`
+must import and run one tiny step under JAX_PLATFORMS=cpu, so bench bit-rot
+is caught by tier-1 instead of burning a driver round. Sections chosen for
+CPU cost: llama (the headline path, smoke config compiles in seconds) and
+input (the new pipeline section, sub-second). The heavy conv sections
+(resnet/detect) compile for minutes on CPU and stay driver-only."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(*flags, timeout=420):
+    env = {"JAX_PLATFORMS": "cpu",
+           "PATH": os.environ.get("PATH", "/usr/bin:/bin:/usr/local/bin"),
+           "PYTHONPATH": REPO,
+           "HOME": os.environ.get("HOME", "/tmp"),
+           "BENCH_BUDGET_S": "3600",   # never self-skip in the smoke run
+           "BENCH_CACHE_DIR": os.path.join(REPO, ".jax_cache")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), *flags],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    metrics = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            metrics[d["metric"]] = d
+    return metrics, proc
+
+
+def test_bench_llama_entry_point():
+    """The headline section: one tiny fused+donated train step end to end,
+    final stdout line is the llama_train_mfu re-emit the driver parses."""
+    metrics, proc = _run_bench("--llama", "--steps", "1")
+    assert "llama_train_mfu" in metrics, proc.stdout + proc.stderr
+    last = [l for l in proc.stdout.splitlines() if l.strip()][-1]
+    assert json.loads(last)["metric"] == "llama_train_mfu"
+
+
+def test_bench_input_entry_point():
+    """The input-pipeline section: H2D cost + prefetch overlap rows."""
+    metrics, proc = _run_bench("--input", "--steps", "2")
+    assert "input_h2d_ms_per_batch" in metrics, proc.stdout + proc.stderr
+    assert "input_overlap_pct" in metrics
+    assert metrics["input_h2d_ms_per_batch"]["value"] > 0
+    assert 0.0 <= metrics["input_overlap_pct"]["value"] <= 100.0

@@ -1,0 +1,507 @@
+"""Fused donation-aware train step + NHWC layout pass + device prefetch.
+
+Donation-correctness oracle (the ISSUE 2 acceptance): K fused-DONATED steps
+must equal the undonated path bitwise — donation is a buffer-aliasing
+contract and must never change numerics — and the fused program must match
+the eager tape path to FP-reorder tolerance (XLA fuses across op boundaries,
+so fused-vs-eager is reassociation-tight, not bitwise; same bound the
+existing to_static parity tests use). NHWC: the channels-last model must
+produce NCHW-identical outputs (bitwise in eval on CPU) with an
+interchangeable state_dict.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io.dataloader import prefetch_to_device
+from paddle_tpu.jit.train_step import (TrainStep, donation_supported,
+                                       jit_step, make_train_step)
+from paddle_tpu.nn.layout import (ChannelsLast, to_channels_first,
+                                  to_channels_last)
+from paddle_tpu.optimizer import Adam, Momentum
+
+
+class ConvNet(nn.Layer):
+    """Conv + BN(train-mode running stats) + pool + fc: exercises params,
+    optimizer accumulators AND mutated buffers in one fused program."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, padding=1)
+        self.bn = nn.BatchNorm2D(8)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x):
+        x = self.pool(self.relu(self.bn(self.conv(x))))
+        from paddle_tpu.ops.manipulation import flatten
+        return self.fc(flatten(x, 1))
+
+
+def _twin_nets(seed=0):
+    paddle.seed(seed)
+    a = ConvNet()
+    b = ConvNet()
+    b.set_state_dict(a.state_dict())
+    return a, b
+
+
+def _batches(k=4, batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((batch, 3, 8, 8)).astype("float32"),
+             rng.integers(0, 4, (batch,)).astype("int64")) for _ in range(k)]
+
+
+def _acc_arrays(opt):
+    """Accumulators keyed by (acc_name, param position) — the auto-generated
+    param_N names differ between twin nets, the traversal order doesn't."""
+    order = {p.name: i for i, p in enumerate(opt._params())}
+    return {(a, order[p]): t.numpy() for a, store in
+            opt._accumulators.items() for p, t in store.items()}
+
+
+class TestDonationParity:
+    def test_fp32_fused_matches_eager(self):
+        """K fused steps vs K eager tape steps: same params, same optimizer
+        accumulators, same BN running stats (reassociation-tight)."""
+        n1, n2 = _twin_nets()
+        loss_fn = nn.CrossEntropyLoss()
+        o1 = Momentum(learning_rate=0.1, momentum=0.9,
+                      parameters=n1.parameters())
+        o2 = Momentum(learning_rate=0.1, momentum=0.9,
+                      parameters=n2.parameters())
+        step = make_train_step(n2, o2, loss_fn)
+        for x, y in _batches():
+            n1.train()
+            loss = loss_fn(n1(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            fused = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(loss), float(fused),
+                                   rtol=1e-4, atol=1e-6)
+        s1, s2 = n1.state_dict(), n2.state_dict()
+        for k in s1:
+            np.testing.assert_allclose(s1[k].numpy(), s2[k].numpy(),
+                                       rtol=1e-4, atol=1e-6, err_msg=k)
+        # accumulator name suffixes match (param_N differs per instance, the
+        # ordered traversal doesn't)
+        a1, a2 = _acc_arrays(o1), _acc_arrays(o2)
+        assert len(a1) == len(a2) > 0
+        for (k1, v1), (k2, v2) in zip(sorted(a1.items()), sorted(a2.items())):
+            np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-6,
+                                       err_msg=f"{k1} vs {k2}")
+
+    def test_donated_bitwise_equals_undonated(self):
+        """THE donation invariant: donation must not change a single bit of
+        params or optimizer state, fp32. (On CPU XLA ignores the aliasing —
+        the same program property the TPU run relies on; the strict-warning
+        guard below pins that the CPU path stays silent.)"""
+        n1, n2 = _twin_nets(seed=1)
+        loss_fn = nn.CrossEntropyLoss()
+        o1 = Adam(learning_rate=0.01, parameters=n1.parameters())
+        o2 = Adam(learning_rate=0.01, parameters=n2.parameters())
+        s_undonated = make_train_step(n1, o1, loss_fn, donate=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # donation warning would fail
+            s_donated = make_train_step(n2, o2, loss_fn, donate=True)
+            for x, y in _batches(seed=1):
+                l1 = s_undonated(paddle.to_tensor(x), paddle.to_tensor(y))
+                l2 = s_donated(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert float(l1) == float(l2)
+        s1, s2 = n1.state_dict(), n2.state_dict()
+        for k in s1:
+            assert np.array_equal(s1[k].numpy(), s2[k].numpy()), k
+        for (k1, v1), (k2, v2) in zip(sorted(_acc_arrays(o1).items()),
+                                      sorted(_acc_arrays(o2).items())):
+            assert np.array_equal(v1, v2), (k1, k2)
+
+    def test_amp_bf16_fused_matches_eager(self):
+        """bf16 AMP flavor: fused auto_cast path vs eager auto_cast path
+        (bf16 boundary rounding differs across fusion seams — bounded, not
+        bitwise), plus donated ≡ undonated bitwise under AMP."""
+        from paddle_tpu import amp
+        n1, n2 = _twin_nets(seed=2)
+        loss_fn = nn.CrossEntropyLoss()
+        o1 = Momentum(learning_rate=0.05, momentum=0.9,
+                      parameters=n1.parameters())
+        o2 = Momentum(learning_rate=0.05, momentum=0.9,
+                      parameters=n2.parameters())
+        step = make_train_step(n2, o2, loss_fn, amp=True)
+        for x, y in _batches(k=3, seed=2):
+            n1.train()
+            with amp.auto_cast():
+                loss = loss_fn(n1(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            fused = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(loss), float(fused),
+                                   rtol=1e-3, atol=1e-4)
+        s1, s2 = n1.state_dict(), n2.state_dict()
+        for k in s1:
+            np.testing.assert_allclose(s1[k].numpy(), s2[k].numpy(),
+                                       rtol=5e-3, atol=5e-4, err_msg=k)
+
+    def test_amp_donated_bitwise_equals_undonated(self):
+        n1, n2 = _twin_nets(seed=3)
+        loss_fn = nn.CrossEntropyLoss()
+        o1 = Momentum(learning_rate=0.05, parameters=n1.parameters())
+        o2 = Momentum(learning_rate=0.05, parameters=n2.parameters())
+        s1 = make_train_step(n1, o1, loss_fn, amp=True, donate=False)
+        s2 = make_train_step(n2, o2, loss_fn, amp=True, donate=True)
+        for x, y in _batches(k=3, seed=3):
+            s1(paddle.to_tensor(x), paddle.to_tensor(y))
+            s2(paddle.to_tensor(x), paddle.to_tensor(y))
+        d1, d2 = n1.state_dict(), n2.state_dict()
+        for k in d1:
+            assert np.array_equal(d1[k].numpy(), d2[k].numpy()), k
+
+    def test_state_rebinds_after_donated_step(self):
+        """After a fused step every state Tensor is rebound to the program's
+        output buffer — the pre-step raw arrays are never mutated in place
+        (the rebinding is what keeps framework Tensors valid once the old
+        buffers are donated on TPU)."""
+        paddle.seed(4)
+        net = ConvNet()
+        opt = Momentum(learning_rate=0.1, parameters=net.parameters())
+        step = make_train_step(net, opt, nn.CrossEntropyLoss(), donate=True)
+        batches = _batches(k=3, seed=4)
+        for x, y in batches[:2]:   # warmup eager + compile
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+        before = {k: (t._raw, t.numpy().copy())
+                  for k, t in net.state_dict().items()}
+        x, y = batches[2]
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        for k, t in net.state_dict().items():
+            old_raw, old_np = before[k]
+            assert t._raw is not old_raw, f"{k} not rebound"
+            assert np.isfinite(t.numpy()).all()  # rebound buffer is live
+            # the donated input buffer was CONSUMED by the program (jax
+            # marks it deleted — using it again would be the donation bug
+            # this test guards) or, where the backend skips aliasing, left
+            # bit-identical; the framework must never write through it
+            if not old_raw.is_deleted():
+                np.testing.assert_array_equal(np.asarray(old_raw), old_np)
+
+    def test_backend_auto_donation_off_cpu(self):
+        assert donation_supported("cpu") is False
+        assert donation_supported("tpu") is True
+        step = TrainStep(ConvNet(), Momentum(parameters=[]), lambda o, y: o)
+        import jax
+        assert step.donate == (jax.default_backend() != "cpu")
+
+    def test_scaler_falls_back_to_eager(self):
+        """Dynamic loss scaling branches host-side on isfinite — it cannot
+        live in one compiled program, so an enabled GradScaler routes the
+        step down the eager tape path (and still trains)."""
+        from paddle_tpu.amp import GradScaler
+        paddle.seed(5)
+        net = ConvNet()
+        opt = Momentum(learning_rate=0.1, parameters=net.parameters())
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8)
+        step = make_train_step(net, opt, nn.CrossEntropyLoss(),
+                               scaler=scaler)
+        assert step._sf is None  # eager-only
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                  for x, y in _batches(k=3, seed=5)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_jit_step_functional(self):
+        """jit_step drops donation on CPU (no warning spam) and still runs
+        the pure step."""
+        import jax.numpy as jnp
+
+        def sgd(params, grads):
+            return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                          params, grads)
+        import jax
+        f = jit_step(sgd, donate_argnums=(0,))
+        if not donation_supported():
+            assert f._donate_argnums == ()
+        p = {"w": jnp.ones((4,))}
+        g = {"w": jnp.full((4,), 2.0)}
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = f(p, g)
+        np.testing.assert_allclose(np.asarray(out["w"]), 0.8)
+
+    def test_optimizer_fuse_spelling(self):
+        paddle.seed(6)
+        net = ConvNet()
+        opt = Momentum(learning_rate=0.1, parameters=net.parameters())
+        step = opt.fuse(net, nn.CrossEntropyLoss())
+        assert isinstance(step, TrainStep)
+        x, y = _batches(k=1, seed=6)[0]
+        assert np.isfinite(float(step(paddle.to_tensor(x),
+                                      paddle.to_tensor(y))))
+
+
+class TestNHWCLayout:
+    def _twins(self, factory, seed=7):
+        paddle.seed(seed)
+        m1 = factory()
+        m2 = ChannelsLast(factory())
+        m2.set_state_dict(m1.state_dict())
+        return m1, m2
+
+    def test_resnet_eval_forward_bitwise(self):
+        """Acceptance: channels-last ResNet forward is NCHW-identical (the
+        conv/pool/norm lowerings reduce in the same order on CPU — measured
+        bitwise; atol=0)."""
+        from paddle_tpu.vision.models import resnet18
+        m1, m2 = self._twins(lambda: resnet18(num_classes=10))
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 32, 32)).astype("float32"))
+        m1.eval()
+        m2.eval()
+        np.testing.assert_array_equal(m1(x).numpy(), m2(x).numpy())
+
+    def test_resnet_train_forward_backward_parity(self):
+        """Train mode: BN batch stats + backward through the whole stack.
+        FP reorder amplifies through 18 normalization layers, so the bound
+        is reassociation-tight rather than bitwise (measured ~1e-5 rel)."""
+        from paddle_tpu.vision.models import resnet18
+        m1, m2 = self._twins(lambda: resnet18(num_classes=10), seed=8)
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(
+            rng.standard_normal((4, 3, 32, 32)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 10, (4,)).astype("int64"))
+        loss_fn = nn.CrossEntropyLoss()
+        m1.train()
+        m2.train()
+        o1, o2 = m1(x), m2(x)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        l1, l2 = loss_fn(o1, y), loss_fn(o2, y)
+        l1.backward()
+        l2.backward()
+        g1 = m1.conv1.weight.grad.numpy()
+        g2 = m2.net.conv1.weight.grad.numpy()
+        np.testing.assert_allclose(g1, g2, rtol=1e-2, atol=1e-3 * np.abs(
+            g1).max())
+
+    def test_mobilenet_feature_maps_transposed_back(self):
+        """feature_only backbones return 4-D maps — the wrapper must hand
+        them back NCHW."""
+        from paddle_tpu.vision.models import mobilenet_v3_small
+        m1, m2 = self._twins(
+            lambda: mobilenet_v3_small(feature_only=True), seed=9)
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 3, 64, 64)).astype("float32"))
+        m1.eval()
+        m2.eval()
+        f1, f2 = m1(x), m2(x)
+        assert len(f1) == len(f2) == 3
+        for a, b in zip(f1, f2):
+            assert a.shape == b.shape  # NCHW both
+            np.testing.assert_allclose(a.numpy(), b.numpy(),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_adaptive_max_pool_channels_last(self):
+        """Regression: the layout pass sets data_format on AdaptiveMaxPool
+        layers — their forward must pass it through to the functional (it
+        used to drop it, pooling the wrong axes under ChannelsLast)."""
+        class P(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.pool = nn.AdaptiveMaxPool2D(1)
+
+            def forward(self, x):
+                return self.pool(x)
+
+        m1, m2 = P(), ChannelsLast(P())
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 8, 8)).astype("float32"))
+        a, b = m1(x), m2(x)
+        assert a.shape == b.shape == [2, 3, 1, 1]
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_container_inputs_transposed(self):
+        """Regression: 4-D tensors nested inside list/dict inputs must be
+        transposed at the boundary like top-level ones."""
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 1, bias_attr=False)
+
+            def forward(self, d):
+                return self.conv(d["img"])
+
+        paddle.seed(14)
+        m1 = M()
+        m2 = ChannelsLast(M())
+        m2.set_state_dict(m1.state_dict())
+        rng = np.random.default_rng(5)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 6, 6)).astype("float32"))
+        np.testing.assert_allclose(m1({"img": x}).numpy(),
+                                   m2({"img": x}).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_data_format_flip_and_inverse(self):
+        net = ConvNet()
+        assert net.conv.data_format == "NCHW"
+        to_channels_last(net)
+        assert net.conv.data_format == "NHWC"
+        assert net.bn.data_format == "NHWC"
+        assert net.pool.data_format == "NHWC"  # adaptive pool (None before)
+        to_channels_first(net)
+        assert net.conv.data_format == "NCHW"
+        assert net.bn.data_format == "NCHW"
+
+    def test_state_dict_interchange(self):
+        """ChannelsLast checkpoints round-trip with the NCHW model — keys
+        carry no wrapper prefix and conv weights keep [O, I, kh, kw]."""
+        paddle.seed(10)
+        nchw = ConvNet()
+        wrapped = ChannelsLast(ConvNet())
+        sd = wrapped.state_dict()
+        assert set(sd) == set(nchw.state_dict())
+        assert list(sd["conv.weight"].shape) == [8, 3, 3, 3]
+        nchw.set_state_dict(sd)   # no missing/unexpected warning path
+        wrapped.set_state_dict(nchw.state_dict())
+
+    def test_fused_nhwc_train_step(self):
+        """The bench composition: ChannelsLast net under the fused donated
+        step trains and tracks the NCHW twin's loss."""
+        n1, n2 = _twin_nets(seed=11)
+        wrapped = ChannelsLast(n2)
+        loss_fn = nn.CrossEntropyLoss()
+        o1 = Momentum(learning_rate=0.1, parameters=n1.parameters())
+        o2 = Momentum(learning_rate=0.1, parameters=wrapped.parameters())
+        s1 = make_train_step(n1, o1, loss_fn)
+        s2 = make_train_step(wrapped, o2, loss_fn)
+        for x, y in _batches(k=3, seed=11):
+            l1 = s1(paddle.to_tensor(x), paddle.to_tensor(y))
+            l2 = s2(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestPrefetch:
+    def test_order_and_types(self):
+        rng = np.random.default_rng(0)
+        batches = [rng.standard_normal((2, 3)).astype("float32")
+                   for _ in range(5)]
+        out = list(prefetch_to_device(batches, size=2))
+        assert len(out) == 5
+        for src, got in zip(batches, out):
+            assert isinstance(got, paddle.Tensor)
+            np.testing.assert_array_equal(src, got.numpy())
+
+    def test_nested_batches(self):
+        rng = np.random.default_rng(1)
+        batches = [{"x": rng.standard_normal((2, 2)).astype("float32"),
+                    "y": (rng.integers(0, 5, (2,)).astype("int64"),)}
+                   for _ in range(3)]
+        out = list(prefetch_to_device(batches, size=3))
+        assert len(out) == 3
+        for src, got in zip(batches, out):
+            np.testing.assert_array_equal(src["x"], got["x"].numpy())
+            np.testing.assert_array_equal(src["y"][0], got["y"][0].numpy())
+
+    def test_empty_iterable(self):
+        assert list(prefetch_to_device([], size=4)) == []
+
+    def test_dataloader_buffered_reader_unchanged(self):
+        """DataLoader's buffered reader rides prefetch_to_device — order and
+        content must match the unbuffered path."""
+        from paddle_tpu.io import DataLoader, TensorDataset
+        rng = np.random.default_rng(2)
+        xs = paddle.to_tensor(
+            rng.standard_normal((12, 4)).astype("float32"))
+        ds = TensorDataset([xs])
+        a = [b[0].numpy() for b in DataLoader(ds, batch_size=4,
+                                              use_buffer_reader=True)]
+        b = [b[0].numpy() for b in DataLoader(ds, batch_size=4,
+                                              use_buffer_reader=False)]
+        assert len(a) == len(b) == 3
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_profile_annotations_flag(self):
+        """annotate() is a nullcontext when the flag is off and a real
+        TraceAnnotation when on."""
+        import contextlib
+
+        from paddle_tpu.profiler import annotate
+        assert paddle.get_flags("FLAGS_profile_annotations")[
+            "FLAGS_profile_annotations"] is False
+        assert isinstance(annotate("step"), contextlib.nullcontext)
+        paddle.set_flags({"FLAGS_profile_annotations": True})
+        try:
+            span = annotate("step")
+            assert not isinstance(span, contextlib.nullcontext)
+            with span:   # usable as a context manager
+                pass
+            # spans wrap the prefetch path without breaking it
+            out = list(prefetch_to_device(
+                [np.zeros((2, 2), np.float32)], size=2))
+            assert len(out) == 1
+        finally:
+            paddle.set_flags({"FLAGS_profile_annotations": False})
+
+
+class TestCompileCacheFlag:
+    def test_flag_wires_jax_config(self, tmp_path):
+        import jax
+        d = str(tmp_path / "xla_cache")
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir": d})
+            assert jax.config.jax_compilation_cache_dir == d
+            # empty path DISABLES the cache again (not a silent no-op)
+            paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+            assert jax.config.jax_compilation_cache_dir is None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+
+class TestHapiJit:
+    def test_model_fit_jit_matches_eager(self):
+        """Model.prepare(jit=True): fused path trains through fit() and
+        lands on the same loss trajectory as the eager Model."""
+        from paddle_tpu.io import DataLoader, TensorDataset
+        rng = np.random.default_rng(3)
+        xs = rng.standard_normal((16, 3, 8, 8)).astype("float32")
+        ys = rng.integers(0, 4, (16, 1)).astype("int64")
+
+        def run(jit):
+            paddle.seed(12)
+            net = ConvNet()
+            model = paddle.Model(net)
+            model.prepare(
+                Momentum(learning_rate=0.1, parameters=net.parameters()),
+                nn.CrossEntropyLoss(), jit=jit)
+            ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+            loader = DataLoader(ds, batch_size=4)
+            return model.fit(loader, epochs=2, verbose=0)
+
+        h_eager = run(False)
+        h_jit = run(True)
+        np.testing.assert_allclose(h_eager["loss"], h_jit["loss"],
+                                   rtol=1e-3, atol=1e-4)
+        assert h_jit["loss"][-1] < h_jit["loss"][0]
+
+    def test_train_batch_metrics_with_jit(self):
+        from paddle_tpu.metric import Accuracy
+        paddle.seed(13)
+        net = ConvNet()
+        model = paddle.Model(net)
+        model.prepare(
+            Momentum(learning_rate=0.1, parameters=net.parameters()),
+            nn.CrossEntropyLoss(), metrics=Accuracy(), jit=True)
+        x, y = _batches(k=1, seed=13)[0]
+        res = model.train_batch([x], [y.reshape(-1, 1)])
+        assert isinstance(res, tuple)  # (losses, metrics)
+        assert np.isfinite(res[0][0])
