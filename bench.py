@@ -580,6 +580,111 @@ def bench_tuned(backend, peak, steps=10, batch=8, seq=2048):
     return 100.0 * flops / per_step / 1e12 / peak, per_step
 
 
+def bench_health(backend, peak, steps=10):
+    """Run-health sentinel cost (docs/FAULT_TOLERANCE.md "Runtime
+    anomalies"): the tuned llama row with and without the on-device
+    NaN/Inf detector fused into the donated step
+    (llama.make_train_step(sentinel=True) — the bad-step gate rides
+    inside the AdamW update via _adamw_apply(skip=bad): one fused grad
+    mask + scalar decay/LR selects, plus the packed [loss, bad, ema]
+    health vector; the generic output-side health.guard_step wrapper
+    costs an extra select pass per buffer and is measured by
+    tests/test_health.py instead). Acceptance bound: overhead <= 2%.
+    Also proves containment end to end: one
+    NaN-poisoned step must flag bad=1 AND leave the optimizer state
+    un-advanced (step counter frozen, moments finite)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import health
+    from paddle_tpu.jit.train_step import jit_step
+    from paddle_tpu.models import llama
+
+    cfg, batch, seq = _presets(backend, wide=False)
+    if backend == "tpu":
+        cfg = dataclasses.replace(cfg, remat_policy="save_flash",
+                                  ce_chunks=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, step_fn = llama.make_train_step(cfg, lr=1e-4)
+    opt = init_opt(params)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+
+    def timed(jfn, state, n):
+        """Warmup/drain/timing protocol shared by BOTH rows (any drift
+        between them would skew the overhead_pct the 2% bound rests on).
+        ``state`` is the tuple of leading state args threaded through the
+        step; the trailing output is the loss/health scalar drained for
+        sync."""
+        k = len(state)
+        out = None
+        for _ in range(2):
+            out = jfn(*state, ids, ids)
+            state = out[:k]
+        float(jax.tree_util.tree_leaves(out[-1])[0].ravel()[0])  # drain
+        t0 = time.time()
+        for _ in range(n):
+            out = jfn(*state, ids, ids)
+            state = out[:k]
+        float(jax.tree_util.tree_leaves(out[-1])[0].ravel()[0])
+        return (time.time() - t0) / n, out, state
+
+    it = max(steps, 10)
+    jbase = jit_step(step_fn, donate_argnums=(0, 1))
+    params2 = llama.init_params(cfg, jax.random.PRNGKey(0))
+    _, gstep_fn = llama.make_train_step(cfg, lr=1e-4, sentinel=True)
+    opt2 = init_opt(params2)
+    jguard = jit_step(gstep_fn, donate_argnums=(0, 1, 2))
+
+    # Host-load noise on a busy machine dwarfs the 2% bound, so two
+    # monolithic back-to-back blocks can't measure it — and load spikes
+    # are SHORTER than a block, so pairing adjacent blocks doesn't cancel
+    # them either (a median-of-ratios reads pure noise). Interleave many
+    # small blocks of each variant and take each one's MIN per-step time:
+    # the least-contended block estimates the variant's uncontended cost,
+    # which is the quantity the 2% bound is about.
+    rounds, n = 8, max(2, it // 2)
+    state_b = (params, opt)
+    state_g = (params2, opt2, health.sentinel_init())
+    base_s = guard_s = float("inf")
+    out = None
+    for _ in range(rounds):
+        b, _, state_b = timed(jbase, state_b, n)
+        g, out, state_g = timed(jguard, state_g, n)
+        base_s = min(base_s, b)
+        guard_s = min(guard_s, g)
+    p, o, sent = state_g
+    loss, bad, ema = health.unpack_health(out[-1])
+    assert not bad and np.isfinite(loss), (loss, bad)
+    overhead_pct = 100.0 * (guard_s - base_s) / base_s
+
+    # containment proof: a NaN-poisoned step must be flagged bad AND
+    # gated — the AdamW step counter must not advance and the moments
+    # must stay finite (an applied NaN update would poison both). The ids
+    # are ints and can't carry NaN, so the poison rides the params (chaos
+    # nan_payload's fault model applied to the weight buffers). Counter
+    # read happens BEFORE the call: the call donates o's buffers.
+    step_before = int(o["step"])
+    p2, o2, sent2, h2 = jguard(
+        jax.tree_util.tree_map(lambda a: (a * jnp.float32(np.nan)).astype(
+            a.dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, p),
+        o, sent, ids, ids)
+    _, bad2, _ = health.unpack_health(h2)
+    moments_finite = all(
+        bool(jnp.isfinite(a).all())
+        for tree in (o2["m"], o2["v"])
+        for a in jax.tree_util.tree_leaves(tree))
+    contained = int(o2["step"]) == step_before and moments_finite
+    return {"base_step_s": round(base_s, 4),
+            "sentinel_step_s": round(guard_s, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "nan_step_flagged": bool(bad2),
+            "nan_step_contained": contained,
+            "loss": round(loss, 3)}
+
+
 def bench_roofline(backend, steps=10):
     """Phase-isolated timing of the HEADLINE config's train step (r3 VERDICT
     #3): each term measured as its own in-graph loop (same _loop_timed
@@ -805,6 +910,11 @@ _R2_ANCHORS = {
     "resnet_nhwc_throughput": 964.0,   # img/s, anchored to the NCHW row
     "input_overlap_pct": 50.0,         # % of H2D hidden, provisional
     "input_h2d_ms_per_batch": 10.0,    # ms, lower is better, provisional
+    # run-health sentinel row (first recorded this round; lower is
+    # better). The anchor IS the acceptance bound from the robustness
+    # issue: <= 2% step overhead for the fused NaN/Inf/spike detector on
+    # the tuned llama row.
+    "health_sentinel_overhead_pct": 2.0,
 }
 
 
@@ -841,7 +951,8 @@ def main():
     ap = argparse.ArgumentParser()
     _SECTIONS = ("llama", "wide", "attn", "resnet", "resnet_nhwc", "bert",
                  "sdxl", "decode", "int8",
-                 "tuned", "detect", "checkpoint", "input", "roofline")
+                 "tuned", "detect", "checkpoint", "input", "health",
+                 "roofline")
     for sec in _SECTIONS:
         ap.add_argument(f"--{sec}", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
@@ -902,12 +1013,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0} if _warm else
+                  "input": 20.0, "health": 45.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0})
+                  "input": 30.0, "health": 90.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -1040,6 +1151,18 @@ def main():
             _emit("ckpt_restore_verify_ms", r, "ms",
                   _R2_ANCHORS["ckpt_restore_verify_ms"] / max(r, 1.0))
         section("checkpoint", _ckpt)
+    if want("health"):
+        def _health():
+            h = bench_health(backend, peak, steps=args.steps)
+            print(json.dumps({"health": h}), file=sys.stderr)
+            # LOWER is better; the anchor is the 2% acceptance bound.
+            # Clamp: overhead can measure ~0 (or negative, timing noise)
+            # and the ratio must not explode.
+            v = h["overhead_pct"]
+            _emit("health_sentinel_overhead_pct", v, "%",
+                  _R2_ANCHORS["health_sentinel_overhead_pct"] /
+                  max(v, 0.25))
+        section("health", _health)
     if "roofline" in chosen:   # explicit-only: a diagnostic, not a metric
         def _roof():
             r = bench_roofline(backend, steps=args.steps)

@@ -33,7 +33,7 @@ _LAZY_SUBMODULES = (
     "hapi", "device", "profiler", "static", "autograd", "framework", "linalg",
     "fft", "sparse", "distribution", "incubate", "text", "audio", "callbacks",
     "kernels", "regularizer", "utils", "version", "inference", "native",
-    "models", "signal", "geometric", "testing",
+    "models", "signal", "geometric", "testing", "health",
 )
 
 

@@ -54,19 +54,25 @@ class GradScaler:
 
     @autograd.no_grad()
     def unscale_(self, optimizer):
-        """Divide grads by the scale; record found_inf (ref: _unscale)."""
+        """Divide grads by the scale; record found_inf (ref: _unscale).
+
+        The finiteness checks stay ON DEVICE (one ``isfinite().all()``
+        scalar per grad, reduced with a single ``all``); only the final
+        verdict crosses to the host — ONE device->host fetch per unscale
+        instead of one blocking fetch per parameter, which serialized the
+        async dispatch queue N times per step on TPU."""
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
+        finite_flags = []
         for p in optimizer._params():
             if p.grad is None:
                 continue
             g = p.grad._value.astype(jnp.float32) * inv
-            if not bool(jnp.isfinite(g).all()):
-                found = True
+            finite_flags.append(jnp.isfinite(g).all())
             p.grad._value = g.astype(p.grad._value.dtype)
-        self._found_inf = found
+        self._found_inf = bool(finite_flags) and not bool(
+            jnp.stack(finite_flags).all())   # the single scalar fetch
         self._unscaled = True
 
     def step(self, optimizer):

@@ -8,7 +8,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau"]
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau",
+           "AnomalyMonitor"]
 
 
 class Callback:
@@ -195,11 +196,22 @@ class EarlyStopping(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
-        cur = logs.get(self.monitor) or logs.get(f"eval_{self.monitor}")
+        # explicit None check: `or` would misroute a metric of exactly 0.0
+        # (falsy) to the eval_ fallback
+        cur = logs.get(self.monitor)
+        if cur is None:
+            cur = logs.get(f"eval_{self.monitor}")
         if cur is None:
             return
+        cur = float(cur)
         ref = self.best if self.best is not None else self.baseline
-        if ref is None or self._better(cur, ref):
+        # A NaN metric is NEVER an improvement: NaN comparisons are all
+        # False, so an unguarded `ref is None` (first epoch) would adopt
+        # NaN as `best` — which then can never be beaten — while a NaN
+        # `cur` against a finite ref silently counts as a plateau epoch
+        # with no hint the run diverged. Count it as no-improvement
+        # explicitly so patience runs out on a NaN'd run.
+        if not np.isnan(cur) and (ref is None or self._better(cur, ref)):
             self.best = cur
             self.wait = 0
             return
@@ -209,6 +221,160 @@ class EarlyStopping(Callback):
             if self.verbose:
                 print(f"EarlyStopping: no {self.monitor} improvement for "
                       f"{self.wait} epochs; stopping")
+
+
+class AnomalyMonitor(Callback):
+    """hapi surface of the run-health subsystem (paddle_tpu.health).
+
+    Watches the per-batch loss through the HealthMonitor escalation
+    ladder: isolated bad steps are logged (and — when the model was
+    prepared with ``jit=True, sentinel=True`` — already SKIPPED on device
+    by the fused sentinel before this callback sees them);
+    ``skip_threshold`` consecutive bad steps roll the model + optimizer
+    back to the last-good snapshot (in-memory host copy, refreshed every
+    ``snapshot_freq`` good batches) with an optional LR backoff;
+    ``max_restores`` exhausted raises :class:`health.HealthAbortError`
+    with a diagnosis instead of finishing a diverged fit.
+
+    Thresholds default to the ``FLAGS_health_*`` flags. For sharded /
+    large models pass an ``AsyncCheckpointer``-backed HealthMonitor to the
+    train loop directly instead of the in-memory snapshot.
+
+    Cost note: a snapshot is a full device->host copy of the model +
+    optimizer state, so ``snapshot_freq`` trades rollback staleness
+    against per-step overhead — the default refreshes every 25 good
+    batches (a rollback then replays at most 25 steps); set it to 1 only
+    for small models.
+    """
+
+    def __init__(self, skip_threshold=None, max_restores=None,
+                 lr_backoff=None, spike_factor=None, snapshot_freq: int = 25,
+                 verbose: int = 1):
+        super().__init__()
+        self._kw = dict(skip_threshold=skip_threshold,
+                        max_restores=max_restores, lr_backoff=lr_backoff,
+                        spike_factor=spike_factor)
+        self.snapshot_freq = max(1, int(snapshot_freq))
+        self.verbose = verbose
+        self.monitor = None
+        self._snap = None
+        self._pending = None
+        self._base_lr = None
+        self._good_since_snap = 0
+
+    # -- snapshot / rollback -------------------------------------------------
+    def _state_pair(self):
+        net = self.model.network
+        opt = getattr(self.model, "_optimizer", None)
+        return net, opt
+
+    def _capture(self):
+        net, opt = self._state_pair()
+
+        def host_copy(sd):
+            out = {}
+            for k, v in sd.items():
+                out[k] = (np.array(v.numpy(), copy=True)
+                          if hasattr(v, "numpy") else v)
+            return out
+
+        return {
+            "net": host_copy(net.state_dict()),
+            "opt": (host_copy(opt.state_dict())
+                    if opt is not None and hasattr(opt, "state_dict")
+                    else None),
+        }
+
+    def _rollback(self):
+        net, opt = self._state_pair()
+        net.set_state_dict(self._snap["net"])
+        if self._snap["opt"] is not None:
+            opt.set_state_dict(self._snap["opt"])
+        if (self._base_lr is not None and self.monitor.lr_backoff != 1.0
+                and hasattr(opt, "set_lr")):
+            # backoff from the PRE-training base LR: monitor.lr_scale is
+            # already cumulative (backoff ** restores) — multiplying a
+            # snapshot LR that itself carries earlier backoffs would
+            # compound quadratically
+            try:
+                opt.set_lr(self._base_lr * self.monitor.lr_scale)
+            except RuntimeError:
+                # an LRScheduler owns the LR (set_lr refuses); a crash
+                # here would abort the fit mid-recovery — roll back
+                # without the backoff and say so once
+                if not getattr(self, "_warned_sched_lr", False):
+                    self._warned_sched_lr = True
+                    import warnings
+                    warnings.warn(
+                        "AnomalyMonitor: lr_backoff has no effect when the "
+                        "optimizer uses an LRScheduler (the scheduler owns "
+                        "the LR); rolling back without it")
+        # the fused sentinel's loss EMA references the pre-divergence run;
+        # against rolled-back (older) weights it would flag legitimate
+        # higher losses as spikes — reseed it with the weights
+        ts = getattr(self.model, "_train_step", None)
+        sent = getattr(ts, "sentinel", None)
+        if sent is not None:
+            sent.reset()
+
+    # -- callback hooks ------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        from ..health import HealthMonitor
+        self.monitor = HealthMonitor(verbose=bool(self.verbose), **self._kw)
+        opt = getattr(self.model, "_optimizer", None)
+        self._base_lr = (opt.get_lr() if opt is not None
+                         and hasattr(opt, "get_lr") else None)
+        # seed the last-good snapshot from the PRE-training state: this
+        # hook runs before any update, so even a run whose very first
+        # batch diverges rolls back to sane (initial) weights — seeding
+        # lazily from a post-update batch could capture poisoned state
+        self._snap = self._capture()
+        self._pending = None
+        self._good_since_snap = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        # CERTIFIED snapshots only: batch N's loss is computed before
+        # update N, so a finite loss certifies the state at batch BEGIN,
+        # not the post-update state — capture the candidate here and
+        # promote it once this batch's loss comes back good (a snapshot
+        # taken after an exploding update would itself be poisoned)
+        if self.monitor is None:
+            return
+        if self._good_since_snap >= self.snapshot_freq:
+            self._pending = self._capture()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..health import HealthAction
+        logs = logs or {}
+        loss = logs.get("loss")
+        if loss is None:
+            return
+        rec = self.monitor.observe(step, float(loss))
+        if rec.action is HealthAction.OK:
+            if self._pending is not None:
+                self._snap = self._pending
+                self._pending = None
+                self._good_since_snap = 0
+            else:
+                self._good_since_snap += 1
+            return
+        self._pending = None   # uncertified candidate: discard
+        if rec.action is HealthAction.RESTORE:
+            from ..health import HealthAbortError
+            try:
+                self.monitor.restore()   # raises past max_restores
+            except HealthAbortError:
+                # terminal — but leave the model on last-good weights,
+                # not the poisoned ones, so it can be inspected/saved
+                self._rollback()
+                raise
+            self._rollback()
+            self._good_since_snap = 0
+            if self.verbose:
+                print(f"AnomalyMonitor: rolled back to last-good snapshot "
+                      f"(restore {self.monitor.restores}/"
+                      f"{self.monitor.max_restores}, "
+                      f"lr_scale={self.monitor.lr_scale:.3g})")
 
 
 class LRScheduler(Callback):
@@ -256,15 +422,22 @@ class ReduceLROnPlateau(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
-        cur = logs.get(self.monitor) or logs.get(f"eval_{self.monitor}")
+        cur = logs.get(self.monitor)
+        if cur is None:   # not `or`: a metric of exactly 0.0 is falsy
+            cur = logs.get(f"eval_{self.monitor}")
         if cur is None:
             return
+        cur = float(cur)
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.wait = 0
-        better = (self.best is None or
-                  (cur < self.best - self.min_delta if self.mode == "min"
-                   else cur > self.best + self.min_delta))
+        # same NaN audit as EarlyStopping: a NaN metric must count as "no
+        # improvement" (and never become `best`), not slip through the
+        # first-epoch `best is None` arm
+        better = not np.isnan(cur) and (
+            self.best is None or
+            (cur < self.best - self.min_delta if self.mode == "min"
+             else cur > self.best + self.min_delta))
         if better:
             self.best = cur
             self.wait = 0

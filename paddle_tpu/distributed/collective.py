@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.jax_compat import shard_map
 
 from ..core.tensor import Tensor, _wrap_value
+from ..health import watchdog
 from ..ops._helpers import ensure_tensor, forward_op
 from .topology import ParallelAxis, get_hybrid_communicate_group
 
@@ -172,16 +173,21 @@ def _per_rank(value, axis: ParallelAxis):
 def _run_collective(op: str, t, group, extra=None, differentiable=True):
     t = ensure_tensor(t)
     axis = _resolve_axis(group)
-    if _axis_bound(axis.name):
-        # in-graph path: emit the raw collective on the bound axis
-        return forward_op(op, lambda x: _ingraph(op, x, axis.name, extra), [t],
-                          differentiable=differentiable)
-    fn = _compiled_collective(op, axis.mesh, axis.name, None, None, extra)
+    # a rank frozen here is the classic alive-but-hung failure: the section
+    # marker lets the hang watchdog's diagnosis name the collective (and
+    # the heartbeat watchdog name the rank) instead of reporting a generic
+    # stall (health.watchdog; no-op unless a watchdog is installed)
+    with watchdog.section(f"collective:{op}"):
+        if _axis_bound(axis.name):
+            # in-graph path: emit the raw collective on the bound axis
+            return forward_op(op, lambda x: _ingraph(op, x, axis.name, extra),
+                              [t], differentiable=differentiable)
+        fn = _compiled_collective(op, axis.mesh, axis.name, None, None, extra)
 
-    def impl(x):
-        return fn(_per_rank(x, axis))
+        def impl(x):
+            return fn(_per_rank(x, axis))
 
-    return forward_op(op, impl, [t], differentiable=differentiable)
+        return forward_op(op, impl, [t], differentiable=differentiable)
 
 
 def _ingraph(op, x, axis, extra):
@@ -293,5 +299,6 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
 
 def barrier(group=None):
     """Device-level barrier: block until all pending device work completes."""
-    jnp.zeros(()).block_until_ready()
+    with watchdog.section("collective:barrier"):
+        jnp.zeros(()).block_until_ready()
     return None

@@ -33,8 +33,8 @@ import time
 from typing import Callable, Optional
 
 __all__ = ["start_heartbeat", "stop_heartbeat", "HeartbeatMonitor",
-           "install_preemption_handler", "uninstall_preemption_handler",
-           "preempted", "EMERGENCY_EXIT_RC"]
+           "RankWatchdog", "install_preemption_handler",
+           "uninstall_preemption_handler", "preempted", "EMERGENCY_EXIT_RC"]
 
 _worker = {"thread": None, "stop": None, "pause": None}
 _worker_lock = threading.Lock()
@@ -160,8 +160,81 @@ class HeartbeatMonitor:
         for r in range(world_size):
             self.store.delete_key(f"hb/{self.job}/{r}")
 
+    def start_watchdog(self, ranks, ttl: float,
+                       on_hang: Optional[Callable] = None,
+                       poll: float = 0.5) -> "RankWatchdog":
+        """Grow a watchdog THREAD over :meth:`hung_ranks`: detect
+        alive-but-frozen ranks (a worker stuck in a collective stops
+        stamping but never exits) and fail fast with WHICH rank hung
+        instead of letting the job — or a test suite waiting on it —
+        hang until an external timeout.
+
+        The thread polls every ``poll`` seconds; on the first stale stamp
+        it records the hung ranks, fires ``on_hang(hung_ranks)`` (default:
+        print the diagnosis to stderr), sets the handle's event, and
+        stands down. Consumers either install a callback (the launcher's
+        kill-and-restart path) or poll/``wait()`` the returned
+        :class:`RankWatchdog` — ``wait()`` raises with the rank list, so
+        a suite blocked on a frozen job gets a diagnosis, not a hang."""
+        return RankWatchdog(self, list(ranks), float(ttl), on_hang,
+                            float(poll))
+
     def close(self):
         self.store.close()
+
+
+class RankWatchdog:
+    """Handle for :meth:`HeartbeatMonitor.start_watchdog`: ``.hung`` (the
+    rank list, once detected), ``.event`` (set on detection), ``wait()``
+    (raises ``TimeoutError`` naming the ranks), ``.stop()``."""
+
+    def __init__(self, monitor: "HeartbeatMonitor", ranks, ttl: float,
+                 on_hang: Optional[Callable], poll: float):
+        self.monitor = monitor
+        self.ranks = ranks
+        self.ttl = ttl
+        self.on_hang = on_hang
+        self.hung = []
+        self.event = threading.Event()
+        self._stop = threading.Event()
+        self._poll = max(0.05, poll)
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="rank-watchdog")
+        self._thread.start()
+
+    def _watch(self):
+        while not self._stop.wait(self._poll):
+            try:
+                hung = self.monitor.hung_ranks(self.ranks, self.ttl)
+            except Exception:
+                continue   # store teardown race — never crash the watcher
+            if hung:
+                self.hung = hung
+                if self.on_hang is not None:
+                    self.on_hang(hung)
+                else:
+                    import sys
+                    print(f"[health] rank watchdog: rank(s) {hung} "
+                          f"alive-but-frozen (no heartbeat for "
+                          f">{self.ttl}s)", file=sys.stderr)
+                self.event.set()
+                return
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until a hang is detected (True) or ``timeout`` elapses
+        (False is never returned silently for a detected hang — a
+        detection raises ``TimeoutError`` naming the frozen ranks)."""
+        if self.event.wait(timeout):
+            raise TimeoutError(
+                f"rank(s) {self.hung} hung: alive but not stamping "
+                f"heartbeats for >{self.ttl}s (frozen in a collective, "
+                f"native deadlock, or swap storm)")
+        return False
+
+    def stop(self, join_timeout: float = 2.0):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
 
 
 # ---------------------------------------------------------------------------

@@ -54,11 +54,21 @@ def define_flag(name: str, default: Any, help: str = "", type: Optional[type] = 
         _registry[name] = defn
 
 
-def flag(name: str) -> Any:
-    """Fast read of a single flag value."""
+_MISSING = object()
+
+
+def flag(name: str, default: Any = _MISSING) -> Any:
+    """Fast read of a single flag value. With ``default``, an unknown
+    flag returns it instead of raising (lets early-import callers read
+    flags without a try/except per site)."""
     if not name.startswith("FLAGS_"):
         name = "FLAGS_" + name
-    return _registry[name].value
+    d = _registry.get(name)
+    if d is None:
+        if default is not _MISSING:
+            return default
+        raise KeyError(name)
+    return d.value
 
 
 def get_flags(names=None) -> Dict[str, Any]:
@@ -160,6 +170,50 @@ define_flag("FLAGS_compile_cache_dir", "",
 # env-provided value now so `FLAGS_compile_cache_dir=... python train.py`
 # works with zero code changes
 _wire_compile_cache(flag("FLAGS_compile_cache_dir"))
+
+# ---------------------------------------------------------------------------
+# Run-health sentinel / recovery (paddle_tpu.health; docs/FAULT_TOLERANCE.md
+# "Runtime anomalies"). The FLAGS_health_ prefix is the generated-docs key.
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_health_sentinel", False,
+            "Default for TrainStep/Model.prepare's sentinel knob: fuse the "
+            "on-device NaN/Inf/loss-spike detector into the train step and "
+            "skip bad updates (jnp.where-gated; overhead tracked by bench "
+            "--health as health_sentinel_overhead_pct).", bool)
+define_flag("FLAGS_health_spike_factor", 0.0,
+            "Loss-spike threshold: a step is bad when loss > factor * |EMA| "
+            "(after FLAGS_health_spike_warmup good steps). 0 disables the "
+            "spike test; NaN/Inf detection is always on when the sentinel "
+            "is.", float)
+define_flag("FLAGS_health_spike_warmup", 20,
+            "Good steps required to seed the loss EMA before the spike test "
+            "arms (early-training loss is legitimately volatile).", int)
+define_flag("FLAGS_health_skip_threshold", 3,
+            "K: consecutive bad steps before HealthMonitor escalates from "
+            "skip to a last-good checkpoint restore.", int)
+define_flag("FLAGS_health_max_restores", 3,
+            "M: last-good restores before HealthMonitor aborts with a "
+            "diagnosis (HealthAbortError) instead of burning more TPU "
+            "hours.", int)
+define_flag("FLAGS_health_lr_backoff", 1.0,
+            "LR multiplier applied per health restore (HealthMonitor."
+            "lr_scale; AnomalyMonitor applies it to the optimizer). 1.0 = "
+            "no backoff.", float)
+define_flag("FLAGS_health_data_retries", 0,
+            "Default DataLoader retries for a failing Dataset.__getitem__ "
+            "(bounded backoff between attempts). 0 keeps the raise-through "
+            "behavior.", int)
+define_flag("FLAGS_health_data_backoff_s", 0.05,
+            "Base backoff (seconds, doubled per attempt) between "
+            "Dataset.__getitem__ retries.", float)
+define_flag("FLAGS_health_worker_restarts", 0,
+            "Default max resurrections of a dead DataLoader worker "
+            "(map-style datasets; in-flight batches are re-queued). 0 keeps "
+            "the fail-fast behavior.", int)
+define_flag("FLAGS_health_watchdog_timeout_s", 0.0,
+            "health.watchdog.install() default: seconds without a progress "
+            "tick before the in-process hang watchdog fires (stack-dump "
+            "diagnosis; fatal=True exits HUNG_EXIT_RC). 0 = off.", float)
 
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "

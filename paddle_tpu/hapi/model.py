@@ -34,19 +34,33 @@ class Model:
         self.stop_training = False
         self._jit = False
         self._amp_level = None
+        self._sentinel = None
         self._train_step = None
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, jit: bool = False):
+                amp_configs=None, jit: bool = False, sentinel=None):
         """``jit=True`` fuses forward+backward+optimizer-update into one
         donation-aware XLA program per input signature (jit.train_step) —
         the fast path for TPU training loops. ``amp_configs`` takes the
         reference's level string ("O1"/"O2") or a dict with a "level" key;
-        it applies to both the fused and the eager batch paths."""
+        it applies to both the fused and the eager batch paths.
+        ``sentinel`` (None -> FLAGS_health_sentinel) fuses the run-health
+        NaN/Inf/spike detector into the jit step so bad updates are skipped
+        on device (health.sentinel; escalation via the
+        ``callbacks.AnomalyMonitor`` callback)."""
         self._optimizer = optimizer
         self._loss = loss
         self._jit = bool(jit)
+        if sentinel and not self._jit:
+            import warnings
+            warnings.warn(
+                "Model.prepare(sentinel=...) only guards the fused jit "
+                "train step — pass jit=True, or use the "
+                "callbacks.AnomalyMonitor callback for the eager path; "
+                "ignoring sentinel.")
+            sentinel = None
+        self._sentinel = sentinel
         self._train_step = None
         if amp_configs is None:
             self._amp_level = None
@@ -81,6 +95,8 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         if self._optimizer is None:
             raise RuntimeError("Model.prepare(optimizer=...) must be set")
+        from ..health import watchdog
+        watchdog.touch()   # hang-watchdog progress tick (free when off)
         self.network.train()
         ins = [t if isinstance(t, Tensor) else to_tensor(t)
                for t in _as_list(inputs)]
@@ -94,7 +110,7 @@ class Model:
                     self.network, self._optimizer, self._loss,
                     amp=self._amp_level is not None,
                     amp_level=self._amp_level or "O1",
-                    return_outputs=True)
+                    return_outputs=True, sentinel=self._sentinel)
             loss, outputs = self._train_step(ins, labs)
             metrics = self._update_metrics(outputs, labs)
             return ([float(loss)], metrics) if metrics else [float(loss)]

@@ -1,0 +1,263 @@
+"""On-device run-health sentinel: NaN/Inf/loss-spike detection fused into
+the train step.
+
+The megascale-training observation (PAPERS.md large-scale-training line):
+at production scale a bad step — a NaN loss from an overflowed reduction, a
+corrupt sample, a numerics edge — is *routine*, and the cheapest correct
+response is to detect it ON DEVICE and skip the update, exactly the
+skip-step semantics GradScaler already applies on ``found_inf``. Host-side
+detection (``float(loss)`` then branch) would add a device->host sync per
+step; the sentinel instead keeps the verdict in the compiled program:
+
+* ``sentinel_check(loss, sent)`` is a pure jax function: ``bad`` is
+  ``~isfinite(loss)`` OR (past a warmup) ``loss > spike_factor * ema``;
+  the loss EMA only advances on good steps (one bad loss must not poison
+  the reference level the next steps are judged against);
+* the state update is gated by a single ``jnp.where(bad, old, new)``
+  select per buffer — XLA fuses the selects into the update kernels, so
+  the overhead is a predicate broadcast, not an extra pass (bench
+  ``--health`` tracks it as ``health_sentinel_overhead_pct``, bound 2%);
+* the host learns the verdict from the SAME fetch that reads the loss
+  (the packed ``[loss, bad, ema]`` health vector / the Sentinel's state
+  tensors) — no recompile, no extra sync.
+
+Two spellings, one core:
+
+* :func:`guard_step` wraps a pure functional step
+  ``(params, opt, *batch) -> (params, opt, loss)`` (models/llama style)
+  into ``(params, opt, sent, *batch) -> (params, opt, sent, health)``,
+  donation-compatible;
+* :class:`Sentinel` is the imperative/fused spelling used inside
+  ``jit.train_step.TrainStep``: snapshot the mutable state tensors
+  (params, optimizer accumulators, master weights, BN running stats)
+  before the update, where-gate them after. Every op is a ``jnp``
+  eager-or-traced op, so the SAME code path serves the compiled donated
+  program and the eager tape loop (the eager-path equivalent the
+  escalation tests drive).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..flags import flag as _flag
+
+__all__ = ["sentinel_init", "sentinel_check", "tree_where", "guard_step",
+           "unpack_health", "Sentinel", "health_state_tensors"]
+
+
+def sentinel_init() -> Dict[str, jax.Array]:
+    """Fresh device-side sentinel state: loss EMA + good-step count."""
+    return {"ema": jnp.zeros((), jnp.float32),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def sentinel_check(loss, sent: Dict, *, spike_factor: Optional[float] = None,
+                   warmup: Optional[int] = None, ema_alpha: float = 0.1):
+    """Pure verdict: ``(bad, new_sent)``.
+
+    ``bad`` is a scalar bool: the loss is NaN/Inf, or — once ``warmup``
+    good steps seeded the EMA and ``spike_factor > 0`` — the loss exceeds
+    ``spike_factor * |ema|``. The EMA/count advance only on good steps.
+    """
+    if spike_factor is None:
+        spike_factor = float(_flag("FLAGS_health_spike_factor", 0.0))
+    if warmup is None:
+        warmup = int(_flag("FLAGS_health_spike_warmup", 20))
+    l32 = jnp.asarray(loss).astype(jnp.float32)
+    if l32.ndim:                       # multi-loss steps: judge the sum
+        l32 = l32.sum()
+    bad = ~jnp.isfinite(l32)
+    ema, count = sent["ema"], sent["count"]
+    if spike_factor and spike_factor > 0:
+        seeded = count >= max(1, warmup)
+        bad = bad | (seeded & (l32 > spike_factor *
+                               jnp.maximum(jnp.abs(ema), 1e-6)))
+    good = ~bad
+    first = count == 0
+    new_ema = jnp.where(
+        good, jnp.where(first, l32, (1.0 - ema_alpha) * ema + ema_alpha * l32),
+        ema)
+    new_count = count + good.astype(jnp.int32)
+    return bad, {"ema": new_ema, "count": new_count}
+
+
+def tree_where(bad, old_tree, new_tree):
+    """Per-leaf ``jnp.where(bad, old, new)`` — the gated update. ``bad`` is
+    a scalar predicate, so each select broadcasts and XLA fuses it into the
+    producing kernel (no extra memory pass)."""
+    return jax.tree_util.tree_map(
+        lambda o, n: jnp.where(bad, o, n), old_tree, new_tree)
+
+
+def pack_health(loss, bad, sent) -> jax.Array:
+    """``[loss, bad, ema]`` as ONE f32 vector — a single device buffer so
+    the host reads loss AND verdict with one fetch."""
+    l32 = jnp.asarray(loss).astype(jnp.float32)
+    if l32.ndim:
+        l32 = l32.sum()
+    return jnp.stack([l32, bad.astype(jnp.float32), sent["ema"]])
+
+
+def unpack_health(health) -> Tuple[float, bool, float]:
+    """Host side of :func:`pack_health`: ``(loss, bad, ema)`` from one
+    device->host read."""
+    h = np.asarray(health)
+    return float(h[0]), bool(h[1] > 0.5), float(h[2])
+
+
+def guard_step(step_fn, *, spike_factor: Optional[float] = None,
+               warmup: Optional[int] = None, ema_alpha: float = 0.1):
+    """Wrap a pure functional train step with the sentinel.
+
+        init_opt, step = llama.make_train_step(cfg)
+        gstep = jit_step(guard_step(step), donate_argnums=(0, 1, 2))
+        sent = sentinel_init()
+        params, opt, sent, health = gstep(params, opt, sent, ids, labels)
+        loss, bad, ema = unpack_health(health)
+
+    A bad step returns the INPUT params/opt_state unchanged (the selects
+    alias under donation — XLA writes the kept side back into the donated
+    buffers); the sentinel state still records the verdict.
+    """
+    def guarded(params, opt_state, sent, *batch):
+        new_p, new_o, loss = step_fn(params, opt_state, *batch)
+        bad, new_sent = sentinel_check(loss, sent, spike_factor=spike_factor,
+                                       warmup=warmup, ema_alpha=ema_alpha)
+        out_p = tree_where(bad, params, new_p)
+        out_o = tree_where(bad, opt_state, new_o)
+        return out_p, out_o, new_sent, pack_health(loss, bad, new_sent)
+
+    return guarded
+
+
+def health_state_tensors(model=None, optimizer=None) -> List:
+    """The mutable-state tensor set a skipped step must leave intact:
+    parameters, BN running stats (buffers), optimizer accumulators and
+    fp32 master weights. Collected fresh per step — lazily-created
+    accumulators (first eager warmup call) join on the next call."""
+    out, seen = [], set()
+
+    def add(t):
+        if t is not None and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+
+    if model is not None:
+        for p in model.parameters():
+            add(p)
+        if hasattr(model, "buffers"):
+            for b in model.buffers():
+                add(b)
+    if optimizer is not None:
+        for store in getattr(optimizer, "_accumulators", {}).values():
+            for t in store.values():
+                add(t)
+        for t in getattr(optimizer, "_master_weights", {}).values():
+            add(t)
+    return out
+
+
+class Sentinel:
+    """Imperative/fused-path sentinel (jit.train_step.TrainStep integration).
+
+    Holds its device state (EMA, count, last health vector) as framework
+    Tensors so the to_static machinery transports them as program state:
+    inside the compiled donated step the verdict and the gated selects are
+    ordinary traced ops; after the program runs, the rebound state tensors
+    give the host the verdict without an extra program or sync.
+
+    Usage inside a traced (or eager) step function::
+
+        snap = sentinel.snapshot(health_state_tensors(model, opt))
+        ... forward / backward / optimizer.step() ...
+        sentinel.gate(snap, loss)     # jnp.where-gated rollback on bad
+
+    Host side, after the step ran: :attr:`last_bad`, :attr:`last_loss`,
+    :meth:`last_record`.
+    """
+
+    def __init__(self, spike_factor: Optional[float] = None,
+                 warmup: Optional[int] = None, ema_alpha: float = 0.1):
+        from ..core.tensor import to_tensor
+        self.spike_factor = spike_factor
+        self.warmup = warmup
+        self.ema_alpha = ema_alpha
+        # pre-created (NOT lazily inside a trace) so the discovery trace
+        # sees ordinary pre-existing state tensors
+        self._ema = to_tensor(np.zeros((), np.float32))
+        self._count = to_tensor(np.zeros((), np.int32))
+        self._health = to_tensor(np.zeros((3,), np.float32))
+        self.steps = 0            # host-side call count (records only)
+
+    # -- in-step (trace-safe) ------------------------------------------------
+    def snapshot(self, tensors: Sequence) -> List[Tuple]:
+        """Record ``(tensor, value)`` pairs BEFORE the mutating update (the
+        reads also mark the tensors as program state)."""
+        return [(t, t._value) for t in tensors]
+
+    def gate(self, snapshot: Sequence[Tuple], loss,
+             post_tensors: Optional[Sequence] = None):
+        """Verdict + gated rollback; returns the ``bad`` scalar (traced or
+        eager jax value).
+
+        ``post_tensors``: the state tensor set AFTER the update. Tensors in
+        it that the snapshot never saw were CREATED during this step
+        (lazily-built optimizer accumulators / master weights on the very
+        first call) — a bad first step would otherwise leave them poisoned
+        with no old value to roll back to. They roll back to their unborn
+        state instead: the creation fill the optimizer stamped on them
+        (``_acc_init``), or a re-derivation from the already-rolled-back
+        source param for master weights (``_master_of``)."""
+        lv = loss._value if hasattr(loss, "_value") else loss
+        sent = {"ema": self._ema._value, "count": self._count._value}
+        bad, new_sent = sentinel_check(
+            lv, sent, spike_factor=self.spike_factor, warmup=self.warmup,
+            ema_alpha=self.ema_alpha)
+        self._ema._value = new_sent["ema"]
+        self._count._value = new_sent["count"]
+        self._health._value = pack_health(lv, bad, new_sent)
+        seen = set()
+        for t, old in snapshot:
+            seen.add(id(t))
+            t._value = jnp.where(bad, old, t._value)
+        for t in (post_tensors or ()):
+            if id(t) in seen:
+                continue
+            src = getattr(t, "_master_of", None)
+            if src is not None:   # after params gated: src is rolled back
+                unborn = src._value.astype(t._value.dtype)
+            else:
+                unborn = jnp.full_like(
+                    t._value, float(getattr(t, "_acc_init", 0.0)))
+            t._value = jnp.where(bad, unborn, t._value)
+        return bad
+
+    # -- host side -----------------------------------------------------------
+    @property
+    def last_loss(self) -> float:
+        return float(np.asarray(self._health._value)[0])
+
+    @property
+    def last_bad(self) -> bool:
+        return bool(np.asarray(self._health._value)[1] > 0.5)
+
+    @property
+    def ema(self) -> float:
+        return float(np.asarray(self._ema._value))
+
+    def last_record(self):
+        """The last step's verdict as ``(loss, bad, ema)`` — one host read
+        of the packed health vector."""
+        return unpack_health(self._health._value)
+
+    def reset(self):
+        # rebind VALUES (not tensors): compiled programs hold the tensor
+        # identities as state slots
+        self._ema._value = jnp.zeros((), jnp.float32)
+        self._count._value = jnp.zeros((), jnp.int32)
+        self._health._value = jnp.zeros((3,), jnp.float32)

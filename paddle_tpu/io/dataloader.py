@@ -20,8 +20,10 @@ import collections
 import itertools
 import multiprocessing as mp
 import queue as pyqueue
+import signal as _signal
 import time
 import traceback
+import warnings
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -31,6 +33,85 @@ from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "get_worker_info", "default_collate_fn",
            "default_convert_fn", "WorkerInfo", "prefetch_to_device"]
+
+
+def _describe_exit(code: Optional[int]) -> str:
+    """Human-readable worker exit: decodes the signal for negative codes
+    (multiprocessing convention) so 'exit code -9' reads as the OOM kill
+    it almost always is."""
+    if code is None:
+        return "still exiting"
+    if code < 0:
+        try:
+            name = _signal.Signals(-code).name
+        except ValueError:
+            name = f"signal {-code}"
+        hint = " (likely the kernel OOM killer)" if -code == 9 else \
+            " (segfault in dataset/native code)" if -code == 11 else ""
+        return f"killed by {name}{hint}"
+    return f"exit code {code}"
+
+
+def _fetch_sample(dataset, idx, retries: int, backoff_s: float):
+    """``dataset[idx]`` with bounded retry + exponential backoff — the
+    self-healing path for transient failures (flaky remote reads, racing
+    decoders). Deterministic failures exhaust the retries and re-raise
+    for the caller's quarantine/raise decision."""
+    attempt = 0
+    while True:
+        try:
+            return dataset[idx]
+        except Exception:
+            if attempt >= retries:
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
+            attempt += 1
+
+
+class _SkippedBatch:
+    """Worker->parent marker: every index of this batch is quarantined —
+    the batch is dropped, the epoch continues."""
+
+
+def _gather_batch(dataset, indices, quarantined: set, retries: int,
+                  backoff_s: float, quarantine: bool, who: str = "DataLoader",
+                  on_quarantine: Optional[Callable] = None):
+    """Fetch a batch's samples with the self-healing policy — shared by
+    the worker loop and the single-process path so the retry/quarantine
+    semantics cannot drift apart. Mutates ``quarantined`` in place; calls
+    ``on_quarantine(idx)`` for each NEWLY quarantined index.
+
+    Returns the item list, or ``None`` when quarantine healing left the
+    batch EMPTY (every index bad) — the batch is skipped, not fatal: a
+    self-healing loader must survive even a fully-poisoned batch."""
+    items, last_exc = [], None
+    for i in indices:
+        if i in quarantined:
+            continue
+        try:
+            items.append(_fetch_sample(dataset, i, retries, backoff_s))
+        except Exception as e:
+            if not quarantine:
+                raise
+            # self-healing: drop the sample, remember the index so it is
+            # never re-fetched (and never re-pays the retries)
+            last_exc = e
+            quarantined.add(i)
+            if on_quarantine is not None:
+                on_quarantine(i)
+            warnings.warn(
+                f"{who}: sample {i} failed {retries + 1}x and was "
+                f"quarantined ({type(e).__name__}: {e}); the batch "
+                f"continues without it")
+    if not items:
+        if quarantine:
+            if last_exc is not None:   # newly emptied this epoch: say so
+                warnings.warn(f"{who}: every index of a batch is "
+                              f"quarantined; skipping the batch")
+            return None
+        raise last_exc if last_exc is not None else RuntimeError(
+            "batch: every index quarantined")
+    return items
 
 
 class WorkerInfo:
@@ -88,12 +169,26 @@ class _ExceptionWrapper:
             f"DataLoader worker raised {self.exc_type}: {self.msg}")
 
 
+_RING_FALLBACK_WARNED = False
+
+
 class _RingSource:
-    """Round-robin poll of per-worker shm rings behind a Queue-like .get."""
+    """Round-robin poll of per-worker shm rings behind a Queue-like .get.
+    ``rings`` is mutated in place by worker resurrection (a replacement
+    worker gets a FRESH ring — the dead worker may have died mid-push,
+    leaving its old ring's slot state unusable)."""
 
     def __init__(self, rings):
         self.rings = list(rings)
         self._next = 0
+
+    def swap(self, idx, new_ring):
+        old = self.rings[idx]
+        self.rings[idx] = new_ring
+        try:
+            old.close()
+        except Exception:
+            pass
 
     def get(self, timeout=None):
         import pickle
@@ -112,7 +207,7 @@ class _RingSource:
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
                  worker_id, num_workers, seed, iterable, ring=None,
-                 all_rings=()):
+                 all_rings=(), retry_cfg=(0, 0.05, False, frozenset())):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
     np.random.seed(seed % (2 ** 31))
@@ -158,13 +253,24 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
             except Exception as e:
                 result_queue.put((bidx, _ExceptionWrapper(e)))
     else:
+        retries, backoff_s, quarantine, initial_q = retry_cfg
+        # seeded from the parent loader's set at fork: indices quarantined
+        # in earlier epochs (reported back via the (-2, idx) notice) are
+        # skipped immediately instead of re-paying the retries
+        quarantined: set = set(initial_q)
         while True:
             req = index_queue.get()
             if req is None:
                 return
             bidx, indices = req
             try:
-                result_queue.put((bidx, collate_fn([dataset[i] for i in indices])))
+                items = _gather_batch(
+                    dataset, indices, quarantined, retries, backoff_s,
+                    quarantine, who=f"DataLoader worker {worker_id}",
+                    # tell the parent so the NEXT epoch's workers inherit
+                    on_quarantine=lambda i: result_queue.put((-2, i)))
+                result_queue.put((bidx, _SkippedBatch() if items is None
+                                  else collate_fn(items)))
             except Exception as e:
                 result_queue.put((bidx, _ExceptionWrapper(e)))
 
@@ -239,6 +345,117 @@ def donation_like_backend_supports_overlap() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+class _WorkerSet:
+    """Worker processes + transport + in-flight bookkeeping, with
+    resurrection: a dead worker (OOM kill, segfault in dataset code) is
+    replaced by a fresh fork — same worker id, FRESH index queue and shm
+    ring (the old ones may hold a torn request/push from the death) — and
+    every batch that was in flight on it is re-queued, so one lost worker
+    costs a recompute instead of the epoch.
+
+    Resurrection is map-style only: an IterableDataset worker's stream
+    position died with the process, so replaying its requests would
+    silently skip or duplicate samples — those keep the fail-fast path.
+    """
+
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.ctx = mp.get_context("fork")  # workers reuse the parent dataset
+        self.nw = loader.num_workers
+        self.result_queue = self.ctx.Queue()
+        self.rings = loader._make_rings(self.nw)
+        self.result_src = (_RingSource(self.rings) if self.rings
+                           else self.result_queue)
+        self.base_seed = np.random.randint(0, 2 ** 31 - 1)
+        self.index_queues: List = []
+        self.procs: List = []
+        self.inflight: dict = {}       # bidx -> (worker_id, payload)
+        self.restarts_left = (0 if loader._iterable
+                              else loader.worker_restarts)
+        self.generation = 0
+        for w in range(self.nw):
+            self.index_queues.append(self.ctx.Queue())
+            self.procs.append(self._spawn(w))
+
+    def _spawn(self, w: int):
+        ring = self.rings[w] if self.rings else None
+        p = self.ctx.Process(
+            target=_worker_loop,
+            args=(self.loader.dataset, self.index_queues[w],
+                  self.result_queue, self.loader.collate_fn,
+                  self.loader.worker_init_fn, w, self.nw,
+                  self.base_seed + w + self.generation * self.nw,
+                  self.loader._iterable, ring,
+                  tuple(self.rings) if self.rings else (),
+                  (self.loader.sample_retries,
+                   self.loader.sample_retry_backoff,
+                   self.loader.quarantine_bad_samples,
+                   frozenset(self.loader._quarantined))),
+            daemon=True)
+        p.start()
+        return p
+
+    # -- in-flight bookkeeping (map-style) ----------------------------------
+    def submit(self, bidx: int, payload):
+        w = bidx % self.nw
+        self.index_queues[w].put((bidx, payload))
+        self.inflight[bidx] = (w, payload)
+
+    def done(self, bidx: int):
+        self.inflight.pop(bidx, None)
+
+    def revive(self, dead) -> bool:
+        """Replace dead workers and re-queue their in-flight batches.
+        Returns False (caller raises) when the restart budget is spent or
+        the dataset is iterable."""
+        if self.restarts_left < len(dead):
+            return False
+        self.restarts_left -= len(dead)
+        self.generation += 1
+        for w, code in dead:
+            warnings.warn(
+                f"DataLoader worker {w} died ({_describe_exit(code)}); "
+                f"resurrecting it and re-queuing "
+                f"{sum(1 for ww, _ in self.inflight.values() if ww == w)} "
+                f"in-flight batch(es) "
+                f"({self.restarts_left} restart(s) left)")
+            try:
+                self.procs[w].join(timeout=0.1)
+            except Exception:
+                pass
+            # fresh queue + ring: the old ones may be torn mid-operation
+            self.index_queues[w] = self.ctx.Queue()
+            if self.rings:
+                try:
+                    new_ring = self.loader._make_ring(w, self.generation)
+                except Exception:
+                    return False     # can't rebuild transport — fail fast
+                self.rings[w] = new_ring
+                self.result_src.swap(w, new_ring)
+            self.procs[w] = self._spawn(w)
+            for bidx, (ww, payload) in sorted(self.inflight.items()):
+                if ww == w:
+                    self.index_queues[w].put((bidx, payload))
+        return True
+
+    def shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=1.0)
+            if p.is_alive():
+                p.terminate()
+        if self.rings:
+            for r in self.rings:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+
+
 class DataLoader:
     """ref: paddle.io.DataLoader (return_list=True semantics only — the
     legacy feed-dict mode targets the static graph executor, which this
@@ -252,7 +469,26 @@ class DataLoader:
                  num_workers: int = 0, use_buffer_reader: bool = True,
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
                  timeout: float = 0, worker_init_fn: Optional[Callable] = None,
-                 persistent_workers: bool = False):
+                 persistent_workers: bool = False,
+                 sample_retries: Optional[int] = None,
+                 sample_retry_backoff: Optional[float] = None,
+                 quarantine_bad_samples: Optional[bool] = None,
+                 worker_restarts: Optional[int] = None):
+        """Self-healing knobs (docs/FAULT_TOLERANCE.md "Runtime anomalies";
+        defaults come from the FLAGS_health_* flags, which default OFF so
+        error propagation is unchanged unless opted in):
+
+        * ``sample_retries`` — retry a failing ``Dataset.__getitem__``
+          with bounded exponential backoff (transient I/O);
+        * ``quarantine_bad_samples`` — after the retries, drop the sample
+          and quarantine its index (warn once) instead of poisoning the
+          epoch (defaults on when retries are enabled);
+        * ``worker_restarts`` — resurrect a dead worker process
+          (OOM-kill, segfault) up to N times, re-queuing its in-flight
+          batches (map-style datasets; an iterable worker's stream
+          position died with it, so those still fail fast).
+        """
+        from ..flags import flag
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -263,6 +499,19 @@ class DataLoader:
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.sample_retries = int(
+            flag("FLAGS_health_data_retries") if sample_retries is None
+            else sample_retries)
+        self.sample_retry_backoff = float(
+            flag("FLAGS_health_data_backoff_s")
+            if sample_retry_backoff is None else sample_retry_backoff)
+        self.quarantine_bad_samples = bool(
+            self.sample_retries > 0 if quarantine_bad_samples is None
+            else quarantine_bad_samples)
+        self.worker_restarts = int(
+            flag("FLAGS_health_worker_restarts") if worker_restarts is None
+            else worker_restarts)
+        self._quarantined: set = set()   # num_workers=0 path
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             if batch_sampler is not None or shuffle:
@@ -304,70 +553,65 @@ class DataLoader:
                     yield self.collate_fn(items)
             else:
                 for indices in self.batch_sampler:
-                    yield self.collate_fn([self.dataset[i] for i in indices])
+                    items = self._fetch_batch(indices)
+                    if items is None:   # fully-quarantined batch: skip
+                        continue
+                    yield self.collate_fn(items)
             return
         yield from self._multiprocess_batches()
 
+    def _fetch_batch(self, indices):
+        """Single-process fetch with the same retry/quarantine healing the
+        workers apply (shared quarantine set across epochs)."""
+        return _gather_batch(self.dataset, indices, self._quarantined,
+                             self.sample_retries, self.sample_retry_backoff,
+                             self.quarantine_bad_samples)
+
     def _make_rings(self, nw):
         """Shared-memory transport (native C++ ring; reference shm parity).
-        Falls back to mp.Queue when the native lib is unavailable."""
+        Falls back to mp.Queue when the native lib is unavailable — with
+        ONE warning saying why, instead of silently downgrading every
+        loader in the process to the slow path."""
         if not self.use_shared_memory:
             return None
         try:
-            import os
-            from ..native import ShmRing
-            tag = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
-            return [ShmRing(f"{tag}_{w}", slots=4,
-                            slot_bytes=self.shm_slot_bytes)
-                    for w in range(nw)]
-        except Exception:
+            return [self._make_ring(w) for w in range(nw)]
+        except Exception as e:
+            global _RING_FALLBACK_WARNED
+            if not _RING_FALLBACK_WARNED:
+                _RING_FALLBACK_WARNED = True
+                warnings.warn(
+                    f"DataLoader: shared-memory ring transport unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the slower "
+                    f"mp.Queue transport (pass use_shared_memory=False to "
+                    f"silence)")
             return None
 
+    def _make_ring(self, w: int, generation: int = 0):
+        import os
+        from ..native import ShmRing
+        tag = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+        suffix = f"_r{generation}" if generation else ""
+        return ShmRing(f"{tag}_{w}{suffix}", slots=4,
+                       slot_bytes=self.shm_slot_bytes)
+
     def _multiprocess_batches(self):
-        ctx = mp.get_context("fork")  # workers reuse the parent's dataset
-        nw = self.num_workers
-        result_queue = ctx.Queue()
-        rings = self._make_rings(nw)
-        result_src = _RingSource(rings) if rings else result_queue
-        index_queues, workers = [], []
-        base_seed = np.random.randint(0, 2 ** 31 - 1)
-        for w in range(nw):
-            iq = ctx.Queue()
-            p = ctx.Process(
-                target=_worker_loop,
-                args=(self.dataset, iq, result_queue, self.collate_fn,
-                      self.worker_init_fn, w, nw, base_seed + w,
-                      self._iterable, rings[w] if rings else None,
-                      tuple(rings) if rings else ()),
-                daemon=True)
-            p.start()
-            index_queues.append(iq)
-            workers.append(p)
+        ws = _WorkerSet(self)
         try:
             if self._iterable:
-                yield from self._mp_iterable(index_queues, result_src, nw,
-                                             workers)
+                yield from self._mp_iterable(ws.index_queues, ws.result_src,
+                                             ws.nw, ws.procs)
             else:
-                yield from self._mp_map(index_queues, result_src, nw,
-                                        workers)
+                yield from self._mp_map(ws)
         finally:
-            for iq in index_queues:
-                try:
-                    iq.put(None)
-                except Exception:
-                    pass
-            for p in workers:
-                p.join(timeout=1.0)
-                if p.is_alive():
-                    p.terminate()
-            if rings:
-                for r in rings:
-                    r.close()
+            ws.shutdown()
 
-    def _get(self, result_queue, workers=()):
-        """Queue get with a liveness watchdog: wait in short slices and fail
-        fast with a descriptive error when a worker died (OOM-kill/segfault)
-        instead of blocking forever (the reference DataLoader's watchdog)."""
+    def _get(self, result_queue, workers=(), revive=None):
+        """Queue get with a liveness watchdog: wait in short slices; when a
+        worker died (OOM-kill/segfault) either resurrect it via ``revive``
+        (self-healing map-style path) or fail fast with the worker's
+        decoded exit signal instead of blocking forever."""
+        from ..health import watchdog
         deadline = (None if not self.timeout
                     else time.monotonic() + self.timeout)
         while True:
@@ -380,7 +624,12 @@ class DataLoader:
                         f"for a worker batch")
                 slice_t = min(slice_t, left)
             try:
-                return result_queue.get(timeout=slice_t)
+                out = result_queue.get(timeout=slice_t)
+                # progress tick ONLY on a real batch: ticking the empty
+                # poll slices would mask exactly the stalled-input hang
+                # the watchdog exists to catch
+                watchdog.touch()
+                return out
             except pyqueue.Empty:
                 dead = [(i, p.exitcode) for i, p in enumerate(workers)
                         if not p.is_alive()]
@@ -392,33 +641,44 @@ class DataLoader:
                         return result_queue.get(timeout=0.2)
                     except pyqueue.Empty:
                         pass
-                    descr = ", ".join(f"worker {i} exit code {c}"
-                                      for i, c in dead)
+                    if revive is not None and revive(dead):
+                        continue   # replacements spawned, work re-queued
+                    descr = ", ".join(
+                        f"worker {i}: {_describe_exit(c)}" for i, c in dead)
                     raise RuntimeError(
-                        f"DataLoader worker(s) died unexpectedly ({descr}) — "
-                        f"likely killed by OOM or a segfault in dataset "
-                        f"code; the remaining batch will never arrive"
+                        f"DataLoader worker(s) died unexpectedly ({descr}); "
+                        f"the remaining batch will never arrive. Map-style "
+                        f"datasets can self-heal via worker_restarts= / "
+                        f"FLAGS_health_worker_restarts."
                     ) from None
 
-    def _mp_map(self, index_queues, result_queue, nw, workers=()):
+    def _mp_map(self, ws: "_WorkerSet"):
         batches = list(self.batch_sampler)
-        depth = min(len(batches), self.prefetch_factor * nw)
-        nxt = 0
+        depth = min(len(batches), self.prefetch_factor * ws.nw)
         for nxt in range(depth):
-            index_queues[nxt % nw].put((nxt, batches[nxt]))
+            ws.submit(nxt, batches[nxt])
         nxt = depth
         reorder = {}
         for want in range(len(batches)):
             while want not in reorder:
-                bidx, data = self._get(result_queue, workers)
+                bidx, data = self._get(ws.result_src, ws.procs,
+                                       revive=ws.revive)
+                if bidx == -2:
+                    # quarantine notice: the next epoch's workers (a fresh
+                    # fork) inherit it and skip the index outright
+                    self._quarantined.add(data)
+                    continue
                 if bidx == -1 or isinstance(data, _ExceptionWrapper):
                     if isinstance(data, _ExceptionWrapper):
                         data.reraise()
+                ws.done(bidx)
                 reorder[bidx] = data
             data = reorder.pop(want)
             if nxt < len(batches):
-                index_queues[nxt % nw].put((nxt, batches[nxt]))
+                ws.submit(nxt, batches[nxt])
                 nxt += 1
+            if isinstance(data, _SkippedBatch):
+                continue            # fully-quarantined batch: dropped
             yield data
 
     def _mp_iterable(self, index_queues, result_queue, nw, workers=()):

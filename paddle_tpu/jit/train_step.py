@@ -107,13 +107,25 @@ class TrainStep:
     when an enabled scaler is passed the step runs on the eager tape path
     instead (documented divergence; bf16 AMP on TPU needs no loss scaling,
     which is the fused path's target).
+
+    ``sentinel``: the run-health NaN/Inf/loss-spike detector
+    (health.sentinel), fused INTO the step: the mutable state (params,
+    optimizer accumulators, master weights, BN running stats) is
+    snapshotted before the update and ``jnp.where``-gated after it, so a
+    bad step is a state no-op — the same skip-step semantics GradScaler
+    applies on found_inf, decided on device with no extra host sync.
+    ``True`` builds a Sentinel from the FLAGS_health_* defaults, or pass a
+    configured ``health.Sentinel``; ``None`` follows
+    ``FLAGS_health_sentinel``. The verdict is readable after each step via
+    ``step.sentinel.last_record()`` (one fetch of the packed health
+    vector).
     """
 
     def __init__(self, model, optimizer, loss_fn: Callable, *,
                  amp: bool = False, amp_level: str = "O1",
                  amp_dtype: str = "bfloat16", scaler=None,
                  donate: Optional[bool] = None,
-                 return_outputs: bool = False):
+                 return_outputs: bool = False, sentinel=None):
         from ..nn.layer import Layer
 
         self.model = model
@@ -126,9 +138,22 @@ class TrainStep:
         self._return_outputs = bool(return_outputs)
         self.donate = donation_supported() if donate is None else bool(donate)
         self._eager_only = scaler is not None and scaler.is_enable()
+        if sentinel is None:
+            from ..flags import flag
+            sentinel = bool(flag("FLAGS_health_sentinel"))
+        if sentinel is True:
+            from ..health.sentinel import Sentinel
+            sentinel = Sentinel()
+        self.sentinel = sentinel or None
 
         def _fn(ins, labs):
             from .. import amp as amp_mod
+            if self.sentinel is not None:
+                # snapshot BEFORE forward: BN running stats mutate in the
+                # forward pass and must also survive a skipped step
+                from ..health.sentinel import health_state_tensors
+                snap = self.sentinel.snapshot(
+                    health_state_tensors(self.model, self.optimizer))
             cm = (amp_mod.auto_cast(level=self._amp_level,
                                     dtype=self._amp_dtype)
                   if self._amp else contextlib.nullcontext())
@@ -143,6 +168,11 @@ class TrainStep:
             else:
                 loss.backward()
                 self.optimizer.step()
+            if self.sentinel is not None:
+                # re-enumerate: accumulators/masters created BY this step
+                # (first call) roll back to their unborn state
+                self.sentinel.gate(snap, loss, health_state_tensors(
+                    self.model, self.optimizer))
             self.optimizer.clear_grad()
             return (loss, out) if self._return_outputs else loss
 
@@ -157,7 +187,9 @@ class TrainStep:
         labs = [t if isinstance(t, Tensor) else to_tensor(t)
                 for t in _as_list(labels)]
         self.model.train()
+        from ..health import watchdog
         from ..profiler import annotate
+        watchdog.touch()   # progress tick for the hang watchdog (free when off)
         with annotate("step"):
             if self._sf is None:
                 return self._fn(ins, labs)

@@ -559,22 +559,49 @@ def _adamw_init(params, opt_dtype=jnp.float32):
 
 
 def _adamw_apply(params, grads, opt_state, *, lr, beta1, beta2, eps,
-                 weight_decay, opt_dtype):
-    """One AdamW update with fp32 moment arithmetic (multi_precision path)."""
-    step = opt_state["step"] + 1
-    t = step.astype(jnp.float32)
+                 weight_decay, opt_dtype, skip=None):
+    """One AdamW update with fp32 moment arithmetic (multi_precision path).
+
+    ``skip``: optional scalar bool (traced or eager) — when True the update
+    is an exact state-preserving no-op, gated INSIDE the update math
+    instead of by an output-side ``jnp.where(bad, old, new)`` over every
+    buffer: the grads are masked to 0 through one fused elementwise select
+    (``0 * NaN`` would stay NaN, a select doesn't) and the decay / step-size
+    scalars collapse to identity (``beta -> 1``, ``lr -> 0``), so m/v/params
+    pass through bit-exact and no second copy of the state is ever
+    materialized. That keeps the sentinel's skip-step cost at a handful of
+    scalar selects — the ``health_sentinel_overhead_pct`` bound rests on it.
+    """
+    if skip is None:
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+    else:
+        step = opt_state["step"] + (~skip).astype(jnp.int32)
+        # a skipped FIRST step leaves t=0 -> bc1=0 -> u=0/0=NaN, and even
+        # lr_eff=0 can't mask it (0*NaN=NaN); clamp — good steps have t>=1
+        t = jnp.maximum(step.astype(jnp.float32), 1.0)
+        b1_eff = jnp.where(skip, 1.0, beta1)
+        b2_eff = jnp.where(skip, 1.0, beta2)
+        c1_eff = jnp.where(skip, 0.0, 1 - beta1)
+        c2_eff = jnp.where(skip, 0.0, 1 - beta2)
+        lr_eff = jnp.where(skip, 0.0, lr)
     bc1 = 1.0 - beta1 ** t
     bc2 = 1.0 - beta2 ** t
 
     def upd(p, g, m, v):
         g = g.astype(jnp.float32)
-        m = beta1 * m.astype(jnp.float32) + (1 - beta1) * g
-        v = beta2 * v.astype(jnp.float32) + (1 - beta2) * (g * g)
+        if skip is None:
+            m = beta1 * m.astype(jnp.float32) + (1 - beta1) * g
+            v = beta2 * v.astype(jnp.float32) + (1 - beta2) * (g * g)
+        else:
+            g = jnp.where(skip, 0.0, g)
+            m = b1_eff * m.astype(jnp.float32) + c1_eff * g
+            v = b2_eff * v.astype(jnp.float32) + c2_eff * (g * g)
         u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
         pf = p.astype(jnp.float32)
         if weight_decay:
             u = u + weight_decay * pf
-        return ((pf - lr * u).astype(p.dtype),
+        return ((pf - (lr if skip is None else lr_eff) * u).astype(p.dtype),
                 m.astype(opt_dtype), v.astype(opt_dtype))
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
@@ -591,7 +618,8 @@ def _adamw_apply(params, grads, opt_state, *, lr, beta1, beta2, eps,
 
 def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
                     eps=1e-8, weight_decay=0.0, opt_dtype=jnp.float32,
-                    grad_dtype=None):
+                    grad_dtype=None, sentinel=False, spike_factor=None,
+                    spike_warmup=None):
     """Returns ``(init_opt_state, train_step)`` pure functions.
 
     ``train_step(params, opt_state, input_ids, labels) ->
@@ -605,22 +633,48 @@ def make_train_step(cfg: LlamaConfig, lr: float = 3e-4, beta1=0.9, beta2=0.95,
     at the boundary, so this adds a single extra rounding while XLA fuses
     the downcast into the producers — the fp32 grad tree (2.95GB at the
     bench config) never materializes. Moment arithmetic stays fp32.
+
+    ``sentinel=True`` returns the health-guarded step instead:
+    ``(params, opt_state, sent, input_ids, labels) ->
+    (params, opt_state, sent, health)`` with ``sent`` from
+    ``health.sentinel_init()`` and ``health`` the packed
+    ``[loss, bad, ema]`` vector (``health.unpack_health``). Unlike the
+    generic black-box ``health.guard_step`` wrapper — which must
+    ``jnp.where``-select every output buffer against its old value — the
+    bad-step gate here rides INSIDE ``_adamw_apply(skip=bad)``, so a good
+    step is bit-identical to the unguarded step and the sentinel adds only
+    the verdict reduction plus scalar selects.
     """
 
     def init_opt_state(params):
         return _adamw_init(params, opt_dtype)
 
-    def train_step(params, opt_state, input_ids, labels):
+    def _loss_and_grads(params, input_ids, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, input_ids, labels, cfg)
         if grad_dtype is not None:
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(grad_dtype), grads)
+        return loss, grads
+
+    def train_step(params, opt_state, input_ids, labels):
+        loss, grads = _loss_and_grads(params, input_ids, labels)
         params, opt_state = _adamw_apply(
             params, grads, opt_state, lr=lr, beta1=beta1, beta2=beta2,
             eps=eps, weight_decay=weight_decay, opt_dtype=opt_dtype)
         return params, opt_state, loss
 
-    return init_opt_state, train_step
+    def train_step_sentinel(params, opt_state, sent, input_ids, labels):
+        from ..health.sentinel import pack_health, sentinel_check
+        loss, grads = _loss_and_grads(params, input_ids, labels)
+        bad, sent = sentinel_check(loss, sent, spike_factor=spike_factor,
+                                   warmup=spike_warmup)
+        params, opt_state = _adamw_apply(
+            params, grads, opt_state, lr=lr, beta1=beta1, beta2=beta2,
+            eps=eps, weight_decay=weight_decay, opt_dtype=opt_dtype,
+            skip=bad)
+        return params, opt_state, sent, pack_health(loss, bad, sent)
+
+    return init_opt_state, (train_step_sentinel if sentinel else train_step)
 
 
 # ---------------------------------------------------------------------------

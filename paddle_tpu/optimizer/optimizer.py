@@ -11,7 +11,7 @@ plain Tensors keyed by parameter name (Paddle's accumulator convention).
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
@@ -71,7 +71,13 @@ class Optimizer:
                 shp = shape if shape is not None else param._value.shape
                 dt = dtype or (jnp.float32 if self._multi_precision
                                else param._value.dtype)
-                store[param.name] = _wrap_value(jnp.full(shp, fill_value, dt))
+                t = _wrap_value(jnp.full(shp, fill_value, dt))
+                # the health sentinel's unborn-state rollback: an
+                # accumulator CREATED during a bad step rolls back to this
+                # creation fill (velocity 0, beta pows 1.0, ...) — as if
+                # the step never ran (health.sentinel.Sentinel.gate)
+                t._acc_init = float(fill_value)
+                store[param.name] = t
         return store[param.name]
 
     def _get_accumulator(self, name: str, param: Tensor) -> Tensor:
@@ -83,7 +89,11 @@ class Optimizer:
         if not self._multi_precision or p._value.dtype == jnp.float32:
             return None
         if p.name not in self._master_weights:
-            self._master_weights[p.name] = _wrap_value(p._value.astype(jnp.float32))
+            mw = _wrap_value(p._value.astype(jnp.float32))
+            # sentinel unborn-state rollback: a master created during a bad
+            # step re-derives from its (rolled-back) source param
+            mw._master_of = p
+            self._master_weights[p.name] = mw
         return self._master_weights[p.name]
 
     # -- the step ------------------------------------------------------------
@@ -183,6 +193,21 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state: Dict):
+        def _restore(cur: Optional[Tensor], v: Any) -> Tensor:
+            """Restore IN PLACE when the existing tensor matches: compiled
+            programs (jit fused steps) hold accumulator/master tensor
+            IDENTITIES as state slots — rebinding the dict entry to a new
+            Tensor would silently desync the live program from the dict
+            (e.g. a health rollback that never reaches the compiled
+            step). Shape mismatch / no current tensor falls back to the
+            old rebind behavior."""
+            val = v if isinstance(v, Tensor) else to_tensor(v)
+            if cur is not None and tuple(cur.shape) == tuple(val.shape):
+                cur._value = val._value.astype(cur._value.dtype)
+                cur._version += 1
+                return cur
+            return val
+
         if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state["LR_Scheduler"])
         if "@step" in state:
@@ -190,13 +215,12 @@ class Optimizer:
             self._step_count = int(v.item() if isinstance(v, Tensor) else v)
         mw = state.get("master_weights", {})
         for k, v in mw.items():
-            self._master_weights[k] = v if isinstance(v, Tensor) else to_tensor(v)
+            self._master_weights[k] = _restore(self._master_weights.get(k), v)
         for acc_name, store in list(self._accumulators.items()):
             for pname in list(store):
                 key = f"{pname}_{acc_name}"
                 if key in state:
-                    v = state[key]
-                    store[pname] = v if isinstance(v, Tensor) else to_tensor(v)
+                    store[pname] = _restore(store[pname], state[key])
         # keys for accumulators not yet created are applied lazily
         self._pending_state = {k: v for k, v in state.items()
                                if k not in ("LR_Scheduler", "@step", "master_weights")}

@@ -17,6 +17,12 @@ injector               fault it models
                        launcher's TTL without exiting)
 ``kill_self``          a rank dying mid-step (preemption without grace,
                        OOM kill)
+``nan_payload``        NaN/Inf landing in a batch/activation buffer (the
+                       sentinel's bad-step fault)
+``bad_sample``         a corrupt record: Dataset.__getitem__ raising,
+                       transiently (retry path) or forever (quarantine)
+``dead_worker``        a DataLoader worker segfaulting mid-epoch (fires
+                       once; the resurrected replacement survives)
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -34,7 +40,8 @@ import signal
 from typing import Optional
 
 __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
-           "stall_heartbeat", "kill_self", "INJECTORS"]
+           "stall_heartbeat", "kill_self", "nan_payload", "bad_sample",
+           "dead_worker", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -144,6 +151,90 @@ def kill_self(sig: int = signal.SIGKILL) -> None:
     os.kill(os.getpid(), sig)
 
 
+# ---------------------------------------------------------------------------
+# runtime-anomaly injectors (paddle_tpu.health; ISSUE 3)
+# ---------------------------------------------------------------------------
+
+def nan_payload(x, frac: float = 1.0, value: float = float("nan"),
+                seed: int = 0):
+    """Poison a numpy array (or a nested batch of them) with NaN/Inf —
+    models an overflowed reduction, a bf16 numerics edge, or corrupt DMA
+    landing in an activations/input buffer: the fault the on-device
+    sentinel must catch as a bad step. ``frac`` of the elements (chosen by
+    ``seed``) are replaced; returns a poisoned COPY."""
+    import numpy as np
+    if isinstance(x, dict):
+        return {k: nan_payload(v, frac, value, seed) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(nan_payload(v, frac, value, seed) for v in x)
+    arr = np.array(x, copy=True)
+    if not np.issubdtype(arr.dtype, np.floating):
+        return arr          # int payloads can't carry NaN — pass through
+    flat = arr.reshape(-1)
+    n = max(1, int(flat.size * min(1.0, max(0.0, frac))))
+    idx = random.Random(seed).sample(range(flat.size), n) \
+        if n < flat.size else slice(None)
+    flat[idx] = value
+    return arr
+
+
+class bad_sample:
+    """Dataset wrapper: ``__getitem__`` raises for the chosen indices —
+    models a corrupt record / undecodable image. ``fails_each=None`` makes
+    the fault DETERMINISTIC (every access raises: the quarantine path);
+    ``fails_each=n`` makes it TRANSIENT (the first n accesses per index
+    raise, then heal: the retry/backoff path)."""
+
+    def __init__(self, dataset, indices, fails_each: Optional[int] = None,
+                 exc_type=ValueError):
+        self.dataset = dataset
+        self.bad = set(int(i) for i in indices)
+        self.fails_each = fails_each
+        self.exc_type = exc_type
+        self._counts = {}
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, i):
+        if int(i) in self.bad:
+            n = self._counts.get(int(i), 0)
+            if self.fails_each is None or n < self.fails_each:
+                self._counts[int(i)] = n + 1
+                raise self.exc_type(
+                    f"chaos: injected bad sample at index {i} "
+                    f"(attempt {n + 1})")
+        return self.dataset[i]
+
+
+class dead_worker:
+    """Dataset wrapper: the DataLoader worker that fetches ``at_index``
+    SIGKILLs itself — a segfault/OOM in dataset code mid-epoch. The death
+    fires ONCE per ``marker`` file (fork-shared), so the resurrected
+    replacement worker survives the re-queued batch and the epoch heals."""
+
+    def __init__(self, dataset, at_index: int, marker: str,
+                 sig: int = signal.SIGKILL):
+        self.dataset = dataset
+        self.at_index = int(at_index)
+        self.marker = marker
+        self.sig = sig
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, i):
+        if int(i) == self.at_index:
+            try:
+                fd = os.open(self.marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pass            # already died once — the replacement lives
+            else:
+                os.close(fd)
+                os.kill(os.getpid(), self.sig)
+        return self.dataset[i]
+
+
 # name -> injector; docs/FAULT_TOLERANCE.md's generated injector count
 # (tools/refresh_docs.py) reads this registry
 INJECTORS = {
@@ -153,4 +244,7 @@ INJECTORS = {
     "async_writer_fault": async_writer_fault,
     "stall_heartbeat": stall_heartbeat,
     "kill_self": kill_self,
+    "nan_payload": nan_payload,
+    "bad_sample": bad_sample,
+    "dead_worker": dead_worker,
 }

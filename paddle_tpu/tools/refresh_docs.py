@@ -61,6 +61,9 @@ def measured_counts() -> dict:
            and issubclass(getattr(lrmod, n), base)
            and n != "LRScheduler"]
     from paddle_tpu.testing.chaos import INJECTORS
+    from paddle_tpu.flags import get_flags
+    health_flags = sorted(n for n in get_flags()
+                          if n.startswith("FLAGS_health_"))
     return {
         "ops": total,
         "swept": covered,
@@ -70,6 +73,8 @@ def measured_counts() -> dict:
         "optimizers": len(optimizers),
         "lr_schedulers": len(lrs),
         "chaos_injectors": len(INJECTORS),
+        "health_flags": len(health_flags),
+        "_health_flag_rows": health_flags,   # consumed by health_flags_table
     }
 
 
@@ -127,6 +132,17 @@ _GEN = re.compile(r"<!--gen:(?P<key>[a-z0-9_]+)-->(?P<body>.*?)"
 
 
 def render(key: str, counts: dict, bench: dict) -> str:
+    if key == "health_flags_table":
+        # generated flags table: name | default | what it gates (the help
+        # text's first sentence), straight from the live registry so the
+        # docs cannot drift from flags.py
+        from paddle_tpu.flags import _registry
+        rows = ["| flag | default | gates |", "|------|---------|-------|"]
+        for name in counts["_health_flag_rows"]:
+            d = _registry[name]
+            first = d.help.split(". ")[0].rstrip(".") + "."
+            rows.append(f"| `{name}` | `{d.default}` | {first} |")
+        return "\n" + "\n".join(rows) + "\n"
     if key in counts:
         return str(counts[key])
     if key == "sweep_line":

@@ -54,3 +54,25 @@ def test_bench_input_entry_point():
     assert "input_overlap_pct" in metrics
     assert metrics["input_h2d_ms_per_batch"]["value"] > 0
     assert 0.0 <= metrics["input_overlap_pct"]["value"] <= 100.0
+
+
+def test_bench_health_entry_point():
+    """The run-health section (ISSUE 3): sentinel overhead row on the
+    tuned llama path plus the in-bench containment proof (a NaN-poisoned
+    step must be flagged bad by the fused detector)."""
+    metrics, proc = _run_bench("--health", "--steps", "1")
+    assert "health_sentinel_overhead_pct" in metrics, \
+        proc.stdout + proc.stderr
+    detail = None
+    for line in proc.stderr.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "health" in d:
+                detail = d["health"]
+    assert detail is not None, proc.stderr
+    assert detail["nan_step_flagged"] is True
+    assert detail["nan_step_contained"] is True

@@ -169,6 +169,309 @@ class TestFastChaos:
 
 
 # ---------------------------------------------------------------------------
+# runtime-anomaly chaos (ISSUE 3): drive paddle_tpu.health + the self-
+# healing dataloader through the nan_payload / bad_sample / dead_worker
+# injectors — tier-1 smokes here, convergence parity in the slow tier
+# ---------------------------------------------------------------------------
+
+import warnings
+
+import paddle_tpu.nn as _nn
+from paddle_tpu import health
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.jit.train_step import make_train_step
+from paddle_tpu.optimizer import SGD
+
+
+class _IotaDS(Dataset):
+    def __init__(self, n=32, dim=3):
+        self.n, self.dim = n, dim
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((self.dim,), float(i), np.float32)
+
+
+class TestRuntimeChaos:
+    """Tier-1 smoke chaos for the runtime-anomaly injectors."""
+
+    def test_nan_payload_step_skipped_state_intact(self):
+        """Injected NaN batch -> the fused sentinel skips the update with
+        params AND optimizer accumulators bitwise intact (the acceptance
+        bullet)."""
+        paddle.seed(0)
+        net = _nn.Sequential(_nn.Linear(4, 8), _nn.ReLU(), _nn.Linear(8, 2))
+        from paddle_tpu.optimizer import Momentum
+        opt = Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=net.parameters())
+        step = make_train_step(net, opt, _nn.CrossEntropyLoss(),
+                               sentinel=True)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4).astype("float32")
+        y = rng.randint(0, 2, (8,)).astype("int64")
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # warmup
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # compiled
+        w0 = {p.name: p.numpy().copy() for p in net.parameters()}
+        acc0 = {k: {n: t.numpy().copy() for n, t in s.items()}
+                for k, s in opt._accumulators.items()}
+        loss = float(step(paddle.to_tensor(chaos.nan_payload(x)),
+                          paddle.to_tensor(y)))
+        assert not np.isfinite(loss) and step.sentinel.last_bad
+        for p in net.parameters():
+            np.testing.assert_array_equal(p.numpy(), w0[p.name])
+        for k, s in opt._accumulators.items():
+            for n, t in s.items():
+                np.testing.assert_array_equal(t.numpy(), acc0[k][n])
+
+    def test_k_consecutive_nan_triggers_last_good_restore(self, tmp_path):
+        """K NaN steps in a row escalate through HealthMonitor to an
+        AsyncCheckpointer last-good restore (the acceptance bullet)."""
+        import jax.numpy as jnp
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), keep_last_k=2)
+
+        def stepfn(params, opt, x):
+            loss = (params["w"] * x).mean()
+            return ({"w": params["w"] - 0.1 * x.mean()},
+                    {"n": opt["n"] + 1}, loss)
+
+        g = health.guard_step(stepfn)
+        sent = health.sentinel_init()
+        params = {"w": jnp.full((4,), 3.0)}
+        opt = {"n": jnp.zeros((), jnp.int32)}
+        mon = health.HealthMonitor(checkpointer=ck, skip_threshold=2,
+                                   max_restores=2, verbose=False)
+        # healthy prefix with a commit
+        params, opt, sent, h = g(params, opt, sent, jnp.ones((4,)))
+        good_w = np.asarray(params["w"]).copy()
+        state = {"w": paddle.to_tensor(good_w),
+                 "n": paddle.to_tensor(np.asarray(opt["n"]))}
+        ck.save(state, 1)
+        ck.wait()
+        mon.observe(1, *health.unpack_health(h)[:2])
+        # K=2 consecutive NaN batches: skip then RESTORE
+        nan_x = jnp.asarray(chaos.nan_payload(np.ones((4,), np.float32)))
+        actions = []
+        for s in (2, 3):
+            params, opt, sent, h = g(params, opt, sent, nan_x)
+            loss, bad, _ = health.unpack_health(h)
+            actions.append(mon.observe(s, loss, bad).action)
+        assert actions == [health.HealthAction.SKIP,
+                           health.HealthAction.RESTORE]
+        np.testing.assert_array_equal(np.asarray(params["w"]), good_w)
+        dst = {"w": paddle.to_tensor(np.zeros((4,), np.float32)),
+               "n": paddle.to_tensor(np.zeros((), np.int32))}
+        assert mon.restore(dst) == 1
+        np.testing.assert_array_equal(dst["w"].numpy(), good_w)
+
+    def test_bad_sample_transient_healed_by_retry(self):
+        ds = chaos.bad_sample(_IotaDS(), [5], fails_each=2)
+        dl = DataLoader(ds, batch_size=4, sample_retries=3,
+                        sample_retry_backoff=0.001, use_buffer_reader=False)
+        batches = list(dl)
+        assert len(batches) == 8
+        assert all(b.shape[0] == 4 for b in batches)   # nothing dropped
+
+    def test_bad_sample_deterministic_quarantined(self):
+        ds = chaos.bad_sample(_IotaDS(), [6], fails_each=None)
+        dl = DataLoader(ds, batch_size=4, sample_retries=1,
+                        sample_retry_backoff=0.001, use_buffer_reader=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            sizes = [b.shape[0] for b in dl]          # epoch 1: quarantines
+            sizes2 = [b.shape[0] for b in dl]         # epoch 2: no re-pay
+        assert sizes.count(3) == 1 and sizes.count(4) == 7
+        assert sizes2.count(3) == 1
+        msgs = [str(x.message) for x in w]
+        assert sum("quarantined" in m for m in msgs) == 1   # warned ONCE
+
+    def test_bad_sample_quarantine_persists_across_mp_epochs(self, tmp_path):
+        """Workers report quarantined indices back to the parent, and the
+        next epoch's (freshly forked) workers inherit them — the bad
+        index is dropped outright instead of re-paying the retries."""
+        access_dir = tmp_path / "accesses"
+        access_dir.mkdir()
+
+        class _Tracked(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                if int(i) == 6:    # fork-shared access ledger on disk
+                    n = len(list(access_dir.iterdir()))
+                    (access_dir / f"a{n}").touch()
+                    raise ValueError("always bad")
+                return np.full((3,), float(i), np.float32)
+
+        dl = DataLoader(_Tracked(), batch_size=4, num_workers=2,
+                        sample_retries=1, sample_retry_backoff=0.001,
+                        use_buffer_reader=False)
+        sizes1 = [b.shape[0] for b in dl]
+        assert sizes1.count(3) == 1 and dl._quarantined == {6}
+        hits_epoch1 = len(list(access_dir.iterdir()))
+        assert hits_epoch1 == 2            # 1 try + 1 retry, then quarantine
+        sizes2 = [b.shape[0] for b in dl]
+        assert sizes2.count(3) == 1        # still dropped...
+        assert len(list(access_dir.iterdir())) == hits_epoch1   # ...unfetched
+
+    def test_fully_quarantined_batch_skipped_not_fatal(self):
+        """Every index of one batch bad: the batch is dropped and the
+        epoch (and the NEXT epoch) completes — self-healing must survive
+        even a fully-poisoned batch."""
+        ds = chaos.bad_sample(_IotaDS(), [4, 5, 6, 7], fails_each=None)
+        for workers in (0, 2):
+            dl = DataLoader(ds, batch_size=4, num_workers=workers,
+                            sample_retries=0, sample_retry_backoff=0.001,
+                            quarantine_bad_samples=True,
+                            use_buffer_reader=False)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                firsts1 = sorted(float(b.numpy().ravel()[0]) for b in dl)
+                firsts2 = sorted(float(b.numpy().ravel()[0]) for b in dl)
+            assert firsts1 == firsts2
+            assert len(firsts1) == 7 and 4.0 not in firsts1, (workers,
+                                                             firsts1)
+
+    def test_bad_sample_raises_without_optin(self):
+        ds = chaos.bad_sample(_IotaDS(), [2], fails_each=None)
+        dl = DataLoader(ds, batch_size=4, use_buffer_reader=False)
+        with pytest.raises(ValueError, match="injected bad sample"):
+            list(dl)   # default behavior unchanged: the epoch fails
+
+    def test_dead_worker_resurrected_mid_epoch(self, tmp_path):
+        """A SIGKILLed worker is replaced and its in-flight batches
+        re-queued — the epoch completes with every batch (the acceptance
+        bullet)."""
+        ds = chaos.dead_worker(_IotaDS(), at_index=9,
+                               marker=str(tmp_path / "died"))
+        dl = DataLoader(ds, batch_size=4, num_workers=2, worker_restarts=2,
+                        use_buffer_reader=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            firsts = sorted(float(b.numpy().ravel()[0]) for b in dl)
+        assert firsts == [0.0, 4.0, 8.0, 12.0, 16.0, 20.0, 24.0, 28.0]
+        assert (tmp_path / "died").exists()           # the kill DID fire
+        assert any("resurrecting" in str(x.message) for x in w)
+
+    def test_dead_worker_fail_fast_names_signal(self, tmp_path):
+        ds = chaos.dead_worker(_IotaDS(), at_index=3,
+                               marker=str(tmp_path / "died"))
+        dl = DataLoader(ds, batch_size=4, num_workers=2,
+                        use_buffer_reader=False)
+        with pytest.raises(RuntimeError, match="SIGKILL"):
+            list(dl)
+
+    def test_stalled_rank_reported_by_watchdog_not_hanging(self):
+        """chaos.stall_heartbeat in-process: the HeartbeatMonitor watchdog
+        names the frozen rank instead of the suite hanging on it (the
+        acceptance bullet)."""
+        from paddle_tpu.distributed import elastic
+        monitor = elastic.HeartbeatMonitor("chaos-wd")
+        try:
+            os.environ["PADDLE_ELASTIC_STORE"] = monitor.addr
+            os.environ["PADDLE_JOB_ID"] = "chaos-wd"
+            elastic.start_heartbeat(rank=0, interval=0.1)
+            deadline = time.time() + 5.0
+            while monitor.last_beat(0) is None:       # first stamp landed
+                assert time.time() < deadline
+                time.sleep(0.02)
+            wd = monitor.start_watchdog([0], ttl=0.6, poll=0.1)
+            try:
+                with chaos.stall_heartbeat():
+                    with pytest.raises(TimeoutError, match=r"\[0\]"):
+                        wd.wait(timeout=5.0)
+                assert wd.hung == [0]
+            finally:
+                wd.stop()
+        finally:
+            elastic.stop_heartbeat()
+            os.environ.pop("PADDLE_ELASTIC_STORE", None)
+            os.environ.pop("PADDLE_JOB_ID", None)
+            monitor.close()
+
+
+@pytest.mark.slow
+class TestRuntimeChaosConvergence:
+    def test_anomalous_run_converges_to_clean_loss(self, tmp_path):
+        """Convergence parity: a run with injected NaN bursts (skipped +
+        rolled back) and a self-healing loader under transient sample
+        faults reaches the clean run's loss within tolerance."""
+        import jax.numpy as jnp
+
+        def make_ds(poison):
+            rng = np.random.RandomState(0)
+            X = rng.randn(64, 3).astype(np.float32)
+            W = np.array([[1.5], [-2.0], [0.5]], np.float32)
+            y = X @ W
+
+            class _DS(Dataset):
+                def __len__(self):
+                    return 64
+
+                def __getitem__(self, i):
+                    return X[i], y[i]
+
+            ds = _DS()
+            if poison:
+                ds = chaos.bad_sample(ds, [11, 40], fails_each=1)
+            return ds
+
+        def stepfn(params, opt, x, t):
+            pred = x @ params["w"]
+            loss = ((pred - t) ** 2).mean()
+            g = 2.0 * x.T @ (pred - t) / x.shape[0]
+            return ({"w": params["w"] - 0.05 * g},
+                    {"n": opt["n"] + 1}, loss)
+
+        def run(poison):
+            ck = AsyncCheckpointer(
+                str(tmp_path / ("ck_p" if poison else "ck_c")),
+                keep_last_k=3)
+            g = health.guard_step(stepfn)
+            sent = health.sentinel_init()
+            params = {"w": jnp.zeros((3, 1))}
+            opt = {"n": jnp.zeros((), jnp.int32)}
+            mon = health.HealthMonitor(checkpointer=ck, skip_threshold=3,
+                                       max_restores=3, verbose=False)
+            loader = DataLoader(
+                make_ds(poison), batch_size=8, shuffle=False,
+                sample_retries=2 if poison else 0,
+                sample_retry_backoff=0.001, use_buffer_reader=False)
+            step = 0
+            final = None
+            for epoch in range(12):
+                for batch in loader:
+                    x = jnp.asarray(batch[0].numpy())
+                    t = jnp.asarray(batch[1].numpy())
+                    if poison and epoch in (2, 5) and step % 8 == 5:
+                        # a NaN burst shorter than K: pure skips
+                        x = jnp.asarray(chaos.nan_payload(
+                            np.asarray(x), frac=0.25))
+                    params, opt, sent, h = g(params, opt, sent, x, t)
+                    loss, bad, _ = health.unpack_health(h)
+                    rec = mon.observe(step, loss, bad)
+                    if rec.action is health.HealthAction.RESTORE:
+                        state = {"w": paddle.to_tensor(
+                            np.zeros((3, 1), np.float32))}
+                        mon.restore(state)
+                        params = {"w": jnp.asarray(state["w"].numpy())}
+                    if not bad:
+                        final = loss
+                    step += 1
+                if epoch % 3 == 2:
+                    ck.save({"w": paddle.to_tensor(
+                        np.asarray(params["w"]))}, step)
+                    ck.wait()
+            return final, mon
+
+        clean, _ = run(False)
+        faulted, mon = run(True)
+        assert mon.bad_steps >= 2          # anomalies actually fired
+        np.testing.assert_allclose(faulted, clean, rtol=5e-2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
 # launcher-driven chaos: inject the fault into a real elastic job and
 # require convergence parity with the unfaulted run
 # ---------------------------------------------------------------------------

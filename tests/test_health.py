@@ -1,0 +1,689 @@
+"""Run-health subsystem tests (ISSUE 3): on-device sentinel skip semantics,
+the HealthMonitor escalation ladder, hang watchdogs, the hapi
+AnomalyMonitor callback, and the satellite fixes (EarlyStopping /
+ReduceLROnPlateau NaN handling, GradScaler single-fetch found_inf +
+checkpoint round-trip)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import health
+from paddle_tpu.io import Dataset
+from paddle_tpu.jit.train_step import make_train_step
+from paddle_tpu.optimizer import SGD, Momentum
+
+import jax
+import jax.numpy as jnp
+
+
+def _toy_step():
+    """A pure functional step over a 1-param model: params {'w'}, opt {'n'}."""
+    def step(params, opt, x):
+        loss = ((params["w"] - x) ** 2).mean()
+        g = 2.0 * (params["w"] - x) / x.size
+        return ({"w": params["w"] - 0.1 * g.mean() * jnp.ones_like(params["w"])},
+                {"n": opt["n"] + 1}, loss)
+    return step
+
+
+class TestSentinelFunctional:
+    def test_good_step_updates_and_counts(self):
+        g = health.guard_step(_toy_step())
+        sent = health.sentinel_init()
+        p, o = {"w": jnp.ones((3,))}, {"n": jnp.zeros((), jnp.int32)}
+        p, o, sent, h = g(p, o, sent, jnp.zeros((3,)))
+        loss, bad, ema = health.unpack_health(h)
+        assert not bad and np.isfinite(loss)
+        assert int(o["n"]) == 1
+        assert not np.allclose(np.asarray(p["w"]), 1.0)
+
+    def test_nan_step_is_noop_on_state(self):
+        g = health.guard_step(_toy_step())
+        sent = health.sentinel_init()
+        p, o = {"w": jnp.ones((3,))}, {"n": jnp.zeros((), jnp.int32)}
+        p, o, sent, h = g(p, o, sent, jnp.zeros((3,)))   # one good step
+        p2, o2, sent, h = g(p, o, sent, jnp.full((3,), np.nan))
+        loss, bad, _ = health.unpack_health(h)
+        assert bad and not np.isfinite(loss)
+        np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(p["w"]))
+        assert int(o2["n"]) == int(o["n"])   # optimizer state intact
+
+    def test_nan_step_does_not_advance_ema(self):
+        g = health.guard_step(_toy_step())
+        sent = health.sentinel_init()
+        p, o = {"w": jnp.ones((3,))}, {"n": jnp.zeros((), jnp.int32)}
+        p, o, sent, h = g(p, o, sent, jnp.zeros((3,)))
+        _, _, ema0 = health.unpack_health(h)
+        p, o, sent, h = g(p, o, sent, jnp.full((3,), np.inf))
+        _, bad, ema1 = health.unpack_health(h)
+        assert bad and ema1 == ema0   # one bad loss must not poison the EMA
+
+    def test_spike_detection_after_warmup(self):
+        def step(params, opt, x):
+            return params, opt, x.sum()
+        g = health.guard_step(step, spike_factor=5.0, warmup=2)
+        sent = health.sentinel_init()
+        p, o = {"w": jnp.ones(())}, {"n": jnp.zeros((), jnp.int32)}
+        for _ in range(3):   # seed the EMA at ~1.0
+            p, o, sent, h = g(p, o, sent, jnp.ones(()))
+        _, bad, _ = health.unpack_health(h)
+        assert not bad
+        p, o, sent, h = g(p, o, sent, jnp.full((), 50.0))  # 50 > 5 * 1.0
+        _, bad, _ = health.unpack_health(h)
+        assert bad
+
+    def test_spike_not_armed_during_warmup(self):
+        def step(params, opt, x):
+            return params, opt, x.sum()
+        g = health.guard_step(step, spike_factor=5.0, warmup=10)
+        sent = health.sentinel_init()
+        p, o = {"w": jnp.ones(())}, {"n": jnp.zeros((), jnp.int32)}
+        p, o, sent, h = g(p, o, sent, jnp.ones(()))
+        p, o, sent, h = g(p, o, sent, jnp.full((), 50.0))
+        _, bad, _ = health.unpack_health(h)
+        assert not bad   # volatile early loss is not an anomaly
+
+    def test_jit_donated_parity(self):
+        """The guarded step under jax.jit with donation produces the same
+        trajectory as undonated/unjitted (the selects are pure numerics)."""
+        from paddle_tpu.jit.train_step import jit_step
+        step = _toy_step()
+        g = health.guard_step(step)
+        jg = jit_step(g, donate_argnums=(0, 1, 2))
+        x = jnp.arange(3.0)
+        pa, oa = {"w": jnp.ones((3,))}, {"n": jnp.zeros((), jnp.int32)}
+        pb, ob = {"w": jnp.ones((3,))}, {"n": jnp.zeros((), jnp.int32)}
+        sa, sb = health.sentinel_init(), health.sentinel_init()
+        for _ in range(3):
+            pa, oa, sa, ha = g(pa, oa, sa, x)
+            pb, ob, sb, hb = jg(pb, ob, sb, x)
+        np.testing.assert_array_equal(np.asarray(pa["w"]),
+                                      np.asarray(pb["w"]))
+        np.testing.assert_array_equal(np.asarray(ha), np.asarray(hb))
+
+
+class TestLlamaInUpdateGate:
+    """llama.make_train_step(sentinel=True): the bad-step gate fused INTO
+    _adamw_apply(skip=bad) — the variant bench --health's 2% bound rests
+    on — must match the unguarded step bitwise on good steps and be a
+    state-preserving no-op on bad ones."""
+
+    @staticmethod
+    def _setup(**kw):
+        from paddle_tpu.models import llama
+        cfg = llama.LlamaConfig(vocab_size=64, hidden_size=32,
+                                intermediate_size=64, num_hidden_layers=2,
+                                num_attention_heads=4)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        init_opt, base = llama.make_train_step(cfg, lr=1e-2, **kw)
+        _, guarded = llama.make_train_step(cfg, lr=1e-2, sentinel=True, **kw)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)), jnp.int32)
+        return llama, params, init_opt, base, guarded, ids
+
+    def test_good_steps_bitwise_parity(self):
+        llama, params, init_opt, base, guarded, ids = self._setup(
+            weight_decay=0.01)
+        pa, oa = params, init_opt(params)
+        pb, ob = jax.tree_util.tree_map(jnp.copy, params), init_opt(params)
+        sent = health.sentinel_init()
+        for _ in range(3):
+            pa, oa, loss = base(pa, oa, ids, ids)
+            pb, ob, sent, h = guarded(pb, ob, sent, ids, ids)
+        lossg, bad, _ = health.unpack_health(h)
+        assert not bad and np.float32(lossg) == np.float32(loss)
+        for a, b in zip(jax.tree_util.tree_leaves((pa, oa)),
+                        jax.tree_util.tree_leaves((pb, ob))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bad_step_preserves_state_exactly(self):
+        llama, params, init_opt, base, guarded, ids = self._setup()
+        p, o = params, init_opt(params)
+        sent = health.sentinel_init()
+        p, o, sent, _ = guarded(p, o, sent, ids, ids)      # one good step
+        poisoned = jax.tree_util.tree_map(
+            lambda a: (a * jnp.float32(np.nan)).astype(a.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+        p2, o2, sent2, h = guarded(poisoned, o, sent, ids, ids)
+        _, bad, _ = health.unpack_health(h)
+        assert bad
+        assert int(o2["step"]) == int(o["step"])           # counter frozen
+        for a, b in zip(jax.tree_util.tree_leaves((o["m"], o["v"])),
+                        jax.tree_util.tree_leaves((o2["m"], o2["v"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bad_first_step_no_bias_correction_nan(self):
+        """A skipped FIRST step leaves the counter at 0; the bias
+        correction t must clamp to 1 or 1-beta**0 = 0 turns the update
+        into 0/0 and lr_eff=0 can't mask the NaN (0*NaN=NaN)."""
+        llama, params, init_opt, base, guarded, ids = self._setup()
+        o = init_opt(params)
+        poisoned = jax.tree_util.tree_map(
+            lambda a: (a * jnp.float32(np.nan)).astype(a.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+        p2, o2, sent, h = guarded(poisoned, o, sent := health.sentinel_init(),
+                                  ids, ids)
+        _, bad, _ = health.unpack_health(h)
+        assert bad and int(o2["step"]) == 0
+        for a in jax.tree_util.tree_leaves((o2["m"], o2["v"])):
+            assert bool(jnp.isfinite(a).all())
+        # and the run recovers: a clean batch after the skipped first step
+        p3, o3, sent, h = guarded(params, o2, sent, ids, ids)
+        loss, bad, _ = health.unpack_health(h)
+        assert not bad and np.isfinite(loss) and int(o3["step"]) == 1
+
+
+class TestSentinelFused:
+    """Sentinel fused into jit.train_step.TrainStep (imperative path)."""
+
+    def _setup(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=net.parameters())
+        step = make_train_step(net, opt, nn.CrossEntropyLoss(),
+                               sentinel=True)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype("float32")
+        y = rng.integers(0, 2, (8,)).astype("int64")
+        return net, opt, step, x, y
+
+    def test_nan_batch_skipped_state_intact_compiled(self):
+        from paddle_tpu.testing import chaos
+        net, opt, step, x, y = self._setup()
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # eager warmup
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # compiled
+        assert not step.sentinel.last_bad
+        w0 = {p.name: p.numpy().copy() for p in net.parameters()}
+        acc0 = {k: {n: t.numpy().copy() for n, t in s.items()}
+                for k, s in opt._accumulators.items()}
+        loss = float(step(paddle.to_tensor(chaos.nan_payload(x)),
+                          paddle.to_tensor(y)))
+        assert not np.isfinite(loss) and step.sentinel.last_bad
+        for p in net.parameters():      # params bitwise intact
+            np.testing.assert_array_equal(p.numpy(), w0[p.name])
+        for k, s in opt._accumulators.items():   # accumulators intact
+            for n, t in s.items():
+                np.testing.assert_array_equal(t.numpy(), acc0[k][n])
+        # and the step recovers with no recompile side effects
+        l2 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert np.isfinite(l2) and not step.sentinel.last_bad
+
+    def test_nan_on_very_first_step_rolls_back_unborn_accumulators(self):
+        """Regression: a NaN on the FIRST step, before the optimizer's
+        lazily-created accumulators exist, must not poison them — they
+        roll back to their unborn state (creation fill: velocity 0, Adam
+        beta pows 1.0) and the run recovers as if the step never ran."""
+        from paddle_tpu.optimizer import Adam
+        from paddle_tpu.testing import chaos
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = Adam(learning_rate=0.05, parameters=net.parameters())
+        step = make_train_step(net, opt, nn.CrossEntropyLoss(),
+                               sentinel=True)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype("float32")
+        y = rng.integers(0, 2, (8,)).astype("int64")
+        w0 = {p.name: p.numpy().copy() for p in net.parameters()}
+        loss = float(step(paddle.to_tensor(chaos.nan_payload(x)),
+                          paddle.to_tensor(y)))   # FIRST call, eager, NaN
+        assert not np.isfinite(loss) and step.sentinel.last_bad
+        for p in net.parameters():
+            np.testing.assert_array_equal(p.numpy(), w0[p.name])
+        for name, store in opt._accumulators.items():
+            for pname, t in store.items():
+                v = t.numpy()
+                assert np.isfinite(v).all(), (name, pname)
+                if name in ("moment1", "moment2"):
+                    np.testing.assert_array_equal(v, np.zeros_like(v))
+                if name in ("beta1_pow_acc", "beta2_pow_acc"):
+                    np.testing.assert_array_equal(v, np.ones_like(v))
+        # clean steps after the poisoned first one must train normally
+        for _ in range(3):
+            l2 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+            assert np.isfinite(l2) and not step.sentinel.last_bad
+        for p in net.parameters():
+            assert np.isfinite(p.numpy()).all()
+
+    def test_sentinel_parity_with_unguarded(self):
+        """On clean data the sentinel changes nothing: K steps of the
+        guarded fused step == K steps of the unguarded one, bitwise."""
+        paddle.seed(0)
+        net_a = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        paddle.seed(0)
+        net_b = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt_a = SGD(learning_rate=0.1, parameters=net_a.parameters())
+        opt_b = SGD(learning_rate=0.1, parameters=net_b.parameters())
+        sa = make_train_step(net_a, opt_a, nn.CrossEntropyLoss(),
+                             sentinel=True)
+        sb = make_train_step(net_b, opt_b, nn.CrossEntropyLoss(),
+                             sentinel=False)
+        rng = np.random.default_rng(1)
+        for i in range(3):
+            x = rng.standard_normal((8, 4)).astype("float32")
+            y = rng.integers(0, 2, (8,)).astype("int64")
+            la = float(sa(paddle.to_tensor(x), paddle.to_tensor(y)))
+            lb = float(sb(paddle.to_tensor(x), paddle.to_tensor(y)))
+            assert la == lb, (i, la, lb)
+        for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+            np.testing.assert_array_equal(pa.numpy(), pb.numpy())
+
+    def test_flag_default_off(self):
+        net = nn.Sequential(nn.Linear(2, 2))
+        opt = SGD(learning_rate=0.1, parameters=net.parameters())
+        step = make_train_step(net, opt, nn.CrossEntropyLoss())
+        assert step.sentinel is None   # FLAGS_health_sentinel defaults off
+
+    def test_flag_enables_sentinel(self):
+        paddle.set_flags({"FLAGS_health_sentinel": True})
+        try:
+            net = nn.Sequential(nn.Linear(2, 2))
+            opt = SGD(learning_rate=0.1, parameters=net.parameters())
+            step = make_train_step(net, opt, nn.CrossEntropyLoss())
+            assert step.sentinel is not None
+        finally:
+            paddle.set_flags({"FLAGS_health_sentinel": False})
+
+
+class TestHealthMonitor:
+    def test_skip_then_restore_then_abort_ladder(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+        ck = AsyncCheckpointer(str(tmp_path / "ck"), keep_last_k=2)
+        state = {"w": paddle.to_tensor(np.full((4,), 5.0, np.float32))}
+        ck.save(state, 7)
+        ck.wait()
+        mon = health.HealthMonitor(checkpointer=ck, skip_threshold=2,
+                                   max_restores=1, lr_backoff=0.5,
+                                   verbose=False)
+        assert mon.observe(0, 1.0).action is health.HealthAction.OK
+        assert mon.observe(1, float("nan")).action is health.HealthAction.SKIP
+        r = mon.observe(2, float("nan"))
+        assert r.action is health.HealthAction.RESTORE and r.streak == 2
+        dst = {"w": paddle.to_tensor(np.zeros((4,), np.float32))}
+        assert mon.restore(dst) == 7
+        np.testing.assert_array_equal(dst["w"].numpy(), np.full((4,), 5.0))
+        assert mon.lr_scale == 0.5
+        # second escalation exceeds max_restores=1 -> abort with diagnosis
+        mon.observe(3, float("nan"))
+        mon.observe(4, float("nan"))
+        with pytest.raises(health.HealthAbortError, match="Recent anomalies"):
+            mon.restore(dst)
+
+    def test_good_step_resets_streak(self):
+        mon = health.HealthMonitor(skip_threshold=2, verbose=False)
+        mon.observe(0, float("nan"))
+        mon.observe(1, 1.0)
+        r = mon.observe(2, float("nan"))
+        assert r.action is health.HealthAction.SKIP and r.streak == 1
+
+    def test_host_spike_detection(self):
+        mon = health.HealthMonitor(spike_factor=10.0, spike_warmup=3,
+                                   verbose=False)
+        for i in range(5):
+            assert mon.observe(i, 2.0).action is health.HealthAction.OK
+        r = mon.observe(5, 100.0)   # 100 > 10 * 2.0
+        assert r.action is health.HealthAction.SKIP and r.kind == "spike"
+
+    def test_host_spike_not_armed_during_warmup(self):
+        """Same arming rule as the device sentinel: no spike verdicts
+        before spike_warmup good steps seeded the EMA."""
+        mon = health.HealthMonitor(spike_factor=2.0, spike_warmup=20,
+                                   verbose=False)
+        assert mon.observe(0, 10.0).action is health.HealthAction.OK
+        assert mon.observe(1, 25.0).action is health.HealthAction.OK
+
+    def test_restore_without_checkpointer_counts_only(self):
+        mon = health.HealthMonitor(skip_threshold=1, max_restores=2,
+                                   verbose=False)
+        mon.observe(0, float("nan"))
+        assert mon.restore() is None
+        assert mon.restores == 1 and mon.streak == 0
+
+    def test_records_are_structured(self):
+        mon = health.HealthMonitor(verbose=False)
+        mon.observe(3, float("nan"))
+        rec = mon.records[-1]
+        assert isinstance(rec, health.AnomalyRecord)
+        assert rec.step == 3 and rec.kind == "nan" and rec.streak == 1
+
+
+class TestHangWatchdog:
+    def test_fires_with_section_diagnosis(self):
+        fired = []
+        wd = health.HangWatchdog(timeout=0.3, name="t",
+                                 on_hang=fired.append, poll=0.05)
+        try:
+            with wd.section("collective:all_reduce"):
+                time.sleep(0.8)
+            assert wd.fired.is_set()
+            assert "collective:all_reduce" in fired[0]
+            assert "Thread stacks" in fired[0]
+            with pytest.raises(health.WatchdogAlarm):
+                wd.check()
+        finally:
+            wd.stop()
+
+    def test_ticks_keep_it_quiet(self):
+        wd = health.HangWatchdog(timeout=0.4, name="t", poll=0.05,
+                                 on_hang=lambda d: None)
+        try:
+            for _ in range(10):
+                wd.tick()
+                time.sleep(0.06)
+            assert not wd.fired.is_set()
+        finally:
+            wd.stop()
+
+    def test_global_install_touch_section(self):
+        fired = []
+        wd = health.install(timeout=0.3, on_hang=fired.append, poll=0.05)
+        try:
+            assert health.watchdog.current() is wd
+            with health.section("collective:barrier"):
+                time.sleep(0.7)
+            assert wd.fired.is_set() and "collective:barrier" in fired[0]
+        finally:
+            health.uninstall()
+        assert health.watchdog.current() is None
+        health.touch()   # no-op when uninstalled
+
+    def test_install_flag_off_is_noop(self):
+        assert health.install() is None   # FLAGS_health_watchdog_timeout_s=0
+
+
+class TestRankWatchdog:
+    def test_stalled_rank_reported_not_hung(self):
+        """The launcher-side watchdog names the frozen rank instead of
+        letting a consumer block forever."""
+        from paddle_tpu.distributed import elastic
+        m = elastic.HeartbeatMonitor("rwd")
+        try:
+            now = time.time()
+            m.store.set("hb/rwd/0", f"{now:.3f}")
+            m.store.set("hb/rwd/1", f"{now - 120:.3f}")   # frozen
+            wd = m.start_watchdog([0, 1], ttl=5.0, poll=0.05)
+            try:
+                with pytest.raises(TimeoutError, match=r"\[1\].*hung"):
+                    wd.wait(timeout=3.0)
+                assert wd.hung == [1]
+            finally:
+                wd.stop()
+        finally:
+            m.close()
+
+    def test_healthy_ranks_no_report(self):
+        from paddle_tpu.distributed import elastic
+        m = elastic.HeartbeatMonitor("rwd2")
+        try:
+            m.store.set("hb/rwd2/0", f"{time.time():.3f}")
+            wd = m.start_watchdog([0], ttl=30.0, poll=0.05)
+            try:
+                assert wd.wait(timeout=0.3) is False
+                assert not wd.hung
+            finally:
+                wd.stop()
+        finally:
+            m.close()
+
+
+# ---------------------------------------------------------------------------
+# hapi AnomalyMonitor callback
+# ---------------------------------------------------------------------------
+
+class _ToyDS(Dataset):
+    def __init__(self, n=32, nan_from=None, nan_until=None):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 4)).astype("float32")
+        self.y = rng.integers(0, 2, (n,)).astype("int64")
+        self.nan_from = nan_from
+        self.nan_until = n if nan_until is None else nan_until
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        x = self.x[i]
+        if self.nan_from is not None and self.nan_from <= i < self.nan_until:
+            x = np.full_like(x, np.nan)
+        return x, self.y[i]
+
+
+def _toy_model(seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(SGD(learning_rate=0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    return model
+
+
+class TestAnomalyMonitor:
+    def test_rollback_after_k_consecutive_bad(self):
+        from paddle_tpu.callbacks import AnomalyMonitor
+        model = _toy_model()
+        cb = AnomalyMonitor(skip_threshold=2, max_restores=3, verbose=0)
+        # 2 NaN batches mid-epoch (indices 8..15 at batch_size=4), then clean
+        model.fit(_ToyDS(nan_from=8, nan_until=16), batch_size=4, epochs=1,
+                  verbose=0, shuffle=False, callbacks=[cb])
+        assert cb.monitor.restores == 1
+        assert cb.monitor.bad_steps == 2
+        for p in model.network.parameters():   # rollback left finite weights
+            assert np.isfinite(p.numpy()).all()
+
+    def test_abort_after_m_restores(self):
+        from paddle_tpu.callbacks import AnomalyMonitor
+        model = _toy_model()
+        cb = AnomalyMonitor(skip_threshold=2, max_restores=1, verbose=0)
+        with pytest.raises(health.HealthAbortError):
+            model.fit(_ToyDS(nan_from=8), batch_size=4, epochs=2,
+                      verbose=0, shuffle=False, callbacks=[cb])
+        assert cb.monitor.restores == 1
+
+    def test_lr_backoff_applied(self):
+        from paddle_tpu.callbacks import AnomalyMonitor
+        model = _toy_model()
+        cb = AnomalyMonitor(skip_threshold=1, max_restores=4, lr_backoff=0.5,
+                            verbose=0)
+        model.fit(_ToyDS(nan_from=8, nan_until=12), batch_size=4, epochs=1,
+                  verbose=0, shuffle=False, callbacks=[cb])
+        assert cb.monitor.restores >= 1
+        assert model._optimizer.get_lr() == pytest.approx(
+            0.1 * 0.5 ** cb.monitor.restores)
+
+    def test_rollback_reaches_compiled_fused_step(self):
+        """Regression: Optimizer.set_state_dict must restore accumulator
+        VALUES in place — the compiled fused program holds the tensor
+        identities as state slots, so a rebinding restore would silently
+        never reach it (and the dict would desync from the live step)."""
+        from paddle_tpu.optimizer import Momentum
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=net.parameters())
+        step = make_train_step(net, opt, nn.CrossEntropyLoss(),
+                               sentinel=True)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype("float32")
+        y = rng.integers(0, 2, (8,)).astype("int64")
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # warmup
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # compiled
+        saved = {k: (np.array(v.numpy(), copy=True)
+                     if hasattr(v, "numpy") else v)
+                 for k, v in opt.state_dict().items()}
+        ids_before = {n: {p: id(t) for p, t in s.items()}
+                      for n, s in opt._accumulators.items()}
+        float(step(paddle.to_tensor(x), paddle.to_tensor(y)))  # advance
+        opt.set_state_dict(saved)                              # roll back
+        for n, s in opt._accumulators.items():   # identity preserved
+            for p, t in s.items():
+                assert id(t) == ids_before[n][p], (n, p)
+                np.testing.assert_array_equal(t.numpy(), saved[f"{p}_{n}"])
+        # the COMPILED step must see the rolled-back accumulators: two
+        # runs from identical (params, accum) state are bitwise equal
+        w_snap = {k: np.array(v.numpy(), copy=True)
+                  for k, v in net.state_dict().items()}
+        l1 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        v1 = {p: t.numpy().copy()
+              for p, t in opt._accumulators["velocity"].items()}
+        net.set_state_dict(w_snap)
+        opt.set_state_dict(saved)
+        l2 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert l1 == l2
+        for p, t in opt._accumulators["velocity"].items():
+            np.testing.assert_array_equal(t.numpy(), v1[p])
+
+    def test_clean_run_untouched(self):
+        from paddle_tpu.callbacks import AnomalyMonitor
+        cb = AnomalyMonitor(verbose=0)
+        model = _toy_model()
+        model.fit(_ToyDS(), batch_size=4, epochs=1, verbose=0,
+                  callbacks=[cb])
+        assert cb.monitor.bad_steps == 0 and cb.monitor.restores == 0
+
+    def test_lr_backoff_with_scheduler_rolls_back_without_crash(self):
+        """Regression: Optimizer.set_lr raises under an LRScheduler (the
+        scheduler owns the LR) — a rollback with lr_backoff must still
+        complete (warn + skip the backoff), not abort the fit mid-recovery
+        with the scheduler's RuntimeError."""
+        import warnings
+        from paddle_tpu.callbacks import AnomalyMonitor
+        from paddle_tpu.optimizer.lr import StepDecay
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(SGD(learning_rate=StepDecay(0.1, step_size=10),
+                          parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        cb = AnomalyMonitor(skip_threshold=2, max_restores=3, lr_backoff=0.5,
+                            verbose=0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            model.fit(_ToyDS(nan_from=8, nan_until=16), batch_size=4,
+                      epochs=1, verbose=0, shuffle=False, callbacks=[cb])
+        assert cb.monitor.restores == 1
+        assert any("LRScheduler" in str(x.message) for x in w)
+        for p in model.network.parameters():
+            assert np.isfinite(p.numpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# satellite: EarlyStopping / ReduceLROnPlateau NaN audit
+# ---------------------------------------------------------------------------
+
+class _StubModel:
+    def __init__(self):
+        self.stop_training = False
+        self._optimizer = None
+
+
+class TestNaNMetricCallbacks:
+    def test_early_stopping_nan_first_epoch_not_best(self):
+        from paddle_tpu.callbacks import EarlyStopping
+        cb = EarlyStopping(monitor="loss", patience=2, verbose=0)
+        cb.set_model(_StubModel())
+        cb.on_epoch_end(0, {"loss": float("nan")})
+        assert cb.best is None and cb.wait == 1   # NaN never becomes best
+
+    def test_early_stopping_nan_run_stops_on_patience(self):
+        from paddle_tpu.callbacks import EarlyStopping
+        cb = EarlyStopping(monitor="loss", patience=2, verbose=0)
+        m = _StubModel()
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"loss": 1.0})
+        for e in range(1, 3):
+            cb.on_epoch_end(e, {"loss": float("nan")})
+        assert m.stop_training     # a NaN'd run runs out of patience
+        assert cb.best == 1.0
+
+    def test_early_stopping_max_mode_nan(self):
+        from paddle_tpu.callbacks import EarlyStopping
+        cb = EarlyStopping(monitor="acc", mode="max", patience=1, verbose=0)
+        m = _StubModel()
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"acc": float("nan")})
+        assert cb.best is None and cb.wait == 1
+
+    def test_reduce_lr_nan_not_best_and_plateaus(self):
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+
+        class _Opt:
+            _lr = 0.1
+
+            def set_lr(self, v):
+                self._lr = v
+
+            def get_lr(self):
+                return self._lr
+
+        m = _StubModel()
+        m._optimizer = _Opt()
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.set_model(m)
+        cb.on_epoch_end(0, {"loss": float("nan")})
+        assert cb.best is None and cb.wait == 1   # NaN never becomes best
+        cb.on_epoch_end(1, {"loss": float("nan")})
+        assert m._optimizer._lr == pytest.approx(0.05)   # plateau fired
+
+
+# ---------------------------------------------------------------------------
+# satellite: GradScaler found_inf single fetch + state round-trip
+# ---------------------------------------------------------------------------
+
+class TestGradScalerSatellite:
+    def _net_with_grads(self, poison=False):
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = SGD(learning_rate=0.1, parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = net(x).mean()
+        loss.backward()
+        if poison:
+            p = net.parameters()[0]
+            g = np.array(p.grad.numpy(), np.float32, copy=True)
+            g.ravel()[0] = np.inf
+            p.grad._value = jnp.asarray(g)
+        return net, opt
+
+    def test_found_inf_detected_and_step_skipped(self):
+        from paddle_tpu.amp import GradScaler
+        net, opt = self._net_with_grads(poison=True)
+        w0 = net.parameters()[0].numpy().copy()
+        scaler = GradScaler(init_loss_scaling=2.0)
+        scaler.step(opt)      # unscale -> found_inf -> skip
+        assert scaler._found_inf
+        np.testing.assert_array_equal(net.parameters()[0].numpy(), w0)
+        scaler.update()
+        assert scaler.get_init_loss_scaling() == pytest.approx(1.0)
+
+    def test_clean_grads_step_applies(self):
+        from paddle_tpu.amp import GradScaler
+        net, opt = self._net_with_grads(poison=False)
+        w0 = net.parameters()[0].numpy().copy()
+        scaler = GradScaler(init_loss_scaling=2.0)
+        scaler.step(opt)
+        assert not scaler._found_inf
+        assert not np.array_equal(net.parameters()[0].numpy(), w0)
+
+    def test_state_dict_round_trip_through_checkpoint(self, tmp_path):
+        """Scaler state survives the PR 1 verified save/load path."""
+        from paddle_tpu.amp import GradScaler
+        s = GradScaler(init_loss_scaling=1024.0, incr_ratio=3.0,
+                       decr_ratio=0.25, incr_every_n_steps=7,
+                       decr_every_n_nan_or_inf=2)
+        s._good_steps, s._bad_steps = 5, 1
+        path = str(tmp_path / "scaler.pdparams")
+        paddle.save(s.state_dict(), path)
+        s2 = GradScaler()
+        s2.load_state_dict(paddle.load(path))
+        assert s2.get_init_loss_scaling() == pytest.approx(1024.0)
+        assert s2.get_incr_ratio() == pytest.approx(3.0)
+        assert s2.get_decr_ratio() == pytest.approx(0.25)
+        assert s2.get_incr_every_n_steps() == 7
+        assert s2.get_decr_every_n_nan_or_inf() == 2
+        assert s2._good_steps == 5 and s2._bad_steps == 1
+        assert s2.is_use_dynamic_loss_scaling()
