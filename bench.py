@@ -866,6 +866,142 @@ def bench_decode(backend, prompt=128, new_tokens=128, batches=(1, 8),
     return out
 
 
+def bench_serve(backend):
+    """Continuous-batching serving vs the static-batch baseline
+    (docs/SERVING.md; ISSUE 4 acceptance): replay a mixed prompt/output-
+    length request trace through (a) the static path — arrival-order
+    batches of ``max_slots`` padded to the batch max prompt and decoded to
+    the batch max output length (one compiled program per batch, the
+    pre-serving deployment story) and (b) the ServingEngine — paged KV
+    cache, iteration-level retire/admit, schedule-sized decode dispatches.
+    Both sides run a warm pass first so compiles stay out of the timing,
+    then 5 INTERLEAVED timed rounds each; the reported speedup is the
+    MEDIAN of per-round ratios (adjacent runs share the host-load window,
+    so each ratio is drift-immune) and tok/s are per-side medians; the
+    static pass's outputs double as the dense-cache parity oracle
+    (``outputs_match``) and the engine's trace counter proves the decode
+    executable count stays constant across the trace
+    (``recompiles_constant``). Reports aggregate tok/s both sides, the
+    speedup (acceptance bound: >= 1.5x), and p50/p99 TTFT / per-token
+    latency."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.serving import ServingConfig, ServingEngine
+    from paddle_tpu.models import generation as G
+    from paddle_tpu.models import llama
+
+    # long-tailed output lengths (the realistic regime: most requests are
+    # short, a quarter run long) — static batching pays every batch's max
+    if backend == "tpu":
+        cfg, _, _ = _presets(backend, wide=False)
+        n_req, max_slots, blk, mlen, chunk = 32, 8, 16, 256, 8
+        p_choices, o_choices = [32, 64, 96, 128], [8, 16, 32, 128]
+    else:
+        # CPU smoke: same structure, but NOT the shared tiny preset — at
+        # hidden 128 the paged step's fixed op-count overhead (gather/
+        # scatter/masks, ~1ms on XLA:CPU) is 2x the matmul work and buries
+        # the scheduling win; at hidden 256 the per-iteration costs match
+        # (measured 4.9ms static vs 4.4ms paged) and the comparison
+        # exercises the same regime the TPU config runs in. Output lengths
+        # 2-64 (25% long): the static path pays each batch's max (~256
+        # decode iterations on this trace) while the engine's makespan is
+        # ~136 — that iteration gap, not per-step costs, is what's measured
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=3,
+                          num_attention_heads=8, num_key_value_heads=4,
+                          max_position_embeddings=128)
+        n_req, max_slots, blk, mlen, chunk = 16, 4, 8, 88, 4
+        p_choices, o_choices = [8, 12, 16, 24], [2, 4, 8, 64]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    plens = rng.choice(p_choices, n_req)
+    outs = rng.choice(o_choices, n_req)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(s),)).astype(np.int32)
+               for s in plens]
+    total_tokens = int(np.sum(outs))
+
+    # ---- static-batch baseline (the dense-cache parity oracle) ----------
+    def run_static():
+        got, ttfts = [], []
+        t0 = time.time()
+        for i0 in range(0, n_req, max_slots):
+            i1 = min(i0 + max_slots, n_req)
+            pl, on = plens[i0:i1], outs[i0:i1]
+            S, n = int(pl.max()), int(on.max())
+            ids = np.zeros((i1 - i0, S), np.int32)
+            for r in range(i0, i1):
+                ids[r - i0, :plens[r]] = prompts[r]
+            toks = np.asarray(G.generate(
+                params, jnp.asarray(ids), cfg, max_new_tokens=n,
+                prompt_lens=jnp.asarray(pl, jnp.int32)))
+            t_batch = time.time() - t0     # first token lands with the batch
+            for r in range(i1 - i0):
+                got.append(toks[r, :on[r]])
+                ttfts.append(t_batch)
+        return got, ttfts, time.time() - t0
+
+    def run_serving(engine):
+        t0 = time.time()
+        rids = [engine.submit(p, max_new_tokens=int(o), eos_token_id=None)
+                for p, o in zip(prompts, outs)]
+        while engine.pending:
+            engine.step()
+        return [engine.request(r) for r in rids], time.time() - t0
+
+    engine = ServingEngine(params, cfg, ServingConfig(
+        block_size=blk, max_slots=max_slots, max_model_len=mlen,
+        decode_chunk=chunk, queue_depth=n_req))
+    run_static()                                           # warm/compile
+    run_serving(engine)                                    # warm/compile
+    traces_before = engine.stats()["decode_traces"]
+    # INTERLEAVED rounds, speedup = MEDIAN of per-round ratios: adjacent
+    # static/serving runs see the same host-load window, so each round's
+    # ratio is drift-immune, and the median absorbs spike rounds. A
+    # min-of-each-side would compare each side's luckiest window — windows
+    # the other side may never have gotten (same lesson as bench --health's
+    # interleaving; monolithic blocks drift apart)
+    rounds = []
+    for _ in range(5):
+        static_out, static_ttft, st_s = run_static()
+        reqs, sv_s = run_serving(engine)
+        rounds.append((st_s, sv_s))
+    static_s = float(np.median([r[0] for r in rounds]))
+    serving_s = float(np.median([r[1] for r in rounds]))
+    speedup = float(np.median([st / sv for st, sv in rounds]))
+    static_tok_s = total_tokens / static_s
+    serving_tok_s = total_tokens / serving_s
+    serve_ttft = [r.ttft_s for r in reqs]
+    serve_lat = [r.tok_latency_s for r in reqs
+                 if r.tok_latency_s is not None]
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs) * 1e3, q)), 2)
+
+    match = all((np.asarray(r.output()) == s).all()
+                for r, s in zip(reqs, static_out))
+    st = engine.stats()
+    return {
+        "serving_tok_s": round(serving_tok_s, 1),
+        "static_tok_s": round(static_tok_s, 1),
+        "speedup": round(speedup, 3),
+        "outputs_match": bool(match),
+        "recompiles_constant": st["decode_traces"] == traces_before,
+        "decode_traces": st["decode_traces"],
+        "prefill_buckets": st["prefill_buckets"],
+        "chunks": st["chunks"],
+        "ttft_p50_ms": pct(serve_ttft, 50),
+        "ttft_p99_ms": pct(serve_ttft, 99),
+        "static_ttft_p50_ms": pct(static_ttft, 50),
+        "static_ttft_p99_ms": pct(static_ttft, 99),
+        "tok_lat_p50_ms": pct(serve_lat, 50) if serve_lat else None,
+        "tok_lat_p99_ms": pct(serve_lat, 99) if serve_lat else None,
+        "requests": n_req, "max_slots": max_slots,
+        "total_new_tokens": total_tokens,
+        "kv_pool_mb": st["kv_pool_mb"],
+    }
+
+
 # recorded values — regression anchors for vs_baseline on the secondary
 # rows (BASELINE.md; the headline's anchor is the 50% north star). The two
 # kernel microbenches are anchored at round 3 because the timing methodology
@@ -915,6 +1051,13 @@ _R2_ANCHORS = {
     # issue: <= 2% step overhead for the fused NaN/Inf/spike detector on
     # the tuned llama row.
     "health_sentinel_overhead_pct": 2.0,
+    # serving rows (first recorded this round). The speedup anchor IS the
+    # acceptance bound from the serving issue: continuous batching over
+    # the paged KV cache must beat arrival-order static batching >= 1.5x
+    # in aggregate tok/s on the mixed-length trace. The absolute tok/s
+    # anchor is provisional until measured on the driver.
+    "serving_throughput_speedup": 1.5,
+    "serving_agg_tok_s": 3000.0,
 }
 
 
@@ -950,7 +1093,7 @@ def _llama_point(backend, peak, steps, wide, batch_arg=None, seq_arg=None):
 def main():
     ap = argparse.ArgumentParser()
     _SECTIONS = ("llama", "wide", "attn", "resnet", "resnet_nhwc", "bert",
-                 "sdxl", "decode", "int8",
+                 "sdxl", "decode", "int8", "serve",
                  "tuned", "detect", "checkpoint", "input", "health",
                  "roofline")
     for sec in _SECTIONS:
@@ -1013,12 +1156,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0, "health": 45.0} if _warm else
+                  "input": 20.0, "health": 45.0, "serve": 60.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0, "health": 90.0})
+                  "input": 30.0, "health": 90.0, "serve": 120.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -1192,6 +1335,21 @@ def main():
                   "tok/s", d["decode_b8_tok_s"] /
                   _R2_ANCHORS["llama_decode_int8_tok_s_b8"])
         section("int8", _int8)
+    if want("serve"):
+        def _serve():
+            s = bench_serve(backend)
+            print(json.dumps({"serve": s}), file=sys.stderr)
+            # acceptance proofs ride in the metric run itself: paged greedy
+            # must match the dense static path bit-for-bit and the decode
+            # executable count must not grow across the trace
+            assert s["outputs_match"], "paged decode diverged from dense"
+            assert s["recompiles_constant"], \
+                f"decode recompiled mid-trace ({s['decode_traces']})"
+            _emit("serving_agg_tok_s", s["serving_tok_s"], "tok/s",
+                  s["serving_tok_s"] / _R2_ANCHORS["serving_agg_tok_s"])
+            _emit("serving_throughput_speedup", s["speedup"], "x",
+                  s["speedup"] / _R2_ANCHORS["serving_throughput_speedup"])
+        section("serve", _serve)
     if want("wide"):
         def _wide():
             mfu = _llama_point(backend, peak, args.steps, wide=True,

@@ -215,6 +215,36 @@ define_flag("FLAGS_health_watchdog_timeout_s", 0.0,
             "tick before the in-process hang watchdog fires (stack-dump "
             "diagnosis; fatal=True exits HUNG_EXIT_RC). 0 = off.", float)
 
+# ---------------------------------------------------------------------------
+# Serving engine (paddle_tpu.inference.serving; docs/SERVING.md). The
+# FLAGS_serving_ prefix is the generated-docs key. These are the DEFAULTS
+# ServingConfig resolves when a field is left unset — explicit ServingConfig
+# values always win.
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_serving_block_size", 16,
+            "Paged-KV-cache block size (tokens per physical block). Smaller "
+            "blocks waste less capacity per sequence tail but deepen the "
+            "block tables.", int)
+define_flag("FLAGS_serving_max_slots", 8,
+            "Decode slots in the continuous-batching step — the fixed batch "
+            "dimension of the ONE compiled decode program. Retired slots "
+            "are refilled from the admission queue every iteration.", int)
+define_flag("FLAGS_serving_max_model_len", 2048,
+            "Per-sequence KV capacity bound (prompt + generated - 1 KV "
+            "entries); sets the static block-table width "
+            "ceil(len / block_size).", int)
+define_flag("FLAGS_serving_queue_depth", 128,
+            "Admission-queue bound: submits beyond this raise "
+            "ServingQueueFull instead of growing host memory unboundedly.",
+            int)
+define_flag("FLAGS_serving_decode_chunk", 8,
+            "Cap on decode iterations per device dispatch when a live "
+            "request can retire EARLY (EOS enabled) or the caller streams "
+            "token events. Otherwise dispatches are schedule-sized: run "
+            "to the next budget retirement (queue waiting) or drain the "
+            "tail in one dispatch (queue empty) — the bound is a device "
+            "scalar, so sizing never retraces.", int)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

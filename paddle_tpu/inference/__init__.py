@@ -8,16 +8,30 @@ Predictor with named input/output handles, zero-copy IO). TPU redesign
 the compiled XLA executable; the reference's IR fusion passes and TensorRT
 subgraphs are XLA's job here, so Config's GPU/TRT/MKLDNN knobs are accepted
 and recorded but have no effect (documented honestly, queryable).
+
+LLM serving tiers (lazy submodules — importing ``paddle_tpu.inference``
+stays jax-light): ``inference.generation`` (GenerationPredictor — batch /
+streaming / int8 decode over a causal-LM pytree) and ``inference.serving``
+(the continuous-batching engine with the paged KV cache; docs/SERVING.md).
 """
 
 from __future__ import annotations
+
+import importlib
 
 from typing import Dict, List, Optional
 
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version",
+           "generation", "serving"]
+
+
+def __getattr__(name):
+    if name in ("generation", "serving"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def get_version() -> str:

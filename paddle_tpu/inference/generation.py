@@ -3,9 +3,15 @@
 Parity target: the reference ecosystem serves LLM generation through its
 inference engine (Paddle Inference + PaddleNLP's generation heads; SURVEY
 §2.6). Here the serving artifact is the model's parameter pytree plus its
-config; the decode engine is :mod:`paddle_tpu.models.generation` (one
-compiled program for batch generation, a donated-cache streaming session for
-token-at-a-time serving).
+config; the decode engines are :mod:`paddle_tpu.models.generation` (one
+compiled program for batch generation, a donated-cache streaming session
+for token-at-a-time serving) and :mod:`paddle_tpu.inference.serving` (the
+continuous-batching engine with the paged KV cache — ``predictor.serve``).
+
+``GenerationConfig`` here IS :class:`paddle_tpu.models.generation.
+GenerationConfig` — one shared sampling-knob struct across the eager
+``LlamaForCausalLM.generate`` kwargs surface, this predictor, and the
+serving engine (the previously-duplicated class is gone).
 """
 
 from __future__ import annotations
@@ -14,35 +20,36 @@ from typing import Optional
 
 import numpy as np
 
+from ..models.generation import GenerationConfig
+
 __all__ = ["GenerationConfig", "GenerationPredictor"]
 
 
-class GenerationConfig:
-    """Sampling knobs (ref: PaddleNLP GenerationConfig)."""
-
-    def __init__(self, max_new_tokens: int = 64, temperature: float = 0.0,
-                 top_k: Optional[int] = None, top_p: Optional[float] = None,
-                 eos_token_id: Optional[int] = None, pad_token_id: int = 0):
-        self.max_new_tokens = max_new_tokens
-        self.temperature = temperature
-        self.top_k = top_k
-        self.top_p = top_p
-        self.eos_token_id = eos_token_id
-        self.pad_token_id = pad_token_id
-
-
 class GenerationPredictor:
-    """Batch + streaming decode service over a causal-LM param pytree.
+    """Batch + streaming + continuous-batching decode service over a
+    causal-LM param pytree.
 
     ``predictor.generate(ids)`` — whole batch, one compiled program.
     ``predictor.stream(ids)`` — yields one token list per step (greedy),
     using the donated-cache :class:`~paddle_tpu.models.generation.DecodeSession`.
+    ``predictor.serve(prompts)`` — continuous batching over the paged KV
+    cache (:mod:`paddle_tpu.inference.serving`): mixed-length prompts, per
+    request ``max_new_tokens``, slots refilled as requests retire.
+
+    ``quantize="int8"`` converts the pytree once via
+    ``llama.quantize_params`` — every tier (batch, stream, serve) then
+    decodes through the weight-only int8 path (`_mm` stream-dequant;
+    bench's ``llama_decode_int8_tok_s_b8`` row).
     """
 
-    def __init__(self, params, model_config, gen_config: GenerationConfig):
-        self._params = params
+    def __init__(self, params, model_config, gen_config: GenerationConfig,
+                 quantize: Optional[str] = None):
+        from ..models.llama import ensure_quantized
+        self._params = ensure_quantized(params, quantize)
         self._cfg = model_config
         self._gen = gen_config
+        self._quantize = quantize
+        self._engine = None
 
     def generate(self, input_ids, prompt_lens=None, seed: int = 0):
         import jax
@@ -80,3 +87,26 @@ class GenerationPredictor:
                     return
             if t < g.max_new_tokens - 1:
                 logits = sess.step(jnp.asarray(tok))
+
+    def serve(self, prompts, max_new_tokens=None, serving_config=None):
+        """Continuous-batching greedy decode of a request list: each prompt
+        is its own variable-length sequence (no batch padding), admitted to
+        the engine's slot table as capacity frees up. Returns one
+        variable-length token array per prompt (eos included, no pad tail).
+        The engine is built lazily and kept — repeat calls reuse its
+        compiled prefill/decode programs and block pool."""
+        if self._engine is None or serving_config is not None:
+            import dataclasses
+
+            from .serving import ServingConfig, ServingEngine
+            sc = serving_config or ServingConfig()
+            if sc.quantize is None and self._quantize is not None:
+                # params are already quantized; keep the engine consistent
+                # (replace, not mutate — the caller may reuse its config)
+                sc = dataclasses.replace(sc, quantize=self._quantize)
+            if self._engine is None or sc != self._engine.config:
+                # rebuild only on a real config change — an identical
+                # config keeps the warm engine (compiled programs + pool)
+                self._engine = ServingEngine(self._params, self._cfg, sc,
+                                             gen_config=self._gen)
+        return self._engine.run(prompts, max_new_tokens=max_new_tokens)

@@ -1,0 +1,16 @@
+"""Continuous-batching LLM serving (docs/SERVING.md).
+
+The high-traffic decode tier: a paged KV cache (block pool + per-slot block
+tables; ``models.generation`` holds the device math), an iteration-level
+scheduler (retire/admit every step, Orca-style), and the
+:class:`ServingEngine` API (`submit()/step()/stream()/run()`) that
+``inference.GenerationPredictor.serve`` rides. Benchmarked by
+``bench.py --serve`` against the static-batch ``generate()`` baseline.
+"""
+
+from .engine import ServingConfig, ServingEngine
+from .paged_cache import BlockManager, PagedKVCache
+from .scheduler import Request, Scheduler, ServingQueueFull
+
+__all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
+           "Scheduler", "Request", "ServingQueueFull"]

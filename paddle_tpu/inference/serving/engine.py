@@ -1,0 +1,368 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The serving tier the ROADMAP's "heavy traffic" north star asks for:
+iteration-level scheduling (Orca) + a paged KV cache (PagedAttention) on
+top of the compiled decode path PR 2 built (donated buffers, one program
+per shape).
+
+Design (docs/SERVING.md):
+
+* **One compiled decode program.** The decode step runs over a FIXED
+  ``max_slots``-wide slot table — shapes never change, so it traces once
+  and the per-iteration host cost is one dispatch. The iteration bound is
+  a DEVICE SCALAR argument (no retrace): with work queued the dispatch
+  returns exactly when the first live slot exhausts its budget, so
+  retirement/admission happen with zero idle iterations; with the queue
+  empty one dispatch drains the whole tail. ``decode_chunk`` caps the
+  bound only when a live slot can retire EARLY (EOS enabled) or the
+  caller streams (token-granularity responsiveness).
+* **Paged KV.** Slots attend through per-slot block tables into one
+  physical block pool (``models.generation.paged_decode_step``); a retired
+  slot's blocks return to the pool immediately and the next queued request
+  reuses them.
+* **Bucketed prefill.** Admission prefills at the prompt's power-of-2
+  bucket length with the batch dim padded to the power-of-2 bucket of the
+  ADMISSION-WAVE size (not always ``max_slots`` — most waves admit one
+  request and pay one row of flops), so prefill executables are bounded by
+  ``len_buckets * batch_buckets``, not by distinct prompt lengths or wave
+  sizes.
+* **Greedy (v1).** The engine samples by argmax on device; temperature /
+  top-k/top-p serving stays on the batch ``generate()`` tier. int8
+  weight-only decode rides transparently via ``quantize="int8"``
+  (``llama.quantize_params`` — `_mm` routes every projection through the
+  stream-dequant path).
+
+API::
+
+    engine = ServingEngine(params, model_cfg, ServingConfig(max_slots=8))
+    rid = engine.submit(prompt_ids, max_new_tokens=64)
+    while engine.pending:
+        for rid, toks in engine.step().items(): ...
+    # or: for rid, tok in engine.stream(): ...
+    # or: outs = engine.run(prompts, max_new_tokens=64)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...flags import flag
+from .paged_cache import PagedKVCache
+from .scheduler import Request, Scheduler, ServingQueueFull  # noqa: F401
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine shape/capacity knobs. ``None`` fields resolve from the
+    ``FLAGS_serving_*`` registry at construction (flags.py), so a fleet can
+    retune the engine from the environment without code changes."""
+
+    block_size: Optional[int] = None
+    max_slots: Optional[int] = None
+    max_model_len: Optional[int] = None
+    queue_depth: Optional[int] = None
+    decode_chunk: Optional[int] = None
+    num_blocks: int = 0              # 0 = auto (max_slots full sequences)
+    quantize: Optional[str] = None   # "int8" -> weight-only decode path
+    cache_dtype: Any = None          # None -> model activation dtype
+
+    def __post_init__(self):
+        for f, name in (("block_size", "FLAGS_serving_block_size"),
+                        ("max_slots", "FLAGS_serving_max_slots"),
+                        ("max_model_len", "FLAGS_serving_max_model_len"),
+                        ("queue_depth", "FLAGS_serving_queue_depth"),
+                        ("decode_chunk", "FLAGS_serving_decode_chunk")):
+            if getattr(self, f) is None:
+                setattr(self, f, int(flag(name)))
+        from ...models.llama import QUANTIZE_MODES
+        if self.quantize not in QUANTIZE_MODES:
+            raise ValueError(f"unknown quantize mode {self.quantize!r}; "
+                             f"options: {QUANTIZE_MODES}")
+
+
+class ServingEngine:
+    """Continuous-batching greedy decode service over a causal-LM pytree."""
+
+    def __init__(self, params, model_config, serving_config:
+                 Optional[ServingConfig] = None, gen_config=None):
+        import jax
+
+        from ...models.generation import GenerationConfig
+        self.config = serving_config or ServingConfig()
+        self._gen = gen_config or GenerationConfig()
+        if self._gen.temperature:
+            raise ValueError(
+                "ServingEngine is greedy-only (temperature=0); sampling "
+                "serving stays on GenerationPredictor.generate")
+        from ...models.llama import ensure_quantized
+        self._params = ensure_quantized(params, self.config.quantize)
+        self._cfg = model_config
+        self.cache = PagedKVCache(model_config, self.config.max_slots,
+                                  self.config.max_model_len,
+                                  self.config.block_size,
+                                  self.config.num_blocks,
+                                  dtype=self.config.cache_dtype)
+        self._sched = Scheduler(self.cache, self.config.max_slots,
+                                self.config.queue_depth)
+        M = self.config.max_slots
+        self._tokens = np.zeros((M,), np.int32)
+        self._seq_lens = np.zeros((M,), np.int32)
+        self._steps_left = np.zeros((M,), np.int32)
+        self._done = np.ones((M,), bool)          # empty slots are inactive
+        self._eos = np.full((M,), -1, np.int32)
+        self._stats = {"decode_traces": 0, "prefill_traces": 0,
+                       "chunks": 0, "steps": 0}
+        self._prefill_buckets: set = set()
+        # widest token buffer one dispatch can emit per slot (a budget
+        # never exceeds max_model_len KV entries, so neither can steps)
+        self._out_width = int(self.config.max_model_len)
+        self._jax = jax
+        self._jprefill, self._jdecode = self._build(jax)
+
+    # ---- compiled programs ------------------------------------------------
+
+    def _build(self, jax):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ...jit.train_step import donation_supported
+        from ...models import generation as G
+        cfg, stats, Cmax = self._cfg, self._stats, self._out_width
+
+        def prefill_fn(params, ids, prompt_lens, block_tables, pool, active):
+            stats["prefill_traces"] += 1           # trace-time only
+            return G.paged_prefill(params, cfg, ids, prompt_lens,
+                                   block_tables, pool, active)
+
+        def decode_fn(params, pool, tokens, seq_lens, steps_left, done,
+                      block_tables, eos_ids, limit):
+            stats["decode_traces"] += 1            # trace-time only
+            M = tokens.shape[0]
+
+            # while (not scan): the chunk EXITS the moment every live row
+            # is done, so a retirement wave mid-chunk costs nothing — the
+            # same alive-mask early exit the batch generate() loop uses.
+            # ``limit`` is a device scalar, so the host can size every
+            # dispatch to the schedule (return at the next budget
+            # retirement; drain the tail in one go) without retracing
+            def body(carry):
+                i, tokens, seq_lens, steps_left, done, pool, out = carry
+                active = (~done) & (steps_left > 0)
+                logits, pool, _drops = G.paged_decode_step(
+                    params, cfg, tokens, seq_lens, block_tables, pool,
+                    active)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tokens)
+                done = done | (active & (nxt == eos_ids))
+                seq_lens = seq_lens + active
+                steps_left = steps_left - active.astype(jnp.int32)
+                out = lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+                return (i + 1, nxt, seq_lens, steps_left, done, pool, out)
+
+            def cond(carry):
+                i, _, _, steps_left, done, _, _ = carry
+                return (i < limit) & ((~done) & (steps_left > 0)).any()
+
+            out0 = jnp.zeros((M, Cmax), jnp.int32)
+            (_, tokens, seq_lens, steps_left, done, pool, out) = \
+                lax.while_loop(cond, body, (jnp.int32(0), tokens, seq_lens,
+                                            steps_left, done, pool, out0))
+            return pool, tokens, seq_lens, steps_left, done, out
+
+        donate = donation_supported()
+        jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
+        jdec = jax.jit(decode_fn, donate_argnums=(1,) if donate else ())
+        return jpre, jdec
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    # ---- request lifecycle ------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = "unset") -> int:
+        """Queue one prompt; returns the request id. ``eos_token_id``
+        defaults to the engine's GenerationConfig (pass ``None`` explicitly
+        to disable EOS for this request)."""
+        g = self._gen
+        req = Request(
+            rid=-1, prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens if max_new_tokens is not None
+                               else g.max_new_tokens),
+            eos_token_id=(g.eos_token_id if eos_token_id == "unset"
+                          else eos_token_id))
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        return self._sched.submit(req)
+
+    def _admit(self, emitted: Dict[int, List[int]]) -> None:
+        import jax.numpy as jnp
+        admitted: List[Request] = []
+        while (req := self._sched.next_admission()) is not None:
+            admitted.append(req)
+        if not admitted:
+            return
+        # one prefill dispatch per BUCKET, batch dim padded to the
+        # power-of-2 bucket of the GROUP size (<= max_slots): executables
+        # stay bounded by len_buckets * batch_buckets, a burst of
+        # admissions costs O(buckets) dispatches, and the common
+        # steady-state wave (ONE request refilling a retired slot) pays
+        # one row of prefill flops instead of max_slots rows
+        M = self.config.max_slots
+        by_bucket: Dict[int, List[Request]] = {}
+        for req in admitted:
+            by_bucket.setdefault(self._bucket(req.prompt_len), []).append(req)
+        for Sb, group in sorted(by_bucket.items()):
+            self._prefill_buckets.add(Sb)
+            Bb = 1
+            while Bb < len(group):
+                Bb *= 2
+            Bb = min(Bb, M)
+            ids = np.zeros((Bb, Sb), np.int32)
+            plens = np.ones((Bb,), np.int32)      # pad rows: harmless len 1
+            tables = np.zeros((Bb, self.cache.blocks_per_seq), np.int32)
+            act = np.zeros((Bb,), bool)
+            for r, req in enumerate(group):
+                ids[r, :req.prompt_len] = req.prompt
+                plens[r] = req.prompt_len
+                tables[r] = self.cache.tables[req.slot]
+                act[r] = True
+            logits, self.cache.pool, _ = self._jprefill(
+                self._params, jnp.asarray(ids), jnp.asarray(plens),
+                jnp.asarray(tables), self.cache.pool, jnp.asarray(act))
+            first = np.argmax(np.asarray(logits), axis=-1)
+            now = time.time()
+            for r, req in enumerate(group):
+                tok0 = int(first[r])
+                req.first_token_t = now
+                req.tokens.append(tok0)
+                emitted.setdefault(req.rid, []).append(tok0)
+                if req.eos_token_id is not None and \
+                        tok0 == req.eos_token_id:
+                    req.eos_seen = True
+                if req.finished:
+                    self._sched.finish(req)
+                    continue
+                m = req.slot
+                self._tokens[m] = tok0
+                self._seq_lens[m] = req.prompt_len
+                self._steps_left[m] = req.max_new_tokens - 1
+                self._done[m] = False
+                self._eos[m] = -1 if req.eos_token_id is None \
+                    else req.eos_token_id
+
+    def _limit(self, live, max_iters: Optional[int]) -> int:
+        """Iterations for the next decode dispatch. Queue waiting: run to
+        the FIRST budget retirement (admit with zero idle iterations).
+        Queue empty: drain the whole tail in one dispatch (the in-graph
+        alive-mask exit handles rows finishing early). ``decode_chunk``
+        caps the bound only when a live row can retire EARLIER than its
+        budget (EOS enabled) so admission latency stays bounded, or when
+        the caller asked for streaming granularity via ``max_iters``."""
+        sl = [int(self._steps_left[r.slot]) for r in live]
+        n = min(sl) if self._sched.queue else max(sl)
+        if max_iters is None and \
+                any(r.eos_token_id is not None for r in live):
+            max_iters = self.config.decode_chunk
+        if max_iters is not None:
+            n = min(n, int(max_iters))
+        return max(1, min(n, self._out_width))
+
+    def step(self, max_iters: Optional[int] = None) -> Dict[int, List[int]]:
+        """One scheduler iteration: retire -> admit (+ prefill) -> one
+        decode dispatch of up to ``_limit()`` iterations (``max_iters``
+        caps it). Returns ``{rid: [tokens emitted]}``."""
+        import jax.numpy as jnp
+        emitted: Dict[int, List[int]] = {}
+        self._sched.retire_finished()
+        self._admit(emitted)
+        live = self._sched.live
+        if live:
+            before = self._steps_left.copy()
+            (self.cache.pool, tokens, seq_lens, steps_left, done,
+             toks) = self._jdecode(
+                self._params, self.cache.pool, jnp.asarray(self._tokens),
+                jnp.asarray(self._seq_lens), jnp.asarray(self._steps_left),
+                jnp.asarray(self._done), jnp.asarray(self.cache.tables),
+                jnp.asarray(self._eos),
+                jnp.asarray(self._limit(live, max_iters), jnp.int32))
+            toks = np.asarray(toks)
+            # np.array (copy): zero-copy views of jax outputs are read-only,
+            # and admission writes these slots in place next step
+            self._tokens = np.array(tokens)
+            self._seq_lens = np.array(seq_lens)
+            self._steps_left = np.array(steps_left)
+            self._done = np.array(done)
+            for req in live:
+                m = req.slot
+                n = int(before[m] - self._steps_left[m])
+                if n <= 0:
+                    continue
+                got = toks[m, :n].tolist()
+                req.tokens.extend(got)
+                if bool(self._done[m]):
+                    req.eos_seen = True
+                emitted.setdefault(req.rid, []).extend(got)
+            self._stats["chunks"] += 1
+            self._sched.retire_finished()
+        self._stats["steps"] += 1
+        return emitted
+
+    def stream(self) -> Iterator[Tuple[int, int]]:
+        """Drain the engine, yielding ``(rid, token)`` events in emission
+        order (within a step, by request id). Dispatches are capped at
+        ``decode_chunk`` iterations so events surface with bounded
+        latency instead of arriving in one tail-drain burst."""
+        while self.pending:
+            for rid, toks in sorted(
+                    self.step(self.config.decode_chunk).items()):
+                for t in toks:
+                    yield rid, int(t)
+
+    def run(self, prompts: Sequence, max_new_tokens=None,
+            eos_token_id="unset") -> List[np.ndarray]:
+        """Submit every prompt, drain, return outputs in submission order.
+        ``max_new_tokens`` may be one int or a per-prompt sequence."""
+        n = len(prompts)
+        mnt = ([max_new_tokens] * n
+               if max_new_tokens is None or np.isscalar(max_new_tokens)
+               else list(max_new_tokens))
+        if len(mnt) != n:
+            raise ValueError(f"max_new_tokens has {len(mnt)} entries for "
+                             f"{n} prompts")
+        rids = [self.submit(p, max_new_tokens=m, eos_token_id=eos_token_id)
+                for p, m in zip(prompts, mnt)]
+        while self.pending:
+            self.step()
+        return [self._sched.result(r) for r in rids]
+
+    # ---- introspection ----------------------------------------------------
+
+    @property
+    def pending(self) -> bool:
+        return self._sched.pending
+
+    def request(self, rid: int) -> Request:
+        """The finished request record (tokens + latency timestamps)."""
+        return self._sched.finished[rid]
+
+    def stats(self) -> Dict[str, Any]:
+        return {**self._stats,
+                "prefill_buckets": len(self._prefill_buckets),
+                "admitted": self._sched.admitted,
+                "retired": self._sched.retired,
+                "queued": len(self._sched.queue),
+                "live_slots": len(self._sched.live),
+                "max_slots": self.config.max_slots,
+                "free_blocks": self.cache.free_blocks,
+                "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}

@@ -1,0 +1,124 @@
+"""Paged KV cache — host-side block accounting over the device block pool.
+
+The PagedAttention idea (vLLM) recast for the XLA serving stack: the device
+holds ONE physical block pool ``{"k","v": [L, num_blocks, block_size, Hk,
+D]}`` (:func:`paddle_tpu.models.generation.init_paged_pool`); a sequence
+owns an ordered list of physical blocks recorded in its slot's row of the
+block-table matrix, and the compiled decode step gathers exactly those
+blocks. This module is the HOST half: a free-list block manager plus the
+``[max_slots, W]`` block-table matrix the engine ships with every dispatch.
+No jax import here — device math lives in ``models/generation.py``.
+
+Allocation policy: blocks for a request's full worst-case KV footprint
+(``prompt + max_new_tokens - 1`` entries) are reserved at admission, so a
+running sequence can never hit a mid-flight out-of-blocks condition and the
+engine needs no preemption/swap machinery (documented trade: admission is
+conservative; docs/SERVING.md). Physical block 0 is the NULL block — the
+masked-lane scatter target — and is never allocated.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["BlockManager", "PagedKVCache"]
+
+
+class BlockManager:
+    """Free-list allocator over the physical block ids ``1..num_blocks-1``
+    (block 0 = null). Double-free and foreign-id frees raise — a serving
+    engine that corrupts its free list serves one sequence's KV to
+    another, which must fail loudly."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 null + 1 usable), "
+                             f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: hot blocks are reused first (their pool pages are
+        # the most likely still resident in any cache hierarchy)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, kv_tokens: int) -> int:
+        """Physical blocks needed to hold ``kv_tokens`` KV entries."""
+        return max(1, math.ceil(kv_tokens / self.block_size))
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"out of KV blocks: want {n}, "
+                               f"free {len(self._free)}")
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise RuntimeError(f"double/foreign free of block {b}")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """The device block pool + its host bookkeeping, per serving engine.
+
+    ``tables`` is the ``[max_slots, W]`` int32 block-table matrix shipped
+    with every decode dispatch (W = ceil(max_model_len / block_size));
+    unassigned entries point at the null block 0 and are masked by the
+    sequence-length mask on device.
+    """
+
+    def __init__(self, model_config, max_slots: int, max_model_len: int,
+                 block_size: int, num_blocks: int = 0, dtype=None):
+        from ...models.generation import init_paged_pool
+        self.block_size = int(block_size)
+        self.max_model_len = int(max_model_len)
+        self.blocks_per_seq = max(1, math.ceil(max_model_len / block_size))
+        if num_blocks <= 0:
+            # auto-size: every slot can hold a full-length sequence, +1 null
+            num_blocks = max_slots * self.blocks_per_seq + 1
+        self.pool: Dict = init_paged_pool(model_config, num_blocks,
+                                          block_size, dtype)
+        self.manager = BlockManager(num_blocks, block_size)
+        self.tables = np.zeros((max_slots, self.blocks_per_seq), np.int32)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.manager.free_blocks
+
+    def reserve(self, kv_tokens: int) -> Optional[List[int]]:
+        """Reserve blocks for a sequence's full KV footprint; None when the
+        pool can't cover it right now (the request stays queued)."""
+        n = self.manager.blocks_for(kv_tokens)
+        if n > self.blocks_per_seq:
+            raise ValueError(
+                f"sequence needs {n} blocks ({kv_tokens} KV entries) but "
+                f"max_model_len {self.max_model_len} caps block tables at "
+                f"{self.blocks_per_seq}")
+        if not self.manager.can_alloc(n):
+            return None
+        return self.manager.alloc(n)
+
+    def assign(self, slot: int, blocks: List[int]) -> None:
+        self.tables[slot] = 0
+        self.tables[slot, :len(blocks)] = blocks
+
+    def release(self, slot: int, blocks: List[int]) -> None:
+        self.manager.free(blocks)
+        self.tables[slot] = 0
+
+    def kv_bytes(self) -> int:
+        k = self.pool["k"]
+        return 2 * k.size * k.dtype.itemsize

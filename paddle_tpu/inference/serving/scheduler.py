@@ -1,0 +1,188 @@
+"""Continuous-batching scheduler — iteration-level request lifecycle.
+
+Orca-style scheduling recast as pure host logic: a FIFO admission queue
+feeding a fixed table of ``max_slots`` decode slots. Every engine step (1)
+RETIRES slots whose request finished (EOS sampled or token budget spent),
+returning their KV blocks to the pool, (2) ADMITS queued requests into free
+slots while the block pool can reserve their worst-case footprint, and (3)
+hands the engine the set of live slots for one fixed-shape decode dispatch.
+The scheduler never touches the device — the engine owns dispatch; this
+module owns WHO is running WHERE and the per-request records (tokens,
+timestamps) the bench's TTFT/latency percentiles come from.
+
+FIFO is strict: a queue head too large for the currently-free blocks blocks
+later, smaller requests (head-of-line; no deadlock — running slots always
+retire and their blocks return, and submit() rejects requests larger than
+the whole pool up front).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "Scheduler", "ServingQueueFull"]
+
+
+class ServingQueueFull(RuntimeError):
+    """submit() beyond the admission queue's depth bound."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its serving-side record."""
+
+    rid: int
+    prompt: np.ndarray                 # [S] int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    eos_seen: bool = False
+    blocks: Optional[List[int]] = None
+    slot: Optional[int] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def kv_tokens(self) -> int:
+        """Worst-case KV entries: the prompt plus every generated token's
+        KV except the last sampled token (its KV is never written)."""
+        return self.prompt_len + self.max_new_tokens - 1
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def finished(self) -> bool:
+        return self.eos_seen or self.remaining <= 0
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def tok_latency_s(self) -> Optional[float]:
+        """Mean decode latency per token after the first (None for 1-token
+        requests)."""
+        if self.finish_t is None or len(self.tokens) < 2:
+            return None
+        return (self.finish_t - self.first_token_t) / (len(self.tokens) - 1)
+
+    def output(self) -> np.ndarray:
+        return np.asarray(self.tokens, np.int32)
+
+
+class Scheduler:
+    """FIFO admission queue + slot table over a :class:`PagedKVCache`."""
+
+    def __init__(self, cache, max_slots: int, queue_depth: int):
+        self.cache = cache
+        self.max_slots = int(max_slots)
+        self.queue_depth = int(queue_depth)
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * max_slots
+        # finished-record retention is BOUNDED (a long-lived engine must
+        # not leak every prompt it ever served): insertion-ordered dict,
+        # oldest evicted past queue_depth + max_slots — enough that one
+        # full run()/drain cycle (submit bounded by queue_depth) can
+        # always collect its results afterwards
+        self.finished: Dict[int, Request] = {}
+        self.keep_finished = self.queue_depth + self.max_slots
+        self._next_rid = 0
+        self.admitted = 0
+        self.retired = 0
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def submit(self, req: Request) -> int:
+        if len(self.queue) >= self.queue_depth:
+            raise ServingQueueFull(
+                f"admission queue full ({self.queue_depth}); drain with "
+                f"step()/stream() or raise FLAGS_serving_queue_depth")
+        # fail fast on requests the pool can NEVER hold (vs transiently
+        # full); the bound is KV entries, not blocks — block granularity
+        # would admit up to block_size-1 entries past max_model_len
+        if req.kv_tokens > self.cache.max_model_len:
+            raise ValueError(
+                f"request needs {req.kv_tokens} KV entries "
+                f"(prompt {req.prompt_len} + {req.max_new_tokens} new) > "
+                f"max_model_len {self.cache.max_model_len}")
+        n = self.cache.manager.blocks_for(req.kv_tokens)
+        usable = self.cache.manager.num_blocks - 1      # block 0 is null
+        if n > usable:
+            raise ValueError(
+                f"request needs {n} KV blocks but the pool only has "
+                f"{usable} usable blocks (num_blocks="
+                f"{self.cache.manager.num_blocks} incl. the null block); "
+                f"admitting it would wait forever")
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.submit_t = time.time()
+        self.queue.append(req)
+        return req.rid
+
+    def next_admission(self) -> Optional[Request]:
+        """Pop the queue head into a free slot if its blocks fit; None when
+        nothing can be admitted this iteration."""
+        if not self.queue:
+            return None
+        free = [m for m, r in enumerate(self.slots) if r is None]
+        if not free:
+            return None
+        req = self.queue[0]
+        blocks = self.cache.reserve(req.kv_tokens)
+        if blocks is None:
+            return None                       # head-of-line waits for blocks
+        self.queue.popleft()
+        slot = free[0]
+        req.blocks, req.slot = blocks, slot
+        self.cache.assign(slot, blocks)
+        self.slots[slot] = req
+        self.admitted += 1
+        return req
+
+    def finish(self, req: Request) -> None:
+        """Mark finished + free its KV back to the pool."""
+        req.finish_t = time.time()
+        if req.blocks is not None:
+            # blocks and slot are only ever assigned together in
+            # next_admission, so a request with blocks always holds a slot
+            self.cache.release(req.slot, req.blocks)
+            self.slots[req.slot] = None
+            req.blocks = None
+        req.slot = None
+        self.finished[req.rid] = req
+        while len(self.finished) > self.keep_finished:
+            del self.finished[next(iter(self.finished))]
+        self.retired += 1
+
+    def retire_finished(self) -> List[Request]:
+        done = [r for r in self.slots if r is not None and r.finished]
+        for r in done:
+            self.finish(r)
+        return done
+
+    # ---- introspection ----------------------------------------------------
+
+    @property
+    def live(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.slots)
+
+    def result(self, rid: int) -> np.ndarray:
+        return self.finished[rid].output()

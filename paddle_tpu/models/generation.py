@@ -7,7 +7,10 @@ eos handling, ragged prompt batches; SURVEY §2.6 ecosystem row).
 TPU redesign, not a translation:
 
 * **One compiled program.** Prefill + the whole decode loop run inside a
-  single ``jax.jit`` — the decode loop is a ``lax.scan`` over token steps, so
+  single ``jax.jit`` — the decode loop is a ``lax.while_loop`` over token
+  steps with an ALIVE-MASK EARLY EXIT (a batch whose rows all hit eos at
+  step k pays k steps, not max_new_tokens; greedy outputs stay
+  bit-identical because skipped steps would only have emitted pad), so
   there is no per-token Python dispatch (the reference's per-token Python
   loop is exactly the pattern SURVEY §3.1 warns against on TPU).
 * **Static cache layout.** The KV cache is a stacked ``[L, B, C, Hk, D]``
@@ -26,6 +29,12 @@ TPU redesign, not a translation:
   jitted functions with the cache DONATED between dispatches, for callers
   that need a token at a time (``inference.Predictor`` wiring, speculative
   clients). Same kernels, same cache layout.
+* **Paged tier.** :func:`init_paged_pool` / :func:`paged_prefill` /
+  :func:`paged_decode_step` are the block-table attention entry points the
+  continuous-batching serving engine drives (``inference.serving``,
+  docs/SERVING.md): one physical block pool shared by every slot,
+  gather-based attention over each sequence's own blocks, token-level
+  bit-parity with the dense cache path pinned by tests/test_serving.py.
 
 MoE caveat: GShard routing capacity is evaluated per forward call, so a
 decode step routes B tokens in isolation while a full no-cache forward
@@ -38,6 +47,7 @@ of B tokens over E experts rarely exceeds capacity).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Dict, Optional
 
@@ -45,10 +55,59 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .llama import LlamaConfig, _mm, _moe_ffn, _rms_norm, _rope
+from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
+                    _rope)
 
-__all__ = ["init_cache", "prefill", "decode_step", "make_generate_fn",
-           "generate", "DecodeSession"]
+__all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
+           "make_generate_fn", "generate", "DecodeSession",
+           "init_paged_pool", "paged_prefill", "paged_decode_step"]
+
+
+# ---------------------------------------------------------------------------
+# sampling-knob config (the ONE struct shared by every decode tier)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Sampling knobs (ref: PaddleNLP GenerationConfig).
+
+    The single source of truth for every decode tier: the functional
+    :func:`generate`, the eager ``LlamaForCausalLM.generate`` kwargs
+    surface, ``inference.GenerationPredictor``, and the serving engine
+    (``inference.serving``) all resolve through this one struct — the two
+    previously-duplicated knob sets (``inference.generation``'s class vs
+    the eager wrapper's kwargs) are gone.
+    """
+
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+    def replace(self, **kw) -> "GenerationConfig":
+        return dataclasses.replace(self, **kw)
+
+    # knobs for which None is a VALUE (disable), not the unset spelling
+    _NONEABLE = frozenset({"top_k", "top_p", "eos_token_id"})
+
+    @classmethod
+    def resolve(cls, generation_config: Optional["GenerationConfig"] = None,
+                **overrides) -> "GenerationConfig":
+        """Merge a kwargs surface onto an optional base config. The string
+        ``"unset"`` always means "not given" (keeps the base's field; the
+        same sentinel ``ServingEngine.submit`` uses). For the Optional
+        knobs (``top_k``/``top_p``/``eos_token_id``) ``None`` is a real
+        override — ``eos_token_id=None`` disables EOS even when the base
+        config sets one; for every other field ``None`` means "not given"
+        (None is never a valid value for them, e.g. ``pad_token_id=None``
+        keeps the base's pad id)."""
+        base = generation_config if generation_config is not None else cls()
+        updates = {k: v for k, v in overrides.items()
+                   if not (isinstance(v, str) and v == "unset")
+                   and not (v is None and k not in cls._NONEABLE)}
+        return dataclasses.replace(base, **updates) if updates else base
 
 
 # ---------------------------------------------------------------------------
@@ -89,25 +148,35 @@ def _cached_layer(lp: Dict, x, ck, cv, cos, sin, kv_mask, write_idx,
     ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, write_idx, 0, 0))
     cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, write_idx, 0, 0))
 
-    kk, vv = ck, cv
-    if Hk != H:                       # GQA: expand kv heads for the einsum
-        rep = H // Hk
-        kk = jnp.repeat(kk, rep, axis=2)
-        vv = jnp.repeat(vv, rep, axis=2)
-    scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum("bthd,bjhd->bhtj", q.astype(jnp.float32),
-                   kk.astype(jnp.float32)) * scale
-    s = jnp.where(kv_mask[:, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhtj,bjhd->bthd", p.astype(vv.dtype), vv)
+    o = _masked_sdpa(q, ck, cv, kv_mask)
     x = x + _mm(o.reshape(B, T, H * D).astype(dt), lp, "wo", dt)
 
+    x, drops = _ffn_tail(lp, x, cfg)
+    return x, ck, cv, drops
+
+
+def _ffn_tail(lp: Dict, x, cfg: LlamaConfig):
+    """The post-attention half of a decoder block on ``x [B, T, E]``:
+    pre-norm + dense SwiGLU or the routed MoE FFN. Returns
+    ``(block output, dropped_tokens)``."""
+    dt = cfg.dtype
     h = _rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps, cfg.use_fused_norm)
     if cfg.moe_num_experts:
         y, _, drops = _moe_ffn(lp, h, cfg)
-        return x + y, ck, cv, drops
+        return x + y, drops
     g = jax.nn.silu(_mm(h, lp, "w_gate", dt)) * _mm(h, lp, "w_up", dt)
-    return x + _mm(g, lp, "w_down", dt), ck, cv, jnp.float32(0.0)
+    return x + _mm(g, lp, "w_down", dt), jnp.float32(0.0)
+
+
+def _lm_head(params: Dict, cfg: LlamaConfig, x):
+    """Final norm + LM head on the last-position hidden ``x [B, 1, E]`` ->
+    fp32 logits ``[B, V]`` (shared by the dense and paged cache paths)."""
+    x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    if cfg.tie_word_embeddings:
+        logits = (x @ params["embed"].T.astype(cfg.dtype))[:, 0]
+    else:
+        logits = _mm(x, params, "lm_head", cfg.dtype)[:, 0]
+    return logits.astype(jnp.float32)
 
 
 def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
@@ -125,13 +194,8 @@ def _fwd_cached(params: Dict, cfg: LlamaConfig, ids, cache: Dict, cos, sin,
 
     x, (ck, cv, drops) = lax.scan(body, x, (params["layers"], cache["k"],
                                             cache["v"]))
-    x = _rms_norm(x[:, -1:], params["ln_f"], cfg.rms_norm_eps,
-                  cfg.use_fused_norm)
-    if cfg.tie_word_embeddings:
-        logits = (x @ params["embed"].T.astype(cfg.dtype))[:, 0]
-    else:
-        logits = _mm(x, params, "lm_head", cfg.dtype)[:, 0]
-    return logits.astype(jnp.float32), {"k": ck, "v": cv}, drops.sum()
+    logits = _lm_head(params, cfg, x[:, -1:])
+    return logits, {"k": ck, "v": cv}, drops.sum()
 
 
 def _row_tables(cfg: LlamaConfig, pos):
@@ -256,24 +320,35 @@ def make_generate_fn(cfg: LlamaConfig, *, max_new_tokens: int,
         done0 = (jnp.zeros((B,), bool) if eos_token_id is None
                  else tok0 == eos_token_id)
 
-        def body(carry, t):
-            tok, cache, done, key, drops = carry
+        # decode loop: a lax.while_loop (not scan) so the program EXITS as
+        # soon as every row has hit eos — a batch that finishes at step k
+        # pays k steps, not max_new_tokens (the alive-mask early exit).
+        # Greedy outputs are bit-identical to the full-length scan: the
+        # output buffer is pre-filled with pad_token_id, which is exactly
+        # what the skipped steps would have emitted for all-done rows.
+        def body(carry):
+            t, tok, cache, done, key, drops, out = carry
             logits, cache, d = decode_step(params, cfg, tok, t, prompt_lens,
                                            jnp.int32(S), cache)
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p)
-            nxt = jnp.where(done, pad_token_id, nxt)
+            nxt = jnp.where(done, pad_token_id, nxt).astype(ids.dtype)
             ndone = done if eos_token_id is None else \
                 done | (nxt == eos_token_id)
-            return (nxt.astype(ids.dtype), cache, ndone, key, drops + d), \
-                nxt.astype(ids.dtype)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, t + 1))
+            return (t + 1, nxt, cache, ndone, key, drops + d, out)
+
+        def cond(carry):
+            t, _, _, done, _, _, _ = carry
+            return (t < max_new_tokens - 1) & ~done.all()
 
         if max_new_tokens > 1:
-            carry = (tok0.astype(ids.dtype), cache, done0, key, drops0)
-            (_, _, _, _, drops), rest = lax.scan(
-                body, carry, jnp.arange(max_new_tokens - 1))
-            out = jnp.concatenate([tok0[:, None].astype(ids.dtype),
-                                   rest.T], axis=1)
+            out0 = jnp.full((B, max_new_tokens), pad_token_id, ids.dtype)
+            out0 = lax.dynamic_update_slice(
+                out0, tok0[:, None].astype(ids.dtype), (0, 0))
+            carry = (jnp.int32(0), tok0.astype(ids.dtype), cache, done0, key,
+                     drops0, out0)
+            _, _, _, _, _, drops, out = lax.while_loop(cond, body, carry)
         else:
             drops = drops0
             out = tok0[:, None].astype(ids.dtype)
@@ -388,3 +463,131 @@ class DecodeSession:
         from the full-forward oracle — the checkable form of the module
         docstring's MoE caveat; r4 VERDICT next #10)."""
         return float(self._dropped) if self._dropped is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache (block-table attention — the serving-engine entry points)
+# ---------------------------------------------------------------------------
+
+def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
+                    dtype=None) -> Dict:
+    """Physical KV block pool ``{"k","v": [L, num_blocks, block_size, Hk,
+    D]}`` shared by every sequence the serving engine runs (PagedAttention
+    layout): a sequence holds only the blocks its block table points at,
+    so HBM scales with tokens actually in flight instead of
+    ``max_slots * max_seq``. Physical block 0 is reserved as the NULL
+    block — the scatter target for masked lanes (padded prefill positions,
+    retired slots) — and is never handed out by the block manager
+    (``inference.serving.paged_cache``).
+    """
+    dt = dtype if dtype is not None else cfg.dtype
+    shape = (cfg.num_hidden_layers, num_blocks, block_size, cfg.kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
+                  block_tables, pool: Dict, active):
+    """Prefill a BATCH of admitted sequences into the paged pool.
+
+    ``ids [B, Sb]`` right-padded to the (power-of-2 bucketed) length
+    ``Sb``; ``prompt_lens [B]`` the real token counts; ``block_tables
+    [B, W]`` each row's physical block ids (logical position ``j`` lives
+    in block ``table[j // block_size]`` at offset ``j % block_size``);
+    ``active [B]`` bool — the admission step pads the batch dim to the
+    engine's ``max_slots`` so prefill executables are bounded by the
+    BUCKET count alone, and inactive pad rows scatter into the null block.
+    Right-padding keeps RoPE positions at the plain ``0..Sb-1`` table and
+    the causal mask makes each row's pad tail invisible to its real
+    positions; pad-position K/V also scatter into the null block. Returns
+    (next-token logits ``[B, V]`` read at each row's ``prompt_len - 1``,
+    pool, dropped_tokens).
+    """
+    from ..kernels.rope import rope_cos_sin
+    B, Sb = ids.shape
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    dt = cfg.dtype
+    cos, sin = rope_cos_sin(Sb, D, cfg.rope_theta)
+    j = jnp.arange(Sb)
+    valid = (j[None, :] < prompt_lens[:, None]) & active[:, None]   # [B, Sb]
+    phys = jnp.where(valid, block_tables[:, jnp.minimum(j // bs, W - 1)], 0)
+    off = jnp.broadcast_to(j % bs, (B, Sb))
+    kv_mask = jnp.broadcast_to((j[None, :] <= j[:, None])[None],
+                               (B, Sb, Sb))             # causal per row
+
+    x = jnp.take(params["embed"], ids, axis=0).astype(dt)
+
+    def body(h, xs):
+        lp, pk, pv = xs
+        hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
+        q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
+        k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
+        v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
+        q = _rope(q, cos, sin, False)
+        k = _rope(k, cos, sin, False)
+        pk = pk.at[phys, off].set(k.astype(pk.dtype))
+        pv = pv.at[phys, off].set(v.astype(pv.dtype))
+        o = _masked_sdpa(q, k, v, kv_mask)
+        h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
+        h, drops = _ffn_tail(lp, h, cfg)
+        return h, (pk, pv, drops)
+
+    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
+                                            pool["v"]))
+    idx = jnp.maximum(prompt_lens - 1, 0)[:, None, None]
+    last = jnp.take_along_axis(x, idx, axis=1)          # [B, 1, E]
+    return _lm_head(params, cfg, last), {"k": pk, "v": pv}, drops.sum()
+
+
+def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
+                      block_tables, pool: Dict, active):
+    """One decode iteration over ``M`` serving slots against the block pool.
+
+    ``tokens [M]`` the last sampled token per slot; ``seq_lens [M]`` the KV
+    entries already written (= the new token's position); ``block_tables
+    [M, W]``; ``active [M]`` bool — inactive slots (empty, retired, past
+    their budget) scatter their K/V into the null block and their logits
+    are garbage the scheduler ignores. Attention gathers each slot's blocks
+    ``pool[block_tables]`` into logical order — a sequence touches only the
+    blocks it owns — and masks gathered positions ``> seq_len``. Returns
+    (logits ``[M, V]``, pool, dropped_tokens).
+    """
+    M = tokens.shape[0]
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * bs
+    dt = cfg.dtype
+    cos, sin = _row_tables(cfg, seq_lens[:, None])       # [M, 1, D]
+    widx = jnp.minimum(seq_lens // bs, W - 1)
+    phys = jnp.where(active,
+                     jnp.take_along_axis(block_tables, widx[:, None],
+                                         axis=1)[:, 0], 0)
+    off = seq_lens % bs
+    jj = jnp.arange(C)[None, :]
+    kv_mask = (jj <= seq_lens[:, None])[:, None, :]      # [M, 1, C]
+
+    x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(dt)
+
+    def body(h, xs):
+        lp, pk, pv = xs
+        hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
+        q = _mm(hh, lp, "wq", dt).reshape(M, 1, H, D)
+        k = _mm(hh, lp, "wk", dt).reshape(M, 1, Hk, D)
+        v = _mm(hh, lp, "wv", dt).reshape(M, 1, Hk, D)
+        q = _rope(q, cos, sin, False)
+        k = _rope(k, cos, sin, False)
+        pk = pk.at[phys, off].set(k[:, 0].astype(pk.dtype))
+        pv = pv.at[phys, off].set(v[:, 0].astype(pv.dtype))
+        kk = pk[block_tables].reshape(M, C, Hk, D)
+        vv = pv[block_tables].reshape(M, C, Hk, D)
+        o = _masked_sdpa(q, kk, vv, kv_mask)
+        h = h + _mm(o.reshape(M, 1, H * D).astype(dt), lp, "wo", dt)
+        h, drops = _ffn_tail(lp, h, cfg)
+        return h, (pk, pv, drops)
+
+    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
+                                            pool["v"]))
+    return _lm_head(params, cfg, x), {"k": pk, "v": pv}, drops.sum()

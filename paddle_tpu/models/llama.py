@@ -327,6 +327,27 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
     return o.astype(q.dtype)
 
 
+def _masked_sdpa(q, kk, vv, kv_mask):
+    """Decode-path attention over an explicit KV set: ``q [B, T, H, D]``
+    against ``kk/vv [B, C, Hk, D]`` with ``kv_mask [B, T, C]`` (True =
+    query t may attend key j). fp32 scores, GQA kv-head expansion, masked
+    positions at -1e30 (exp underflows to an exact 0.0 in the softmax, so
+    enlarging C with masked slots never changes the attended values).
+    Shared by the dense KV cache and the paged block cache
+    (:mod:`paddle_tpu.models.generation`)."""
+    H, Hk = q.shape[2], kk.shape[2]
+    if Hk != H:                       # GQA: expand kv heads for the einsum
+        rep = H // Hk
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bthd,bjhd->bhtj", q.astype(jnp.float32),
+                   kk.astype(jnp.float32)) * scale
+    s = jnp.where(kv_mask[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhtj,bjhd->bthd", p.astype(vv.dtype), vv)
+
+
 def _moe_ffn(lp: Dict, h, cfg: LlamaConfig):
     """GShard-routed SwiGLU experts on ``h [B, S, E]`` -> (out, aux_loss).
 
@@ -403,6 +424,24 @@ def quantize_params(params: Dict) -> Dict:
         qp["lm_head"] = q
         qp["lm_head_s"] = s
     return qp
+
+
+QUANTIZE_MODES = (None, "int8")
+
+
+def ensure_quantized(params: Dict, mode) -> Dict:
+    """Validate a weight-only quantize mode and make the pytree match it:
+    ``None`` returns ``params`` untouched, ``"int8"`` runs
+    :func:`quantize_params` unless the tree already carries the scale
+    leaves (``wq_s``). The one place the accepted-modes list and the
+    already-quantized marker live — every decode tier (predictor, serving
+    engine) resolves through here."""
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(f"unknown quantize mode {mode!r}; "
+                         f"options: {QUANTIZE_MODES}")
+    if mode == "int8" and "wq_s" not in params.get("layers", {}):
+        return quantize_params(params)
+    return params
 
 
 def decoder_layer(lp: Dict, x, cos, sin, cfg: LlamaConfig,
@@ -933,23 +972,39 @@ class LlamaForCausalLM:
                 return forward_op("llama_loss", f,
                                   [input_ids, labels, *self._flat_params])
 
-            def generate(self, input_ids, *, max_new_tokens: int,
-                         prompt_lens=None, temperature: float = 0.0,
-                         top_k=None, top_p=None, eos_token_id=None,
-                         pad_token_id: int = 0, seed: int = 0):
+            def generate(self, input_ids, *, max_new_tokens=None,
+                         prompt_lens=None, temperature=None,
+                         top_k="unset", top_p="unset",
+                         eos_token_id="unset",
+                         pad_token_id=None, seed: int = 0,
+                         generation_config=None):
                 """KV-cache autoregressive decoding (greedy when
                 ``temperature == 0``, else top-k/top-p sampling); prefill +
                 the whole decode loop compile to ONE device program — see
-                :mod:`paddle_tpu.models.generation`."""
+                :mod:`paddle_tpu.models.generation`.
+
+                Sampling knobs resolve through the ONE shared
+                :class:`~paddle_tpu.models.generation.GenerationConfig`
+                (also the ``inference.GenerationPredictor`` struct):
+                ``generation_config`` supplies defaults, explicit keyword
+                arguments override its fields — including an explicit
+                ``eos_token_id=None``/``top_k=None``/``top_p=None`` to
+                DISABLE a knob the base config sets (their not-given
+                spelling is the ``"unset"`` sentinel)."""
+                from .generation import GenerationConfig
                 from .generation import generate as _gen
+                g = GenerationConfig.resolve(
+                    generation_config, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_token_id=eos_token_id, pad_token_id=pad_token_id)
                 ids = getattr(input_ids, "_value", input_ids)
                 out = _gen(self.params_pytree(), ids, self.config,
-                           max_new_tokens=max_new_tokens,
+                           max_new_tokens=g.max_new_tokens,
                            prompt_lens=getattr(prompt_lens, "_value",
                                                prompt_lens),
-                           temperature=temperature, top_k=top_k, top_p=top_p,
-                           eos_token_id=eos_token_id,
-                           pad_token_id=pad_token_id,
+                           temperature=g.temperature, top_k=g.top_k,
+                           top_p=g.top_p, eos_token_id=g.eos_token_id,
+                           pad_token_id=g.pad_token_id,
                            key=jax.random.PRNGKey(seed))
                 return Tensor(out)
 

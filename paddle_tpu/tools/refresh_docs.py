@@ -64,6 +64,8 @@ def measured_counts() -> dict:
     from paddle_tpu.flags import get_flags
     health_flags = sorted(n for n in get_flags()
                           if n.startswith("FLAGS_health_"))
+    serving_flags = sorted(n for n in get_flags()
+                           if n.startswith("FLAGS_serving_"))
     return {
         "ops": total,
         "swept": covered,
@@ -74,7 +76,9 @@ def measured_counts() -> dict:
         "lr_schedulers": len(lrs),
         "chaos_injectors": len(INJECTORS),
         "health_flags": len(health_flags),
+        "serving_flags": len(serving_flags),
         "_health_flag_rows": health_flags,   # consumed by health_flags_table
+        "_serving_flag_rows": serving_flags,  # ... serving_flags_table
     }
 
 
@@ -132,13 +136,14 @@ _GEN = re.compile(r"<!--gen:(?P<key>[a-z0-9_]+)-->(?P<body>.*?)"
 
 
 def render(key: str, counts: dict, bench: dict) -> str:
-    if key == "health_flags_table":
+    if key in ("health_flags_table", "serving_flags_table"):
         # generated flags table: name | default | what it gates (the help
         # text's first sentence), straight from the live registry so the
         # docs cannot drift from flags.py
         from paddle_tpu.flags import _registry
         rows = ["| flag | default | gates |", "|------|---------|-------|"]
-        for name in counts["_health_flag_rows"]:
+        for name in counts["_" + key.replace("_table", "_rows").replace(
+                "flags", "flag")]:
             d = _registry[name]
             first = d.help.split(". ")[0].rstrip(".") + "."
             rows.append(f"| `{name}` | `{d.default}` | {first} |")
@@ -159,7 +164,7 @@ def refresh(check: bool = False) -> int:
     bench = latest_bench()
     drift = []
     for rel in ("README.md", "docs/FAULT_TOLERANCE.md",
-                "docs/PERFORMANCE.md"):
+                "docs/PERFORMANCE.md", "docs/SERVING.md"):
         path = os.path.join(ROOT, rel)
         src = open(path).read()
 

@@ -56,6 +56,33 @@ def test_bench_input_entry_point():
     assert 0.0 <= metrics["input_overlap_pct"]["value"] <= 100.0
 
 
+def test_bench_serve_entry_point():
+    """The serving section (ISSUE 4): continuous batching over the paged KV
+    cache vs the static-batch baseline on one mixed-length trace. The
+    section itself asserts the acceptance proofs (paged greedy bit-equal to
+    the dense path, constant decode-executable count) before emitting, so a
+    green run here pins them in tier-1; the smoke additionally checks the
+    detail record and that both throughput rows landed."""
+    metrics, proc = _run_bench("--serve")
+    assert "serving_agg_tok_s" in metrics, proc.stdout + proc.stderr
+    assert "serving_throughput_speedup" in metrics
+    assert metrics["serving_agg_tok_s"]["value"] > 0
+    detail = None
+    for line in proc.stderr.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "serve" in d:
+                detail = d["serve"]
+    assert detail is not None, proc.stderr
+    assert detail["outputs_match"] is True
+    assert detail["recompiles_constant"] is True
+    assert detail["decode_traces"] == 1
+
+
 def test_bench_health_entry_point():
     """The run-health section (ISSUE 3): sentinel overhead row on the
     tuned llama path plus the in-bench containment proof (a NaN-poisoned

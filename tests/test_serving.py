@@ -1,0 +1,440 @@
+"""Continuous-batching serving engine tests (ISSUE 4).
+
+Oracle pattern (SURVEY §4): the DENSE KV-cache path (models.generation
+.generate — itself pinned to the full-forward oracle by test_generation) is
+the numerics reference; paged greedy decode must reproduce its token
+sequences exactly, per request, across mixed-length traces, GQA configs,
+EOS retirement and slot reuse. Scheduler/block-manager units run host-only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import generation as G
+from paddle_tpu.models.llama import LlamaConfig, init_params
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, hidden_size=64, intermediate_size=96,
+                num_hidden_layers=3, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def make_engine(params, cfg, **kw):
+    from paddle_tpu.inference.serving import ServingConfig, ServingEngine
+    sc = dict(block_size=4, max_slots=3, max_model_len=32, decode_chunk=2,
+              queue_depth=64)
+    sc.update(kw)
+    return ServingEngine(params, cfg, ServingConfig(**sc))
+
+
+def dense_rows(params, cfg, prompts, outs):
+    """Per-request dense-cache greedy decode (the oracle)."""
+    return [np.asarray(G.generate(params, jnp.asarray(p[None]), cfg,
+                                  max_new_tokens=int(n)))[0]
+            for p, n in zip(prompts, outs)]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 97, (int(s),)).astype(np.int32)
+               for s in [9, 5, 12, 7, 9, 4, 11, 6]]
+    outs = [6, 3, 8, 2, 5, 7, 4, 6]
+    return cfg, params, prompts, outs
+
+
+class TestPagedParity:
+    def test_mixed_trace_matches_dense(self, setup):
+        """More requests than slots, mixed prompt/output lengths: every
+        request's paged greedy output must equal the dense-cache path's,
+        bit for bit."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg)
+        got = eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts, outs)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        st = eng.stats()
+        assert st["retired"] == len(prompts)
+        assert st["live_slots"] == 0 and st["queued"] == 0
+
+    @pytest.mark.parametrize("kvh", [4, 1])   # MHA and max-GQA
+    def test_gqa_variants(self, setup, kvh):
+        _, _, prompts, _ = setup
+        cfg = tiny_cfg(num_key_value_heads=kvh)
+        params = init_params(cfg, jax.random.PRNGKey(1))
+        eng = make_engine(params, cfg, max_slots=2)
+        got = eng.run(prompts[:4], max_new_tokens=4, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts[:4], [4] * 4)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_eos_stops_row_and_frees_slot(self, setup):
+        cfg, params, prompts, _ = setup
+        oracle = dense_rows(params, cfg, prompts[:1], [6])[0]
+        eos = int(oracle[1])
+        stop = int(np.argmax(oracle == eos))    # first occurrence wins
+        eng = make_engine(params, cfg)
+        out = eng.run([prompts[0]], max_new_tokens=6, eos_token_id=eos)[0]
+        np.testing.assert_array_equal(np.asarray(out), oracle[:stop + 1])
+        assert eng.stats()["free_blocks"] == \
+            eng.cache.manager.num_blocks - 1
+
+    def test_streaming_events(self, setup):
+        """stream() yields (rid, token) events that reassemble to run()'s
+        outputs."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg)
+        rids = [eng.submit(p, max_new_tokens=n, eos_token_id=None)
+                for p, n in zip(prompts[:4], outs[:4])]
+        acc = {r: [] for r in rids}
+        for rid, tok in eng.stream():
+            acc[rid].append(tok)
+        want = dense_rows(params, cfg, prompts[:4], outs[:4])
+        for rid, w in zip(rids, want):
+            np.testing.assert_array_equal(np.asarray(acc[rid]), w)
+
+    def test_int8_engine(self, setup):
+        """quantize='int8' decodes through the weight-only path: the paged
+        engine must reproduce the DENSE path's greedy tokens under the SAME
+        quantized params exactly (int8 wiring parity — fp-vs-int8 token
+        drift is the batch test's concern, not this one's)."""
+        from paddle_tpu.models.llama import quantize_params
+        cfg, params, prompts, _ = setup
+        qp = quantize_params(params)
+        eng = make_engine(params, cfg, quantize="int8")
+        assert eng._params["layers"]["wq"].dtype == jnp.int8
+        got = eng.run(prompts[:3], max_new_tokens=6, eos_token_id=None)
+        want = dense_rows(qp, cfg, prompts[:3], [6] * 3)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+
+class TestScheduler:
+    def _cache(self, cfg, **kw):
+        from paddle_tpu.inference.serving import PagedKVCache
+        base = dict(max_slots=2, max_model_len=16, block_size=4)
+        base.update(kw)
+        return PagedKVCache(cfg, **base)
+
+    def test_block_manager_accounting(self, setup):
+        from paddle_tpu.inference.serving import BlockManager
+        bm = BlockManager(num_blocks=9, block_size=4)
+        assert bm.free_blocks == 8                  # block 0 reserved null
+        a = bm.alloc(3)
+        assert bm.free_blocks == 5 and 0 not in a
+        with pytest.raises(RuntimeError, match="out of KV blocks"):
+            bm.alloc(6)
+        bm.free(a)
+        assert bm.free_blocks == 8
+        with pytest.raises(RuntimeError, match="free"):
+            bm.free(a)                              # double free
+        assert bm.blocks_for(1) == 1 and bm.blocks_for(5) == 2
+
+    def test_fifo_admission_and_slot_reuse(self, setup):
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        cache = self._cache(cfg)
+        sched = Scheduler(cache, max_slots=2, queue_depth=8)
+        rids = [sched.submit(Request(rid=-1,
+                                     prompt=np.zeros((8,), np.int32),
+                                     max_new_tokens=4)) for _ in range(4)]
+        assert rids == [0, 1, 2, 3]
+        first = sched.next_admission()
+        second = sched.next_admission()
+        assert (first.rid, second.rid) == (0, 1)    # FIFO
+        assert sched.next_admission() is None       # no free slot
+        slot0 = first.slot
+        sched.finish(first)                          # retire -> slot+blocks
+        third = sched.next_admission()
+        assert third.rid == 2 and third.slot == slot0       # slot reused
+        for r in (second, third):
+            sched.finish(r)
+        fourth = sched.next_admission()
+        assert fourth.rid == 3
+        sched.finish(fourth)
+        assert cache.free_blocks == cache.manager.num_blocks - 1
+        assert not sched.pending
+
+    def test_queue_depth_bound(self, setup):
+        from paddle_tpu.inference.serving import (Request, Scheduler,
+                                                  ServingQueueFull)
+        cfg, _, _, _ = setup
+        sched = Scheduler(self._cache(cfg), max_slots=2, queue_depth=2)
+        req = lambda: Request(rid=-1, prompt=np.zeros((4,), np.int32),
+                              max_new_tokens=2)
+        sched.submit(req())
+        sched.submit(req())
+        with pytest.raises(ServingQueueFull):
+            sched.submit(req())
+
+    def test_oversized_request_rejected(self, setup):
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        sched = Scheduler(self._cache(cfg), max_slots=2, queue_depth=8)
+        with pytest.raises(ValueError, match="max_model_len"):
+            sched.submit(Request(rid=-1, prompt=np.zeros((8,), np.int32),
+                                 max_new_tokens=32))   # 39 KV > 16
+
+    def test_kv_entry_bound_not_block_granular(self, setup):
+        """max_model_len is enforced in KV entries: with block_size 16 and
+        max_model_len 20 a 30-KV request fits 2 blocks (32 slots) but must
+        still be rejected."""
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        sched = Scheduler(self._cache(cfg, max_model_len=20, block_size=16),
+                          max_slots=2, queue_depth=8)
+        with pytest.raises(ValueError, match="max_model_len"):
+            sched.submit(Request(rid=-1, prompt=np.zeros((1,), np.int32),
+                                 max_new_tokens=30))    # 30 KV > 20
+        sched.submit(Request(rid=-1, prompt=np.zeros((1,), np.int32),
+                             max_new_tokens=20))        # 20 KV == bound
+
+    def test_unsatisfiable_request_rejected_not_hung(self, setup):
+        """A request that fits max_model_len but exceeds the pool's USABLE
+        block count must be rejected at submit() — otherwise reserve()
+        returns None forever with nothing live to retire and the engine's
+        drain loop spins."""
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        cache = self._cache(cfg, max_model_len=88, block_size=8,
+                            num_blocks=4)               # 3 usable < 11 cap
+        sched = Scheduler(cache, max_slots=2, queue_depth=8)
+        with pytest.raises(ValueError, match="usable blocks"):
+            sched.submit(Request(rid=-1, prompt=np.zeros((24,), np.int32),
+                                 max_new_tokens=64))    # 87 KV -> 11 blocks
+        # right at the pool bound still queues fine
+        sched.submit(Request(rid=-1, prompt=np.zeros((8,), np.int32),
+                             max_new_tokens=17))        # 24 KV -> 3 blocks
+        assert sched.next_admission() is not None
+
+    def test_finished_records_bounded(self, setup):
+        """A long-lived scheduler retains only the most recent
+        queue_depth + max_slots finished records (host memory must not
+        grow with total requests served)."""
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        sched = Scheduler(self._cache(cfg), max_slots=2, queue_depth=3)
+        for _ in range(9):
+            sched.submit(Request(rid=-1, prompt=np.zeros((4,), np.int32),
+                                 max_new_tokens=2))
+            sched.finish(sched.next_admission())
+        assert sched.retired == 9
+        assert len(sched.finished) == sched.keep_finished == 5
+        assert sorted(sched.finished) == [4, 5, 6, 7, 8]  # oldest evicted
+        sched.result(8)
+        with pytest.raises(KeyError):
+            sched.result(0)
+
+    def test_head_of_line_waits_for_blocks(self, setup):
+        from paddle_tpu.inference.serving import Request, Scheduler
+        cfg, _, _, _ = setup
+        cache = self._cache(cfg, max_slots=2, max_model_len=16,
+                            num_blocks=5)               # 4 usable blocks
+        sched = Scheduler(cache, max_slots=2, queue_depth=8)
+        big = Request(rid=-1, prompt=np.zeros((12,), np.int32),
+                      max_new_tokens=5)                 # 16 KV -> 4 blocks
+        sched.submit(big)
+        sched.submit(Request(rid=-1, prompt=np.zeros((4,), np.int32),
+                             max_new_tokens=1))
+        a = sched.next_admission()
+        assert a.rid == 0                               # big got everything
+        assert sched.next_admission() is None           # no blocks left
+        sched.finish(a)
+        assert sched.next_admission().rid == 1          # admitted after free
+
+
+class TestRecompileBounds:
+    def test_decode_compiles_once_prefill_per_bucket(self, setup):
+        """The acceptance criterion's compile story: ONE decode executable
+        across the whole mixed trace (the per-dispatch iteration bound is
+        a device scalar, not a shape); prefill executables bounded by
+        len_buckets * batch_buckets; a second trace adds zero traces."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg)
+        eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        st = eng.stats()
+        assert st["decode_traces"] == 1
+        # prompts 4..12 -> len buckets {8, 16}; the initial burst admits 3
+        # (shapes (8,1) + (16,2)), steady-state refills admit one at a time
+        # ((16,1)) -> 3 executables, within the 2 len x 2 batch bound
+        assert st["prefill_buckets"] == 2
+        assert st["prefill_traces"] == 3
+        eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        st2 = eng.stats()
+        assert st2["decode_traces"] == 1
+        assert st2["prefill_traces"] == 3
+
+    def test_exact_schedule_dispatch_counts(self, setup):
+        """Dispatch sizing follows the schedule: with no queue the whole
+        tail drains in ONE decode dispatch (budgets 7 and 3 with
+        decode_chunk=2 — the bound is dynamic, not the chunk flag);
+        with a queue, dispatches return at budget-retirement boundaries
+        so a freed slot refills with zero idle iterations."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg)                  # 3 slots, chunk 2
+        got = eng.run(prompts[:2], max_new_tokens=[8, 4], eos_token_id=None)
+        want = dense_rows(params, cfg, prompts[:2], [8, 4])
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        assert eng.stats()["chunks"] == 1
+        # queued trace: 4 one-slot waves of budget 4 (3 steps after the
+        # prefill token) -> retirement-aligned dispatches, not ceil(3/2)
+        # chunks per wave
+        eng2 = make_engine(params, cfg, max_slots=1)
+        eng2.run(prompts[:4], max_new_tokens=4, eos_token_id=None)
+        assert eng2.stats()["chunks"] == 4
+
+
+class TestUnifiedGenerationConfig:
+    def test_one_shared_struct(self):
+        from paddle_tpu.inference.generation import (
+            GenerationConfig as PredictorConfig)
+        assert PredictorConfig is G.GenerationConfig
+
+    def test_resolve_merges_kwargs_over_base(self):
+        g = G.GenerationConfig(max_new_tokens=7, eos_token_id=5,
+                               pad_token_id=9)
+        r = G.GenerationConfig.resolve(g, max_new_tokens=3,
+                                       temperature=None)
+        assert (r.max_new_tokens, r.eos_token_id, r.pad_token_id) == \
+            (3, 5, 9)
+        assert G.GenerationConfig.resolve(None).max_new_tokens == 64
+
+    def test_resolve_none_disables_optional_knobs(self):
+        """For the Optional knobs None is a real override (disable), not
+        the unset spelling — that job belongs to the "unset" sentinel."""
+        g = G.GenerationConfig(eos_token_id=5, top_k=4, top_p=0.9)
+        r = G.GenerationConfig.resolve(g, eos_token_id=None, top_k=None)
+        assert r.eos_token_id is None and r.top_k is None
+        assert r.top_p == 0.9
+        kept = G.GenerationConfig.resolve(g, eos_token_id="unset",
+                                          max_new_tokens="unset")
+        assert kept.eos_token_id == 5 and kept.max_new_tokens == 64
+        # non-Optional fields keep None-means-unset back-compat
+        assert G.GenerationConfig.resolve(g, pad_token_id=None,
+                                          max_new_tokens=None) == g
+
+    def test_eager_generate_explicit_none_disables_eos(self, setup):
+        """generate(generation_config=g, eos_token_id=None) must actually
+        disable EOS (pre-unification meaning of None), not silently keep
+        g's id."""
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        net = LlamaForCausalLM(cfg, key=jax.random.PRNGKey(0))
+        ids = jnp.asarray(prompts[0][None, :5])
+        base = G.GenerationConfig(max_new_tokens=4)
+        # oracle: no EOS at all ([B, max_new] — generated tokens only)
+        want = np.asarray(net.generate(ids, max_new_tokens=4)._value)
+        # pick the second generated token as a poison EOS id
+        eos = int(want[0, 1])
+        poisoned = base.replace(eos_token_id=eos)
+        stopped = np.asarray(net.generate(
+            ids, generation_config=poisoned)._value)
+        assert not np.array_equal(stopped, want)        # EOS really fires
+        out = np.asarray(net.generate(ids, generation_config=poisoned,
+                                      eos_token_id=None)._value)
+        np.testing.assert_array_equal(out, want)
+
+    def test_eager_generate_accepts_config(self, setup):
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        net = LlamaForCausalLM(cfg, key=jax.random.PRNGKey(0))
+        ids = jnp.asarray(np.stack([prompts[0][:5], prompts[1][:5]]))
+        via_kwargs = net.generate(ids, max_new_tokens=4)
+        via_config = net.generate(
+            ids, generation_config=G.GenerationConfig(max_new_tokens=4))
+        np.testing.assert_array_equal(np.asarray(via_kwargs._value),
+                                      np.asarray(via_config._value))
+
+
+class TestPredictorServe:
+    def test_serve_matches_generate(self, setup):
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.inference.generation import (GenerationConfig,
+                                                     GenerationPredictor)
+        from paddle_tpu.inference.serving import ServingConfig
+        pred = GenerationPredictor(params, cfg,
+                                   GenerationConfig(max_new_tokens=5))
+        ids = np.stack([p[:5] for p in prompts[:3]])
+        batch = pred.generate(ids)
+        sc = ServingConfig(block_size=4, max_slots=2, max_model_len=16,
+                           decode_chunk=2, queue_depth=8)
+        served = pred.serve([r for r in ids], serving_config=sc)
+        for row, s in zip(batch, served):
+            np.testing.assert_array_equal(row, np.asarray(s))
+        # an identical config keeps the warm engine; a different one rebuilds
+        eng = pred._engine
+        pred.serve([ids[0]], serving_config=ServingConfig(**dict(
+            block_size=4, max_slots=2, max_model_len=16, decode_chunk=2,
+            queue_depth=8)))
+        assert pred._engine is eng
+        pred.serve([ids[0]], serving_config=ServingConfig(
+            block_size=4, max_slots=3, max_model_len=16, decode_chunk=2,
+            queue_depth=8))
+        assert pred._engine is not eng
+        # per-prompt budget list must match the prompt count
+        with pytest.raises(ValueError, match="entries"):
+            pred._engine.run([ids[0], ids[1]], max_new_tokens=[3])
+
+    def test_predictor_int8_quantize(self, setup):
+        """quantize='int8' converts the pytree once; the predictor's batch
+        decode then matches the dense path under the SAME quantized params
+        exactly."""
+        from paddle_tpu.models.llama import quantize_params
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.inference.generation import (GenerationConfig,
+                                                     GenerationPredictor)
+        ids = np.stack([prompts[0][:6], prompts[2][:6]])
+        q = GenerationPredictor(params, cfg,
+                                GenerationConfig(max_new_tokens=6),
+                                quantize="int8")
+        assert q._params["layers"]["wq"].dtype == jnp.int8
+        want = np.asarray(G.generate(quantize_params(params),
+                                     jnp.asarray(ids), cfg,
+                                     max_new_tokens=6))
+        np.testing.assert_array_equal(q.generate(ids), want)
+        # serve() inherits the predictor's quantize mode WITHOUT mutating
+        # the caller's config object
+        from paddle_tpu.inference.serving import ServingConfig
+        sc = ServingConfig(block_size=4, max_slots=2, max_model_len=16,
+                           decode_chunk=2, queue_depth=8)
+        q.serve([ids[0]], max_new_tokens=3, serving_config=sc)
+        assert sc.quantize is None
+        assert q._engine.config.quantize == "int8"
+
+
+class TestEarlyExitDecodeLoop:
+    def test_decode_loop_is_a_while_loop(self, setup):
+        """The fixed-batch decode loop must lower to lax.while_loop (the
+        alive-mask early exit), not a fixed-trip scan."""
+        cfg, params, prompts, _ = setup
+        gen = G.make_generate_fn(cfg, max_new_tokens=4, eos_token_id=0)
+        ids = jnp.asarray(np.stack([prompts[0][:5], prompts[1][:5]]))
+        jaxpr = jax.make_jaxpr(gen)(
+            params, ids, jnp.full((2,), 5, jnp.int32), jax.random.PRNGKey(0))
+        prims = {e.primitive.name for e in jaxpr.eqns}
+        # the layer stack still scans; the TOKEN loop is the while
+        assert "while" in prims
+
+    def test_early_eos_keeps_output_contract(self, setup):
+        """All rows hitting eos at the first decode step must still return
+        the full [B, max_new_tokens] buffer, padded — bit-identical to the
+        full-length loop's output."""
+        cfg, params, prompts, _ = setup
+        ids = jnp.asarray(prompts[0][None, :5])
+        free = np.asarray(G.generate(params, ids, cfg, max_new_tokens=16))
+        eos = int(free[0, 1])                # fires at decode step 1
+        got = np.asarray(G.generate(params, ids, cfg, max_new_tokens=16,
+                                    eos_token_id=eos, pad_token_id=0))
+        assert got.shape == (1, 16)
+        stop = int(np.argmax(free[0] == eos))
+        np.testing.assert_array_equal(got[0, :stop + 1], free[0, :stop + 1])
+        assert (got[0, stop + 1:] == 0).all()
