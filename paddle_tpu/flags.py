@@ -239,11 +239,34 @@ define_flag("FLAGS_serving_queue_depth", 128,
             int)
 define_flag("FLAGS_serving_decode_chunk", 8,
             "Cap on decode iterations per device dispatch when a live "
-            "request can retire EARLY (EOS enabled) or the caller streams "
-            "token events. Otherwise dispatches are schedule-sized: run "
-            "to the next budget retirement (queue waiting) or drain the "
-            "tail in one dispatch (queue empty) — the bound is a device "
-            "scalar, so sizing never retraces.", int)
+            "request can retire EARLY (EOS enabled), a prompt is "
+            "mid-chunked-prefill, or the caller streams token events. "
+            "Otherwise dispatches are schedule-sized: run to the next "
+            "budget retirement (queue waiting) or drain the tail in one "
+            "dispatch (queue empty) — the bound is a device scalar, so "
+            "sizing never retraces.", int)
+define_flag("FLAGS_serving_prefix_cache", True,
+            "Automatic prefix caching: full KV blocks are content-hashed "
+            "(chained block-aligned token-id keys) into the ref-counted "
+            "BlockManager table, so requests sharing a system-prompt/"
+            "few-shot prefix map the cached blocks instead of re-running "
+            "prefill over them. Refcount-0 blocks stay cached (LRU) until "
+            "allocation pressure evicts them. ServingConfig(prefix_cache="
+            "None/False) disables per engine.", bool)
+define_flag("FLAGS_serving_prefill_chunk", 256,
+            "Chunked prefill: prompts longer than this prefill in chunks "
+            "of this many tokens interleaved with decode dispatches, so a "
+            "long admission no longer freezes in-flight streams. 0 "
+            "disables (whole prompt in one dispatch); ServingConfig("
+            "prefill_chunk=None) disables per engine.", int)
+define_flag("FLAGS_serving_preempt", True,
+            "On-demand KV paging: a sequence holds only the blocks it has "
+            "filled, and when the pool runs dry the newest-admitted "
+            "running sequence is preempted (blocks freed, re-queued for "
+            "recompute-on-readmission) instead of refusing admission. "
+            "False restores the legacy reservation-at-admission policy "
+            "(prompt + max_new - 1 KV entries charged up front, "
+            "conservative admission, no preemption).", bool)
 
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "

@@ -94,7 +94,20 @@ class GenerationPredictor:
         the engine's slot table as capacity frees up. Returns one
         variable-length token array per prompt (eos included, no pad tail).
         The engine is built lazily and kept — repeat calls reuse its
-        compiled prefill/decode programs and block pool."""
+        compiled prefill/decode programs, block pool, AND prefix cache
+        (a second call sharing prompts/prefixes with the first maps the
+        cached KV blocks instead of re-running prefill).
+
+        Capacity and paging behavior come from ``serving_config``
+        (:class:`~paddle_tpu.inference.serving.ServingConfig`): notably
+        ``prefix_cache`` (automatic content-hashed prefix sharing),
+        ``prefill_chunk`` (long prompts prefill in chunks interleaved with
+        decode) and ``preempt`` (on-demand block allocation with
+        preempt-and-recompute when the pool runs dry). The three resolve
+        from their ``FLAGS_serving_*`` flags when left unset; an EXPLICIT
+        ``None`` disables the feature (the same "unset" sentinel
+        convention as ``GenerationConfig.resolve``). Greedy outputs are
+        bit-identical to the dense-cache path under all three."""
         if self._engine is None or serving_config is not None:
             import dataclasses
 

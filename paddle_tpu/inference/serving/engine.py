@@ -14,23 +14,33 @@ Design (docs/SERVING.md):
   returns exactly when the first live slot exhausts its budget, so
   retirement/admission happen with zero idle iterations; with the queue
   empty one dispatch drains the whole tail. ``decode_chunk`` caps the
-  bound only when a live slot can retire EARLY (EOS enabled) or the
-  caller streams (token-granularity responsiveness).
-* **Paged KV.** Slots attend through per-slot block tables into one
-  physical block pool (``models.generation.paged_decode_step``); a retired
-  slot's blocks return to the pool immediately and the next queued request
-  reuses them.
-* **Bucketed prefill.** Admission prefills at the prompt's power-of-2
-  bucket length with the batch dim padded to the power-of-2 bucket of the
-  ADMISSION-WAVE size (not always ``max_slots`` — most waves admit one
-  request and pay one row of flops), so prefill executables are bounded by
-  ``len_buckets * batch_buckets``, not by distinct prompt lengths or wave
-  sizes.
+  bound only when a live slot can retire EARLY (EOS enabled), a prompt is
+  mid-chunked-prefill, or the caller streams (token granularity).
+* **On-demand paged KV + preemption.** A sequence holds only the blocks
+  covering KV it has actually written: admission allocates the prompt's
+  blocks (prefix-cache hits are MAPPED, not recomputed), decode extends
+  block by block ahead of each dispatch. When the pool runs dry the
+  newest-admitted running sequence is PREEMPTED — blocks freed, tokens
+  kept, re-queued at the front for recompute-on-readmission (greedy
+  recompute is bit-identical) — so worst-case ``max_new`` budgets are
+  never pre-charged and effective concurrency tracks real usage.
+  ``preempt=False`` restores the legacy reservation-at-admission mode.
+* **Automatic prefix caching.** Full KV blocks are content-hashed (chained
+  block-aligned token-id keys) into the ref-counted ``BlockManager`` table
+  as prefill/decode completes them; admissions sharing a system-prompt /
+  few-shot prefix map the cached blocks and prefill only their suffix.
+  Refcount-0 blocks stay cached on an LRU list until allocation pressure
+  evicts them. ``prefix_cache=False`` disables.
+* **Chunked prefill.** Prompts longer than ``prefill_chunk`` prefill in
+  fixed-size chunks (``models.generation.paged_prefill_chunk`` — offset
+  and length are device scalars) interleaved with decode dispatches, so a
+  long admission no longer freezes in-flight streams. Short cold prompts
+  still take the BATCHED bucketed prefill: one dispatch per power-of-2
+  length bucket with the batch dim padded to the power-of-2 bucket of the
+  admission-wave size.
 * **Greedy (v1).** The engine samples by argmax on device; temperature /
   top-k/top-p serving stays on the batch ``generate()`` tier. int8
-  weight-only decode rides transparently via ``quantize="int8"``
-  (``llama.quantize_params`` — `_mm` routes every projection through the
-  stream-dequant path).
+  weight-only decode rides transparently via ``quantize="int8"``.
 
 API::
 
@@ -56,12 +66,21 @@ from .scheduler import Request, Scheduler, ServingQueueFull  # noqa: F401
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
+_UNSET = "unset"
+
 
 @dataclasses.dataclass
 class ServingConfig:
     """Engine shape/capacity knobs. ``None`` fields resolve from the
     ``FLAGS_serving_*`` registry at construction (flags.py), so a fleet can
-    retune the engine from the environment without code changes."""
+    retune the engine from the environment without code changes.
+
+    The three feature knobs use the ``"unset"`` sentinel instead (the same
+    convention as ``GenerationConfig.resolve``): left unset they resolve
+    from their flag; an EXPLICIT ``None`` (or ``False``/``0``) disables
+    the feature even when the flag enables it — ``prefix_cache=None`` and
+    ``prefill_chunk=None`` are real overrides, not "not given".
+    """
 
     block_size: Optional[int] = None
     max_slots: Optional[int] = None
@@ -71,6 +90,9 @@ class ServingConfig:
     num_blocks: int = 0              # 0 = auto (max_slots full sequences)
     quantize: Optional[str] = None   # "int8" -> weight-only decode path
     cache_dtype: Any = None          # None -> model activation dtype
+    prefix_cache: Any = _UNSET       # bool; None/False = off
+    prefill_chunk: Any = _UNSET      # tokens/chunk; None/0 = whole prompt
+    preempt: Any = _UNSET            # bool; None/False = legacy reservation
 
     def __post_init__(self):
         for f, name in (("block_size", "FLAGS_serving_block_size"),
@@ -80,6 +102,21 @@ class ServingConfig:
                         ("decode_chunk", "FLAGS_serving_decode_chunk")):
             if getattr(self, f) is None:
                 setattr(self, f, int(flag(name)))
+        if self.prefix_cache == _UNSET:
+            self.prefix_cache = bool(flag("FLAGS_serving_prefix_cache"))
+        else:
+            self.prefix_cache = bool(self.prefix_cache)
+        if self.preempt == _UNSET:
+            self.preempt = bool(flag("FLAGS_serving_preempt"))
+        else:
+            self.preempt = bool(self.preempt)
+        if self.prefill_chunk == _UNSET:
+            self.prefill_chunk = int(flag("FLAGS_serving_prefill_chunk"))
+        self.prefill_chunk = (int(self.prefill_chunk)
+                              if self.prefill_chunk else None)
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1 or None/0 "
+                             f"(got {self.prefill_chunk})")
         from ...models.llama import QUANTIZE_MODES
         if self.quantize not in QUANTIZE_MODES:
             raise ValueError(f"unknown quantize mode {self.quantize!r}; "
@@ -107,9 +144,11 @@ class ServingEngine:
                                   self.config.max_model_len,
                                   self.config.block_size,
                                   self.config.num_blocks,
-                                  dtype=self.config.cache_dtype)
+                                  dtype=self.config.cache_dtype,
+                                  prefix_cache=self.config.prefix_cache)
         self._sched = Scheduler(self.cache, self.config.max_slots,
-                                self.config.queue_depth)
+                                self.config.queue_depth,
+                                preempt=self.config.preempt)
         M = self.config.max_slots
         self._tokens = np.zeros((M,), np.int32)
         self._seq_lens = np.zeros((M,), np.int32)
@@ -117,13 +156,13 @@ class ServingEngine:
         self._done = np.ones((M,), bool)          # empty slots are inactive
         self._eos = np.full((M,), -1, np.int32)
         self._stats = {"decode_traces": 0, "prefill_traces": 0,
-                       "chunks": 0, "steps": 0}
+                       "chunk_prefill_traces": 0, "chunks": 0, "steps": 0}
         self._prefill_buckets: set = set()
         # widest token buffer one dispatch can emit per slot (a budget
         # never exceeds max_model_len KV entries, so neither can steps)
         self._out_width = int(self.config.max_model_len)
         self._jax = jax
-        self._jprefill, self._jdecode = self._build(jax)
+        self._jprefill, self._jchunk, self._jdecode = self._build(jax)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -139,6 +178,11 @@ class ServingEngine:
             stats["prefill_traces"] += 1           # trace-time only
             return G.paged_prefill(params, cfg, ids, prompt_lens,
                                    block_tables, pool, active)
+
+        def chunk_fn(params, ids, start, chunk_len, block_tables, pool):
+            stats["chunk_prefill_traces"] += 1     # trace-time only
+            return G.paged_prefill_chunk(params, cfg, ids, start, chunk_len,
+                                         block_tables, pool)
 
         def decode_fn(params, pool, tokens, seq_lens, steps_left, done,
                       block_tables, eos_ids, limit):
@@ -177,8 +221,9 @@ class ServingEngine:
 
         donate = donation_supported()
         jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
+        jchk = jax.jit(chunk_fn, donate_argnums=(5,) if donate else ())
         jdec = jax.jit(decode_fn, donate_argnums=(1,) if donate else ())
-        return jpre, jdec
+        return jpre, jchk, jdec
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -205,6 +250,44 @@ class ServingEngine:
             raise ValueError("max_new_tokens must be >= 1")
         return self._sched.submit(req)
 
+    def _chain_ids(self, req: Request, start: int, stop: int) -> np.ndarray:
+        """Token ids backing the KV entries ``[start, stop)`` a running
+        request has written (entry p < prompt_len holds prompt[p]'s KV,
+        entry p >= prompt_len holds tokens[p - prompt_len]'s) — the
+        prefix-cache registration chain. Sliced, not the whole history:
+        rebuilding prompt+tokens per filled block would cost O(seq_len^2)
+        per request in the continuous-batching hot loop."""
+        pl = len(req.prompt)
+        if stop <= pl:
+            return req.prompt[start:stop]
+        gen = np.asarray(req.tokens[max(0, start - pl):stop - pl], np.int32)
+        if start >= pl:
+            return gen
+        return np.concatenate([req.prompt[start:], gen])
+
+    def _start_decode(self, req: Request) -> None:
+        """Move a request whose prefill just completed into the decode slot
+        arrays. Fresh requests enter with their first sampled token already
+        in ``tokens``; readmitted ones resume from their last token."""
+        m = req.slot
+        self._tokens[m] = req.tokens[-1]
+        self._seq_lens[m] = req.prompt_len + len(req.tokens) - 1
+        self._steps_left[m] = req.max_new_tokens - len(req.tokens)
+        self._done[m] = False
+        self._eos[m] = -1 if req.eos_token_id is None else req.eos_token_id
+
+    def _emit_first(self, req: Request, tok0: int, now: float,
+                    emitted: Dict[int, List[int]]) -> None:
+        req.first_token_t = now
+        req.tokens.append(tok0)
+        emitted.setdefault(req.rid, []).append(tok0)
+        if req.eos_token_id is not None and tok0 == req.eos_token_id:
+            req.eos_seen = True
+        if req.finished:
+            self._sched.finish(req)
+        else:
+            self._start_decode(req)
+
     def _admit(self, emitted: Dict[int, List[int]]) -> None:
         import jax.numpy as jnp
         admitted: List[Request] = []
@@ -212,15 +295,18 @@ class ServingEngine:
             admitted.append(req)
         if not admitted:
             return
-        # one prefill dispatch per BUCKET, batch dim padded to the
-        # power-of-2 bucket of the GROUP size (<= max_slots): executables
-        # stay bounded by len_buckets * batch_buckets, a burst of
-        # admissions costs O(buckets) dispatches, and the common
-        # steady-state wave (ONE request refilling a retired slot) pays
-        # one row of prefill flops instead of max_slots rows
+        # split the wave: COLD short prompts take the batched bucketed
+        # prefill (one dispatch per power-of-2 length bucket, batch dim
+        # padded to the wave-size bucket); prefix-cache hits (prefill
+        # starts at an offset), long prompts (chunked), and readmissions
+        # (recompute) go through the offset chunk path, one row at a time
+        chunk = self.config.prefill_chunk
+        fast = [r for r in admitted
+                if r.num_computed == 0 and not r.tokens
+                and (chunk is None or r.prompt_len <= chunk)]
         M = self.config.max_slots
         by_bucket: Dict[int, List[Request]] = {}
-        for req in admitted:
+        for req in fast:
             by_bucket.setdefault(self._bucket(req.prompt_len), []).append(req)
         for Sb, group in sorted(by_bucket.items()):
             self._prefill_buckets.add(Sb)
@@ -243,59 +329,168 @@ class ServingEngine:
             first = np.argmax(np.asarray(logits), axis=-1)
             now = time.time()
             for r, req in enumerate(group):
-                tok0 = int(first[r])
-                req.first_token_t = now
-                req.tokens.append(tok0)
-                emitted.setdefault(req.rid, []).append(tok0)
-                if req.eos_token_id is not None and \
-                        tok0 == req.eos_token_id:
-                    req.eos_seen = True
-                if req.finished:
-                    self._sched.finish(req)
-                    continue
-                m = req.slot
-                self._tokens[m] = tok0
-                self._seq_lens[m] = req.prompt_len
-                self._steps_left[m] = req.max_new_tokens - 1
-                self._done[m] = False
-                self._eos[m] = -1 if req.eos_token_id is None \
-                    else req.eos_token_id
+                req.num_computed = req.prompt_len
+                req.reg_state = self.cache.register_prefix(
+                    req.prompt, req.blocks, req.prompt_len, req.reg_state)
+                self._emit_first(req, int(first[r]), now, emitted)
+        # chunked/offset admissions advance via _advance_prefills
 
-    def _limit(self, live, max_iters: Optional[int]) -> int:
-        """Iterations for the next decode dispatch. Queue waiting: run to
-        the FIRST budget retirement (admit with zero idle iterations).
-        Queue empty: drain the whole tail in one dispatch (the in-graph
-        alive-mask exit handles rows finishing early). ``decode_chunk``
-        caps the bound only when a live row can retire EARLIER than its
-        budget (EOS enabled) so admission latency stays bounded, or when
-        the caller asked for streaming granularity via ``max_iters``."""
-        sl = [int(self._steps_left[r.slot]) for r in live]
-        n = min(sl) if self._sched.queue else max(sl)
-        if max_iters is None and \
-                any(r.eos_token_id is not None for r in live):
-            max_iters = self.config.decode_chunk
+    def _advance_prefills(self, emitted: Dict[int, List[int]]) -> None:
+        """One prefill chunk per mid-prefill slot (offset path, B=1):
+        long admissions make progress WITHOUT freezing the decode slots —
+        the decode dispatch between chunks is what kills head-of-line
+        pressure. Completing requests emit their first token (fresh) or
+        resume from their kept tokens (post-preemption recompute)."""
+        import jax.numpy as jnp
+        chunk = self.config.prefill_chunk
+        for req in [r for r in self._sched.live if r.prefilling]:
+            total = len(req.prefill_ids)
+            n = total - req.num_computed
+            if chunk is not None:
+                n = min(n, chunk)
+            Sb = self._bucket(n)
+            ids = np.zeros((1, Sb), np.int32)
+            ids[0, :n] = req.prefill_ids[req.num_computed:
+                                         req.num_computed + n]
+            logits, self.cache.pool, _ = self._jchunk(
+                self._params, jnp.asarray(ids),
+                jnp.asarray(req.num_computed, jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                jnp.asarray(self.cache.tables[req.slot][None]),
+                self.cache.pool)
+            req.num_computed += n
+            req.reg_state = self.cache.register_prefix(
+                req.prefill_ids, req.blocks, req.num_computed,
+                req.reg_state)
+            if req.prefilling:
+                continue                          # more chunks to go
+            if req.tokens:                        # readmission: resume
+                self._start_decode(req)
+            else:
+                tok0 = int(np.argmax(np.asarray(logits)[0]))
+                self._emit_first(req, tok0, time.time(), emitted)
+
+    # ---- decode dispatch sizing -------------------------------------------
+
+    def _limit(self, decoding, max_iters: Optional[int]) -> int:
+        """Iterations for the next decode dispatch. Queue waiting or a
+        prompt mid-chunked-prefill: run to the FIRST budget retirement
+        (admit with zero idle iterations) and cap at ``decode_chunk`` so
+        prefill chunks interleave. Queue empty: drain the whole tail in
+        one dispatch (the in-graph alive-mask exit handles rows finishing
+        early). ``decode_chunk`` also caps when a live row can retire
+        EARLIER than its budget (EOS enabled) so admission latency stays
+        bounded, or when the caller asked for streaming granularity via
+        ``max_iters``."""
+        sl = [int(self._steps_left[r.slot]) for r in decoding]
+        prefilling = any(r.prefilling for r in self._sched.live)
+        waiting = bool(self._sched.queue) or prefilling
+        n = min(sl) if waiting else max(sl)
+        if prefilling or (max_iters is None and
+                          any(r.eos_token_id is not None
+                              for r in decoding)):
+            max_iters = min(max_iters or self.config.decode_chunk,
+                            self.config.decode_chunk)
         if max_iters is not None:
             n = min(n, int(max_iters))
         return max(1, min(n, self._out_width))
 
+    def _ensure_blocks(self, want: int) -> int:
+        """Make the pool cover ``want`` decode iterations for every
+        decoding slot — each needs blocks for ``seq_len + min(want,
+        steps_left)`` KV entries. Returns the feasible iteration count
+        (shrunk to what the pool can back), PREEMPTING the newest-admitted
+        live request (never the oldest — that's the no-livelock proof)
+        whenever even one iteration doesn't fit. If the sole survivor
+        still can't get a block the pool is truly exhausted relative to
+        its budget: it is retired early with ``oom_truncated`` set rather
+        than hung."""
+        bf = self.cache.manager.blocks_for
+
+        while True:
+            decoding = self._sched.decoding
+            if not decoding:
+                return 0
+
+            def need(k: int) -> int:
+                tot = 0
+                for r in decoding:
+                    e = int(self._seq_lens[r.slot]) + \
+                        min(k, int(self._steps_left[r.slot]))
+                    tot += max(0, bf(e) - len(r.blocks))
+                return tot
+
+            avail = self.cache.free_blocks
+            if need(1) <= avail:
+                lo, hi = 1, max(1, want)
+                while lo < hi:                    # largest feasible k
+                    mid = (lo + hi + 1) // 2
+                    if need(mid) <= avail:
+                        lo = mid
+                    else:
+                        hi = mid - 1
+                for r in decoding:
+                    e = int(self._seq_lens[r.slot]) + \
+                        min(lo, int(self._steps_left[r.slot]))
+                    if self.cache.extend(r.slot, r.blocks, e) is None:
+                        break                     # raced an estimate; retry
+                else:
+                    return lo
+                continue
+            victim = self._sched.preempt_victim()
+            if victim is not None:
+                self._preempt(victim)
+                continue
+            # sole oldest request and the pool STILL can't cover one more
+            # block: its budget exceeds the whole pool. Truncate — retire
+            # with the tokens it has — instead of hanging the drain loop.
+            r = decoding[0]
+            r.oom_truncated = True
+            self._sched.oom_truncated += 1
+            self._done[r.slot] = True
+            return 0
+
+    def _preempt(self, req: Request) -> None:
+        m = req.slot
+        self._sched.preempt(req)
+        self._tokens[m] = 0
+        self._seq_lens[m] = 0
+        self._steps_left[m] = 0
+        self._done[m] = True
+        self._eos[m] = -1
+
+    # ---- the scheduler iteration ------------------------------------------
+
     def step(self, max_iters: Optional[int] = None) -> Dict[int, List[int]]:
-        """One scheduler iteration: retire -> admit (+ prefill) -> one
-        decode dispatch of up to ``_limit()`` iterations (``max_iters``
-        caps it). Returns ``{rid: [tokens emitted]}``."""
+        """One scheduler iteration: retire -> admit (+ prefill) -> advance
+        chunked prefills -> extend/preempt for blocks -> one decode
+        dispatch of up to ``_limit()`` iterations (``max_iters`` caps it).
+        Returns ``{rid: [tokens emitted]}``."""
         import jax.numpy as jnp
         emitted: Dict[int, List[int]] = {}
         self._sched.retire_finished()
         self._admit(emitted)
-        live = self._sched.live
-        if live:
+        self._advance_prefills(emitted)
+        k = 0
+        decoding = self._sched.decoding
+        if decoding:
+            want = self._limit(decoding, max_iters)
+            k = self._ensure_blocks(want)
+            decoding = self._sched.decoding       # preemption may shrink it
+            if decoding and k >= 1:
+                # an in-call preemption re-queued its victim, flipping the
+                # sizing policy from drain-the-tail to first-retirement;
+                # re-derive the cap so the victim isn't stalled for the
+                # survivors' whole remaining budget (no-op otherwise)
+                k = min(k, self._limit(decoding, max_iters))
+        if decoding and k >= 1:
             before = self._steps_left.copy()
             (self.cache.pool, tokens, seq_lens, steps_left, done,
              toks) = self._jdecode(
                 self._params, self.cache.pool, jnp.asarray(self._tokens),
                 jnp.asarray(self._seq_lens), jnp.asarray(self._steps_left),
                 jnp.asarray(self._done), jnp.asarray(self.cache.tables),
-                jnp.asarray(self._eos),
-                jnp.asarray(self._limit(live, max_iters), jnp.int32))
+                jnp.asarray(self._eos), jnp.asarray(k, jnp.int32))
             toks = np.asarray(toks)
             # np.array (copy): zero-copy views of jax outputs are read-only,
             # and admission writes these slots in place next step
@@ -303,7 +498,7 @@ class ServingEngine:
             self._seq_lens = np.array(seq_lens)
             self._steps_left = np.array(steps_left)
             self._done = np.array(done)
-            for req in live:
+            for req in decoding:
                 m = req.slot
                 n = int(before[m] - self._steps_left[m])
                 if n <= 0:
@@ -313,21 +508,51 @@ class ServingEngine:
                 if bool(self._done[m]):
                     req.eos_seen = True
                 emitted.setdefault(req.rid, []).extend(got)
+                # blocks the dispatch just completed become shareable;
+                # skip the chain-ids build unless a block actually filled
+                # (reg_state makes registration itself incremental)
+                sl = int(self._seq_lens[m])
+                base = req.reg_state[0] * self.config.block_size
+                if self.config.prefix_cache and \
+                        sl // self.config.block_size > req.reg_state[0]:
+                    req.reg_state = self.cache.register_prefix(
+                        self._chain_ids(req, base, sl), req.blocks, sl,
+                        req.reg_state, base=base)
             self._stats["chunks"] += 1
             self._sched.retire_finished()
         self._stats["steps"] += 1
         return emitted
 
-    def stream(self) -> Iterator[Tuple[int, int]]:
+    def stream(self, finish_events: bool = False
+               ) -> Iterator[Tuple[int, Any]]:
         """Drain the engine, yielding ``(rid, token)`` events in emission
         order (within a step, by request id). Dispatches are capped at
-        ``decode_chunk`` iterations so events surface with bounded
-        latency instead of arriving in one tail-drain burst."""
+        ``decode_chunk`` iterations so events surface with bounded latency
+        instead of arriving in one tail-drain burst. With
+        ``finish_events=True``, each request's retirement additionally
+        yields ``(rid, dict)`` carrying its serving record —
+        ``prefix_hit_tokens`` / ``preemptions`` / ``recomputed_tokens`` /
+        ``tokens`` / ``ttft_s`` — so a streaming caller observes the
+        paging machinery per request, not just in aggregate stats()."""
         while self.pending:
+            seen = set(self._sched.finished) if finish_events else None
             for rid, toks in sorted(
                     self.step(self.config.decode_chunk).items()):
                 for t in toks:
                     yield rid, int(t)
+            if finish_events:
+                for rid in sorted(r for r in self._sched.finished
+                                  if r not in seen):
+                    req = self._sched.finished[rid]
+                    yield rid, {
+                        "finished": True,
+                        "tokens": len(req.tokens),
+                        "prefix_hit_tokens": req.prefix_hit_tokens,
+                        "preemptions": req.preemptions,
+                        "recomputed_tokens": req.recomputed_tokens,
+                        "oom_truncated": req.oom_truncated,
+                        "ttft_s": req.ttft_s,
+                    }
 
     def run(self, prompts: Sequence, max_new_tokens=None,
             eos_token_id="unset") -> List[np.ndarray]:
@@ -353,7 +578,8 @@ class ServingEngine:
         return self._sched.pending
 
     def request(self, rid: int) -> Request:
-        """The finished request record (tokens + latency timestamps)."""
+        """The finished request record (tokens + latency timestamps +
+        prefix-hit/preemption counters)."""
         return self._sched.finished[rid]
 
     def stats(self) -> Dict[str, Any]:
@@ -365,4 +591,10 @@ class ServingEngine:
                 "live_slots": len(self._sched.live),
                 "max_slots": self.config.max_slots,
                 "free_blocks": self.cache.free_blocks,
+                "prefix_hit_tokens": self._sched.prefix_hit_tokens,
+                "preemptions": self._sched.preemptions,
+                "recomputed_tokens": self._sched.recomputed_tokens,
+                "oom_truncated": self._sched.oom_truncated,
+                "cached_blocks": self.cache.manager.cached_blocks,
+                "evictions": self.cache.manager.evictions,
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}

@@ -5,33 +5,83 @@ holds ONE physical block pool ``{"k","v": [L, num_blocks, block_size, Hk,
 D]}`` (:func:`paddle_tpu.models.generation.init_paged_pool`); a sequence
 owns an ordered list of physical blocks recorded in its slot's row of the
 block-table matrix, and the compiled decode step gathers exactly those
-blocks. This module is the HOST half: a free-list block manager plus the
-``[max_slots, W]`` block-table matrix the engine ships with every dispatch.
-No jax import here — device math lives in ``models/generation.py``.
+blocks. This module is the HOST half: a ref-counted block manager with a
+content-hash prefix cache plus the ``[max_slots, W]`` block-table matrix
+the engine ships with every dispatch. No jax import here — device math
+lives in ``models/generation.py``.
 
-Allocation policy: blocks for a request's full worst-case KV footprint
-(``prompt + max_new_tokens - 1`` entries) are reserved at admission, so a
-running sequence can never hit a mid-flight out-of-blocks condition and the
-engine needs no preemption/swap machinery (documented trade: admission is
-conservative; docs/SERVING.md). Physical block 0 is the NULL block — the
+Allocation policy (ISSUE 5): **on-demand** — a sequence holds only the
+blocks covering KV entries it has actually filled (admission maps/allocates
+the prompt; decode extends block by block as ``seq_len`` grows). When the
+pool runs dry mid-decode the ENGINE preempts the newest-admitted running
+sequence (``scheduler.Scheduler.preempt``) instead of refusing progress.
+The legacy reservation-at-admission policy (``prompt + max_new - 1``
+entries reserved up front, no preemption needed) survives behind
+``preempt=False`` / ``FLAGS_serving_preempt=0`` as a conservative
+fallback, tested end-to-end. Physical block 0 is the NULL block — the
 masked-lane scatter target — and is never allocated.
+
+Prefix cache: every FULL block's token ids are content-hashed into a
+CHAINED key (the key covers the whole block-aligned prefix, not just the
+block — two different prefixes sharing one identical middle block must not
+collide), so admissions sharing a system-prompt/few-shot prefix map the
+cached blocks by refcount instead of re-running prefill over them. Blocks
+whose refcount drops to 0 stay cached on an LRU list and are evicted only
+when the free list runs dry.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["BlockManager", "PagedKVCache"]
+__all__ = ["BlockManager", "PagedKVCache", "prefix_block_chain"]
+
+
+def prefix_block_chain(ids: Sequence[int], block_size: int, upto: int,
+                       start: int = 0, prev_key: Optional[int] = None,
+                       base: int = 0):
+    """Yield ``(key, tokens)`` for the FULL blocks ``start .. upto //
+    block_size`` of a sequence — the ONE definition of the chained content
+    key (lookup, registration and incremental resumption all walk this,
+    so the formula cannot drift between them).
+
+    Key ``i`` hashes (key ``i-1``, the ``block_size`` token ids of block
+    ``i``), so equal keys imply equal whole block-aligned prefixes — a
+    shared middle block under two different prefixes gets two different
+    keys. Keys are still 64-bit hashes, so a hit is VERIFIED against the
+    stored block tokens before mapping (:meth:`BlockManager.lookup`);
+    ``tokens`` is yielded so registration can store them at zero extra
+    cost. ``ids`` is indexed relative to ``base`` (``ids[i * block_size -
+    base]`` is block ``i``'s first token), letting callers pass only the
+    not-yet-registered tail instead of rebuilding the whole chain.
+    """
+    h = prev_key
+    for i in range(start, int(upto) // block_size):
+        lo = i * block_size - base
+        toks = tuple(int(t) for t in ids[lo:lo + block_size])
+        h = hash((h, toks))
+        yield h, toks
 
 
 class BlockManager:
-    """Free-list allocator over the physical block ids ``1..num_blocks-1``
-    (block 0 = null). Double-free and foreign-id frees raise — a serving
-    engine that corrupts its free list serves one sequence's KV to
-    another, which must fail loudly."""
+    """Ref-counted allocator over the physical block ids ``1..num_blocks-1``
+    (block 0 = null) with a content-hash prefix cache.
+
+    Lifecycle of a block: free list -> ``alloc`` (refcount 1) -> optionally
+    ``register``\\ ed under its chained content key once its ``block_size``
+    KV entries are written -> shared by later sequences via ``lookup`` +
+    ``share`` (refcount++) -> ``free`` (refcount--) -> at refcount 0 a
+    registered block parks on the EVICTABLE LRU list (still a cache hit!)
+    while an unregistered one returns to the free list. ``alloc`` takes
+    from the free list first and evicts LRU refcount-0 cached blocks only
+    when that runs dry. Double-free and foreign-id frees raise — a serving
+    engine that corrupts its accounting serves one sequence's KV to
+    another, which must fail loudly.
+    """
 
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
@@ -42,33 +92,108 @@ class BlockManager:
         # LIFO free list: hot blocks are reused first (their pool pages are
         # the most likely still resident in any cache hierarchy)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated: set = set()
+        self._ref: Dict[int, int] = {}           # block -> live refcount
+        self._hash2block: Dict[int, int] = {}    # chained key -> block
+        self._block2hash: Dict[int, int] = {}
+        # block -> its block_size token ids: lookup() verifies a hit
+        # against these, so a 64-bit key collision degrades to a cache
+        # MISS instead of silently mapping another sequence's KV
+        self._block_tokens: Dict[int, Tuple[int, ...]] = {}
+        # refcount-0 registered blocks, insertion order = LRU release order
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Blocks allocatable RIGHT NOW: the free list plus the refcount-0
+        cached blocks eviction can reclaim."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._hash2block)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._ref)
 
     def blocks_for(self, kv_tokens: int) -> int:
         """Physical blocks needed to hold ``kv_tokens`` KV entries."""
         return max(1, math.ceil(kv_tokens / self.block_size))
 
     def can_alloc(self, n: int) -> bool:
-        return len(self._free) >= n
+        return n <= self.free_blocks
 
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        if n > self.free_blocks:
             raise RuntimeError(f"out of KV blocks: want {n}, "
-                               f"free {len(self._free)}")
-        blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+                               f"free {self.free_blocks}")
+        blocks = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:                                # LRU-evict a cached block
+                b, _ = self._evictable.popitem(last=False)
+                del self._hash2block[self._block2hash.pop(b)]
+                self._block_tokens.pop(b, None)
+                self.evictions += 1
+            self._ref[b] = 1
+            blocks.append(b)
         return blocks
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
-            if b not in self._allocated:
+            if self._ref.get(b, 0) <= 0:
                 raise RuntimeError(f"double/foreign free of block {b}")
-            self._allocated.discard(b)
-            self._free.append(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if b in self._block2hash:        # stays cached, evictable
+                    self._evictable[b] = None
+                else:
+                    self._free.append(b)
+
+    # ---- prefix cache ------------------------------------------------------
+
+    def lookup(self, key: int,
+               tokens: Optional[Tuple[int, ...]] = None) -> Optional[int]:
+        """The cached block for a chained content key, or None. With
+        ``tokens`` (the candidate block's ids) the hit is VERIFIED — an
+        O(block_size) compare per block, so a hash collision can only
+        cost a miss, never map another sequence's KV."""
+        b = self._hash2block.get(key)
+        if b is not None and tokens is not None \
+                and self._block_tokens.get(b) != tokens:
+            return None                          # unverifiable == miss
+        return b
+
+    def share(self, block: int) -> int:
+        """Take a reference on a cached block (a prefix-cache hit mapping
+        it into another sequence's table)."""
+        if block in self._evictable:             # revive from the LRU list
+            del self._evictable[block]
+            self._ref[block] = 1
+        elif self._ref.get(block, 0) > 0:
+            self._ref[block] += 1
+        else:
+            raise RuntimeError(f"share of unknown block {block}")
+        return block
+
+    def register(self, key: int, block: int,
+                 tokens: Optional[Tuple[int, ...]] = None) -> None:
+        """Content-hash a LIVE full block for prefix sharing. First writer
+        wins: an already-registered key (another sequence beat us to the
+        same prefix) or block is left alone. ``tokens`` (the block's ids)
+        back :meth:`lookup`'s hit verification; without them a verified
+        lookup of this key reports a miss."""
+        if key in self._hash2block or block in self._block2hash:
+            return
+        if self._ref.get(block, 0) <= 0:
+            raise RuntimeError(f"register of non-live block {block}")
+        self._hash2block[key] = block
+        self._block2hash[block] = key
+        if tokens is not None:
+            self._block_tokens[block] = tokens
 
 
 class PagedKVCache:
@@ -81,10 +206,12 @@ class PagedKVCache:
     """
 
     def __init__(self, model_config, max_slots: int, max_model_len: int,
-                 block_size: int, num_blocks: int = 0, dtype=None):
+                 block_size: int, num_blocks: int = 0, dtype=None,
+                 prefix_cache: bool = True):
         from ...models.generation import init_paged_pool
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
+        self.prefix_cache = bool(prefix_cache)
         self.blocks_per_seq = max(1, math.ceil(max_model_len / block_size))
         if num_blocks <= 0:
             # auto-size: every slot can hold a full-length sequence, +1 null
@@ -98,18 +225,95 @@ class PagedKVCache:
     def free_blocks(self) -> int:
         return self.manager.free_blocks
 
-    def reserve(self, kv_tokens: int) -> Optional[List[int]]:
-        """Reserve blocks for a sequence's full KV footprint; None when the
-        pool can't cover it right now (the request stays queued)."""
-        n = self.manager.blocks_for(kv_tokens)
-        if n > self.blocks_per_seq:
+    # ---- admission ---------------------------------------------------------
+
+    def admit(self, ids: np.ndarray,
+              reserve_kv: Optional[int] = None
+              ) -> Optional[Tuple[List[int], int, Tuple[int, Optional[int]]]]:
+        """Map + allocate blocks for a sequence entering prefill.
+
+        ``ids`` are the tokens prefill will compute (the prompt, or prompt
+        + already-generated tokens on post-preemption readmission). With
+        the prefix cache on, the longest chain of cached full blocks over
+        ``ids[:-1]`` is SHARED into the sequence (capped one token short of
+        the whole sequence so at least one token always runs through
+        prefill — the next-token logits have to come from somewhere); only
+        the remainder is allocated. ``reserve_kv`` switches to the legacy
+        worst-case reservation (allocate the full ``prompt + max_new - 1``
+        footprint now — the ``preempt=False`` mode). Returns ``(blocks,
+        hit_tokens, reg_state)`` — ``reg_state`` seeds
+        :meth:`register_prefix` at the hit boundary so later registration
+        never re-hashes the hit chain — or None when the pool can't cover
+        it right now (the request stays queued; admission never preempts
+        running work).
+        """
+        n_tokens = int(reserve_kv) if reserve_kv is not None else len(ids)
+        n_total = self.manager.blocks_for(n_tokens)
+        if n_total > self.blocks_per_seq:
             raise ValueError(
-                f"sequence needs {n} blocks ({kv_tokens} KV entries) but "
-                f"max_model_len {self.max_model_len} caps block tables at "
-                f"{self.blocks_per_seq}")
+                f"sequence needs {n_total} blocks ({n_tokens} KV entries) "
+                f"but max_model_len {self.max_model_len} caps block tables "
+                f"at {self.blocks_per_seq}")
+        hits: List[int] = []
+        last_key: Optional[int] = None
+        if self.prefix_cache:
+            for key, toks in prefix_block_chain(ids, self.block_size,
+                                                len(ids) - 1):
+                b = self.manager.lookup(key, toks)
+                if b is None:
+                    break
+                hits.append(b)
+                last_key = key
+        # pin the hit blocks FIRST — allocating the remainder may otherwise
+        # LRU-evict the very blocks we are about to map
+        for b in hits:
+            self.manager.share(b)
+        n_new = n_total - len(hits)
+        if not self.manager.can_alloc(n_new):
+            if hits:
+                self.manager.free(hits)
+            return None
+        return (hits + self.manager.alloc(n_new),
+                len(hits) * self.block_size, (len(hits), last_key))
+
+    def extend(self, slot: int, blocks: List[int],
+               kv_tokens: int) -> Optional[List[int]]:
+        """Grow a slot's block list (in place) to cover ``kv_tokens`` KV
+        entries — the on-demand decode path. Returns the newly allocated
+        blocks ([] when already covered), or None when the pool is dry
+        (the engine then preempts)."""
+        n = self.manager.blocks_for(kv_tokens) - len(blocks)
+        if n <= 0:
+            return []
         if not self.manager.can_alloc(n):
             return None
-        return self.manager.alloc(n)
+        new = self.manager.alloc(n)
+        self.tables[slot, len(blocks):len(blocks) + n] = new
+        blocks.extend(new)
+        return new
+
+    def register_prefix(self, ids, blocks: List[int], upto: int,
+                        state: Tuple[int, Optional[int]] = (0, None),
+                        base: int = 0) -> Tuple[int, Optional[int]]:
+        """Register the full blocks covering KV entries ``[..upto)`` (those
+        the device has finished writing) in the prefix cache,
+        INCREMENTALLY: ``state`` is ``(blocks already registered, chained
+        key of the last one)`` from the previous call (or ``admit``'s hit
+        boundary), so each block's tokens are hashed exactly once over a
+        sequence's lifetime — a per-dispatch full-chain re-hash would make
+        the continuous-batching host loop O(seq_len^2) per request. For
+        the same reason ``ids`` may be just the not-yet-registered TAIL
+        with ``base`` naming its first KV position (``ids[p - base]``
+        backs entry ``p``). Returns the advanced state; the caller keeps
+        it on the request."""
+        if not self.prefix_cache:
+            return state
+        n, h = state
+        for key, toks in prefix_block_chain(ids, self.block_size, upto,
+                                            start=n, prev_key=h, base=base):
+            self.manager.register(key, blocks[n], toks)
+            n, h = n + 1, key
+        return (n, h)
 
     def assign(self, slot: int, blocks: List[int]) -> None:
         self.tables[slot] = 0

@@ -4,16 +4,22 @@ Orca-style scheduling recast as pure host logic: a FIFO admission queue
 feeding a fixed table of ``max_slots`` decode slots. Every engine step (1)
 RETIRES slots whose request finished (EOS sampled or token budget spent),
 returning their KV blocks to the pool, (2) ADMITS queued requests into free
-slots while the block pool can reserve their worst-case footprint, and (3)
-hands the engine the set of live slots for one fixed-shape decode dispatch.
-The scheduler never touches the device — the engine owns dispatch; this
-module owns WHO is running WHERE and the per-request records (tokens,
-timestamps) the bench's TTFT/latency percentiles come from.
+slots while the block pool covers their PROMPT (on-demand allocation —
+decode extends block by block as the sequence grows), and (3) hands the
+engine the live slots for prefill-chunk and decode dispatches. When the
+pool runs dry mid-decode the engine PREEMPTS the newest-admitted running
+sequence (:meth:`Scheduler.preempt`): its blocks return to the pool, its
+generated-so-far tokens are kept, and it re-queues at the FRONT for
+recompute-on-readmission. The OLDEST running sequence is never preempted,
+so at least one request always progresses — no livelock. The scheduler
+never touches the device — the engine owns dispatch; this module owns WHO
+is running WHERE and the per-request records (tokens, timestamps, prefix
+hits, preemptions) the bench's stats come from.
 
-FIFO is strict: a queue head too large for the currently-free blocks blocks
-later, smaller requests (head-of-line; no deadlock — running slots always
-retire and their blocks return, and submit() rejects requests larger than
-the whole pool up front).
+FIFO is strict for ADMISSION ORDER, but with reservation gone a large
+queue head no longer charges its worst case up front — it admits on its
+prompt footprint alone, and chunked prefill (engine-side) keeps a long
+prompt from freezing in-flight decode streams.
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -47,6 +53,26 @@ class Request:
     eos_seen: bool = False
     blocks: Optional[List[int]] = None
     slot: Optional[int] = None
+    # prefill progress: KV entries mapped-or-written so far (cache hits
+    # count — their KV already exists). prefilling == num_computed short of
+    # the full prefill set; the slot joins decode when they meet.
+    num_computed: int = 0
+    prefill_ids: Optional[np.ndarray] = None   # tokens prefill must cover
+    admit_seq: int = -1                # admission order (newest = preempt
+    #                                    victim; re-admission re-stamps)
+    # incremental prefix-registration cursor: (full blocks registered,
+    # chained key of the last one) — PagedKVCache.register_prefix state
+    reg_state: Tuple[int, Optional[int]] = (0, None)
+    # observability counters (engine stats() aggregates these)
+    prefix_hit_tokens: int = 0
+    preemptions: int = 0
+    recomputed_tokens: int = 0
+    computed_hwm: int = 0              # most KV entries ever written; caps
+    #                                    the recompute charge on readmission
+    #                                    (a mid-prefill preemption only
+    #                                    repeats what it had finished)
+    oom_truncated: bool = False        # pool exhausted with nothing left to
+    #                                    preempt: retired early, output kept
 
     @property
     def prompt_len(self) -> int:
@@ -64,7 +90,22 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.eos_seen or self.remaining <= 0
+        return self.eos_seen or self.remaining <= 0 or self.oom_truncated
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_ids is not None and \
+            self.num_computed < len(self.prefill_ids)
+
+    def build_prefill_ids(self) -> np.ndarray:
+        """The token ids prefill must compute KV for: the prompt, plus —
+        after a preemption — every generated token except the last (whose
+        KV the first decode step writes). Greedy determinism makes the
+        recomputed KV bit-identical to what was freed."""
+        if self.tokens:
+            return np.concatenate(
+                [self.prompt, np.asarray(self.tokens[:-1], np.int32)])
+        return self.prompt
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -85,12 +126,20 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission queue + slot table over a :class:`PagedKVCache`."""
+    """FIFO admission queue + slot table over a :class:`PagedKVCache`.
 
-    def __init__(self, cache, max_slots: int, queue_depth: int):
+    ``preempt=True`` (the default) is the on-demand mode: admission maps
+    prefix-cache hits and allocates only the prompt's remaining blocks;
+    ``preempt=False`` restores the legacy worst-case reservation (no
+    preemption machinery needed, conservative admission).
+    """
+
+    def __init__(self, cache, max_slots: int, queue_depth: int,
+                 preempt: bool = True):
         self.cache = cache
         self.max_slots = int(max_slots)
         self.queue_depth = int(queue_depth)
+        self.preempt_enabled = bool(preempt)
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * max_slots
         # finished-record retention is BOUNDED (a long-lived engine must
@@ -101,8 +150,13 @@ class Scheduler:
         self.finished: Dict[int, Request] = {}
         self.keep_finished = self.queue_depth + self.max_slots
         self._next_rid = 0
+        self._admit_seq = 0
         self.admitted = 0
         self.retired = 0
+        self.preemptions = 0
+        self.prefix_hit_tokens = 0
+        self.recomputed_tokens = 0
+        self.oom_truncated = 0
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -119,11 +173,20 @@ class Scheduler:
                 f"request needs {req.kv_tokens} KV entries "
                 f"(prompt {req.prompt_len} + {req.max_new_tokens} new) > "
                 f"max_model_len {self.cache.max_model_len}")
-        n = self.cache.manager.blocks_for(req.kv_tokens)
         usable = self.cache.manager.num_blocks - 1      # block 0 is null
+        if self.preempt_enabled:
+            # on-demand: only the PROMPT must fit the pool (a max_new worst
+            # case is a budget, not a charge — EOS usually lands first, and
+            # a genuinely over-budget sole survivor is truncated, not hung)
+            n = self.cache.manager.blocks_for(req.prompt_len)
+            what = f"prompt ({req.prompt_len} tokens)"
+        else:
+            # reservation mode admits only full worst-case footprints
+            n = self.cache.manager.blocks_for(req.kv_tokens)
+            what = f"worst case ({req.kv_tokens} KV entries)"
         if n > usable:
             raise ValueError(
-                f"request needs {n} KV blocks but the pool only has "
+                f"request {what} needs {n} KV blocks but the pool only has "
                 f"{usable} usable blocks (num_blocks="
                 f"{self.cache.manager.num_blocks} incl. the null block); "
                 f"admitting it would wait forever")
@@ -135,23 +198,69 @@ class Scheduler:
 
     def next_admission(self) -> Optional[Request]:
         """Pop the queue head into a free slot if its blocks fit; None when
-        nothing can be admitted this iteration."""
+        nothing can be admitted this iteration. On-demand mode maps
+        prefix-cache hits and allocates only the remaining prompt blocks;
+        reservation mode allocates the full worst case. Admission never
+        preempts running work — it waits for retirement to free blocks."""
         if not self.queue:
             return None
         free = [m for m, r in enumerate(self.slots) if r is None]
         if not free:
             return None
         req = self.queue[0]
-        blocks = self.cache.reserve(req.kv_tokens)
-        if blocks is None:
-            return None                       # head-of-line waits for blocks
+        ids = req.build_prefill_ids()
+        res = self.cache.admit(
+            ids, reserve_kv=None if self.preempt_enabled else req.kv_tokens)
+        if res is None:
+            return None                       # head waits for blocks
+        blocks, hit, reg_state = res
         self.queue.popleft()
         slot = free[0]
         req.blocks, req.slot = blocks, slot
+        req.prefill_ids = ids
+        req.num_computed = hit
+        req.reg_state = reg_state
+        req.prefix_hit_tokens += hit
+        self.prefix_hit_tokens += hit
+        if req.preemptions:
+            # KV this readmission re-runs prefill over: cache hits exempt,
+            # and never more than the request ever actually computed
+            rec = max(0, min(req.computed_hwm, len(ids)) - hit)
+            req.recomputed_tokens += rec
+            self.recomputed_tokens += rec
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
         self.cache.assign(slot, blocks)
         self.slots[slot] = req
         self.admitted += 1
         return req
+
+    def preempt(self, req: Request) -> None:
+        """Free a RUNNING request's blocks and re-queue it at the FRONT for
+        recompute-on-readmission (tokens kept — greedy recompute is
+        bit-identical). The engine calls this only when the pool is dry,
+        picking its newest-admitted victim via :meth:`preempt_victim`."""
+        done = (req.num_computed if req.prefilling
+                else req.prompt_len + max(len(req.tokens) - 1, 0))
+        req.computed_hwm = max(req.computed_hwm, done)
+        self.cache.release(req.slot, req.blocks)
+        self.slots[req.slot] = None
+        req.blocks, req.slot = None, None
+        req.num_computed = 0
+        req.prefill_ids = None
+        req.reg_state = (0, None)          # readmission re-seeds from hits
+        req.preemptions += 1
+        self.preemptions += 1
+        self.queue.appendleft(req)
+
+    def preempt_victim(self) -> Optional[Request]:
+        """The newest-admitted live request — UNLESS it is the only one
+        (the oldest is never preempted; its monotonic progress is the
+        livelock-freedom proof)."""
+        live = [r for r in self.slots if r is not None]
+        if len(live) < 2:
+            return None
+        return max(live, key=lambda r: r.admit_seq)
 
     def finish(self, req: Request) -> None:
         """Mark finished + free its KV back to the pool."""
@@ -179,6 +288,11 @@ class Scheduler:
     @property
     def live(self) -> List[Request]:
         return [r for r in self.slots if r is not None]
+
+    @property
+    def decoding(self) -> List[Request]:
+        """Live requests past prefill (the decode dispatch's active set)."""
+        return [r for r in self.slots if r is not None and not r.prefilling]
 
     @property
     def pending(self) -> bool:

@@ -60,7 +60,8 @@ from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
 
 __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
-           "init_paged_pool", "paged_prefill", "paged_decode_step"]
+           "init_paged_pool", "paged_prefill", "paged_prefill_chunk",
+           "paged_decode_step"]
 
 
 # ---------------------------------------------------------------------------
@@ -364,7 +365,19 @@ def generate(params: Dict, ids, cfg: LlamaConfig, *, max_new_tokens: int,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              eos_token_id: Optional[int] = None, pad_token_id: int = 0,
              key: Optional[jax.Array] = None):
-    """Convenience wrapper: jit-cached by (cfg, sampling knobs, shapes)."""
+    """Fixed-batch decode convenience wrapper: jit-cached by (cfg,
+    sampling knobs, shapes).
+
+    This is the DENSE-cache tier — every row holds a ``[B, max_seq]`` KV
+    cache for its whole lifetime and the batch retires together (with the
+    in-graph all-EOS early exit). Serving traffic with mixed lengths,
+    shared prefixes, or admission churn belongs on
+    ``inference.serving.ServingEngine`` / ``GenerationPredictor.serve``,
+    whose ``ServingConfig.prefix_cache`` / ``prefill_chunk`` / ``preempt``
+    knobs add paged on-demand KV, automatic prefix caching, and chunked
+    prefill while staying bit-identical to this path under greedy
+    decoding — this function doubles as that parity oracle in the tests
+    and ``bench --serve``."""
     ids = jnp.asarray(ids)
     B, S = ids.shape
     if prompt_lens is None:
@@ -538,6 +551,75 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
                                             pool["v"]))
     idx = jnp.maximum(prompt_lens - 1, 0)[:, None, None]
     last = jnp.take_along_axis(x, idx, axis=1)          # [B, 1, E]
+    return _lm_head(params, cfg, last), {"k": pk, "v": pv}, drops.sum()
+
+
+def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
+                        chunk_len, block_tables, pool: Dict):
+    """Prefill-from-offset: one sequence's token chunk against the pool.
+
+    The entry point behind CHUNKED PREFILL and PREFIX-CACHE HITS
+    (``inference.serving``): compute KV for positions ``[start, start +
+    chunk_len)`` of a single sequence whose earlier positions are already
+    in the pool — written by previous chunks, or mapped from the prefix
+    cache (the cache-hit block remap is pure host bookkeeping; this kernel
+    just attends through the block table it is handed).
+
+    ``ids [1, Sb]`` right-padded chunk tokens (``Sb`` the power-of-2
+    bucket); ``start``/``chunk_len`` DEVICE scalars — chunk position and
+    real length never retrace; ``block_tables [1, W]`` must cover ``start
+    + chunk_len`` KV entries. Queries RoPE at their absolute positions,
+    scatter their K/V into the pool, then attend the GATHERED pool
+    (``pool[block_tables]``) under the causal mask ``j <= start + i`` —
+    exactly the decode step's gather generalized to ``Sb`` queries, so
+    cached-prefix KV and freshly-scattered in-chunk KV are read through
+    one path. Masked lanes sit at -1e30 -> exact 0.0 in the fp32 softmax
+    (see ``_masked_sdpa``), so outputs are bit-identical to the dense
+    cache's regardless of the gather width. Returns (next-token logits
+    ``[1, V]`` read at position ``start + chunk_len - 1``, pool,
+    dropped_tokens).
+    """
+    B, Sb = ids.shape
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * bs
+    dt = cfg.dtype
+    j = jnp.arange(Sb)
+    pos = start + j[None, :]                             # [1, Sb] absolute
+    cos, sin = _row_tables(cfg, pos)
+    valid = j[None, :] < chunk_len                       # [1, Sb]
+    phys = jnp.where(valid,
+                     block_tables[:, jnp.minimum(pos[0] // bs, W - 1)], 0)
+    off = pos % bs
+    jg = jnp.arange(C)[None, None, :]                    # key positions
+    # every position <= the query's is written (previous chunks + cache
+    # hits + this chunk's causal prefix); later/pad lanes are masked
+    kv_mask = jg <= pos[:, :, None]                      # [1, Sb, C]
+
+    x = jnp.take(params["embed"], ids, axis=0).astype(dt)
+
+    def body(h, xs):
+        lp, pk, pv = xs
+        hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
+        q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
+        k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
+        v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
+        q = _rope(q, cos, sin, False)
+        k = _rope(k, cos, sin, False)
+        pk = pk.at[phys, off].set(k.astype(pk.dtype))
+        pv = pv.at[phys, off].set(v.astype(pv.dtype))
+        kk = pk[block_tables].reshape(B, C, Hk, D)
+        vv = pv[block_tables].reshape(B, C, Hk, D)
+        o = _masked_sdpa(q, kk, vv, kv_mask)
+        h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
+        h, drops = _ffn_tail(lp, h, cfg)
+        return h, (pk, pv, drops)
+
+    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
+                                            pool["v"]))
+    idx = jnp.full((B, 1, 1), jnp.maximum(chunk_len - 1, 0))
+    last = jnp.take_along_axis(x, idx, axis=1)           # [1, 1, E]
     return _lm_head(params, cfg, last), {"k": pk, "v": pv}, drops.sum()
 
 

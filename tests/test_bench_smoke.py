@@ -57,15 +57,19 @@ def test_bench_input_entry_point():
 
 
 def test_bench_serve_entry_point():
-    """The serving section (ISSUE 4): continuous batching over the paged KV
-    cache vs the static-batch baseline on one mixed-length trace. The
-    section itself asserts the acceptance proofs (paged greedy bit-equal to
-    the dense path, constant decode-executable count) before emitting, so a
-    green run here pins them in tier-1; the smoke additionally checks the
-    detail record and that both throughput rows landed."""
+    """The serving section (ISSUE 4 + 5): continuous batching over the
+    paged KV cache vs the static-batch baseline on one mixed-length trace,
+    plus the shared-prefix trace (prefix cache on vs off) and the
+    preemption-pressure trace (on-demand paging under a deliberately
+    undersized pool). The section itself asserts the acceptance proofs
+    (paged greedy bit-equal to the dense path, constant decode-executable
+    count, pressure-row parity) before emitting, so a green run here pins
+    them in tier-1; the smoke additionally checks the detail record and
+    that the throughput rows landed."""
     metrics, proc = _run_bench("--serve")
     assert "serving_agg_tok_s" in metrics, proc.stdout + proc.stderr
     assert "serving_throughput_speedup" in metrics
+    assert "serving_prefix_speedup" in metrics
     assert metrics["serving_agg_tok_s"]["value"] > 0
     detail = None
     for line in proc.stderr.splitlines():
@@ -81,6 +85,13 @@ def test_bench_serve_entry_point():
     assert detail["outputs_match"] is True
     assert detail["recompiles_constant"] is True
     assert detail["decode_traces"] == 1
+    # shared-prefix row: hits actually happened and parity held
+    assert detail["prefix_outputs_match"] is True
+    assert detail["prefix_hit_tokens"] > 0
+    # preemption-pressure row: the machinery fired and stayed bit-exact
+    assert detail["preempt_outputs_match"] is True
+    assert detail["preemptions"] >= 1
+    assert detail["oom_truncated"] == 0
 
 
 def test_bench_health_entry_point():
