@@ -268,6 +268,26 @@ define_flag("FLAGS_serving_preempt", True,
             "(prompt + max_new - 1 KV entries charged up front, "
             "conservative admission, no preemption).", bool)
 
+define_flag("FLAGS_serving_policy", "fifo",
+            "Default admission policy for ServingEngine (ServingConfig."
+            "policy): fifo (submission order — the parity baseline), "
+            "priority (Request.priority classes), fair (weighted fair "
+            "share across tenants), edf (earliest deadline first under "
+            "TTFT SLOs). Policies reorder ADMISSION only; per-request "
+            "greedy outputs are identical under every policy "
+            "(docs/SERVING.md Overload & multi-tenancy).", str)
+define_flag("FLAGS_serving_ttft_slo_s", 0.0,
+            "Default time-to-first-token SLO (seconds) the EDF policy "
+            "assumes for requests submitted without timeout_s/deadline_s "
+            "— ordering only, never sheds by itself. 0 = no default "
+            "(SLO-less requests sort last, FIFO among themselves).", float)
+define_flag("FLAGS_serving_tenant_cache_quota", 0,
+            "Max prefix-cache blocks one tenant may keep registered; at "
+            "the quota a tenant recycles its OWN least-recently-released "
+            "entry instead of LRU-evicting other tenants' (so one tenant "
+            "flooding unique prompts cannot evict everyone's system "
+            "prompt). 0 = unlimited.", int)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

@@ -2,15 +2,28 @@
 
 The high-traffic decode tier: a paged KV cache (block pool + per-slot block
 tables; ``models.generation`` holds the device math), an iteration-level
-scheduler (retire/admit every step, Orca-style), and the
-:class:`ServingEngine` API (`submit()/step()/stream()/run()`) that
+scheduler (retire/admit every step, Orca-style) with a pluggable admission
+policy (FIFO / priority / weighted fair share / EDF — ``policies``), an
+overload-safe request lifecycle (cancel / timeout / deadline / shed, every
+terminal state freeing its KV blocks), and the :class:`ServingEngine` API
+(`submit()/step()/stream()/run()/cancel()/health_snapshot()`) that
 ``inference.GenerationPredictor.serve`` rides. Benchmarked by
-``bench.py --serve`` against the static-batch ``generate()`` baseline.
+``bench.py --serve`` against the static-batch ``generate()`` baseline and
+driven through hostile-traffic faults by ``testing.chaos``'s serving
+injectors.
 """
 
 from .engine import ServingConfig, ServingEngine
 from .paged_cache import BlockManager, PagedKVCache
-from .scheduler import Request, Scheduler, ServingQueueFull
+from .policies import (AdmissionPolicy, EDFPolicy, FairSharePolicy,
+                       FIFOPolicy, POLICIES, PriorityPolicy, resolve_policy)
+from .scheduler import (CANCELLED, FINISHED, QUEUED, RUNNING, SHED,
+                        TERMINAL_STATES, TIMED_OUT, Request, Scheduler,
+                        ServingQueueFull)
 
 __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
-           "Scheduler", "Request", "ServingQueueFull"]
+           "Scheduler", "Request", "ServingQueueFull",
+           "AdmissionPolicy", "FIFOPolicy", "PriorityPolicy",
+           "FairSharePolicy", "EDFPolicy", "POLICIES", "resolve_policy",
+           "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "TIMED_OUT",
+           "SHED", "TERMINAL_STATES"]

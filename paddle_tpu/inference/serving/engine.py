@@ -38,6 +38,17 @@ Design (docs/SERVING.md):
   still take the BATCHED bucketed prefill: one dispatch per power-of-2
   length bucket with the batch dim padded to the power-of-2 bucket of the
   admission-wave size.
+* **Overload-safe lifecycle + policy scheduling.** Every request ends in
+  exactly one terminal state (``finished`` / ``cancelled`` /
+  ``timed_out`` / ``shed``): ``cancel(rid)`` and per-request
+  ``timeout_s``/``deadline_s`` free KV blocks mid-flight through the
+  preemption path (free, do-not-requeue), checked every ``step()``;
+  admission order is a pluggable ``AdmissionPolicy`` (FIFO default,
+  priority / weighted fair share per ``tenant`` / earliest-deadline-
+  first), the bounded queue SHEDS with a retry-after hint instead of
+  blocking, and ``health_snapshot()`` + the global hang watchdog
+  (``serving.step``/``serving.prefill``/``serving.decode`` sections)
+  expose the whole thing to ops endpoints.
 * **Greedy (v1).** The engine samples by argmax on device; temperature /
   top-k/top-p serving stays on the batch ``generate()`` tier. int8
   weight-only decode rides transparently via ``quantize="int8"``.
@@ -61,12 +72,45 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...flags import flag
+from ...health import watchdog as _watchdog
 from .paged_cache import PagedKVCache
-from .scheduler import Request, Scheduler, ServingQueueFull  # noqa: F401
+from .policies import resolve_policy
+from .scheduler import (CANCELLED, DEFAULT_TENANT, SHED,  # noqa: F401
+                        TIMED_OUT, Request, Scheduler, ServingQueueFull)
 
-__all__ = ["ServingConfig", "ServingEngine"]
+__all__ = ["ServingConfig", "ServingEngine", "HEALTH_SNAPSHOT_FIELDS"]
 
 _UNSET = "unset"
+
+# field -> meaning for ServingEngine.health_snapshot(); docs/OPS.md's
+# generated table (ops.gen_docs) renders this, and the snapshot test pins
+# the live payload's keys to it, so the doc cannot drift from the code
+HEALTH_SNAPSHOT_FIELDS = {
+    "ok": "False only when the installed hang watchdog has fired "
+          "(shedding is a healthy degraded mode, not unhealth)",
+    "accepting": "whether a submit() right now would QUEUE rather than "
+                 "shed (queue below its bound)",
+    "policy": "active admission policy name (fifo/priority/fair/edf)",
+    "queued": "requests waiting for a slot",
+    "queue_limit": "admission-queue bound; submits past it shed with "
+                   "ServingQueueFull",
+    "live_slots": "occupied decode slots",
+    "max_slots": "slot-table width (the compiled decode batch dim)",
+    "free_blocks": "KV blocks allocatable right now (free list + "
+                   "evictable refcount-0 cached blocks)",
+    "usable_blocks": "pool size excluding the reserved null block",
+    "retry_after_s": "suggested client backoff when shedding: the mean "
+                     "recent retirement interval (None before two "
+                     "retirements)",
+    "counters": "lifetime totals: admitted / retired / cancelled / "
+                "timed_out / shed / preemptions / oom_truncated / "
+                "prefix_hit_tokens / evictions",
+    "watchdog": "global hang-watchdog state: installed / fired / "
+                "timeout_s",
+    "tenants": "per-tenant breakdown: queued / live / submitted / "
+               "admitted / retired / cancelled / timed_out / shed / "
+               "service_tokens / cached_blocks / ttft_p50_s / ttft_p99_s",
+}
 
 
 @dataclasses.dataclass
@@ -93,6 +137,12 @@ class ServingConfig:
     prefix_cache: Any = _UNSET       # bool; None/False = off
     prefill_chunk: Any = _UNSET      # tokens/chunk; None/0 = whole prompt
     preempt: Any = _UNSET            # bool; None/False = legacy reservation
+    # overload / multi-tenancy (ISSUE 6)
+    policy: Any = None               # AdmissionPolicy | "fifo"/"priority"/
+    #                                  "fair"/"edf"; None -> FLAGS_serving_
+    #                                  policy (default fifo)
+    tenant_cache_quota: Any = _UNSET  # max prefix-cache blocks one tenant
+    #                                   may keep registered; None/0 = off
 
     def __post_init__(self):
         for f, name in (("block_size", "FLAGS_serving_block_size"),
@@ -117,6 +167,13 @@ class ServingConfig:
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1 or None/0 "
                              f"(got {self.prefill_chunk})")
+        if self.tenant_cache_quota == _UNSET:
+            self.tenant_cache_quota = int(
+                flag("FLAGS_serving_tenant_cache_quota"))
+        self.tenant_cache_quota = (int(self.tenant_cache_quota)
+                                   if self.tenant_cache_quota else None)
+        if self.policy is None:
+            self.policy = str(flag("FLAGS_serving_policy"))
         from ...models.llama import QUANTIZE_MODES
         if self.quantize not in QUANTIZE_MODES:
             raise ValueError(f"unknown quantize mode {self.quantize!r}; "
@@ -145,10 +202,15 @@ class ServingEngine:
                                   self.config.block_size,
                                   self.config.num_blocks,
                                   dtype=self.config.cache_dtype,
-                                  prefix_cache=self.config.prefix_cache)
+                                  prefix_cache=self.config.prefix_cache,
+                                  tenant_quota=self.config.tenant_cache_quota)
+        self._policy = resolve_policy(
+            self.config.policy,
+            ttft_slo_s=float(flag("FLAGS_serving_ttft_slo_s")))
         self._sched = Scheduler(self.cache, self.config.max_slots,
                                 self.config.queue_depth,
-                                preempt=self.config.preempt)
+                                preempt=self.config.preempt,
+                                policy=self._policy)
         M = self.config.max_slots
         self._tokens = np.zeros((M,), np.int32)
         self._seq_lens = np.zeros((M,), np.int32)
@@ -235,20 +297,125 @@ class ServingEngine:
     # ---- request lifecycle ------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = "unset") -> int:
+               eos_token_id: Optional[int] = "unset",
+               timeout_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None, priority: int = 0) -> int:
         """Queue one prompt; returns the request id. ``eos_token_id``
         defaults to the engine's GenerationConfig (pass ``None`` explicitly
-        to disable EOS for this request)."""
+        to disable EOS for this request).
+
+        Lifecycle/policy knobs (ISSUE 6): ``timeout_s`` (relative to now) /
+        ``deadline_s`` (absolute ``time.time()``) bound the request's wall
+        time — expiry while QUEUED sheds it (state ``shed``), expiry after
+        it started terminates it mid-flight (state ``timed_out``), both
+        freeing its KV blocks; the earlier of the two wins when both are
+        given. ``tenant`` scopes fair-share scheduling, per-tenant stats
+        and prefix-cache quotas; ``priority`` orders the priority policy
+        (higher first).
+
+        Raises :class:`ServingQueueFull` — carrying ``queue_depth`` /
+        ``live_slots`` / ``retry_after_s`` for the caller's backoff — when
+        the bounded admission queue is full: the submit is SHED, not
+        blocked."""
         g = self._gen
+        deadline = deadline_s
+        if timeout_s is not None:
+            t = time.time() + float(timeout_s)
+            deadline = t if deadline is None else min(deadline, t)
         req = Request(
             rid=-1, prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens if max_new_tokens is not None
                                else g.max_new_tokens),
             eos_token_id=(g.eos_token_id if eos_token_id == "unset"
-                          else eos_token_id))
+                          else eos_token_id),
+            tenant=str(tenant) if tenant is not None else DEFAULT_TENANT,
+            priority=int(priority),
+            deadline=float(deadline) if deadline is not None else None)
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if req.prompt_len < 1:
+            raise ValueError("prompt must contain at least one token")
         return self._sched.submit(req)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or running request: its remaining work is
+        dropped and every KV block it holds returns to the pool
+        immediately (the preemption free path — free, do NOT requeue).
+        Safe at any lifecycle point — queued, mid-chunked-prefill,
+        decoding, or preempted-and-requeued. Returns True when the
+        request was live and is now ``cancelled``; False when it already
+        reached a terminal state (or the rid is unknown) — cancellation
+        is idempotent, racing a retirement is not an error. The partial
+        output stays readable via :meth:`request`/``result``."""
+        req = self._sched.find(rid)
+        if req is None:
+            return False
+        if self._retire_if_finished(req):
+            return False             # its work completed first: not an error
+        self._terminate(req, CANCELLED)
+        return True
+
+    def cancel_all(self) -> int:
+        """Cancel every queued and running request (the abandoned-stream
+        path); returns how many were cancelled."""
+        n = 0
+        for req in list(self._sched.queue) + self._sched.live:
+            if self._retire_if_finished(req):
+                continue
+            self._terminate(req, CANCELLED)
+            n += 1
+        return n
+
+    def _retire_if_finished(self, req: Request) -> bool:
+        """A request can sit FINISHED in its slot until the next step's
+        retire sweep (e.g. oom-truncated with no decode dispatch after
+        it); a cancel or deadline racing that sweep must retire it as the
+        completed work it is, never reclassify it. Only slot-holders can
+        be in this state — a queued request has produced nothing to
+        finish."""
+        if req.slot is None or not req.finished:
+            return False
+        m = req.slot
+        self._sched.finish(req)
+        self._clear_slot(m)
+        return True
+
+    def _clear_slot(self, m: int) -> None:
+        self._tokens[m] = 0
+        self._seq_lens[m] = 0
+        self._steps_left[m] = 0
+        self._done[m] = True
+        self._eos[m] = -1
+
+    def _terminate(self, req: Request, state: str) -> None:
+        m = req.slot
+        self._sched.terminate(req, state)
+        if m is not None:
+            self._clear_slot(m)
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Terminal-state sweep, run once per step and only while some
+        live request carries a deadline: queued requests past theirs are
+        SHED (they never ran — admission control, the client should back
+        off), except preempted ones which already ran and so TIME OUT;
+        running requests past theirs TIME OUT, freeing their blocks
+        mid-flight so a stuck consumer can never pin the pool."""
+        if not self._sched.deadline_requests:
+            return
+        for req in [r for r in self._sched.queue
+                    if r.deadline is not None and r.deadline < now]:
+            self._terminate(req,
+                            SHED if not (req.preemptions or req.tokens)
+                            else TIMED_OUT)
+        # a request that already FINISHED but has not been swept by
+        # retire_finished yet (e.g. oom-truncated with no decode dispatch
+        # after it) keeps its completed record — its work is done, an
+        # expired deadline must not reclassify it as timed out
+        for req in [r for r in self._sched.live
+                    if r.deadline is not None and r.deadline < now
+                    and not r.finished]:
+            self._terminate(req, TIMED_OUT)
 
     def _chain_ids(self, req: Request, start: int, stop: int) -> np.ndarray:
         """Token ids backing the KV entries ``[start, stop)`` a running
@@ -323,15 +490,17 @@ class ServingEngine:
                 plens[r] = req.prompt_len
                 tables[r] = self.cache.tables[req.slot]
                 act[r] = True
-            logits, self.cache.pool, _ = self._jprefill(
-                self._params, jnp.asarray(ids), jnp.asarray(plens),
-                jnp.asarray(tables), self.cache.pool, jnp.asarray(act))
-            first = np.argmax(np.asarray(logits), axis=-1)
+            with _watchdog.section("serving.prefill"):
+                logits, self.cache.pool, _ = self._jprefill(
+                    self._params, jnp.asarray(ids), jnp.asarray(plens),
+                    jnp.asarray(tables), self.cache.pool, jnp.asarray(act))
+                first = np.argmax(np.asarray(logits), axis=-1)
             now = time.time()
             for r, req in enumerate(group):
                 req.num_computed = req.prompt_len
                 req.reg_state = self.cache.register_prefix(
-                    req.prompt, req.blocks, req.prompt_len, req.reg_state)
+                    req.prompt, req.blocks, req.prompt_len, req.reg_state,
+                    tenant=req.tenant)
                 self._emit_first(req, int(first[r]), now, emitted)
         # chunked/offset admissions advance via _advance_prefills
 
@@ -352,16 +521,17 @@ class ServingEngine:
             ids = np.zeros((1, Sb), np.int32)
             ids[0, :n] = req.prefill_ids[req.num_computed:
                                          req.num_computed + n]
-            logits, self.cache.pool, _ = self._jchunk(
-                self._params, jnp.asarray(ids),
-                jnp.asarray(req.num_computed, jnp.int32),
-                jnp.asarray(n, jnp.int32),
-                jnp.asarray(self.cache.tables[req.slot][None]),
-                self.cache.pool)
+            with _watchdog.section("serving.prefill"):
+                logits, self.cache.pool, _ = self._jchunk(
+                    self._params, jnp.asarray(ids),
+                    jnp.asarray(req.num_computed, jnp.int32),
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(self.cache.tables[req.slot][None]),
+                    self.cache.pool)
             req.num_computed += n
             req.reg_state = self.cache.register_prefix(
                 req.prefill_ids, req.blocks, req.num_computed,
-                req.reg_state)
+                req.reg_state, tenant=req.tenant)
             if req.prefilling:
                 continue                          # more chunks to go
             if req.tokens:                        # readmission: resume
@@ -453,21 +623,27 @@ class ServingEngine:
     def _preempt(self, req: Request) -> None:
         m = req.slot
         self._sched.preempt(req)
-        self._tokens[m] = 0
-        self._seq_lens[m] = 0
-        self._steps_left[m] = 0
-        self._done[m] = True
-        self._eos[m] = -1
+        self._clear_slot(m)
 
     # ---- the scheduler iteration ------------------------------------------
 
     def step(self, max_iters: Optional[int] = None) -> Dict[int, List[int]]:
-        """One scheduler iteration: retire -> admit (+ prefill) -> advance
-        chunked prefills -> extend/preempt for blocks -> one decode
-        dispatch of up to ``_limit()`` iterations (``max_iters`` caps it).
-        Returns ``{rid: [tokens emitted]}``."""
+        """One scheduler iteration: expire deadlines -> retire -> admit
+        (+ prefill) -> advance chunked prefills -> extend/preempt for
+        blocks -> one decode dispatch of up to ``_limit()`` iterations
+        (``max_iters`` caps it). Returns ``{rid: [tokens emitted]}``.
+        Each step stamps the global :mod:`~paddle_tpu.health.watchdog`
+        (progress tick + ``serving.step``/``serving.prefill``/
+        ``serving.decode`` section markers), so a frozen dispatch is
+        named in the hang diagnosis exactly like a training section."""
+        _watchdog.touch()
+        with _watchdog.section("serving.step"):
+            return self._step(max_iters)
+
+    def _step(self, max_iters: Optional[int]) -> Dict[int, List[int]]:
         import jax.numpy as jnp
         emitted: Dict[int, List[int]] = {}
+        self._expire_deadlines(time.time())
         self._sched.retire_finished()
         self._admit(emitted)
         self._advance_prefills(emitted)
@@ -485,13 +661,15 @@ class ServingEngine:
                 k = min(k, self._limit(decoding, max_iters))
         if decoding and k >= 1:
             before = self._steps_left.copy()
-            (self.cache.pool, tokens, seq_lens, steps_left, done,
-             toks) = self._jdecode(
-                self._params, self.cache.pool, jnp.asarray(self._tokens),
-                jnp.asarray(self._seq_lens), jnp.asarray(self._steps_left),
-                jnp.asarray(self._done), jnp.asarray(self.cache.tables),
-                jnp.asarray(self._eos), jnp.asarray(k, jnp.int32))
-            toks = np.asarray(toks)
+            with _watchdog.section("serving.decode"):
+                (self.cache.pool, tokens, seq_lens, steps_left, done,
+                 toks) = self._jdecode(
+                    self._params, self.cache.pool, jnp.asarray(self._tokens),
+                    jnp.asarray(self._seq_lens),
+                    jnp.asarray(self._steps_left),
+                    jnp.asarray(self._done), jnp.asarray(self.cache.tables),
+                    jnp.asarray(self._eos), jnp.asarray(k, jnp.int32))
+                toks = np.asarray(toks)
             # np.array (copy): zero-copy views of jax outputs are read-only,
             # and admission writes these slots in place next step
             self._tokens = np.array(tokens)
@@ -517,7 +695,7 @@ class ServingEngine:
                         sl // self.config.block_size > req.reg_state[0]:
                     req.reg_state = self.cache.register_prefix(
                         self._chain_ids(req, base, sl), req.blocks, sl,
-                        req.reg_state, base=base)
+                        req.reg_state, base=base, tenant=req.tenant)
             self._stats["chunks"] += 1
             self._sched.retire_finished()
         self._stats["steps"] += 1
@@ -533,26 +711,40 @@ class ServingEngine:
         yields ``(rid, dict)`` carrying its serving record —
         ``prefix_hit_tokens`` / ``preemptions`` / ``recomputed_tokens`` /
         ``tokens`` / ``ttft_s`` — so a streaming caller observes the
-        paging machinery per request, not just in aggregate stats()."""
-        while self.pending:
-            seen = set(self._sched.finished) if finish_events else None
-            for rid, toks in sorted(
-                    self.step(self.config.decode_chunk).items()):
-                for t in toks:
-                    yield rid, int(t)
-            if finish_events:
-                for rid in sorted(r for r in self._sched.finished
-                                  if r not in seen):
-                    req = self._sched.finished[rid]
-                    yield rid, {
-                        "finished": True,
-                        "tokens": len(req.tokens),
-                        "prefix_hit_tokens": req.prefix_hit_tokens,
-                        "preemptions": req.preemptions,
-                        "recomputed_tokens": req.recomputed_tokens,
-                        "oom_truncated": req.oom_truncated,
-                        "ttft_s": req.ttft_s,
-                    }
+        paging machinery per request, not just in aggregate stats().
+
+        Consumer abandonment: closing the generator (``gen.close()``, a
+        ``break`` followed by GC, the SSE client vanishing) CANCELS every
+        request still queued or running — their KV blocks return to the
+        pool immediately instead of leaking until someone else drains the
+        engine. The partial outputs stay readable via :meth:`request`."""
+        try:
+            while self.pending:
+                seen = set(self._sched.finished) if finish_events else None
+                for rid, toks in sorted(
+                        self.step(self.config.decode_chunk).items()):
+                    for t in toks:
+                        yield rid, int(t)
+                if finish_events:
+                    for rid in sorted(r for r in self._sched.finished
+                                      if r not in seen):
+                        req = self._sched.finished[rid]
+                        yield rid, {
+                            "finished": True,
+                            "state": req.state,
+                            "tokens": len(req.tokens),
+                            "prefix_hit_tokens": req.prefix_hit_tokens,
+                            "preemptions": req.preemptions,
+                            "recomputed_tokens": req.recomputed_tokens,
+                            "oom_truncated": req.oom_truncated,
+                            "ttft_s": req.ttft_s,
+                        }
+        except GeneratorExit:
+            # the consumer walked away mid-stream: nobody will ever pump
+            # step() for these requests again through this generator —
+            # cancel them so their blocks can't sit pinned in the pool
+            self.cancel_all()
+            raise
 
     def run(self, prompts: Sequence, max_new_tokens=None,
             eos_token_id="unset") -> List[np.ndarray]:
@@ -587,9 +779,13 @@ class ServingEngine:
                 "prefill_buckets": len(self._prefill_buckets),
                 "admitted": self._sched.admitted,
                 "retired": self._sched.retired,
+                "cancelled": self._sched.cancelled,
+                "timed_out": self._sched.timed_out,
+                "shed": self._sched.shed,
                 "queued": len(self._sched.queue),
                 "live_slots": len(self._sched.live),
                 "max_slots": self.config.max_slots,
+                "policy": self._policy.name,
                 "free_blocks": self.cache.free_blocks,
                 "prefix_hit_tokens": self._sched.prefix_hit_tokens,
                 "preemptions": self._sched.preemptions,
@@ -598,3 +794,74 @@ class ServingEngine:
                 "cached_blocks": self.cache.manager.cached_blocks,
                 "evictions": self.cache.manager.evictions,
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """One JSON-serializable health/ops record (docs/OPS.md): overall
+        readiness, capacity headroom, lifecycle/shed counters, hang-
+        watchdog state and per-tenant queue-depth/TTFT/shed breakdowns —
+        the payload a ``/healthz`` or metrics endpoint should serve.
+        ``ok`` goes False only when the installed hang watchdog has fired
+        (the engine itself degrades by shedding, which is healthy);
+        ``accepting`` says whether a submit() right now would be queued
+        rather than shed."""
+        sched = self._sched
+        wd = _watchdog.current()
+
+        def pct(xs, q):
+            return (round(float(np.percentile(np.asarray(xs), q)), 4)
+                    if xs else None)
+
+        def tkey(name: str) -> str:
+            # tenants past MAX_TENANTS were folded into the overflow
+            # record at submit; fold their queued/live counts the same
+            # way or the overflow row would report 0 forever
+            return (name if name in sched.tenants
+                    else sched._OVERFLOW_TENANT)
+
+        live_by_tenant: Dict[str, int] = {}
+        for r in sched.live:
+            k = tkey(r.tenant)
+            live_by_tenant[k] = live_by_tenant.get(k, 0) + 1
+        queued_by_tenant: Dict[str, int] = {}
+        for r in sched.queue:
+            k = tkey(r.tenant)
+            queued_by_tenant[k] = queued_by_tenant.get(k, 0) + 1
+        tenants = {}
+        for name, t in sched.tenants.items():
+            ttfts = list(t["ttfts"])
+            tenants[name] = {
+                "queued": queued_by_tenant.get(name, 0),
+                "live": live_by_tenant.get(name, 0),
+                "submitted": t["submitted"], "admitted": t["admitted"],
+                "retired": t["retired"], "cancelled": t["cancelled"],
+                "timed_out": t["timed_out"], "shed": t["shed"],
+                "service_tokens": t["service_tokens"],
+                "cached_blocks": self.cache.manager.tenant_cached(name),
+                "ttft_p50_s": pct(ttfts, 50), "ttft_p99_s": pct(ttfts, 99),
+            }
+        return {
+            "ok": wd is None or not wd.fired.is_set(),
+            "accepting": len(sched.queue) < sched.queue_depth,
+            "policy": self._policy.name,
+            "queued": len(sched.queue),
+            "queue_limit": sched.queue_depth,
+            "live_slots": len(sched.live),
+            "max_slots": self.config.max_slots,
+            "free_blocks": self.cache.free_blocks,
+            "usable_blocks": self.cache.manager.num_blocks - 1,
+            "retry_after_s": sched.retry_after_s(),
+            "counters": {
+                "admitted": sched.admitted, "retired": sched.retired,
+                "cancelled": sched.cancelled, "timed_out": sched.timed_out,
+                "shed": sched.shed, "preemptions": sched.preemptions,
+                "oom_truncated": sched.oom_truncated,
+                "prefix_hit_tokens": sched.prefix_hit_tokens,
+                "evictions": self.cache.manager.evictions,
+            },
+            "watchdog": {
+                "installed": wd is not None,
+                "fired": bool(wd.fired.is_set()) if wd is not None else False,
+                "timeout_s": wd.timeout if wd is not None else None,
+            },
+            "tenants": tenants,
+        }

@@ -83,12 +83,18 @@ class BlockManager:
     another, which must fail loudly.
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 tenant_quota: Optional[int] = None):
         if num_blocks < 2:
             raise ValueError(f"need >= 2 blocks (1 null + 1 usable), "
                              f"got {num_blocks}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        # per-tenant prefix-cache quota (ISSUE 6): at most this many
+        # blocks registered per tenant key — a tenant flooding unique
+        # prompts churns its OWN cache entries instead of LRU-evicting
+        # everyone else's system prompt. None = unlimited.
+        self.tenant_quota = int(tenant_quota) if tenant_quota else None
         # LIFO free list: hot blocks are reused first (their pool pages are
         # the most likely still resident in any cache hierarchy)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
@@ -101,6 +107,9 @@ class BlockManager:
         self._block_tokens: Dict[int, Tuple[int, ...]] = {}
         # refcount-0 registered blocks, insertion order = LRU release order
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        # block -> registering tenant; tenant -> registered-block count
+        self._block_tenant: Dict[int, str] = {}
+        self._tenant_cached: Dict[str, int] = {}
         self.evictions = 0
 
     @property
@@ -134,12 +143,27 @@ class BlockManager:
                 b = self._free.pop()
             else:                                # LRU-evict a cached block
                 b, _ = self._evictable.popitem(last=False)
-                del self._hash2block[self._block2hash.pop(b)]
-                self._block_tokens.pop(b, None)
+                self._unregister(b)
                 self.evictions += 1
             self._ref[b] = 1
             blocks.append(b)
         return blocks
+
+    def _unregister(self, b: int) -> None:
+        """Drop block ``b``'s prefix-cache registration (hash maps, stored
+        tokens, tenant accounting). The caller owns what happens to the
+        block itself."""
+        del self._hash2block[self._block2hash.pop(b)]
+        self._block_tokens.pop(b, None)
+        t = self._block_tenant.pop(b, None)
+        if t is not None:
+            self._tenant_cached[t] -= 1
+            if not self._tenant_cached[t]:
+                del self._tenant_cached[t]
+
+    def tenant_cached(self, tenant: str) -> int:
+        """Registered prefix-cache blocks currently charged to a tenant."""
+        return self._tenant_cached.get(tenant, 0)
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
@@ -180,20 +204,42 @@ class BlockManager:
         return block
 
     def register(self, key: int, block: int,
-                 tokens: Optional[Tuple[int, ...]] = None) -> None:
+                 tokens: Optional[Tuple[int, ...]] = None,
+                 tenant: Optional[str] = None) -> None:
         """Content-hash a LIVE full block for prefix sharing. First writer
         wins: an already-registered key (another sequence beat us to the
         same prefix) or block is left alone. ``tokens`` (the block's ids)
         back :meth:`lookup`'s hit verification; without them a verified
-        lookup of this key reports a miss."""
+        lookup of this key reports a miss.
+
+        With a ``tenant_quota`` set and a ``tenant`` given, a tenant at
+        its quota recycles its OWN least-recently-released refcount-0
+        entry to make room — and when every one of its entries is still
+        referenced, the registration is simply skipped (the block stays
+        usable, just unshared). Either way the tenant cannot push another
+        tenant's entries off the LRU list by flooding unique prompts."""
         if key in self._hash2block or block in self._block2hash:
             return
         if self._ref.get(block, 0) <= 0:
             raise RuntimeError(f"register of non-live block {block}")
+        if self.tenant_quota is not None and tenant is not None and \
+                self._tenant_cached.get(tenant, 0) >= self.tenant_quota:
+            mine = next((b for b in self._evictable
+                         if self._block_tenant.get(b) == tenant), None)
+            if mine is None:
+                return                   # quota full of pinned entries
+            del self._evictable[mine]
+            self._unregister(mine)
+            self._free.append(mine)
+            self.evictions += 1
         self._hash2block[key] = block
         self._block2hash[block] = key
         if tokens is not None:
             self._block_tokens[block] = tokens
+        if tenant is not None:
+            self._block_tenant[block] = tenant
+            self._tenant_cached[tenant] = \
+                self._tenant_cached.get(tenant, 0) + 1
 
 
 class PagedKVCache:
@@ -207,7 +253,8 @@ class PagedKVCache:
 
     def __init__(self, model_config, max_slots: int, max_model_len: int,
                  block_size: int, num_blocks: int = 0, dtype=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 tenant_quota: Optional[int] = None):
         from ...models.generation import init_paged_pool
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
@@ -218,7 +265,8 @@ class PagedKVCache:
             num_blocks = max_slots * self.blocks_per_seq + 1
         self.pool: Dict = init_paged_pool(model_config, num_blocks,
                                           block_size, dtype)
-        self.manager = BlockManager(num_blocks, block_size)
+        self.manager = BlockManager(num_blocks, block_size,
+                                    tenant_quota=tenant_quota)
         self.tables = np.zeros((max_slots, self.blocks_per_seq), np.int32)
 
     @property
@@ -294,7 +342,8 @@ class PagedKVCache:
 
     def register_prefix(self, ids, blocks: List[int], upto: int,
                         state: Tuple[int, Optional[int]] = (0, None),
-                        base: int = 0) -> Tuple[int, Optional[int]]:
+                        base: int = 0, tenant: Optional[str] = None
+                        ) -> Tuple[int, Optional[int]]:
         """Register the full blocks covering KV entries ``[..upto)`` (those
         the device has finished writing) in the prefix cache,
         INCREMENTALLY: ``state`` is ``(blocks already registered, chained
@@ -311,7 +360,7 @@ class PagedKVCache:
         n, h = state
         for key, toks in prefix_block_chain(ids, self.block_size, upto,
                                             start=n, prev_key=h, base=base):
-            self.manager.register(key, blocks[n], toks)
+            self.manager.register(key, blocks[n], toks, tenant=tenant)
             n, h = n + 1, key
         return (n, h)
 

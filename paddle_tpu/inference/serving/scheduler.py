@@ -16,10 +16,28 @@ never touches the device — the engine owns dispatch; this module owns WHO
 is running WHERE and the per-request records (tokens, timestamps, prefix
 hits, preemptions) the bench's stats come from.
 
-FIFO is strict for ADMISSION ORDER, but with reservation gone a large
+Admission ORDER is a pluggable :class:`~.policies.AdmissionPolicy`
+(FIFO default — strict submission order; priority / weighted fair share /
+earliest-deadline-first ship alongside), and with reservation gone a large
 queue head no longer charges its worst case up front — it admits on its
 prompt footprint alone, and chunked prefill (engine-side) keeps a long
 prompt from freezing in-flight decode streams.
+
+Lifecycle (ISSUE 6): every request ends in exactly ONE terminal state —
+
+    queued -> running -> FINISHED   (EOS / budget spent / oom-truncated)
+                      -> CANCELLED  (engine.cancel / abandoned stream)
+                      -> TIMED_OUT  (deadline passed after it started)
+           ->          SHED         (deadline passed while queued, or the
+                                     bounded queue refused the submit)
+
+Terminal transitions release every block the request held (mid-flight via
+the same free path preemption uses — free and do NOT requeue), so a stuck
+or vanished consumer can never pin pool blocks, and the terminal record
+(tokens so far, timestamps, counters) lands in ``finished`` like a normal
+retirement. Per-tenant counters (queue depth, TTFT samples, shed/cancel/
+timeout counts, service tokens) feed the engine's ``health_snapshot()``
+and the fair-share policy.
 """
 
 from __future__ import annotations
@@ -31,11 +49,43 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["Request", "Scheduler", "ServingQueueFull"]
+from .policies import AdmissionPolicy, FIFOPolicy
+
+__all__ = ["Request", "Scheduler", "ServingQueueFull",
+           "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "TIMED_OUT",
+           "SHED", "TERMINAL_STATES"]
+
+# request lifecycle states (Request.state)
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+CANCELLED = "cancelled"
+TIMED_OUT = "timed_out"
+SHED = "shed"
+TERMINAL_STATES = frozenset({FINISHED, CANCELLED, TIMED_OUT, SHED})
+
+DEFAULT_TENANT = "default"
 
 
 class ServingQueueFull(RuntimeError):
-    """submit() beyond the admission queue's depth bound."""
+    """submit() beyond the admission queue's depth bound — the engine is
+    LOAD SHEDDING instead of queueing unboundedly. Structured context for
+    the caller's backoff logic (a 429/Retry-After response, a client-side
+    retry budget):
+
+    * ``queue_depth`` — requests queued when the submit was refused
+    * ``live_slots`` — decode slots currently occupied
+    * ``retry_after_s`` — suggested backoff: the scheduler's estimate of
+      one retirement interval (None before any retirement is observed)
+    """
+
+    def __init__(self, message: str, queue_depth: Optional[int] = None,
+                 live_slots: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.live_slots = live_slots
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass
@@ -46,6 +96,14 @@ class Request:
     prompt: np.ndarray                 # [S] int32
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    # multi-tenancy + lifecycle (ISSUE 6): the tenant key scopes fair-share
+    # accounting and cache quotas; priority orders the priority policy;
+    # deadline is ABSOLUTE (time.time()) — engine.submit derives it from
+    # timeout_s/deadline_s; state walks queued -> running -> one terminal
+    tenant: str = DEFAULT_TENANT
+    priority: int = 0
+    deadline: Optional[float] = None
+    state: str = QUEUED
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
@@ -93,6 +151,10 @@ class Request:
         return self.eos_seen or self.remaining <= 0 or self.oom_truncated
 
     @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    @property
     def prefilling(self) -> bool:
         return self.prefill_ids is not None and \
             self.num_computed < len(self.prefill_ids)
@@ -126,27 +188,41 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission queue + slot table over a :class:`PagedKVCache`.
+    """Policy-ordered admission queue + slot table over a
+    :class:`PagedKVCache`.
 
     ``preempt=True`` (the default) is the on-demand mode: admission maps
     prefix-cache hits and allocates only the prompt's remaining blocks;
     ``preempt=False`` restores the legacy worst-case reservation (no
-    preemption machinery needed, conservative admission).
+    preemption machinery needed, conservative admission). ``policy`` is
+    an :class:`~.policies.AdmissionPolicy` (default FIFO) choosing which
+    queued request admits next.
     """
 
+    # hostile traffic can mint a new tenant string per request; past this
+    # many distinct tenants new ones aggregate under one overflow key so
+    # the stats dict cannot grow without bound
+    MAX_TENANTS = 256
+    _OVERFLOW_TENANT = "_overflow"
+    # TTFT samples retained per tenant for the health snapshot's p50/p99
+    TTFT_SAMPLES = 128
+
     def __init__(self, cache, max_slots: int, queue_depth: int,
-                 preempt: bool = True):
+                 preempt: bool = True,
+                 policy: Optional[AdmissionPolicy] = None):
         self.cache = cache
         self.max_slots = int(max_slots)
         self.queue_depth = int(queue_depth)
         self.preempt_enabled = bool(preempt)
+        self.policy = policy if policy is not None else FIFOPolicy()
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * max_slots
         # finished-record retention is BOUNDED (a long-lived engine must
         # not leak every prompt it ever served): insertion-ordered dict,
         # oldest evicted past queue_depth + max_slots — enough that one
         # full run()/drain cycle (submit bounded by queue_depth) can
-        # always collect its results afterwards
+        # always collect its results afterwards. Terminal records
+        # (cancelled/timed-out/shed) land here too.
         self.finished: Dict[int, Request] = {}
         self.keep_finished = self.queue_depth + self.max_slots
         self._next_rid = 0
@@ -157,14 +233,63 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.recomputed_tokens = 0
         self.oom_truncated = 0
+        # lifecycle counters (terminal states other than FINISHED)
+        self.cancelled = 0
+        self.timed_out = 0
+        self.shed = 0
+        # live requests carrying a deadline — the engine skips the
+        # per-step expiry sweep entirely while this is 0
+        self.deadline_requests = 0
+        # recent retirement timestamps -> the retry-after estimate
+        self._finish_times: Deque[float] = deque(maxlen=16)
+        self.tenants: Dict[str, Dict] = {}
+
+    # ---- per-tenant accounting ---------------------------------------------
+
+    def tenant(self, name: str) -> Dict:
+        """The (lazily created) stats record for one tenant key."""
+        d = self.tenants.get(name)
+        if d is None:
+            if len(self.tenants) >= self.MAX_TENANTS and \
+                    name != self._OVERFLOW_TENANT:
+                return self.tenant(self._OVERFLOW_TENANT)
+            d = self.tenants[name] = {
+                "submitted": 0, "admitted": 0, "retired": 0,
+                "cancelled": 0, "timed_out": 0, "shed": 0,
+                "service_tokens": 0,
+                "ttfts": deque(maxlen=self.TTFT_SAMPLES),
+            }
+        return d
+
+    def retry_after_s(self) -> Optional[float]:
+        """Suggested backoff when shedding: the mean interval between the
+        most recent retirements (one retirement frees one slot, which is
+        what drains one queued request). None until two retirements have
+        been observed."""
+        if len(self._finish_times) < 2:
+            return None
+        span = self._finish_times[-1] - self._finish_times[0]
+        if span <= 0:
+            return 0.001
+        return round(span / (len(self._finish_times) - 1), 3)
 
     # ---- lifecycle --------------------------------------------------------
 
     def submit(self, req: Request) -> int:
         if len(self.queue) >= self.queue_depth:
+            # SHED, don't queue: a bounded queue with a retry-after hint
+            # keeps tail latency bounded under overload — an unbounded one
+            # converts overload into unbounded TTFT for everyone
+            self.shed += 1
+            self.tenant(req.tenant)["shed"] += 1
+            ra = self.retry_after_s()
+            hint = f"; retry in ~{ra}s" if ra is not None else ""
             raise ServingQueueFull(
-                f"admission queue full ({self.queue_depth}); drain with "
-                f"step()/stream() or raise FLAGS_serving_queue_depth")
+                f"admission queue full ({self.queue_depth}): request shed"
+                f"{hint}; drain with step()/stream() or raise "
+                f"FLAGS_serving_queue_depth",
+                queue_depth=len(self.queue), live_slots=len(self.live),
+                retry_after_s=ra)
         # fail fast on requests the pool can NEVER hold (vs transiently
         # full); the bound is KV entries, not blocks — block granularity
         # would admit up to block_size-1 entries past max_model_len
@@ -193,28 +318,41 @@ class Scheduler:
         req.rid = self._next_rid
         self._next_rid += 1
         req.submit_t = time.time()
+        req.state = QUEUED
+        if req.deadline is not None:
+            self.deadline_requests += 1
+        self.tenant(req.tenant)["submitted"] += 1
         self.queue.append(req)
         return req.rid
 
     def next_admission(self) -> Optional[Request]:
-        """Pop the queue head into a free slot if its blocks fit; None when
-        nothing can be admitted this iteration. On-demand mode maps
+        """Pop the policy's pick into a free slot if its blocks fit; None
+        when nothing can be admitted this iteration. On-demand mode maps
         prefix-cache hits and allocates only the remaining prompt blocks;
         reservation mode allocates the full worst case. Admission never
-        preempts running work — it waits for retirement to free blocks."""
+        preempts running work — it waits for retirement to free blocks,
+        and is head-of-line PER THE POLICY'S ORDER: when the pick's
+        blocks don't fit, admission waits rather than skipping to a
+        smaller request (skipping would starve large requests)."""
         if not self.queue:
             return None
         free = [m for m, r in enumerate(self.slots) if r is None]
         if not free:
             return None
-        req = self.queue[0]
+        # a preempted request re-queued at the FRONT outranks any policy
+        # pick: its generated tokens are already paid for, and the
+        # no-livelock argument assumes it readmits at the next retirement
+        if self.queue[0].preemptions:
+            req = self.queue[0]
+        else:
+            req = self.policy.select(self.queue, self, time.time())
         ids = req.build_prefill_ids()
         res = self.cache.admit(
             ids, reserve_kv=None if self.preempt_enabled else req.kv_tokens)
         if res is None:
-            return None                       # head waits for blocks
+            return None                       # the pick waits for blocks
         blocks, hit, reg_state = res
-        self.queue.popleft()
+        self.queue.remove(req)
         slot = free[0]
         req.blocks, req.slot = blocks, slot
         req.prefill_ids = ids
@@ -230,9 +368,13 @@ class Scheduler:
             self.recomputed_tokens += rec
         req.admit_seq = self._admit_seq
         self._admit_seq += 1
+        req.state = RUNNING
         self.cache.assign(slot, blocks)
         self.slots[slot] = req
         self.admitted += 1
+        t = self.tenant(req.tenant)
+        t["admitted"] += 1
+        t["service_tokens"] += req.prompt_len     # prefill work charged now
         return req
 
     def preempt(self, req: Request) -> None:
@@ -251,6 +393,7 @@ class Scheduler:
         req.reg_state = (0, None)          # readmission re-seeds from hits
         req.preemptions += 1
         self.preemptions += 1
+        req.state = QUEUED
         self.queue.appendleft(req)
 
     def preempt_victim(self) -> Optional[Request]:
@@ -264,6 +407,44 @@ class Scheduler:
 
     def finish(self, req: Request) -> None:
         """Mark finished + free its KV back to the pool."""
+        self._release(req)
+        req.state = FINISHED
+        self._record(req)
+        self.retired += 1
+        self._finish_times.append(req.finish_t)
+        t = self.tenant(req.tenant)
+        t["retired"] += 1
+        t["service_tokens"] += len(req.tokens)    # decode work charged here
+        if req.ttft_s is not None:
+            t["ttfts"].append(req.ttft_s)
+
+    def terminate(self, req: Request, state: str) -> None:
+        """Force a queued or running request into a terminal state —
+        CANCELLED (explicit cancel / abandoned stream), TIMED_OUT
+        (deadline passed after it started), or SHED (deadline passed
+        while still queued). Frees any blocks it holds via the same path
+        preemption uses (free, do NOT requeue) and records it in
+        ``finished`` so ``result()``/``request()`` still find the partial
+        output. The caller (engine) is responsible for clearing its slot
+        arrays when the request held a slot."""
+        assert state in TERMINAL_STATES and state != FINISHED, state
+        if req.slot is None:
+            # queued (possibly preempted-and-requeued): no blocks held
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                pass
+        self._release(req)
+        req.state = state
+        self._record(req)
+        counter = {CANCELLED: "cancelled", TIMED_OUT: "timed_out",
+                   SHED: "shed"}[state]
+        setattr(self, counter, getattr(self, counter) + 1)
+        t = self.tenant(req.tenant)
+        t[counter] += 1
+        t["service_tokens"] += len(req.tokens)
+
+    def _release(self, req: Request) -> None:
         req.finish_t = time.time()
         if req.blocks is not None:
             # blocks and slot are only ever assigned together in
@@ -272,10 +453,24 @@ class Scheduler:
             self.slots[req.slot] = None
             req.blocks = None
         req.slot = None
+        if req.deadline is not None:
+            self.deadline_requests -= 1
+
+    def _record(self, req: Request) -> None:
         self.finished[req.rid] = req
         while len(self.finished) > self.keep_finished:
             del self.finished[next(iter(self.finished))]
-        self.retired += 1
+
+    def find(self, rid: int) -> Optional[Request]:
+        """The queued or running request with this id (None when unknown
+        or already terminal)."""
+        for r in self.queue:
+            if r.rid == rid:
+                return r
+        for r in self.slots:
+            if r is not None and r.rid == rid:
+                return r
+        return None
 
     def retire_finished(self) -> List[Request]:
         done = [r for r in self.slots if r is not None and r.finished]

@@ -336,6 +336,16 @@ def _masked_sdpa(q, kk, vv, kv_mask):
     Shared by the dense KV cache and the paged block cache
     (:mod:`paddle_tpu.models.generation`)."""
     H, Hk = q.shape[2], kk.shape[2]
+    # V at positions NO query may attend (the paged null block, stale KV
+    # in a reused block's tail) must be zeroed, not merely zero-WEIGHTED:
+    # a poisoned request can park non-finite KV there (e.g. out-of-vocab
+    # ids -> NaN embeddings scattered through a masked lane), and
+    # 0 * NaN = NaN would wipe every other sequence's row. For finite KV
+    # the masked contribution was already an exact 0.0, so this select is
+    # bit-invisible; K needs nothing — a NaN score at a masked position
+    # is replaced by the -1e30 where below.
+    pos_valid = kv_mask.any(axis=1)   # [B, C]: attendable by some query
+    vv = jnp.where(pos_valid[:, :, None, None], vv, 0)
     if Hk != H:                       # GQA: expand kv heads for the einsum
         rep = H // Hk
         kk = jnp.repeat(kk, rep, axis=2)

@@ -54,9 +54,26 @@ def generate(out_path: str = "docs/OPS.md") -> str:
              "the rest are covered by hand-written domain tests "
              "(tests/test_*.py) or are stateful/random/IO ops outside the "
              "oracle pattern.",
-             "",
-             "| op | signature | doc |",
-             "|---|---|---|"]
+             ""]
+    # serving ops surface (ISSUE 6): the health_snapshot() payload an ops
+    # endpoint serves, generated from the engine's field registry (the
+    # snapshot test pins the live payload to the same registry)
+    from paddle_tpu.inference.serving.engine import HEALTH_SNAPSHOT_FIELDS
+    lines += ["## Serving health surface",
+              "",
+              "`inference.serving.ServingEngine.health_snapshot()` "
+              "(docs/SERVING.md \"Overload & multi-tenancy\") returns one "
+              "JSON-serializable record per call — the payload a "
+              "`/healthz` or metrics endpoint should serve:",
+              "",
+              "| field | meaning |",
+              "|---|---|"]
+    lines += [f"| `{k}` | {v} |" for k, v in HEALTH_SNAPSHOT_FIELDS.items()]
+    lines += ["",
+              "## Op table",
+              "",
+              "| op | signature | doc |",
+              "|---|---|---|"]
     for name in sorted(OP_REGISTRY):
         d = OP_REGISTRY[name]
         try:

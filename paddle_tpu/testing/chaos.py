@@ -23,12 +23,23 @@ injector               fault it models
                        transiently (retry path) or forever (quarantine)
 ``dead_worker``        a DataLoader worker segfaulting mid-epoch (fires
                        once; the resurrected replacement survives)
+``stalled_consumer``   a serving client that reads a few stream tokens
+                       then vanishes without draining (closed SSE
+                       connection) — the abandoned-stream block leak
+``poison_prompt``      a malformed serving request: out-of-vocab token
+                       ids / empty / garbage-length prompts that must not
+                       corrupt neighbouring requests' outputs
+``flood_tenant``       one tenant burst-submitting until the bounded
+                       queue sheds — the noisy-neighbour overload fault
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
 managers and compose by nesting. The chaos test suite
 (``tests/test_chaos.py``) asserts that under every one of these the job
-resumes from a committed checkpoint and converges to the unfaulted loss.
+resumes from a committed checkpoint and converges to the unfaulted loss —
+and, for the serving trio, that the engine ends with BlockManager
+accounting balanced and keeps accepting (and bit-exactly serving) new
+requests.
 """
 
 from __future__ import annotations
@@ -41,7 +52,8 @@ from typing import Optional
 
 __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "stall_heartbeat", "kill_self", "nan_payload", "bad_sample",
-           "dead_worker", "INJECTORS"]
+           "dead_worker", "stalled_consumer", "poison_prompt",
+           "flood_tenant", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -235,6 +247,86 @@ class dead_worker:
         return self.dataset[i]
 
 
+# ---------------------------------------------------------------------------
+# serving-overload injectors (paddle_tpu.inference.serving; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def stalled_consumer(engine, events: int = 2, close: bool = True) -> dict:
+    """A streaming client that reads ``events`` tokens from
+    ``engine.stream()`` and then VANISHES — the closed-SSE-connection /
+    crashed-downstream fault. Before the lifecycle work this leaked the
+    in-flight requests' KV blocks until someone else happened to drain
+    the engine; now closing the abandoned generator must CANCEL the
+    remaining work and return every block to the pool.
+
+    ``close=True`` closes the generator explicitly (what CPython's GC
+    does to an abandoned generator, made deterministic for the test).
+    Returns ``{"events": tokens consumed, "cancelled": requests
+    cancelled by the close}``."""
+    gen = engine.stream()
+    got = 0
+    try:
+        for _ in range(max(0, int(events))):
+            next(gen)
+            got += 1
+    except StopIteration:
+        pass
+    before = engine.stats()["cancelled"]
+    if close:
+        gen.close()               # the consumer is gone; nobody resumes it
+    return {"events": got,
+            "cancelled": engine.stats()["cancelled"] - before}
+
+
+def poison_prompt(prompt, vocab_size: int, mode: str = "oov",
+                  seed: int = 0):
+    """Corrupt a serving prompt the way a broken tokenizer / malicious
+    client would: ``"oov"`` replaces every id with one >= ``vocab_size``
+    (an out-of-range embedding lookup — XLA clamps the gather, producing
+    garbage logits that must stay CONTAINED to this request), ``"neg"``
+    flips ids negative, ``"empty"`` returns a zero-length prompt (must be
+    rejected or served, never wedge the engine). Returns the poisoned
+    COPY; the recovery proof is that co-scheduled clean requests still
+    match the dense oracle bit-for-bit and pool accounting balances."""
+    import numpy as np
+    p = np.array(prompt, np.int32, copy=True)
+    if mode == "empty":
+        return p[:0]
+    rng = random.Random(seed)
+    if mode == "oov":
+        return np.asarray([vocab_size + rng.randrange(2 ** 16)
+                           for _ in p], np.int32)
+    if mode == "neg":
+        return -np.abs(p) - 1
+    raise ValueError(f"unknown poison_prompt mode {mode!r}")
+
+
+def flood_tenant(engine, tenant: str, n: int, prompt_len: int = 8,
+                 max_new_tokens: int = 4, vocab_size: int = 97,
+                 seed: int = 0, **submit_kwargs) -> dict:
+    """One tenant burst-submits ``n`` requests — the noisy-neighbour /
+    abusive-client overload fault. Submits ride the normal ``submit()``
+    path, so the bounded queue SHEDS the overflow (``ServingQueueFull``
+    with a retry-after hint) instead of queueing unboundedly; under the
+    fair-share policy the flood's ADMITTED share stays proportional to
+    its weight, and with a tenant cache quota its churn cannot evict
+    other tenants' prefix blocks. Returns ``{"rids": accepted ids,
+    "shed": refused submits, "retry_after_s": last hint}``."""
+    import numpy as np
+    from paddle_tpu.inference.serving import ServingQueueFull
+    rng = np.random.default_rng(seed)
+    rids, shed, hint = [], 0, None
+    for _ in range(int(n)):
+        p = rng.integers(0, vocab_size, (int(prompt_len),)).astype(np.int32)
+        try:
+            rids.append(engine.submit(p, max_new_tokens=max_new_tokens,
+                                      tenant=tenant, **submit_kwargs))
+        except ServingQueueFull as e:
+            shed += 1
+            hint = e.retry_after_s
+    return {"rids": rids, "shed": shed, "retry_after_s": hint}
+
+
 # name -> injector; docs/FAULT_TOLERANCE.md's generated injector count
 # (tools/refresh_docs.py) reads this registry
 INJECTORS = {
@@ -247,4 +339,7 @@ INJECTORS = {
     "nan_payload": nan_payload,
     "bad_sample": bad_sample,
     "dead_worker": dead_worker,
+    "stalled_consumer": stalled_consumer,
+    "poison_prompt": poison_prompt,
+    "flood_tenant": flood_tenant,
 }

@@ -680,3 +680,178 @@ class TestLauncherChaos:
         assert int((tmp_path / "resumed.0").read_text()) == step
         np.testing.assert_allclose(_final_loss(tmp_path), ref,
                                    rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# serving-overload chaos (ISSUE 6): hostile traffic against the serving
+# engine. Recovery contract for every injector: BlockManager accounting
+# balanced afterwards, and the engine still ACCEPTS and bit-exactly serves
+# fresh requests (the dense-cache greedy path is the oracle).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serving_setup():
+    import jax
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 97, (s,)).astype(np.int32)
+               for s in [9, 5, 12, 7]]
+    return cfg, params, prompts
+
+
+def _serving_engine(params, cfg, **kw):
+    from paddle_tpu.inference.serving import ServingConfig, ServingEngine
+    base = dict(block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+                queue_depth=8)
+    base.update(kw)
+    return ServingEngine(params, cfg, ServingConfig(**base))
+
+
+def _dense(params, cfg, prompt, n):
+    import jax.numpy as jnp
+    from paddle_tpu.models import generation as G
+    return np.asarray(G.generate(params, jnp.asarray(prompt[None]), cfg,
+                                 max_new_tokens=n))[0]
+
+
+def _assert_recovered(eng, params, cfg, prompt):
+    """The shared recovery oracle: pool accounting balanced and a fresh
+    request both accepted and served bit-identically."""
+    assert eng.stats()["free_blocks"] == eng.cache.manager.num_blocks - 1
+    assert eng.cache.manager.blocks_in_use == 0
+    assert eng.health_snapshot()["accepting"] is True
+    out = eng.run([prompt], max_new_tokens=4, eos_token_id=None)[0]
+    np.testing.assert_array_equal(np.asarray(out),
+                                  _dense(params, cfg, prompt, 4))
+
+
+class TestServingChaos:
+    def test_stalled_consumer_frees_blocks(self, serving_setup):
+        """A streaming client reads a few tokens then vanishes: the
+        abandoned stream must cancel the in-flight requests and free
+        their blocks (pre-ISSUE 6 this leaked the pool until drain)."""
+        cfg, params, prompts = serving_setup
+        eng = _serving_engine(params, cfg)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=8, eos_token_id=None)
+        r = chaos.stalled_consumer(eng, events=3)
+        assert r["events"] == 3
+        assert r["cancelled"] >= 1                # close cancelled the rest
+        assert not eng.pending
+        _assert_recovered(eng, params, cfg, prompts[0])
+
+    def test_poison_prompt_contained(self, serving_setup):
+        """Out-of-vocab / negative-id prompts produce garbage for THAT
+        request only: co-scheduled clean requests stay bit-identical to
+        the dense oracle and the pool balances; an empty poisoned prompt
+        is rejected outright, never wedging the engine."""
+        cfg, params, prompts = serving_setup
+        eng = _serving_engine(params, cfg, max_slots=3)
+        clean = prompts[0]
+        want = _dense(params, cfg, clean, 6)
+        for mode in ("oov", "neg"):
+            bad = chaos.poison_prompt(prompts[2], cfg.vocab_size, mode=mode)
+            rid_bad = eng.submit(bad, max_new_tokens=6, eos_token_id=None)
+            rid_ok = eng.submit(clean, max_new_tokens=6, eos_token_id=None)
+            while eng.pending:
+                eng.step()
+            np.testing.assert_array_equal(
+                np.asarray(eng.request(rid_ok).output()), want)
+            assert len(eng.request(rid_bad).tokens) == 6  # served, contained
+        with pytest.raises(ValueError, match="prompt"):
+            eng.submit(chaos.poison_prompt(prompts[2], cfg.vocab_size,
+                                           mode="empty"),
+                       max_new_tokens=4)
+        _assert_recovered(eng, params, cfg, prompts[1])
+
+    def test_poison_prompt_null_block_containment(self, serving_setup):
+        """Regression for the null-block poisoning this injector caught:
+        out-of-vocab ids produce NaN activations (JAX fills OOB gathers
+        with NaN), the poisoned row's prefill scatters NaN K/V through
+        its masked lanes into physical block 0 — which EVERY sequence
+        gathers at masked positions — and 0-weight * NaN wiped whole
+        rows engine-wide. _masked_sdpa now zeroes V at never-attendable
+        positions, so the poison stays contained: a clean request that
+        prefix-HITS and chunk-prefills in a separate dispatch after the
+        poisoned one (the ordering that exposed the bug) stays
+        bit-exact, and a follow-up wave REUSING the poisoned request's
+        freed blocks stays bit-exact too."""
+        cfg, params, prompts = serving_setup
+        eng = _serving_engine(params, cfg, tenant_cache_quota=2)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=8, eos_token_id=None)
+        chaos.stalled_consumer(eng, events=3)   # leaves partial cache state
+        bad = chaos.poison_prompt(prompts[2], cfg.vocab_size, mode="oov")
+        eng.submit(bad, max_new_tokens=4, eos_token_id=None)
+        rid = eng.submit(prompts[0], max_new_tokens=4, eos_token_id=None)
+        while eng.pending:
+            eng.step()
+        assert eng.request(rid).prefix_hit_tokens > 0   # took the hit path
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(rid).output()),
+            _dense(params, cfg, prompts[0], 4))
+        # the poisoned request's blocks are free now: a full wave reusing
+        # them (stale NaN in reused tails) must still match the oracle
+        outs = eng.run(prompts, max_new_tokens=6, eos_token_id=None)
+        for o, p in zip(outs, prompts):
+            np.testing.assert_array_equal(np.asarray(o),
+                                          _dense(params, cfg, p, 6))
+        _assert_recovered(eng, params, cfg, prompts[1])
+
+    def test_flood_tenant_shed_and_fair_share(self, serving_setup):
+        """One tenant burst-submits past the queue bound: the overflow is
+        SHED with a retry-after hint, and under the fair-share policy a
+        quiet tenant arriving BEHIND the flood still admits ahead of the
+        flood's tail instead of waiting out the whole burst."""
+        cfg, params, prompts = serving_setup
+        eng = _serving_engine(params, cfg, max_slots=1, queue_depth=6,
+                              policy="fair")
+        # prime the retirement-rate estimate so the shed hint is real
+        eng.run([prompts[1]], max_new_tokens=2, eos_token_id=None)
+        eng.run([prompts[1]], max_new_tokens=2, eos_token_id=None)
+        r = chaos.flood_tenant(eng, "flood", n=10, prompt_len=8,
+                               max_new_tokens=6, vocab_size=cfg.vocab_size,
+                               eos_token_id=None)
+        assert r["shed"] >= 1
+        assert r["retry_after_s"] is not None and r["retry_after_s"] > 0
+        eng.step()                                 # one flood request admits
+        quiet = eng.submit(prompts[1], max_new_tokens=6, eos_token_id=None,
+                           tenant="quiet")
+        while eng.pending:
+            eng.step()
+        qreq = eng.request(quiet)
+        flood_seqs = [eng.request(rid).admit_seq for rid in r["rids"]]
+        assert qreq.admit_seq < max(flood_seqs)    # jumped the flood's tail
+        np.testing.assert_array_equal(
+            np.asarray(qreq.output()), _dense(params, cfg, prompts[1], 6))
+        snap = eng.health_snapshot()
+        assert snap["tenants"]["flood"]["shed"] >= 1
+        assert snap["counters"]["shed"] >= 1
+        _assert_recovered(eng, params, cfg, prompts[0])
+
+    def test_flood_tenant_cache_quota_protects_system_prompt(
+            self, serving_setup):
+        """Flood churn under a tenant cache quota: the flooding tenant
+        recycles its own prefix-cache entries and the other tenant's
+        system prompt still HITS afterwards."""
+        cfg, params, prompts = serving_setup
+        eng = _serving_engine(params, cfg, tenant_cache_quota=2,
+                              queue_depth=16)
+        sys_p = prompts[2]                         # 12 tokens: 3 full blocks
+        eng.run([sys_p], max_new_tokens=2, eos_token_id=None)
+        chaos.flood_tenant(eng, "spam", n=8, prompt_len=12,
+                           max_new_tokens=2, vocab_size=cfg.vocab_size,
+                           eos_token_id=None)
+        while eng.pending:
+            eng.step()
+        assert eng.cache.manager.tenant_cached("spam") <= 2
+        before = eng.stats()["prefix_hit_tokens"]
+        out = eng.run([sys_p], max_new_tokens=4, eos_token_id=None)[0]
+        np.testing.assert_array_equal(np.asarray(out),
+                                      _dense(params, cfg, sys_p, 4))
+        assert eng.stats()["prefix_hit_tokens"] > before
+        _assert_recovered(eng, params, cfg, prompts[0])

@@ -880,3 +880,622 @@ class TestEarlyExitDecodeLoop:
         stop = int(np.argmax(free[0] == eos))
         np.testing.assert_array_equal(got[0, :stop + 1], free[0, :stop + 1])
         assert (got[0, stop + 1:] == 0).all()
+
+
+class TestRequestLifecycle:
+    """ISSUE 6 tentpole: every request ends in exactly one terminal state
+    (finished / cancelled / timed_out / shed), and every terminal
+    transition frees the blocks it held — checked against the pool's
+    accounting and the dense oracle for the surviving requests."""
+
+    def _balanced(self, eng):
+        assert eng.stats()["free_blocks"] == eng.cache.manager.num_blocks - 1
+
+    def test_cancel_queued_and_running(self, setup):
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=2)
+        rids = [eng.submit(p, max_new_tokens=8, eos_token_id=None)
+                for p in prompts[:4]]
+        eng.step(max_iters=1)                    # 0 and 1 running, 2-3 queued
+        assert eng.cancel(rids[3]) is True       # queued: no blocks held
+        running = [r.rid for r in eng._sched.live]
+        assert eng.cancel(running[0]) is True    # running: blocks freed now
+        assert eng.cancel(rids[3]) is False      # terminal: idempotent False
+        assert eng.cancel(10_000) is False       # unknown rid
+        while eng.pending:
+            eng.step()
+        st = eng.stats()
+        assert st["cancelled"] == 2 and st["retired"] == 2
+        self._balanced(eng)
+        for rid in rids:
+            if rid not in (rids[3], running[0]):
+                np.testing.assert_array_equal(
+                    np.asarray(eng.request(rid).output()),
+                    dense_rows(params, cfg, [prompts[rids.index(rid)]],
+                               [8])[0])
+        for rid in (rids[3], running[0]):
+            assert eng.request(rid).state == "cancelled"
+
+    def test_timeout_mid_flight_frees_blocks(self, setup):
+        """A running request past its deadline is TIMED OUT inside step():
+        blocks freed mid-flight (the preemption free path, do-not-requeue)
+        and its partial output prefix-matches the oracle."""
+        import time as _t
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=1, decode_chunk=1)
+        r0 = eng.submit(prompts[0], max_new_tokens=24, eos_token_id=None,
+                        timeout_s=0.15)
+        r1 = eng.submit(prompts[1], max_new_tokens=3, eos_token_id=None)
+        eng.step(max_iters=1)                    # r0 starts decoding
+        assert eng._sched.live and eng._sched.live[0].rid == r0
+        _t.sleep(0.2)
+        while eng.pending:
+            eng.step(max_iters=1)
+        req = eng.request(r0)
+        assert req.state == "timed_out"
+        assert req.deadline is not None
+        want = dense_rows(params, cfg, [prompts[0]], [24])[0]
+        np.testing.assert_array_equal(np.asarray(req.output()),
+                                      want[:len(req.tokens)])
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(r1).output()),
+            dense_rows(params, cfg, [prompts[1]], [3])[0])
+        self._balanced(eng)
+
+    def test_expired_queued_request_is_shed(self, setup):
+        """A request whose deadline passes while it is still QUEUED never
+        ran: it is SHED (admission control), not timed out."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=1)
+        r0 = eng.submit(prompts[0], max_new_tokens=4, eos_token_id=None)
+        stale = eng.submit(prompts[1], max_new_tokens=4, eos_token_id=None,
+                           deadline_s=0.0)      # already in the past
+        while eng.pending:
+            eng.step()
+        assert eng.request(stale).state == "shed"
+        assert eng.request(stale).tokens == []
+        assert eng.stats()["shed"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(r0).output()),
+            dense_rows(params, cfg, [prompts[0]], [4])[0])
+        self._balanced(eng)
+
+    def test_cancel_racing_preemption(self, setup):
+        """ISSUE 6 satellite: cancel a request that is currently
+        preempted-and-queued. It holds no blocks (preemption freed them),
+        so the cancel must only dequeue it — free list + refcounts
+        balance, prefix-cache entries survive, and the survivors still
+        bit-match the dense oracle."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg, max_slots=3, num_blocks=10,
+                          prefix_cache=True)
+        rids = [eng.submit(p, max_new_tokens=n, eos_token_id=None)
+                for p, n in zip(prompts, outs)]
+        victim = None
+        while eng.pending:
+            eng.step()
+            preempted = [r for r in eng._sched.queue if r.preemptions]
+            if victim is None and preempted:
+                victim = preempted[0].rid
+                assert eng.cancel(victim) is True
+        assert victim is not None, "trace never preempted — not a race"
+        assert eng.request(victim).state == "cancelled"
+        st = eng.stats()
+        assert st["free_blocks"] == 9            # accounting balanced
+        assert st["cached_blocks"] >= 0
+        for rid, p, n in zip(rids, prompts, outs):
+            if rid != victim:
+                np.testing.assert_array_equal(
+                    np.asarray(eng.request(rid).output()),
+                    dense_rows(params, cfg, [p], [n])[0])
+        # registered prefix blocks survived the cancel: re-running the
+        # cancelled prompt hits the cache and still matches the oracle
+        before_hits = st["prefix_hit_tokens"]
+        idx = rids.index(victim)
+        out = eng.run([prompts[idx]], max_new_tokens=outs[idx],
+                      eos_token_id=None)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            dense_rows(params, cfg, [prompts[idx]], [outs[idx]])[0])
+        assert eng.stats()["prefix_hit_tokens"] >= before_hits
+
+    def test_cancel_mid_chunked_prefill(self, setup):
+        """ISSUE 6 satellite: cancel a request that is mid-chunked-
+        prefill. Its partially-filled blocks return to the pool, its
+        already-registered full prefix blocks stay cached (evictable,
+        still hittable), and co-scheduled requests are unaffected."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=2, prefill_chunk=4)
+        short = eng.submit(prompts[1][:5], max_new_tokens=10,
+                           eos_token_id=None)
+        eng.step()                                   # short decoding
+        long_rid = eng.submit(prompts[2], max_new_tokens=4,
+                              eos_token_id=None)     # 12 tokens: 3 chunks
+        eng.step()                                   # first chunk done
+        live = {r.rid: r for r in eng._sched.live}
+        assert long_rid in live and live[long_rid].prefilling
+        cached_before = eng.stats()["cached_blocks"]
+        assert eng.cancel(long_rid) is True
+        while eng.pending:
+            eng.step()
+        assert eng.request(long_rid).state == "cancelled"
+        st = eng.stats()
+        assert st["free_blocks"] == eng.cache.manager.num_blocks - 1
+        assert st["cached_blocks"] >= cached_before  # entries survived
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(short).output()),
+            dense_rows(params, cfg, [prompts[1][:5]], [10])[0])
+
+    def test_finished_request_never_reclassified_timed_out(self, setup):
+        """A request that already FINISHED but sits un-retired in its slot
+        (the oom-truncation path retires at the NEXT step) must keep its
+        completed record even when its deadline expires in between — the
+        work is done; expiry cannot turn success into timed_out."""
+        cfg, params, prompts, _ = setup
+        p = prompts[1][:6]
+        eng = make_engine(params, cfg, max_slots=1, num_blocks=4)
+        rid = eng.submit(p, max_new_tokens=24, eos_token_id=None,
+                         timeout_s=3600.0)
+        truncated = False
+        while eng.pending:
+            eng.step()
+            live = eng._sched.live
+            if live and live[0].oom_truncated and not truncated:
+                truncated = True          # finished, not yet retired:
+                live[0].deadline = 0.0    # force the deadline race
+        assert truncated
+        req = eng.request(rid)
+        assert req.state == "finished" and req.oom_truncated
+        assert eng.stats()["timed_out"] == 0
+        assert eng.stats()["free_blocks"] == eng.cache.manager.num_blocks - 1
+
+    def test_cancel_racing_retirement_returns_false(self, setup):
+        """Same finished-but-unswept window, raced by cancel() instead of
+        a deadline: the cancel must report False and the request retires
+        as the completed work it is."""
+        cfg, params, prompts, _ = setup
+        p = prompts[1][:6]
+        eng = make_engine(params, cfg, max_slots=1, num_blocks=4)
+        rid = eng.submit(p, max_new_tokens=24, eos_token_id=None)
+        raced = False
+        while eng.pending:
+            eng.step()
+            live = eng._sched.live
+            if live and live[0].oom_truncated and not raced:
+                raced = True
+                assert eng.cancel(rid) is False     # finished first
+        assert raced
+        req = eng.request(rid)
+        assert req.state == "finished" and req.oom_truncated
+        assert eng.stats()["cancelled"] == 0
+        assert eng.stats()["retired"] == 1
+        assert eng.stats()["free_blocks"] == eng.cache.manager.num_blocks - 1
+
+    def test_run_returns_partial_output_for_terminated(self, setup):
+        """run() must not hang when a request reaches a non-finished
+        terminal state — the partial result comes back in order."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=1)
+        outs = eng.run([prompts[0], prompts[1]], max_new_tokens=4,
+                       eos_token_id=None)
+        assert len(outs) == 2                      # sanity on the API
+
+    def test_lifecycle_fuzz_accounting(self, setup):
+        """Randomized cancel/timeout/shed interleaving (ISSUE 6 extension
+        of the BlockManager fuzz): after every step the pool's free +
+        evictable + in-use partition must hold, and after the storm the
+        engine still serves a fresh request bit-identically."""
+        cfg, params, prompts, _ = setup
+        rng = np.random.default_rng(7)
+        eng = make_engine(params, cfg, max_slots=3, num_blocks=12,
+                          prefill_chunk=4, queue_depth=16)
+        bm = eng.cache.manager
+        usable = bm.num_blocks - 1
+        live_rids = []
+        for i in range(60):
+            op = rng.integers(0, 4)
+            if op == 0 and len(eng._sched.queue) < 15:
+                p = prompts[int(rng.integers(0, len(prompts)))]
+                kw = {}
+                if rng.integers(0, 3) == 0:
+                    kw["timeout_s"] = float(rng.uniform(0.0, 0.02))
+                try:
+                    live_rids.append(eng.submit(
+                        p, max_new_tokens=int(rng.integers(1, 10)),
+                        eos_token_id=None,
+                        tenant=f"t{int(rng.integers(0, 3))}", **kw))
+                except Exception:
+                    pass
+            elif op == 1 and live_rids:
+                eng.cancel(int(rng.choice(live_rids)))
+            elif eng.pending:
+                eng.step()
+            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
+            assert total == usable, f"leak at iter {i}: {total}"
+        while eng.pending:
+            eng.step()
+        assert bm.blocks_in_use == 0
+        assert eng.stats()["free_blocks"] == usable
+        out = eng.run([prompts[0]], max_new_tokens=5, eos_token_id=None)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out), dense_rows(params, cfg, [prompts[0]], [5])[0])
+
+
+class TestAdmissionPolicies:
+    """The ISSUE 6 policy layer: FIFO stays the default parity oracle;
+    priority / fair-share / EDF reorder ADMISSION only — per-request
+    outputs are identical under every policy."""
+
+    def _sched(self, cfg, policy, **kw):
+        from paddle_tpu.inference.serving import PagedKVCache, Scheduler
+        base = dict(max_slots=1, max_model_len=16, block_size=4)
+        cache = PagedKVCache(cfg, **base)
+        return Scheduler(cache, 1, 16, policy=policy, **kw)
+
+    def _req(self, **kw):
+        from paddle_tpu.inference.serving import Request
+        base = dict(rid=-1, prompt=np.zeros((4,), np.int32),
+                    max_new_tokens=2)
+        base.update(kw)
+        return Request(**base)
+
+    def test_default_policy_is_fifo(self, setup):
+        cfg, params, _, _ = setup
+        eng = make_engine(params, cfg)
+        assert eng.stats()["policy"] == "fifo"
+
+    def test_priority_classes(self, setup):
+        from paddle_tpu.inference.serving import PriorityPolicy
+        cfg, _, _, _ = setup
+        s = self._sched(cfg, PriorityPolicy())
+        lo1 = self._req(priority=0)
+        hi = self._req(priority=5)
+        lo2 = self._req(priority=0)
+        for r in (lo1, hi, lo2):
+            s.submit(r)
+        assert s.next_admission() is hi            # class first
+        s.finish(hi)
+        assert s.next_admission() is lo1           # FIFO within class
+        s.finish(lo1)
+        assert s.next_admission() is lo2
+
+    def test_edf_orders_by_deadline(self, setup):
+        import time as _t
+        from paddle_tpu.inference.serving import EDFPolicy
+        cfg, _, _, _ = setup
+        now = _t.time()
+        s = self._sched(cfg, EDFPolicy())
+        loose = self._req(deadline=now + 100)
+        tight = self._req(deadline=now + 1)
+        none = self._req()                         # no deadline: sorts last
+        for r in (none, loose, tight):
+            s.submit(r)
+        assert s.next_admission() is tight
+        s.finish(tight)
+        assert s.next_admission() is loose
+        s.finish(loose)
+        assert s.next_admission() is none
+
+    def test_edf_default_slo_orders_slo_less_requests(self, setup):
+        """With a default TTFT SLO, submission order becomes the deadline
+        order for SLO-less requests — EDF degrades to FIFO, not chaos."""
+        from paddle_tpu.inference.serving import EDFPolicy
+        cfg, _, _, _ = setup
+        s = self._sched(cfg, EDFPolicy(default_ttft_slo_s=1.0))
+        a, b = self._req(), self._req()
+        s.submit(a)
+        s.submit(b)
+        assert s.next_admission() is a
+
+    def test_fair_share_across_tenants(self, setup):
+        from paddle_tpu.inference.serving import FairSharePolicy
+        cfg, _, _, _ = setup
+        s = self._sched(cfg, FairSharePolicy())
+        flood = [self._req(tenant="flood") for _ in range(3)]
+        quiet = self._req(tenant="quiet")
+        for r in flood:
+            s.submit(r)
+        s.submit(quiet)                            # submitted LAST
+        first = s.next_admission()
+        s.finish(first)
+        second = s.next_admission()
+        # after one flood admission, flood has served tokens and quiet has
+        # none: the quiet tenant admits next despite arriving last
+        assert first.tenant == "flood" and second is quiet
+
+    def test_fair_share_weights(self, setup):
+        from paddle_tpu.inference.serving import FairSharePolicy
+        cfg, _, _, _ = setup
+        s = self._sched(cfg, FairSharePolicy(weights={"big": 100.0}))
+        a = self._req(tenant="small")
+        b = self._req(tenant="big")
+        s.submit(a)
+        s.submit(b)
+        s.tenant("small")["service_tokens"] = 10
+        s.tenant("big")["service_tokens"] = 100    # 100/100 = 1 < 10/1
+        assert s.next_admission() is b
+
+    def test_preempted_request_outranks_policy_pick(self, setup):
+        """A preempted request re-queued at the front readmits ahead of
+        ANY policy pick — the no-livelock contract survives the policy
+        layer."""
+        from paddle_tpu.inference.serving import PriorityPolicy
+        cfg, _, _, _ = setup
+        s = self._sched(cfg, PriorityPolicy())
+        a = self._req(priority=0)
+        s.submit(a)
+        sa = s.next_admission()
+        assert sa is a
+        s.preempt(a)                               # back at the queue front
+        hi = self._req(priority=99)
+        s.submit(hi)
+        assert s.next_admission() is a             # not the priority pick
+
+    @pytest.mark.parametrize("policy", ["priority", "fair", "edf"])
+    def test_policy_outputs_match_fifo_oracle(self, setup, policy):
+        """Admission order must never change a request's tokens: every
+        policy serves the mixed trace bit-identically to the dense
+        oracle (and hence to the FIFO engine)."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg, policy=policy)
+        for i, (p, n) in enumerate(zip(prompts, outs)):
+            eng.submit(p, max_new_tokens=n, eos_token_id=None,
+                       tenant=f"t{i % 3}", priority=i % 2)
+        while eng.pending:
+            eng.step()
+        want = dense_rows(params, cfg, prompts, outs)
+        for rid, w in enumerate(want):
+            np.testing.assert_array_equal(
+                np.asarray(eng.request(rid).output()), w)
+        assert eng.stats()["policy"] == policy
+        assert eng.stats()["decode_traces"] == 1
+
+    def test_policy_resolves_from_flag(self):
+        """ServingConfig(policy=None) must honor FLAGS_serving_policy —
+        the fleet-wide default — not silently hard-code FIFO."""
+        from paddle_tpu.flags import set_flags
+        from paddle_tpu.inference.serving import ServingConfig
+        set_flags({"FLAGS_serving_policy": "edf"})
+        try:
+            sc = ServingConfig(block_size=4, max_slots=2, max_model_len=16,
+                               decode_chunk=2, queue_depth=8)
+            assert sc.policy == "edf"
+        finally:
+            set_flags({"FLAGS_serving_policy": "fifo"})
+        sc = ServingConfig(block_size=4, max_slots=2, max_model_len=16,
+                           decode_chunk=2, queue_depth=8)
+        assert sc.policy == "fifo"
+
+    def test_policy_resolution(self):
+        from paddle_tpu.inference.serving import (EDFPolicy, FairSharePolicy,
+                                                  FIFOPolicy, resolve_policy)
+        assert isinstance(resolve_policy(None), FIFOPolicy)
+        assert isinstance(resolve_policy("fair_share"), FairSharePolicy)
+        edf = resolve_policy("edf", ttft_slo_s=2.5)
+        assert isinstance(edf, EDFPolicy)
+        assert edf.default_ttft_slo_s == 2.5
+        custom = FairSharePolicy(weights={"a": 2.0})
+        assert resolve_policy(custom) is custom
+        with pytest.raises(ValueError, match="policy"):
+            resolve_policy("lifo")
+
+    def test_queue_full_shed_carries_context(self, setup):
+        """ISSUE 6 satellite: ServingQueueFull is structured — queue
+        depth, live slots, and a retry-after hint for the caller's
+        backoff — and counts as shed load."""
+        from paddle_tpu.inference.serving import ServingQueueFull
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, queue_depth=2, max_slots=1)
+        for _ in range(2):
+            eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        with pytest.raises(ServingQueueFull) as ei:
+            eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        e = ei.value
+        assert e.queue_depth == 2 and e.live_slots == 0
+        assert e.retry_after_s is None             # no retirement seen yet
+        assert "shed" in str(e)
+        assert eng.stats()["shed"] == 1
+        while eng.pending:
+            eng.step()
+        with pytest.raises(ServingQueueFull):      # hint now measurable
+            for _ in range(4):
+                eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        while eng.pending:
+            eng.step()
+        assert eng._sched.retry_after_s() is not None
+
+
+class TestTenantCacheQuota:
+    def test_block_manager_quota_recycles_own_entries(self):
+        from paddle_tpu.inference.serving import BlockManager
+        bm = BlockManager(num_blocks=12, block_size=4, tenant_quota=2)
+        sys_blocks = bm.alloc(2)
+        for i, b in enumerate(sys_blocks):
+            bm.register(100 + i, b, tokens=(i,), tenant="sys")
+        bm.free(sys_blocks)                        # refcount-0, cached
+        spam = bm.alloc(4)
+        for i, b in enumerate(spam):
+            bm.register(200 + i, b, tokens=(50 + i,), tenant="spam")
+        bm.free(spam)
+        # spam registered 4 but holds at most its quota of 2 entries
+        assert bm.tenant_cached("spam") <= 2
+        assert bm.tenant_cached("sys") == 2        # untouched by the flood
+        for i in range(2):
+            assert bm.lookup(100 + i, (i,)) is not None
+        total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
+        assert total == 11                         # accounting balanced
+
+    def test_quota_skips_when_all_entries_pinned(self):
+        """At quota with every entry still referenced there is nothing of
+        the tenant's to recycle: the new registration is skipped, never
+        another tenant's entry evicted."""
+        from paddle_tpu.inference.serving import BlockManager
+        bm = BlockManager(num_blocks=12, block_size=4, tenant_quota=1)
+        held = bm.alloc(1)
+        bm.register(1, held[0], tokens=(1,), tenant="t")   # pinned (ref 1)
+        extra = bm.alloc(1)
+        bm.register(2, extra[0], tokens=(2,), tenant="t")  # over quota
+        assert bm.lookup(2, (2,)) is None          # skipped
+        assert bm.tenant_cached("t") == 1
+        bm.free(held)
+        bm.free(extra)
+
+    def test_engine_quota_preserves_other_tenants_prefix(self, setup):
+        """The system-prompt protection story end to end: a quota'd spam
+        tenant churns unique prompts; the sys tenant's shared prefix must
+        still HIT afterwards (and stay bit-exact)."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, max_slots=2, max_model_len=32,
+                          tenant_cache_quota=2, queue_depth=32)
+        sys_p = prompts[2]                         # 12 tokens: 3 full blocks
+        eng.run([sys_p], max_new_tokens=2, eos_token_id=None)
+        rng = np.random.default_rng(11)
+        spam = [rng.integers(0, 97, (12,)).astype(np.int32)
+                for _ in range(8)]
+        for p in spam:
+            eng.submit(p, max_new_tokens=2, eos_token_id=None,
+                       tenant="spam")
+        while eng.pending:
+            eng.step()
+        assert eng.cache.manager.tenant_cached("spam") <= 2
+        before = eng.stats()["prefix_hit_tokens"]
+        out = eng.run([sys_p], max_new_tokens=4, eos_token_id=None)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out), dense_rows(params, cfg, [sys_p], [4])[0])
+        assert eng.stats()["prefix_hit_tokens"] > before   # still cached
+
+
+class TestServingWatchdog:
+    def test_frozen_decode_names_serving_section(self, setup):
+        """ISSUE 6 satellite: with the global hang watchdog installed, a
+        frozen decode dispatch is diagnosed as 'serving.decode' — the
+        same naming contract training sections have."""
+        import time as _t
+        from paddle_tpu.health import watchdog
+        cfg, params, prompts, _ = setup
+        # prefix cache OFF + identical warm shapes: the frozen run must
+        # compile NOTHING (a cold compile would fire the watchdog inside
+        # 'serving.prefill' first and the once-only report would be spent)
+        eng = make_engine(params, cfg, prefix_cache=None)
+        eng.run([prompts[1]], max_new_tokens=2, eos_token_id=None)
+        diagnoses = []
+        real = eng._jdecode
+
+        def frozen(*a, **kw):
+            _t.sleep(0.6)
+            return real(*a, **kw)
+
+        eng._jdecode = frozen
+        wd = watchdog.install(timeout=0.2, on_hang=diagnoses.append)
+        try:
+            eng.run([prompts[1]], max_new_tokens=4, eos_token_id=None)
+            assert wd.fired.wait(2.0)
+        finally:
+            watchdog.uninstall()
+        assert diagnoses and "serving.decode" in diagnoses[0]
+        snap = eng.health_snapshot()               # watchdog uninstalled
+        assert snap["watchdog"]["installed"] is False
+
+    def test_snapshot_reflects_fired_watchdog(self, setup):
+        from paddle_tpu.health import watchdog
+        cfg, params, _, _ = setup
+        eng = make_engine(params, cfg)
+        wd = watchdog.install(timeout=0.05, on_hang=lambda d: None)
+        try:
+            assert wd.fired.wait(2.0)              # idle process: it fires
+            snap = eng.health_snapshot()
+            assert snap["ok"] is False
+            assert snap["watchdog"]["fired"] is True
+        finally:
+            watchdog.uninstall()
+
+
+class TestStreamAbandonment:
+    def test_closed_stream_cancels_and_frees(self, setup):
+        """ISSUE 6 satellite: a consumer that closes (or GCs) the stream
+        generator mid-drain must not leak pool blocks — the remaining
+        requests are cancelled and the engine keeps serving."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg)
+        for p, n in zip(prompts[:4], outs[:4]):
+            eng.submit(p, max_new_tokens=n, eos_token_id=None)
+        gen = eng.stream()
+        for _ in range(3):
+            next(gen)                              # consume a few tokens
+        gen.close()                                # consumer walks away
+        assert not eng.pending                     # nothing left queued
+        st = eng.stats()
+        assert st["cancelled"] >= 1
+        assert st["free_blocks"] == eng.cache.manager.num_blocks - 1
+        # the engine is still healthy: a fresh request serves bit-exact
+        out = eng.run([prompts[0]], max_new_tokens=4, eos_token_id=None)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out), dense_rows(params, cfg, [prompts[0]], [4])[0])
+
+    def test_fully_drained_stream_cancels_nothing(self, setup):
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg)
+        eng.submit(prompts[0], max_new_tokens=3, eos_token_id=None)
+        toks = [t for _, t in eng.stream()]
+        assert len(toks) == 3
+        assert eng.stats()["cancelled"] == 0
+
+
+class TestHealthSnapshot:
+    def test_snapshot_shape_and_tenant_breakdown(self, setup):
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, queue_depth=8)
+        for i, p in enumerate(prompts[:4]):
+            eng.submit(p, max_new_tokens=3, eos_token_id=None,
+                       tenant="a" if i % 2 else "b")
+        while eng.pending:
+            eng.step()
+        snap = eng.health_snapshot()
+        assert snap["ok"] is True and snap["accepting"] is True
+        assert snap["policy"] == "fifo"
+        assert snap["queued"] == 0 and snap["live_slots"] == 0
+        assert snap["free_blocks"] == snap["usable_blocks"]
+        assert set(snap["tenants"]) == {"a", "b"}
+        for t in snap["tenants"].values():
+            assert t["retired"] == 2 and t["shed"] == 0
+            assert t["ttft_p50_s"] is not None
+            assert t["ttft_p99_s"] >= t["ttft_p50_s"]
+        assert snap["counters"]["retired"] == 4
+        import json
+        json.dumps(snap)                           # must be serializable
+        # the payload is pinned to the registry docs/OPS.md is generated
+        # from — a field added to one without the other fails here
+        from paddle_tpu.inference.serving.engine import \
+            HEALTH_SNAPSHOT_FIELDS
+        assert set(snap) == set(HEALTH_SNAPSHOT_FIELDS)
+
+    def test_snapshot_folds_overflow_tenants(self, setup):
+        """Past MAX_TENANTS distinct tenant keys, new tenants aggregate
+        under the overflow record — including their queued/live counts,
+        so an ops dashboard still sees the attack traffic."""
+        from paddle_tpu.inference.serving import Scheduler
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, queue_depth=512)
+        old = Scheduler.MAX_TENANTS
+        Scheduler.MAX_TENANTS = 2
+        try:
+            for i in range(4):
+                eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None,
+                           tenant=f"mint-{i}")
+            snap = eng.health_snapshot()
+            ov = snap["tenants"][Scheduler._OVERFLOW_TENANT]
+            assert ov["submitted"] >= 2
+            assert ov["queued"] >= 1          # folded, not reported as 0
+        finally:
+            Scheduler.MAX_TENANTS = old
+        while eng.pending:
+            eng.step()
+
+    def test_snapshot_not_accepting_when_queue_full(self, setup):
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, queue_depth=1, max_slots=1)
+        eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        assert eng.health_snapshot()["accepting"] is False
+        while eng.pending:
+            eng.step()
+        assert eng.health_snapshot()["accepting"] is True
