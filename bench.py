@@ -903,7 +903,15 @@ def bench_serve(backend):
     bit-match the dense oracle (asserted), and goodput (SLO-met tokens/s)
     is reported for the driver round — not asserted in-section, since the
     shed volume tracks wall-clock against FIFO-calibrated SLOs and a
-    loaded host swings it either way."""
+    loaded host swings it either way.
+
+    The ISSUE 7 FRONT-LINE row serves a mini trace through the asyncio
+    server (in-process transport) with an ``engine_crash`` injected
+    mid-trace: the supervisor must restart the engine (no recompile —
+    shared EnginePrograms), resubmit, keep every stream bit-identical to
+    the dense oracle, and drain with zero leaked blocks (all asserted);
+    the overload burst above must additionally register as a scale-up on
+    the autoscale hook (asserted)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.inference.serving import ServingConfig, ServingEngine
@@ -1100,6 +1108,8 @@ def bench_serve(backend):
     ov_oracle = np.asarray(G.generate(params, jnp.asarray(
         np.stack(ov_prompts)), cfg, max_new_tokens=ov_out))
 
+    from paddle_tpu.inference.serving import autoscale_signal
+
     def run_overload(policy, slos=None):
         eng = ServingEngine(params, cfg, ServingConfig(
             block_size=blk, max_slots=ov_slots, max_model_len=mlen,
@@ -1111,15 +1121,20 @@ def bench_serve(backend):
             p, max_new_tokens=ov_out, eos_token_id=None,
             timeout_s=None if slos is None else slos[i])
             for i, p in enumerate(ov_prompts)]
+        # the telemetry an autoscaler consumes, read MID-BURST (ISSUE 7):
+        # a 2x-capacity queue must register as a scale-up recommendation
+        mid_sig = autoscale_signal(eng.health_snapshot())
         while eng.pending:
             eng.step()
-        return eng, [eng.request(r) for r in rids], time.time() - t0
+        return eng, [eng.request(r) for r in rids], time.time() - t0, \
+            mid_sig
 
-    _, fifo_reqs, fifo_mk = run_overload("fifo")
+    _, fifo_reqs, fifo_mk, _ = run_overload("fifo")
     slo_classes = np.tile([fifo_mk / 8, fifo_mk / 4, fifo_mk / 2,
                            4 * fifo_mk], ov_n // 4 + 1)[:ov_n]
     rng.shuffle(slo_classes)
-    eng_ov, edf_reqs, edf_mk = run_overload("edf", slos=slo_classes)
+    eng_ov, edf_reqs, edf_mk, ov_sig = run_overload("edf",
+                                                    slos=slo_classes)
 
     def served(reqs):
         return [r for r in reqs if r.state == "finished"]
@@ -1142,6 +1157,38 @@ def bench_serve(backend):
     ov_shed = ovst["shed"] + ovst["timed_out"]
     fifo_good = good_tok_s(fifo_reqs, fifo_mk)
     edf_good = good_tok_s(edf_reqs, edf_mk)
+
+    # ---- front-line row: asyncio server + supervised engine (ISSUE 7) --
+    # a mini trace served THROUGH the asyncio front line (in-process
+    # port-free transport, same handler the TCP/SSE path serializes) with
+    # an engine crash injected mid-trace: the supervisor must rebuild
+    # without recompiling (shared EnginePrograms), resubmit every
+    # non-terminal request, keep every streamed output bit-identical to
+    # the dense oracle, then drain clean on close() — zero leaked blocks
+    from paddle_tpu.inference.serving import (EngineSupervisor,
+                                              ServingServer, serve_requests)
+    from paddle_tpu.testing.chaos import engine_crash
+    if backend == "tpu":
+        fl_n, fl_out = 8, 16
+    else:
+        fl_n, fl_out = 6, 8
+    fl_prompts = [rng.integers(0, cfg.vocab_size,
+                               (ov_plen,)).astype(np.int32)
+                  for _ in range(fl_n)]
+    fl_oracle = np.asarray(G.generate(params, jnp.asarray(
+        np.stack(fl_prompts)), cfg, max_new_tokens=fl_out))
+    # same shape signature as the overload engines -> reuse the compiled
+    # programs (the supervisor's own restart-sharing mechanism)
+    sup = EngineSupervisor(params, cfg, ServingConfig(
+        block_size=blk, max_slots=ov_slots, max_model_len=mlen,
+        decode_chunk=chunk, queue_depth=fl_n, prefix_cache=None),
+        programs=eng_ov.programs)
+    engine_crash(sup, at_step=3)          # fires mid-trace under the pump
+    fl = serve_requests(ServingServer(sup), fl_prompts,
+                        max_new_tokens=fl_out, eos_token_id=None)
+    fl_s, fl_report = fl["elapsed_s"], fl["drain_report"]
+    fl_match = all(np.array_equal(np.asarray(o, np.int32), fl_oracle[i])
+                   for i, o in enumerate(fl["outputs"]))
 
     return {
         "serving_tok_s": round(serving_tok_s, 1),
@@ -1186,6 +1233,20 @@ def bench_serve(backend):
         "overload_outputs_match": bool(ov_match(fifo_reqs) and
                                        ov_match(edf_reqs)),
         "overload_edf_decode_traces": ovst["decode_traces"],
+        # autoscale telemetry read mid-burst (ISSUE 7 acceptance: the
+        # overload burst must register as a scale-up recommendation)
+        "autoscale_action": ov_sig["action"],
+        "autoscale_queue_pressure": ov_sig["queue_pressure"],
+        # front-line row (ISSUE 7): crash-under-server recovery proof
+        "frontline_requests": fl_n,
+        "frontline_outputs_match": bool(fl_match),
+        "frontline_restarts": sup.restarts,
+        "frontline_resubmitted": sup.resubmitted,
+        "frontline_tok_s": round(fl_n * fl_out / fl_s, 1),
+        "frontline_drain_completed": fl_report["completed"]
+        if fl_report else None,
+        "frontline_leaked_blocks": fl_report["leaked_blocks"]
+        if fl_report else None,
     }
 
 
@@ -1351,12 +1412,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0, "health": 45.0, "serve": 115.0} if _warm else
+                  "input": 20.0, "health": 45.0, "serve": 130.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0, "health": 90.0, "serve": 195.0})
+                  "input": 30.0, "health": 90.0, "serve": 210.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -1557,6 +1618,20 @@ def main():
             assert s["overload_edf_p99_ttft_ms"] < \
                 s["overload_fifo_p99_ttft_ms"], \
                 "EDF did not beat FIFO on p99 TTFT under overload"
+            # front-line row (ISSUE 7): an engine crash under the asyncio
+            # server must recover bit-exactly (supervisor rebuild +
+            # resubmit), drain clean, and the overload burst must read as
+            # a scale-up to the autoscale hook
+            assert s["frontline_outputs_match"], \
+                "front-line streams diverged from the dense oracle"
+            assert s["frontline_restarts"] >= 1, \
+                "front-line row finished without exercising the crash " \
+                "barrier"
+            assert s["frontline_leaked_blocks"] == 0, \
+                f"drain leaked {s['frontline_leaked_blocks']} KV blocks"
+            assert s["autoscale_action"] == "scale_up", \
+                f"overload burst read as {s['autoscale_action']}, " \
+                f"not scale_up"
             # goodput ("no worse" is the row's other half) is EMITTED but
             # not asserted: the EDF pass's shed volume tracks wall-clock
             # vs the FIFO-calibrated SLOs, so on a loaded CI host EDF

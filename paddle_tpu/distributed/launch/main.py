@@ -26,7 +26,7 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["main", "launch_procs"]
+__all__ = ["main", "launch_procs", "write_rejoin_file", "read_rejoin_count"]
 
 
 def _free_port() -> int:
@@ -206,6 +206,25 @@ def _check_rejoin(path) -> int:
         return int(txt) if txt else 10 ** 9
     except (OSError, ValueError):
         return 10 ** 9
+
+
+# the launcher owns the rejoin-file format; these are the public spellings
+# other layers use — the serving supervisor's autoscale_signal() writes a
+# scale-up through write_rejoin_file so a watching launcher scales out
+read_rejoin_count = _check_rejoin
+
+
+def write_rejoin_file(path: str, workers: Optional[int] = None) -> str:
+    """Write the ``--elastic_rejoin_file`` signal: an empty file means
+    "capacity is back, take what you need"; an integer is the offered
+    worker count. Written atomically (tmp + rename) so the watcher's
+    poll never reads a torn count."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        if workers is not None:
+            f.write(str(int(workers)))
+    os.replace(tmp, path)
+    return path
 
 
 def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0,

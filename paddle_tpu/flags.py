@@ -16,7 +16,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flags_table"]
 
 
 @dataclass
@@ -69,6 +69,20 @@ def flag(name: str, default: Any = _MISSING) -> Any:
             return default
         raise KeyError(name)
     return d.value
+
+
+def flags_table(names) -> List[str]:
+    """Markdown ``| flag | default | gates |`` rows for ``names``, straight
+    from the live registry (the help text's first sentence). The ONE
+    renderer behind every generated flag table (tools/refresh_docs.py and
+    ops/gen_docs.py), so docs/SERVING.md, docs/FAULT_TOLERANCE.md and
+    docs/OPS.md can never diverge in format."""
+    rows = ["| flag | default | gates |", "|------|---------|-------|"]
+    for name in names:
+        d = _registry[name]
+        first = d.help.split(". ")[0].rstrip(".") + "."
+        rows.append(f"| `{name}` | `{d.default}` | {first} |")
+    return rows
 
 
 def get_flags(names=None) -> Dict[str, Any]:
@@ -287,6 +301,33 @@ define_flag("FLAGS_serving_tenant_cache_quota", 0,
             "entry instead of LRU-evicting other tenants' (so one tenant "
             "flooding unique prompts cannot evict everyone's system "
             "prompt). 0 = unlimited.", int)
+
+# serving front line (ISSUE 7): asyncio server + engine supervisor
+define_flag("FLAGS_serving_max_restarts", 3,
+            "EngineSupervisor restart budget: unexpected step-loop "
+            "exceptions (or serving-section hang-watchdog trips) tear the "
+            "engine down, rebuild it and re-submit every non-terminal "
+            "request — past this many restarts the replica flips to "
+            "not-accepting (/readyz 503) instead of crash-looping "
+            "(docs/OPS.md runbook).", int)
+define_flag("FLAGS_serving_drain_deadline_s", 30.0,
+            "Graceful-drain deadline (s): on SIGTERM/close() the front "
+            "line stops admissions (structured 503 + retry_after_s), "
+            "finishes in-flight requests within this window, then cancels "
+            "the remainder. The launcher's PADDLE_PREEMPT_GRACE (minus a "
+            "2s margin) overrides when exported — the same preemption "
+            "window the emergency-checkpoint path uses.", float)
+define_flag("FLAGS_serving_client_queue", 64,
+            "Per-client event-buffer bound in the asyncio serving server. "
+            "A consumer that falls this many undelivered events behind is "
+            "DISCONNECTED and its request cancelled through the normal "
+            "lifecycle path (KV blocks freed immediately) — a stalled SSE "
+            "reader cannot pin pool blocks or host memory.", int)
+define_flag("FLAGS_serving_retry_after_s", 1.0,
+            "Conservative retry-after hint (s) returned to shed clients "
+            "BEFORE the engine has observed two retirements (cold start: "
+            "no retirement interval to estimate from); once measurable, "
+            "the mean recent retirement interval takes over.", float)
 
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "

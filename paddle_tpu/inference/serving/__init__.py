@@ -1,4 +1,4 @@
-"""Continuous-batching LLM serving (docs/SERVING.md).
+"""Continuous-batching LLM serving (docs/SERVING.md, docs/OPS.md).
 
 The high-traffic decode tier: a paged KV cache (block pool + per-slot block
 tables; ``models.generation`` holds the device math), an iteration-level
@@ -7,23 +7,36 @@ policy (FIFO / priority / weighted fair share / EDF — ``policies``), an
 overload-safe request lifecycle (cancel / timeout / deadline / shed, every
 terminal state freeing its KV blocks), and the :class:`ServingEngine` API
 (`submit()/step()/stream()/run()/cancel()/health_snapshot()`) that
-``inference.GenerationPredictor.serve`` rides. Benchmarked by
+``inference.GenerationPredictor.serve`` rides. The production front line
+sits on top (ISSUE 7): :class:`EngineSupervisor` (crash barrier, restart
+budget, bit-exact resubmission, graceful drain, TPOT/autoscale telemetry)
+and the asyncio :class:`ServingServer` (one event loop multiplexing many
+SSE-style streaming clients onto one supervised engine thread, with
+``/healthz`` / ``/readyz`` / ``/metrics`` endpoints). Benchmarked by
 ``bench.py --serve`` against the static-batch ``generate()`` baseline and
 driven through hostile-traffic faults by ``testing.chaos``'s serving
 injectors.
 """
 
-from .engine import ServingConfig, ServingEngine
+from .engine import (EnginePrograms, HEALTH_SNAPSHOT_FIELDS,
+                     SUPERVISOR_SNAPSHOT_KEYS, ServingConfig, ServingEngine)
 from .paged_cache import BlockManager, PagedKVCache
 from .policies import (AdmissionPolicy, EDFPolicy, FairSharePolicy,
                        FIFOPolicy, POLICIES, PriorityPolicy, resolve_policy)
 from .scheduler import (CANCELLED, FINISHED, QUEUED, RUNNING, SHED,
                         TERMINAL_STATES, TIMED_OUT, Request, Scheduler,
                         ServingQueueFull)
+from .server import ClientStream, ServingServer, serve_requests, sse_encode
+from .supervisor import (EngineSupervisor, FAILED, ServingUnavailable,
+                         TrackedRequest, autoscale_signal)
 
 __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
            "Scheduler", "Request", "ServingQueueFull",
            "AdmissionPolicy", "FIFOPolicy", "PriorityPolicy",
            "FairSharePolicy", "EDFPolicy", "POLICIES", "resolve_policy",
            "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "TIMED_OUT",
-           "SHED", "TERMINAL_STATES"]
+           "SHED", "TERMINAL_STATES", "FAILED",
+           "EngineSupervisor", "ServingUnavailable", "TrackedRequest",
+           "autoscale_signal", "ServingServer", "ClientStream",
+           "serve_requests", "sse_encode", "EnginePrograms",
+           "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS"]

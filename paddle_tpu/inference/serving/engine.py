@@ -66,6 +66,7 @@ API::
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -78,18 +79,22 @@ from .policies import resolve_policy
 from .scheduler import (CANCELLED, DEFAULT_TENANT, SHED,  # noqa: F401
                         TIMED_OUT, Request, Scheduler, ServingQueueFull)
 
-__all__ = ["ServingConfig", "ServingEngine", "HEALTH_SNAPSHOT_FIELDS"]
+__all__ = ["ServingConfig", "ServingEngine", "EnginePrograms",
+           "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS"]
 
 _UNSET = "unset"
 
-# field -> meaning for ServingEngine.health_snapshot(); docs/OPS.md's
-# generated table (ops.gen_docs) renders this, and the snapshot test pins
-# the live payload's keys to it, so the doc cannot drift from the code
+# field -> meaning for health_snapshot(); docs/OPS.md's generated table
+# (ops.gen_docs) renders this, and the snapshot test pins the live
+# payload's keys to it, so the doc cannot drift from the code. The engine
+# serves every field except SUPERVISOR_SNAPSHOT_KEYS, which the
+# EngineSupervisor layers on top (supervisor.health_snapshot()).
 HEALTH_SNAPSHOT_FIELDS = {
     "ok": "False only when the installed hang watchdog has fired "
           "(shedding is a healthy degraded mode, not unhealth)",
     "accepting": "whether a submit() right now would QUEUE rather than "
-                 "shed (queue below its bound)",
+                 "shed (queue below its bound; under a supervisor also "
+                 "requires not-draining and restart budget remaining)",
     "policy": "active admission policy name (fifo/priority/fair/edf)",
     "queued": "requests waiting for a slot",
     "queue_limit": "admission-queue bound; submits past it shed with "
@@ -100,8 +105,9 @@ HEALTH_SNAPSHOT_FIELDS = {
                    "evictable refcount-0 cached blocks)",
     "usable_blocks": "pool size excluding the reserved null block",
     "retry_after_s": "suggested client backoff when shedding: the mean "
-                     "recent retirement interval (None before two "
-                     "retirements)",
+                     "recent retirement interval (the conservative "
+                     "FLAGS_serving_retry_after_s default before two "
+                     "retirements exist to estimate from)",
     "counters": "lifetime totals: admitted / retired / cancelled / "
                 "timed_out / shed / preemptions / oom_truncated / "
                 "prefix_hit_tokens / evictions",
@@ -109,8 +115,40 @@ HEALTH_SNAPSHOT_FIELDS = {
                 "timeout_s",
     "tenants": "per-tenant breakdown: queued / live / submitted / "
                "admitted / retired / cancelled / timed_out / shed / "
-               "service_tokens / cached_blocks / ttft_p50_s / ttft_p99_s",
+               "service_tokens / cached_blocks / ttft_p50_s / ttft_p99_s "
+               "/ tpot_p50_s / tpot_p99_s (TPOT = mean inter-token decode "
+               "latency per request; percentiles over recent requests)",
+    "supervisor": "EngineSupervisor layer (supervisor snapshots only): "
+                  "restarts / restart_budget / broken / draining / "
+                  "accepting / resubmitted / recovered_tokens / completed "
+                  "/ crashes (most recent restart reasons)",
+    "autoscale": "autoscale_signal() record (supervisor snapshots only): "
+                 "action (scale_up/scale_in/hold) + reason + "
+                 "queue_pressure / utilization / shed_delta — the "
+                 "telemetry an autoscaler consumes, writable as the "
+                 "launcher's --elastic_rejoin_file format",
 }
+
+# snapshot fields only the EngineSupervisor adds; the engine-level payload
+# is HEALTH_SNAPSHOT_FIELDS minus these (the shape test pins both layers)
+SUPERVISOR_SNAPSHOT_KEYS = ("supervisor", "autoscale")
+
+
+@dataclasses.dataclass
+class EnginePrograms:
+    """The compiled prefill/chunk/decode executables plus the stats dict
+    and bucket set their trace-counter closures mutate. Shareable across
+    engine rebuilds with an IDENTICAL shape signature — the supervisor's
+    restart path hands the dead engine's programs to its replacement, so
+    crash recovery never recompiles (and the shared trace counters PROVE
+    it: decode_traces must not grow across a restart)."""
+
+    prefill: Any
+    chunk: Any
+    decode: Any
+    stats: Dict[str, int]
+    prefill_buckets: set
+    key: tuple          # shape signature; reuse under a different one raises
 
 
 @dataclasses.dataclass
@@ -184,7 +222,8 @@ class ServingEngine:
     """Continuous-batching greedy decode service over a causal-LM pytree."""
 
     def __init__(self, params, model_config, serving_config:
-                 Optional[ServingConfig] = None, gen_config=None):
+                 Optional[ServingConfig] = None, gen_config=None,
+                 programs: Optional[EnginePrograms] = None):
         import jax
 
         from ...models.generation import GenerationConfig
@@ -217,14 +256,42 @@ class ServingEngine:
         self._steps_left = np.zeros((M,), np.int32)
         self._done = np.ones((M,), bool)          # empty slots are inactive
         self._eos = np.full((M,), -1, np.int32)
-        self._stats = {"decode_traces": 0, "prefill_traces": 0,
-                       "chunk_prefill_traces": 0, "chunks": 0, "steps": 0}
-        self._prefill_buckets: set = set()
+        # every mutation (submit/cancel/step) and every snapshot read runs
+        # under this lock, so stats()/health_snapshot() are safe from ANY
+        # thread — the metrics endpoint polls while the engine thread
+        # serves, and a mid-step torn read (counters from one dispatch,
+        # slot table from the next) must be impossible. Reentrant: the
+        # stream() GeneratorExit path cancels while a step frame may still
+        # hold the lock on the same thread.
+        self._lock = threading.RLock()
         # widest token buffer one dispatch can emit per slot (a budget
         # never exceeds max_model_len KV entries, so neither can steps)
         self._out_width = int(self.config.max_model_len)
         self._jax = jax
-        self._jprefill, self._jchunk, self._jdecode = self._build(jax)
+        key = (model_config, self.config.block_size, self.config.max_slots,
+               self.config.max_model_len, self.config.quantize,
+               str(self.config.cache_dtype))
+        if programs is not None:
+            if programs.key != key:
+                raise ValueError(
+                    "EnginePrograms were compiled for a different engine "
+                    "shape; rebuild with programs=None")
+            # SHARED stats/buckets: trace counters keep accumulating in
+            # one place across rebuilds, proving recovery never retraces
+            self._stats = programs.stats
+            self._prefill_buckets = programs.prefill_buckets
+            self._jprefill, self._jchunk, self._jdecode = (
+                programs.prefill, programs.chunk, programs.decode)
+            self.programs = programs
+        else:
+            self._stats = {"decode_traces": 0, "prefill_traces": 0,
+                           "chunk_prefill_traces": 0, "chunks": 0,
+                           "steps": 0}
+            self._prefill_buckets = set()
+            self._jprefill, self._jchunk, self._jdecode = self._build(jax)
+            self.programs = EnginePrograms(
+                self._jprefill, self._jchunk, self._jdecode, self._stats,
+                self._prefill_buckets, key)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -318,11 +385,23 @@ class ServingEngine:
         ``live_slots`` / ``retry_after_s`` for the caller's backoff — when
         the bounded admission queue is full: the submit is SHED, not
         blocked."""
-        g = self._gen
         deadline = deadline_s
         if timeout_s is not None:
             t = time.time() + float(timeout_s)
             deadline = t if deadline is None else min(deadline, t)
+        req = self._make_request(prompt, max_new_tokens, eos_token_id,
+                                 tenant, priority, deadline)
+        with self._lock:
+            return self._sched.submit(req)
+
+    def _make_request(self, prompt, max_new_tokens, eos_token_id, tenant,
+                      priority, deadline,
+                      tokens: Sequence[int] = ()) -> Request:
+        """One Request from user-facing arguments — the single place
+        submit() and resubmit() resolve GenerationConfig defaults, the
+        eos "unset" sentinel and the tenant key, so fresh and
+        crash-recovered requests can never diverge in defaults."""
+        g = self._gen
         req = Request(
             rid=-1, prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens if max_new_tokens is not None
@@ -332,11 +411,39 @@ class ServingEngine:
             tenant=str(tenant) if tenant is not None else DEFAULT_TENANT,
             priority=int(priority),
             deadline=float(deadline) if deadline is not None else None)
+        req.tokens = [int(t) for t in tokens]
+        if req.tokens and req.eos_token_id is not None and \
+                req.tokens[-1] == req.eos_token_id:
+            req.eos_seen = True
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if req.prompt_len < 1:
             raise ValueError("prompt must contain at least one token")
-        return self._sched.submit(req)
+        return req
+
+    def resubmit(self, prompt, tokens: Sequence[int] = (),
+                 max_new_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = "unset",
+                 deadline: Optional[float] = None,
+                 tenant: Optional[str] = None, priority: int = 0) -> int:
+        """Re-queue a request recovered from a torn-down engine with the
+        tokens it had already emitted — the supervisor's restart path.
+        Rides the preemption-recompute machinery: prefill recomputes KV
+        for ``prompt + tokens[:-1]`` and decode resumes from the last
+        token, so greedy outputs are bit-identical to an uninterrupted
+        run and the already-delivered tokens are never re-emitted.
+        ``deadline`` is ABSOLUTE (the original request's). Bypasses the
+        queue-depth shed — everything resubmitted was already accepted
+        once, and the recovered set (old queue + old slots) can exceed
+        the admission bound by up to ``max_slots``."""
+        req = self._make_request(prompt, max_new_tokens, eos_token_id,
+                                 tenant, priority, deadline, tokens=tokens)
+        if req.finished:
+            raise ValueError(
+                f"request is already finished ({len(req.tokens)} tokens of "
+                f"{req.max_new_tokens}); record it, don't resubmit it")
+        with self._lock:
+            return self._sched.submit(req, enforce_bound=False)
 
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request: its remaining work is
@@ -348,24 +455,26 @@ class ServingEngine:
         reached a terminal state (or the rid is unknown) — cancellation
         is idempotent, racing a retirement is not an error. The partial
         output stays readable via :meth:`request`/``result``."""
-        req = self._sched.find(rid)
-        if req is None:
-            return False
-        if self._retire_if_finished(req):
-            return False             # its work completed first: not an error
-        self._terminate(req, CANCELLED)
-        return True
+        with self._lock:
+            req = self._sched.find(rid)
+            if req is None:
+                return False
+            if self._retire_if_finished(req):
+                return False         # its work completed first: not an error
+            self._terminate(req, CANCELLED)
+            return True
 
     def cancel_all(self) -> int:
         """Cancel every queued and running request (the abandoned-stream
         path); returns how many were cancelled."""
-        n = 0
-        for req in list(self._sched.queue) + self._sched.live:
-            if self._retire_if_finished(req):
-                continue
-            self._terminate(req, CANCELLED)
-            n += 1
-        return n
+        with self._lock:
+            n = 0
+            for req in list(self._sched.queue) + self._sched.live:
+                if self._retire_if_finished(req):
+                    continue
+                self._terminate(req, CANCELLED)
+                n += 1
+            return n
 
     def _retire_if_finished(self, req: Request) -> bool:
         """A request can sit FINISHED in its slot until the next step's
@@ -637,7 +746,7 @@ class ServingEngine:
         ``serving.decode`` section markers), so a frozen dispatch is
         named in the hang diagnosis exactly like a training section."""
         _watchdog.touch()
-        with _watchdog.section("serving.step"):
+        with self._lock, _watchdog.section("serving.step"):
             return self._step(max_iters)
 
     def _step(self, max_iters: Optional[int]) -> Dict[int, List[int]]:
@@ -772,9 +881,14 @@ class ServingEngine:
     def request(self, rid: int) -> Request:
         """The finished request record (tokens + latency timestamps +
         prefix-hit/preemption counters)."""
-        return self._sched.finished[rid]
+        with self._lock:
+            return self._sched.finished[rid]
 
     def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict[str, Any]:
         return {**self._stats,
                 "prefill_buckets": len(self._prefill_buckets),
                 "admitted": self._sched.admitted,
@@ -803,7 +917,13 @@ class ServingEngine:
         ``ok`` goes False only when the installed hang watchdog has fired
         (the engine itself degrades by shedding, which is healthy);
         ``accepting`` says whether a submit() right now would be queued
-        rather than shed."""
+        rather than shed. Safe to call from any thread — the whole
+        payload is built under the engine lock, so a metrics endpoint
+        polling mid-trace never sees a torn mid-step state."""
+        with self._lock:
+            return self._health_snapshot_locked()
+
+    def _health_snapshot_locked(self) -> Dict[str, Any]:
         sched = self._sched
         wd = _watchdog.current()
 
@@ -829,6 +949,7 @@ class ServingEngine:
         tenants = {}
         for name, t in sched.tenants.items():
             ttfts = list(t["ttfts"])
+            tpots = list(t["tpots"])
             tenants[name] = {
                 "queued": queued_by_tenant.get(name, 0),
                 "live": live_by_tenant.get(name, 0),
@@ -838,6 +959,10 @@ class ServingEngine:
                 "service_tokens": t["service_tokens"],
                 "cached_blocks": self.cache.manager.tenant_cached(name),
                 "ttft_p50_s": pct(ttfts, 50), "ttft_p99_s": pct(ttfts, 99),
+                # TPOT (time per output token): each retirement's mean
+                # inter-token decode latency is one sample, so the
+                # percentiles track the SLO a streaming client feels
+                "tpot_p50_s": pct(tpots, 50), "tpot_p99_s": pct(tpots, 99),
             }
         return {
             "ok": wd is None or not wd.fired.is_set(),

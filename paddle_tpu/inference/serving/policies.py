@@ -38,6 +38,15 @@ Policies only reorder ADMISSION. Greedy decode is deterministic per
 request, so any admission order yields bit-identical per-request outputs
 — ``tests/test_serving.py`` pins every shipped policy against the FIFO
 oracle.
+
+Across an :class:`~.supervisor.EngineSupervisor` restart (ISSUE 7) the
+same holds: resubmission re-queues survivors in original submission
+order, and each policy re-derives its order from request attributes
+(``priority`` / ``deadline`` / ``tenant``) that survive the rebuild.
+The one lossy input is fair share's ``service_tokens`` accounting, which
+restarts from zero with the fresh scheduler — a restart briefly levels
+the playing field rather than starving anyone, which is the safe
+direction to err.
 """
 
 from __future__ import annotations

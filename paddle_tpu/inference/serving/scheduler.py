@@ -49,6 +49,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...flags import flag
 from .policies import AdmissionPolicy, FIFOPolicy
 
 __all__ = ["Request", "Scheduler", "ServingQueueFull",
@@ -76,7 +77,9 @@ class ServingQueueFull(RuntimeError):
     * ``queue_depth`` — requests queued when the submit was refused
     * ``live_slots`` — decode slots currently occupied
     * ``retry_after_s`` — suggested backoff: the scheduler's estimate of
-      one retirement interval (None before any retirement is observed)
+      one retirement interval; before two retirements have been observed
+      (cold start — nothing to estimate from) it is the conservative
+      ``FLAGS_serving_retry_after_s`` default, never None/0
     """
 
     def __init__(self, message: str, queue_depth: Optional[int] = None,
@@ -177,9 +180,12 @@ class Request:
 
     @property
     def tok_latency_s(self) -> Optional[float]:
-        """Mean decode latency per token after the first (None for 1-token
-        requests)."""
-        if self.finish_t is None or len(self.tokens) < 2:
+        """Mean decode latency per token after the first — the request's
+        TPOT sample. None for 1-token requests and for crash-recovered
+        resubmissions (their first token predates this engine, so no
+        ``first_token_t`` exists to measure from)."""
+        if self.finish_t is None or self.first_token_t is None \
+                or len(self.tokens) < 2:
             return None
         return (self.finish_t - self.first_token_t) / (len(self.tokens) - 1)
 
@@ -219,12 +225,16 @@ class Scheduler:
         self.slots: List[Optional[Request]] = [None] * max_slots
         # finished-record retention is BOUNDED (a long-lived engine must
         # not leak every prompt it ever served): insertion-ordered dict,
-        # oldest evicted past queue_depth + max_slots — enough that one
-        # full run()/drain cycle (submit bounded by queue_depth) can
-        # always collect its results afterwards. Terminal records
+        # oldest evicted past queue_depth + 2*max_slots — the most
+        # requests that can be in flight at once (a supervisor crash
+        # resubmission bypasses the queue bound by up to max_slots, plus
+        # the slots themselves), so one mass termination (drain
+        # cancel_all) can never evict a record before the supervisor's
+        # sweep collects it, and one full run()/drain cycle can always
+        # collect its results afterwards. Terminal records
         # (cancelled/timed-out/shed) land here too.
         self.finished: Dict[int, Request] = {}
-        self.keep_finished = self.queue_depth + self.max_slots
+        self.keep_finished = self.queue_depth + 2 * self.max_slots
         self._next_rid = 0
         self._admit_seq = 0
         self.admitted = 0
@@ -240,8 +250,12 @@ class Scheduler:
         # live requests carrying a deadline — the engine skips the
         # per-step expiry sweep entirely while this is 0
         self.deadline_requests = 0
-        # recent retirement timestamps -> the retry-after estimate
+        # recent retirement timestamps -> the retry-after estimate; the
+        # conservative default covers the cold-start window before two
+        # retirements exist to measure an interval from
         self._finish_times: Deque[float] = deque(maxlen=16)
+        self.default_retry_after_s = float(
+            flag("FLAGS_serving_retry_after_s", 1.0))
         self.tenants: Dict[str, Dict] = {}
 
     # ---- per-tenant accounting ---------------------------------------------
@@ -258,16 +272,19 @@ class Scheduler:
                 "cancelled": 0, "timed_out": 0, "shed": 0,
                 "service_tokens": 0,
                 "ttfts": deque(maxlen=self.TTFT_SAMPLES),
+                "tpots": deque(maxlen=self.TTFT_SAMPLES),
             }
         return d
 
-    def retry_after_s(self) -> Optional[float]:
+    def retry_after_s(self) -> float:
         """Suggested backoff when shedding: the mean interval between the
         most recent retirements (one retirement frees one slot, which is
-        what drains one queued request). None until two retirements have
-        been observed."""
+        what drains one queued request). Before two retirements have been
+        observed there is no interval to estimate, so the conservative
+        ``FLAGS_serving_retry_after_s`` default is returned instead of a
+        degenerate None/0 a client would turn into a hot retry loop."""
         if len(self._finish_times) < 2:
-            return None
+            return self.default_retry_after_s
         span = self._finish_times[-1] - self._finish_times[0]
         if span <= 0:
             return 0.001
@@ -275,8 +292,13 @@ class Scheduler:
 
     # ---- lifecycle --------------------------------------------------------
 
-    def submit(self, req: Request) -> int:
-        if len(self.queue) >= self.queue_depth:
+    def submit(self, req: Request, enforce_bound: bool = True) -> int:
+        """Queue one request. ``enforce_bound=False`` bypasses the
+        queue-depth shed — the supervisor's crash-recovery resubmission
+        path, where every request was ALREADY accepted once and the
+        re-queued set (old queue + old slots) can legitimately exceed the
+        admission bound by up to ``max_slots``."""
+        if enforce_bound and len(self.queue) >= self.queue_depth:
             # SHED, don't queue: a bounded queue with a retry-after hint
             # keeps tail latency bounded under overload — an unbounded one
             # converts overload into unbounded TTFT for everyone
@@ -417,6 +439,8 @@ class Scheduler:
         t["service_tokens"] += len(req.tokens)    # decode work charged here
         if req.ttft_s is not None:
             t["ttfts"].append(req.ttft_s)
+        if req.tok_latency_s is not None:
+            t["tpots"].append(req.tok_latency_s)
 
     def terminate(self, req: Request, state: str) -> None:
         """Force a queued or running request into a terminal state —
@@ -443,6 +467,8 @@ class Scheduler:
         t = self.tenant(req.tenant)
         t[counter] += 1
         t["service_tokens"] += len(req.tokens)
+        if req.tok_latency_s is not None:     # timed-out/cancelled partials
+            t["tpots"].append(req.tok_latency_s)    # are real decode work
 
     def _release(self, req: Request) -> None:
         req.finish_t = time.time()

@@ -63,12 +63,91 @@ def generate(out_path: str = "docs/OPS.md") -> str:
               "",
               "`inference.serving.ServingEngine.health_snapshot()` "
               "(docs/SERVING.md \"Overload & multi-tenancy\") returns one "
-              "JSON-serializable record per call — the payload a "
-              "`/healthz` or metrics endpoint should serve:",
+              "JSON-serializable record per call — the payload the "
+              "serving endpoints below serve. "
+              "`EngineSupervisor.health_snapshot()` adds the "
+              "supervisor-level fields on top:",
               "",
               "| field | meaning |",
               "|---|---|"]
     lines += [f"| `{k}` | {v} |" for k, v in HEALTH_SNAPSHOT_FIELDS.items()]
+    # serving front line (ISSUE 7): endpoints + drain/restart runbook +
+    # the server flag table, all generated from the live registries so
+    # the runbook cannot drift from the code
+    from paddle_tpu.flags import flags_table, get_flags
+    lines += [
+        "",
+        "## Serving front line (`inference.serving.server`)",
+        "",
+        "`ServingServer` multiplexes any number of streaming clients "
+        "onto ONE supervised engine thread: submissions cross a "
+        "thread-safe command queue, token/finish events come back on "
+        "bounded per-client asyncio queues (SSE frames over the TCP "
+        "transport; dict events over the in-process transport the tier-1 "
+        "tests use). A consumer that falls `FLAGS_serving_client_queue` "
+        "events behind is disconnected and its request cancelled — KV "
+        "freed, nothing pinned.",
+        "",
+        "### Endpoints",
+        "",
+        "| endpoint | verb | serves | status |",
+        "|---|---|---|---|",
+        "| `/healthz` | GET | liveness: pump thread alive and the hang "
+        "watchdog quiet | 200 / 503 |",
+        "| `/readyz` | GET | readiness: accepting (not draining/closed) "
+        "AND engine restart budget intact AND queue below its bound | "
+        "200 / 503 |",
+        "| `/metrics` | GET | the full supervisor `health_snapshot()` "
+        "(fields above), incl. per-tenant TTFT/TPOT p50/p99 and the "
+        "`autoscale` recommendation | 200 |",
+        "| `/generate` | POST | SSE token stream for `{\"prompt\": "
+        "[ids], ...submit kwargs}`; 503 + `retry_after_s` while "
+        "draining/broken, 429 + `retry_after_s` when the bounded queue "
+        "sheds | 200 / 429 / 503 / 400 |",
+        "",
+        "### Restart runbook (engine supervision)",
+        "",
+        "The engine step loop runs under `EngineSupervisor`'s crash "
+        "barrier: an unexpected exception — or a hang-watchdog trip "
+        "naming a `serving.*` section — tears the engine down, rebuilds "
+        "it from the same params/config (reusing the compiled "
+        "`EnginePrograms`: recovery never recompiles), and re-submits "
+        "every non-terminal request (queued verbatim; running from "
+        "`prompt + tokens so far` on the preemption-recompute path — "
+        "greedy outputs stay bit-identical, no delivered token "
+        "repeats). Each recovery consumes one unit of the "
+        "`FLAGS_serving_max_restarts` budget; when it runs out the "
+        "replica flips BROKEN: `/readyz` 503, submits refused, in-flight "
+        "requests failed with partials readable. Page on: `restarts` "
+        "climbing (crash loop brewing), `broken: true` (replace the "
+        "replica), `watchdog.fired` (a dispatch hung).",
+        "",
+        "### Drain runbook (deploys / preemption)",
+        "",
+        "SIGTERM — forwarded by the elastic launcher on preemption "
+        "(`--preempt_grace`, exported as `PADDLE_PREEMPT_GRACE`) — or "
+        "`close()` starts a graceful drain: (1) admissions stop, new "
+        "submits get the structured 503 + `retry_after_s`; (2) in-flight "
+        "requests finish within the deadline "
+        "(`PADDLE_PREEMPT_GRACE - 2s` when the launcher set it, else "
+        "`FLAGS_serving_drain_deadline_s`); (3) the remainder is "
+        "cancelled, every KV block returns to the pool (the drain "
+        "report's `leaked_blocks` must read 0).",
+        "",
+        "### Autoscale hook",
+        "",
+        "`EngineSupervisor.autoscale_signal()` turns queue-depth / "
+        "shed-rate / slot-utilization telemetry into `scale_up` / "
+        "`scale_in` / `hold`, and can write the elastic launcher's "
+        "`--elastic_rejoin_file` format "
+        "(`distributed.launch.main.write_rejoin_file`: empty file = "
+        "take what you need, integer = offered worker count) so a "
+        "watching launcher scales the job out.",
+        "",
+        "### Server / serving flags",
+        ""]
+    lines += flags_table(sorted(n for n in get_flags()
+                                if n.startswith("FLAGS_serving_")))
     lines += ["",
               "## Op table",
               "",

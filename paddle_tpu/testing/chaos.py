@@ -31,6 +31,16 @@ injector               fault it models
                        corrupt neighbouring requests' outputs
 ``flood_tenant``       one tenant burst-submitting until the bounded
                        queue sheds — the noisy-neighbour overload fault
+``engine_crash``       the serving engine's step loop raising mid-trace
+                       (device error, host-side bug) — the supervisor
+                       must rebuild and resubmit bit-exactly
+``disconnect_mid_stream``  an asyncio front-line client that consumes a
+                       few SSE events then closes the connection — the
+                       server-side abandoned-stream cancel path
+``slow_client``        a front-line client reading slower than the
+                       engine produces: the bounded per-client buffer
+                       overflows and the server must disconnect it
+                       through engine.cancel (KV freed, not pinned)
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -53,7 +63,8 @@ from typing import Optional
 __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "stall_heartbeat", "kill_self", "nan_payload", "bad_sample",
            "dead_worker", "stalled_consumer", "poison_prompt",
-           "flood_tenant", "INJECTORS"]
+           "flood_tenant", "engine_crash", "disconnect_mid_stream",
+           "slow_client", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -327,6 +338,98 @@ def flood_tenant(engine, tenant: str, n: int, prompt_len: int = 8,
     return {"rids": rids, "shed": shed, "retry_after_s": hint}
 
 
+# ---------------------------------------------------------------------------
+# serving front-line injectors (inference.serving.server/supervisor; ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def engine_crash(target, at_step: int = 1,
+                 exc: Optional[BaseException] = None) -> BaseException:
+    """Arm the LIVE serving engine to raise from its step loop after
+    ``at_step`` more iterations — a device error or host-side bug landing
+    mid-trace. ``target`` is an :class:`EngineSupervisor` (or a bare
+    engine). The patch rides the engine instance, so it dies with the
+    crashed engine: the supervisor's rebuilt replacement runs clean, and
+    the recovery proof is that every request still finishes bit-identical
+    to an uninterrupted dense run with BlockManager accounting balanced.
+    Returns the armed exception (for matching in asserts)."""
+    eng = getattr(target, "engine", target)
+    err = exc if exc is not None else RuntimeError(
+        f"chaos: injected engine crash at step +{at_step}")
+    real = eng._step
+    state = {"calls": 0}
+
+    def crashing(max_iters=None):
+        state["calls"] += 1
+        if state["calls"] >= max(1, int(at_step)):
+            raise err
+        return real(max_iters)
+
+    eng._step = crashing
+    return err
+
+
+async def disconnect_mid_stream(server, prompt, events: int = 2,
+                                **submit_kwargs) -> dict:
+    """An asyncio front-line client that consumes ``events`` stream
+    events then CLOSES the stream (the SSE tab closed / TCP reset fault,
+    made deterministic). Closing must cancel the request through
+    ``engine.cancel`` so its KV blocks free immediately. Async — run
+    inside the loop the server is bound to. Returns ``{"events": n,
+    "rid": srid}``."""
+    gen = server.agenerate(prompt, **submit_kwargs)
+    got, rid = 0, None
+    try:
+        async for ev in gen:
+            if ev["type"] == "start":
+                rid = ev["rid"]
+                continue
+            got += 1
+            if got >= max(0, int(events)):
+                break
+    finally:
+        await gen.aclose()            # the consumer is gone
+    return {"events": got, "rid": rid}
+
+
+async def slow_client(server, prompt, read_events: int = 1,
+                      timeout_s: float = 20.0, **submit_kwargs) -> dict:
+    """A front-line client that reads ``read_events`` events and then
+    STOPS consuming while the engine keeps producing — the slow-consumer
+    fault. The per-client buffer (``FLAGS_serving_client_queue`` /
+    ``ServingServer(client_queue=)``) overflows, the server marks the
+    stream dropped and cancels the request, and the client's eventual
+    reads end in a terminal ``disconnect`` event. Returns ``{"events",
+    "dropped", "disconnected", "rid"}``."""
+    import asyncio
+    import time as _time
+    srid, client = await server.open_stream(prompt, **submit_kwargs)
+    got = 0
+    it = client.events()
+    while got < max(0, int(read_events)):
+        try:
+            await it.__anext__()
+            got += 1
+        except StopAsyncIteration:
+            break
+    # stall: consume nothing until the server drops us (or the request
+    # finishes first — the sentinel overflowing the full buffer also
+    # marks the stream dropped, so this is bounded either way)
+    t0 = _time.time()
+    while not (client.dropped or client.done) \
+            and _time.time() - t0 < timeout_s:
+        await asyncio.sleep(0.01)
+    disconnected = False
+    try:
+        async for ev in it:
+            if ev.get("type") == "disconnect":
+                disconnected = True
+    finally:
+        client.closed = True
+        await it.aclose()
+    return {"events": got, "dropped": client.dropped,
+            "disconnected": disconnected, "rid": srid}
+
+
 # name -> injector; docs/FAULT_TOLERANCE.md's generated injector count
 # (tools/refresh_docs.py) reads this registry
 INJECTORS = {
@@ -342,4 +445,7 @@ INJECTORS = {
     "stalled_consumer": stalled_consumer,
     "poison_prompt": poison_prompt,
     "flood_tenant": flood_tenant,
+    "engine_crash": engine_crash,
+    "disconnect_mid_stream": disconnect_mid_stream,
+    "slow_client": slow_client,
 }

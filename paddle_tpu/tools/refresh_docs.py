@@ -137,16 +137,12 @@ _GEN = re.compile(r"<!--gen:(?P<key>[a-z0-9_]+)-->(?P<body>.*?)"
 
 def render(key: str, counts: dict, bench: dict) -> str:
     if key in ("health_flags_table", "serving_flags_table"):
-        # generated flags table: name | default | what it gates (the help
-        # text's first sentence), straight from the live registry so the
-        # docs cannot drift from flags.py
-        from paddle_tpu.flags import _registry
-        rows = ["| flag | default | gates |", "|------|---------|-------|"]
-        for name in counts["_" + key.replace("_table", "_rows").replace(
-                "flags", "flag")]:
-            d = _registry[name]
-            first = d.help.split(". ")[0].rstrip(".") + "."
-            rows.append(f"| `{name}` | `{d.default}` | {first} |")
+        # generated flags table straight from the live registry (ONE
+        # shared renderer with ops/gen_docs.py) so the docs cannot drift
+        # from flags.py or from each other
+        from paddle_tpu.flags import flags_table
+        rows = flags_table(counts["_" + key.replace("_table", "_rows")
+                                  .replace("flags", "flag")])
         return "\n" + "\n".join(rows) + "\n"
     if key in counts:
         return str(counts[key])

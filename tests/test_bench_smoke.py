@@ -102,6 +102,17 @@ def test_bench_serve_entry_point():
     assert detail["overload_shed"] > 0
     assert detail["overload_served"] > 0
     assert detail["overload_edf_decode_traces"] == 1
+    # front-line row (ISSUE 7): a mini trace through the asyncio server
+    # (in-process transport — port-free) with an engine crash injected
+    # mid-trace, then a graceful drain. The bit-parity / restart /
+    # zero-leak / scale-up proofs are asserted inside the section; the
+    # smoke pins the detail record so the row can't silently vanish.
+    assert detail["frontline_outputs_match"] is True
+    assert detail["frontline_restarts"] >= 1
+    assert detail["frontline_resubmitted"] >= 1
+    assert detail["frontline_leaked_blocks"] == 0
+    assert detail["frontline_tok_s"] > 0
+    assert detail["autoscale_action"] == "scale_up"
 
 
 def test_bench_health_entry_point():

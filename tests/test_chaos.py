@@ -855,3 +855,113 @@ class TestServingChaos:
                                       _dense(params, cfg, sys_p, 4))
         assert eng.stats()["prefix_hit_tokens"] > before
         _assert_recovered(eng, params, cfg, prompts[0])
+
+
+# ---------------------------------------------------------------------------
+# serving front-line chaos (ISSUE 7): crash the engine under the
+# supervisor, drop/stall clients under the asyncio server. Recovery
+# contract: bit-exact greedy outputs, BlockManager accounting balanced
+# after every recovery, replica still accepting.
+# ---------------------------------------------------------------------------
+
+def _mk_supervisor(params, cfg, **kw):
+    from paddle_tpu.inference.serving import (EngineSupervisor,
+                                              ServingConfig)
+    base = dict(block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+                queue_depth=8)
+    sup_kw = {k: kw.pop(k) for k in list(kw)
+              if k in ("max_restarts", "programs")}
+    base.update(kw)
+    return EngineSupervisor(params, cfg, ServingConfig(**base), **sup_kw)
+
+
+class TestFrontlineChaos:
+    def test_injector_registry_has_frontline_trio(self):
+        for name in ("engine_crash", "disconnect_mid_stream",
+                     "slow_client"):
+            assert name in chaos.INJECTORS
+
+    def test_engine_crash_supervisor_recovers_bit_exact(self,
+                                                        serving_setup):
+        """INJECTOR 13: the engine step loop raises mid-trace — the
+        supervisor rebuilds (no recompile: shared programs), resubmits
+        every non-terminal request, and the replica serves every output
+        bit-identical to the dense oracle with the pool balanced."""
+        cfg, params, prompts = serving_setup
+        sup = _mk_supervisor(params, cfg)
+        srids = [sup.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        sup.step(2)
+        traces = sup.engine.stats()["decode_traces"]
+        chaos.engine_crash(sup, at_step=1)
+        sup.step(2)
+        assert sup.restarts == 1
+        while sup.pending:
+            sup.step(2)
+        for s, p in zip(srids, prompts):
+            np.testing.assert_array_equal(sup.result(s),
+                                          _dense(params, cfg, p, 8))
+        assert sup.engine.stats()["decode_traces"] == traces
+        _assert_recovered(sup.engine, params, cfg, prompts[0])
+
+    def test_disconnect_mid_stream_frees_blocks(self, serving_setup):
+        """INJECTOR 14: an SSE client closes mid-stream — the server
+        cancels its request (KV freed) and co-scheduled clients stay
+        bit-exact."""
+        import asyncio
+        from paddle_tpu.inference.serving import ServingServer
+        cfg, params, prompts = serving_setup
+        sup = _mk_supervisor(params, cfg)
+
+        async def main():
+            srv = ServingServer(sup)
+            async with srv.running():
+                async def good():
+                    toks = []
+                    async for ev in srv.agenerate(prompts[1],
+                                                  max_new_tokens=6,
+                                                  eos_token_id=None):
+                        if ev["type"] == "token":
+                            toks.append(ev["token"])
+                    return toks
+                good_toks, r = await asyncio.gather(
+                    good(),
+                    chaos.disconnect_mid_stream(srv, prompts[0], events=2,
+                                                max_new_tokens=24,
+                                                eos_token_id=None))
+                deadline = time.time() + 10
+                while sup.pending and time.time() < deadline:
+                    await asyncio.sleep(0.01)
+                return good_toks, r
+
+        good_toks, r = asyncio.run(asyncio.wait_for(main(), 120))
+        assert r["events"] == 2
+        np.testing.assert_array_equal(np.asarray(good_toks, np.int32),
+                                      _dense(params, cfg, prompts[1], 6))
+        assert sup.engine.stats()["cancelled"] >= 1
+        _assert_recovered(sup.engine, params, cfg, prompts[0])
+
+    def test_slow_client_disconnected_not_pinning(self, serving_setup):
+        """INJECTOR 15: a client reading slower than the engine produces
+        overflows its bounded buffer — the server disconnects it through
+        engine.cancel, so a slacker can never pin KV blocks."""
+        import asyncio
+        from paddle_tpu.inference.serving import ServingServer
+        cfg, params, prompts = serving_setup
+        sup = _mk_supervisor(params, cfg)
+
+        async def main():
+            srv = ServingServer(sup, client_queue=2)
+            async with srv.running():
+                r = await chaos.slow_client(srv, prompts[0], read_events=1,
+                                            max_new_tokens=24,
+                                            eos_token_id=None)
+                deadline = time.time() + 10
+                while sup.pending and time.time() < deadline:
+                    await asyncio.sleep(0.01)
+                return r
+
+        r = asyncio.run(asyncio.wait_for(main(), 120))
+        assert r["dropped"] is True and r["disconnected"] is True
+        assert sup.engine.stats()["cancelled"] >= 1
+        _assert_recovered(sup.engine, params, cfg, prompts[0])

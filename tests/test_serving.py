@@ -229,8 +229,11 @@ class TestScheduler:
 
     def test_finished_records_bounded(self, setup):
         """A long-lived scheduler retains only the most recent
-        queue_depth + max_slots finished records (host memory must not
-        grow with total requests served)."""
+        queue_depth + 2*max_slots finished records (host memory must not
+        grow with total requests served; the bound covers the largest
+        possible in-flight set — a supervisor resubmission can exceed
+        the queue bound by max_slots — so one mass termination can never
+        evict a record before the supervisor's sweep collects it)."""
         from paddle_tpu.inference.serving import Request, Scheduler
         cfg, _, _, _ = setup
         sched = Scheduler(self._cache(cfg), max_slots=2, queue_depth=3)
@@ -239,8 +242,8 @@ class TestScheduler:
                                  max_new_tokens=2))
             sched.finish(sched.next_admission())
         assert sched.retired == 9
-        assert len(sched.finished) == sched.keep_finished == 5
-        assert sorted(sched.finished) == [4, 5, 6, 7, 8]  # oldest evicted
+        assert len(sched.finished) == sched.keep_finished == 7
+        assert sorted(sched.finished) == [2, 3, 4, 5, 6, 7, 8]
         sched.result(8)
         with pytest.raises(KeyError):
             sched.result(0)
@@ -1282,7 +1285,11 @@ class TestAdmissionPolicies:
     def test_queue_full_shed_carries_context(self, setup):
         """ISSUE 6 satellite: ServingQueueFull is structured — queue
         depth, live slots, and a retry-after hint for the caller's
-        backoff — and counts as shed load."""
+        backoff — and counts as shed load. ISSUE 7 satellite: before any
+        retirement (cold start) the hint is the conservative
+        FLAGS_serving_retry_after_s default, never a degenerate None/0 a
+        client would turn into a hot retry loop."""
+        from paddle_tpu.flags import flag
         from paddle_tpu.inference.serving import ServingQueueFull
         cfg, params, prompts, _ = setup
         eng = make_engine(params, cfg, queue_depth=2, max_slots=1)
@@ -1292,7 +1299,9 @@ class TestAdmissionPolicies:
             eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
         e = ei.value
         assert e.queue_depth == 2 and e.live_slots == 0
-        assert e.retry_after_s is None             # no retirement seen yet
+        # no retirement seen yet -> the documented conservative default
+        assert e.retry_after_s == pytest.approx(
+            float(flag("FLAGS_serving_retry_after_s")))
         assert "shed" in str(e)
         assert eng.stats()["shed"] == 1
         while eng.pending:
@@ -1464,10 +1473,16 @@ class TestHealthSnapshot:
         import json
         json.dumps(snap)                           # must be serializable
         # the payload is pinned to the registry docs/OPS.md is generated
-        # from — a field added to one without the other fails here
-        from paddle_tpu.inference.serving.engine import \
-            HEALTH_SNAPSHOT_FIELDS
-        assert set(snap) == set(HEALTH_SNAPSHOT_FIELDS)
+        # from — a field added to one without the other fails here. The
+        # supervisor-only keys ride on top of the engine payload (the
+        # supervisor-level pin lives in tests/test_server.py).
+        from paddle_tpu.inference.serving.engine import (
+            HEALTH_SNAPSHOT_FIELDS, SUPERVISOR_SNAPSHOT_KEYS)
+        assert set(snap) == \
+            set(HEALTH_SNAPSHOT_FIELDS) - set(SUPERVISOR_SNAPSHOT_KEYS)
+        for t in snap["tenants"].values():         # ISSUE 7: TPOT SLOs
+            assert t["tpot_p50_s"] is not None
+            assert t["tpot_p99_s"] >= t["tpot_p50_s"]
 
     def test_snapshot_folds_overflow_tenants(self, setup):
         """Past MAX_TENANTS distinct tenant keys, new tenants aggregate
