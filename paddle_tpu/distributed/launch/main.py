@@ -26,7 +26,8 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["main", "launch_procs", "write_rejoin_file", "read_rejoin_count"]
+__all__ = ["main", "launch_procs", "write_rejoin_file",
+           "read_rejoin_count", "consume_rejoin_file"]
 
 
 def _free_port() -> int:
@@ -227,6 +228,22 @@ def write_rejoin_file(path: str, workers: Optional[int] = None) -> str:
     return path
 
 
+def consume_rejoin_file(path: Optional[str]) -> int:
+    """Read-and-consume one rejoin signal: returns the offered worker
+    count (0 = no signal) and removes the file — even a zero-count one
+    (``write_rejoin_file(path, 0)`` is legal), or the next poll would
+    re-read the stale signal forever — so the handshake both the elastic
+    launcher (between rounds) and the serving router's ``poll_rejoin``
+    use always starts the next round clean."""
+    offered = _check_rejoin(path)
+    if path:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    return offered
+
+
 def _watch(procs: List[_Proc], monitor=None, ttl: float = 0.0,
            rejoin_file=None, want_more: bool = False,
            preempt_grace: float = 15.0) -> int:
@@ -383,7 +400,7 @@ def launch_procs(args) -> int:
                     # scale-out: capacity is back — re-rendezvous with the
                     # larger world (mirror of scale-in; ref:
                     # fleet/elastic/manager.py rejoin handling)
-                    offered = _check_rejoin(rejoin_file)
+                    offered = consume_rejoin_file(rejoin_file)
                     new_nproc = min(max_nprocs,
                                     max(cur_nproc, min(offered,
                                                        max_nprocs)))
@@ -392,10 +409,6 @@ def launch_procs(args) -> int:
                               f"{new_nproc} procs (rejoin signal)",
                               file=sys.stderr)
                         cur_nproc = new_nproc
-                    try:            # consume the signal
-                        os.remove(rejoin_file)
-                    except OSError:
-                        pass
                 elif min_nprocs > 0 and bad:
                     # scale-in: drop the failed/hung ranks from the world
                     # (ref: elastic manager's scale event -> rendezvous

@@ -329,6 +329,37 @@ define_flag("FLAGS_serving_retry_after_s", 1.0,
             "no retirement interval to estimate from); once measurable, "
             "the mean recent retirement interval takes over.", float)
 
+# serving fleet router (ISSUE 9): multi-replica routing over supervised
+# replicas — docs/OPS.md "Serving fleet"
+define_flag("FLAGS_serving_router_replicas", 2,
+            "Replicas the ServingRouter spawns at construction when "
+            "ServingRouter(replicas=) is left unset. All replicas share "
+            "one set of params and ONE compiled EnginePrograms, so extra "
+            "replicas cost KV-pool memory and host scheduling, never a "
+            "recompile.", int)
+define_flag("FLAGS_serving_router_max_replicas", 8,
+            "Ceiling on fleet size: autoscale scale-up (and rejoin-file "
+            "polls) stop spawning replicas at this many; scale-in never "
+            "drains below 1.", int)
+define_flag("FLAGS_serving_router_breaker_threshold", 3,
+            "Per-replica circuit breaker: consecutive failures (probe "
+            "raises, submit unavailability, supervisor restarts) before "
+            "the breaker OPENS and the router stops routing to the "
+            "replica.", int)
+define_flag("FLAGS_serving_router_breaker_cooldown_s", 5.0,
+            "Seconds an OPEN breaker waits before the router re-probes "
+            "the replica HALF-OPEN (one health probe: success closes the "
+            "breaker and the replica rejoins, failure re-opens with a "
+            "fresh cooldown).", float)
+define_flag("FLAGS_serving_router_hedge_ttft_mult", 0.0,
+            "Hedged retry: a request still waiting for its FIRST token "
+            "after mult x FLAGS_serving_ttft_slo_s seconds is duplicated "
+            "onto a second healthy replica; whichever copy emits first "
+            "wins and the loser is cancelled through the lifecycle path "
+            "(KV freed — greedy outputs make the copies bit-identical, so "
+            "the winner's stream is THE stream). 0 disables hedging; it "
+            "also stays off while FLAGS_serving_ttft_slo_s is 0.", float)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

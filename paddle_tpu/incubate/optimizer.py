@@ -8,7 +8,7 @@ same place the reference keeps them between ops)."""
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -25,16 +25,19 @@ class LookAhead:
         self.alpha = float(alpha)
         self.k = int(k)
         self._step_count = 0
-        # slow copies anchor at the CONSTRUCTION-time weights (the
-        # reference initializes slow from the step-1 parameter values), so
-        # the first sync already interpolates
-        self._slow: List[np.ndarray] = [p.numpy().copy()
-                                        for p in self._params()]
+        # slow copies anchor LAZILY on the first step() (the reference
+        # initializes slow from the step-1 parameter values): anchoring at
+        # construction meant a checkpoint loaded into the parameters
+        # AFTERWARDS left stale pre-load anchors, and the first k-step
+        # sync interpolated the live weights back toward them (ADVICE r5)
+        self._slow: Optional[List[np.ndarray]] = None
 
     def _params(self) -> List:
         return self.inner._params()
 
     def step(self):
+        if self._slow is None:
+            self._slow = [p.numpy().copy() for p in self._params()]
         self.inner.step()
         self._step_count += 1
         if self._step_count % self.k:
@@ -55,17 +58,21 @@ class LookAhead:
 
     def state_dict(self) -> Dict:
         # slow copies keyed by parameter ORDER (stable across restarts for
-        # the same parameter list)
+        # the same parameter list); {} before the first step anchors them
         return {"inner": self.inner.state_dict(),
-                "slow": {str(i): v for i, v in enumerate(self._slow)},
+                "slow": {str(i): v
+                         for i, v in enumerate(self._slow or [])},
                 "step_count": self._step_count}
 
     def set_state_dict(self, state: Dict):
         if "inner" in state and hasattr(self.inner, "set_state_dict"):
             self.inner.set_state_dict(state["inner"])
         slow = state.get("slow", {})
-        self._slow = [np.asarray(slow[str(i)])
-                      for i in range(len(slow))] or self._slow
+        # no saved slow entry -> RE-ANCHOR lazily on the next step():
+        # keeping any existing anchor here would interpolate the freshly
+        # loaded weights back toward pre-load values (ADVICE r5)
+        self._slow = ([np.asarray(slow[str(i)]) for i in range(len(slow))]
+                      or None)
         self._step_count = int(state.get("step_count", 0))
 
 

@@ -12,10 +12,16 @@ sits on top (ISSUE 7): :class:`EngineSupervisor` (crash barrier, restart
 budget, bit-exact resubmission, graceful drain, TPOT/autoscale telemetry)
 and the asyncio :class:`ServingServer` (one event loop multiplexing many
 SSE-style streaming clients onto one supervised engine thread, with
-``/healthz`` / ``/readyz`` / ``/metrics`` endpoints). Benchmarked by
-``bench.py --serve`` against the static-batch ``generate()`` baseline and
-driven through hostile-traffic faults by ``testing.chaos``'s serving
-injectors.
+``/healthz`` / ``/readyz`` / ``/metrics`` endpoints). Above the replicas
+sits the fleet tier (ISSUE 9): :class:`ServingRouter` fronts N supervised
+replicas sharing one set of params and one compiled
+:class:`EnginePrograms` — health-probed power-of-two-choices routing with
+prefix/tenant affinity, cross-replica failover (bit-exact resume from
+delivered tokens), per-replica :class:`CircuitBreaker`\\ s, hedged
+retries, autoscale actuation and rolling restarts (docs/OPS.md "Serving
+fleet"). Benchmarked by ``bench.py --serve`` against the static-batch
+``generate()`` baseline and driven through hostile-traffic faults by
+``testing.chaos``'s serving injectors.
 """
 
 from .engine import (EnginePrograms, HEALTH_SNAPSHOT_FIELDS,
@@ -26,6 +32,10 @@ from .policies import (AdmissionPolicy, EDFPolicy, FairSharePolicy,
 from .scheduler import (CANCELLED, FINISHED, QUEUED, RUNNING, SHED,
                         TERMINAL_STATES, TIMED_OUT, Request, Scheduler,
                         ServingQueueFull)
+from .replica import (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+                      CircuitBreaker, Replica)
+from .router import (ROUTER_HEALTH_FIELDS, RouterConfig, RouterRequest,
+                     ServingRouter)
 from .server import ClientStream, ServingServer, serve_requests, sse_encode
 from .supervisor import (EngineSupervisor, FAILED, ServingUnavailable,
                          TrackedRequest, autoscale_signal)
@@ -39,4 +49,7 @@ __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
            "EngineSupervisor", "ServingUnavailable", "TrackedRequest",
            "autoscale_signal", "ServingServer", "ClientStream",
            "serve_requests", "sse_encode", "EnginePrograms",
-           "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS"]
+           "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS",
+           "ServingRouter", "RouterConfig", "RouterRequest",
+           "ROUTER_HEALTH_FIELDS", "Replica", "CircuitBreaker",
+           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]

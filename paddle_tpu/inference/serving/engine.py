@@ -120,7 +120,8 @@ HEALTH_SNAPSHOT_FIELDS = {
                "latency per request; percentiles over recent requests)",
     "supervisor": "EngineSupervisor layer (supervisor snapshots only): "
                   "restarts / restart_budget / broken / draining / "
-                  "accepting / resubmitted / recovered_tokens / completed "
+                  "accepting / resubmitted / recovered_tokens / adopted "
+                  "(requests failed over FROM another replica) / completed "
                   "/ crashes (most recent restart reasons)",
     "autoscale": "autoscale_signal() record (supervisor snapshots only): "
                  "action (scale_up/scale_in/hold) + reason + "
@@ -877,6 +878,13 @@ class ServingEngine:
     @property
     def pending(self) -> bool:
         return self._sched.pending
+
+    def depth(self) -> int:
+        """Queued + live request count under the engine lock — the
+        router-visible load signal its power-of-two-choices pick
+        compares (cheaper than a full health_snapshot per submit)."""
+        with self._lock:
+            return self._sched.depth
 
     def request(self, rid: int) -> Request:
         """The finished request record (tokens + latency timestamps +

@@ -53,8 +53,21 @@ from ...flags import flag
 from .policies import AdmissionPolicy, FIFOPolicy
 
 __all__ = ["Request", "Scheduler", "ServingQueueFull",
+           "completes_by_tokens",
            "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "TIMED_OUT",
            "SHED", "TERMINAL_STATES"]
+
+
+def completes_by_tokens(tokens, max_new_tokens: int,
+                        eos_token_id: Optional[int]) -> bool:
+    """Whether an already-delivered token list alone completes a request
+    (budget spent, or EOS delivered last) — the ONE completion test the
+    supervisor's and the router's recovery records share, so their views
+    of "record it, don't re-run it" can never diverge."""
+    if len(tokens) >= max_new_tokens:
+        return True
+    return (eos_token_id is not None and bool(tokens)
+            and tokens[-1] == eos_token_id)
 
 # request lifecycle states (Request.state)
 QUEUED = "queued"
@@ -518,6 +531,13 @@ class Scheduler:
     @property
     def pending(self) -> bool:
         return bool(self.queue) or any(r is not None for r in self.slots)
+
+    @property
+    def depth(self) -> int:
+        """Outstanding work — queued plus live requests. The router's
+        power-of-two-choices load signal: cheap enough to read per
+        submit, and proportional to the time a new admission waits."""
+        return len(self.queue) + sum(r is not None for r in self.slots)
 
     def result(self, rid: int) -> np.ndarray:
         return self.finished[rid].output()

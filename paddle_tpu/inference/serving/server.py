@@ -110,9 +110,12 @@ class ServingServer:
     serves the same handler over HTTP/1.1 + SSE on a real socket.
     """
 
-    def __init__(self, supervisor: EngineSupervisor,
+    def __init__(self, supervisor,
                  client_queue: Optional[int] = None,
                  poll_s: float = 0.02):
+        # `supervisor` is an EngineSupervisor OR a ServingRouter — both
+        # speak the same submit/cancel/step/pending/drain/health_snapshot
+        # contract, so one server front-lines a single replica or a fleet
         self.sup = supervisor
         self.client_queue = int(client_queue if client_queue is not None
                                 else flag("FLAGS_serving_client_queue"))
@@ -210,7 +213,7 @@ class ServingServer:
         self._route_finishes()
         if not self.sup.pending:
             return
-        emitted = self.sup.step(self.sup.engine.config.decode_chunk)
+        emitted = self.sup.step(self._decode_chunk())
         for srid, toks in emitted.items():
             client = self._open.get(srid)
             if client is None:
@@ -219,6 +222,15 @@ class ServingServer:
                 self._deliver(client, {"type": "token", "rid": srid,
                                        "token": int(t)})
         self._route_finishes()
+
+    def _decode_chunk(self) -> int:
+        """Streaming-granularity cap per pump iteration: the router
+        exposes it directly (one shared ServingConfig), a bare
+        supervisor through its engine."""
+        chunk = getattr(self.sup, "decode_chunk", None)
+        if chunk is not None:
+            return int(chunk)
+        return int(self.sup.engine.config.decode_chunk)
 
     def _run_cmds(self, block: bool) -> None:
         try:

@@ -56,7 +56,8 @@ import numpy as np
 from ...flags import flag
 from ...health import watchdog as _watchdog
 from .engine import ServingEngine
-from .scheduler import CANCELLED, FINISHED, QUEUED, TERMINAL_STATES
+from .scheduler import (CANCELLED, FINISHED, QUEUED, TERMINAL_STATES,
+                        completes_by_tokens)
 
 __all__ = ["EngineSupervisor", "ServingUnavailable", "TrackedRequest",
            "autoscale_signal", "FAILED"]
@@ -109,10 +110,34 @@ class TrackedRequest:
         """True when the delivered tokens alone complete the request
         (budget spent or EOS delivered) — a crash caught it finished but
         not yet swept; record it, don't resubmit it."""
-        if len(self.tokens) >= self.max_new_tokens:
-            return True
-        return (self.eos_token_id is not None and bool(self.tokens)
-                and self.tokens[-1] == self.eos_token_id)
+        return completes_by_tokens(self.tokens, self.max_new_tokens,
+                                   self.eos_token_id)
+
+
+def install_drain_handler(target, signum: int = signal.SIGTERM):
+    """Wire ``signum`` (SIGTERM: the elastic launcher's preemption
+    forward) to ``target.request_drain()`` — the one signal-plumbing
+    helper the supervisor and the router share. Returns ``(handler,
+    previous_handler)``, or ``(None, None)`` off the main thread (the
+    caller polls instead)."""
+
+    def _handler(sig, frame):
+        target.request_drain()
+
+    try:
+        prev = signal.signal(signum, _handler)
+    except ValueError:                 # not the main thread
+        return None, None
+    return _handler, prev
+
+
+def uninstall_drain_handler(prev, signum: int = signal.SIGTERM) -> None:
+    if prev is None:
+        return
+    try:
+        signal.signal(signum, prev)
+    except ValueError:
+        pass
 
 
 def autoscale_signal(snapshot: Dict[str, Any], shed_delta: int = 0,
@@ -179,6 +204,7 @@ class EngineSupervisor:
         self.closed = False
         self.resubmitted = 0
         self.recovered_tokens = 0
+        self.adopted = 0          # requests failed over FROM another replica
         self.completed = 0
         self._drain_requested = False
         self._prev_sigterm = None
@@ -189,6 +215,13 @@ class EngineSupervisor:
         self._last_shed = 0
         self._programs = programs
         self.engine = self._build_engine()
+        # terminal TrackedRequests are retained BOUNDED (insertion order,
+        # oldest evicted) — the scheduler's own record bound, which is
+        # the most requests that can be in flight at once, so one
+        # run()/drain cycle (and the router's per-step sweep) can always
+        # collect results, while a long-lived replica cannot retain
+        # every prompt it ever served
+        self._keep_finished = self.engine._sched.keep_finished
 
     def _build_engine(self) -> ServingEngine:
         eng = ServingEngine(self._params, self._model_config,
@@ -242,19 +275,73 @@ class EngineSupervisor:
                 prompt, max_new_tokens=max_new_tokens,
                 eos_token_id=eos_token_id, timeout_s=timeout_s,
                 deadline_s=deadline_s, tenant=tenant, priority=priority)
-            # mirror the RESOLVED request (defaults, sentinels, deadline
-            # already applied by the one resolver, engine._make_request)
-            # so a crash resubmission re-creates exactly what was queued
-            req = self.engine._sched.find(erid)
-            rec = TrackedRequest(
-                srid=self._next_srid, prompt=req.prompt,
-                max_new_tokens=req.max_new_tokens,
-                eos_token_id=req.eos_token_id, tenant=req.tenant,
-                priority=req.priority, deadline=req.deadline, erid=erid)
-            self._next_srid += 1
-            self._reqs[rec.srid] = rec
-            self._by_erid[rec.erid] = rec
+            return self._track(erid).srid
+
+    def _track(self, erid: int, resubmits: int = 0) -> TrackedRequest:
+        """Mirror the RESOLVED engine record (defaults, sentinels,
+        deadline already applied by the one resolver,
+        engine._make_request) into a TrackedRequest — the single place
+        submit() and resubmit() register work, so a crash resubmission
+        re-creates exactly what was queued."""
+        req = self.engine._sched.find(erid)
+        rec = TrackedRequest(
+            srid=self._next_srid, prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id, tenant=req.tenant,
+            priority=req.priority, deadline=req.deadline, erid=erid)
+        rec.tokens = [int(t) for t in req.tokens]
+        rec.resubmits = resubmits
+        self._next_srid += 1
+        self._reqs[rec.srid] = rec
+        self._by_erid[rec.erid] = rec
+        self._prune_records()
+        return rec
+
+    def _prune_records(self) -> None:
+        """Evict the oldest TERMINAL records past the retention bound
+        (live ones — still in ``_by_erid`` or FAILED-pending-collection
+        within the bound — are never touched)."""
+        excess = len(self._reqs) - len(self._by_erid) - self._keep_finished
+        if excess > 0:
+            for srid in list(self._reqs):
+                if excess <= 0:
+                    break
+                if self._reqs[srid].terminal:
+                    del self._reqs[srid]
+                    excess -= 1
+
+    def resubmit(self, prompt, tokens: Sequence[int] = (),
+                 max_new_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = "unset",
+                 deadline: Optional[float] = None,
+                 tenant: Optional[str] = None, priority: int = 0) -> int:
+        """ADOPT a request recovered from another replica (the router's
+        cross-replica failover): queue it with the tokens the client has
+        already been delivered, riding :meth:`ServingEngine.resubmit`'s
+        recompute path — greedy output stays bit-identical to an
+        uninterrupted run and no delivered token is re-emitted. Bypasses
+        the queue-depth shed (the work was already accepted once,
+        somewhere) but still refuses while draining or broken. Returns
+        the new supervisor rid."""
+        with self._lock:
+            self._check_admitting()
+            erid = self.engine.resubmit(
+                prompt, tokens, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, deadline=deadline,
+                tenant=tenant, priority=priority)
+            rec = self._track(erid, resubmits=1)    # born from a failover
+            self.adopted += 1
+            self.recovered_tokens += len(rec.tokens)
             return rec.srid
+
+    def depth(self) -> int:
+        """Queued + live requests on this replica — the router's
+        power-of-two-choices load signal. A broken replica reports a
+        depth no router should ever pick."""
+        with self._lock:
+            if self.broken:
+                return 1 << 30
+            return self.engine.depth()
 
     def cancel(self, srid: int) -> bool:
         """Cancel by supervisor rid; same idempotence contract as
@@ -355,6 +442,7 @@ class EngineSupervisor:
                           "resubmits": rec.resubmits}
             if rec.state == FINISHED:
                 self.completed += 1
+        self._prune_records()
 
     def _recover(self, reason: str) -> None:
         self.crashes.append(reason)
@@ -448,23 +536,14 @@ class EngineSupervisor:
                 self.drain_deadline_s = max(1.0, float(grace) - 2.0)
             except ValueError:
                 pass
-
-        def _handler(sig, frame):
-            self.request_drain()
-
-        try:
-            self._prev_sigterm = signal.signal(signum, _handler)
-        except ValueError:          # not the main thread: caller polls
-            return None
-        return _handler
+        handler, prev = install_drain_handler(self, signum)
+        if handler is not None:
+            self._prev_sigterm = prev
+        return handler
 
     def uninstall_signal_handler(self, signum: int = signal.SIGTERM):
-        if self._prev_sigterm is not None:
-            try:
-                signal.signal(signum, self._prev_sigterm)
-            except ValueError:
-                pass
-            self._prev_sigterm = None
+        uninstall_drain_handler(self._prev_sigterm, signum)
+        self._prev_sigterm = None
 
     def drain(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:
         """Stop admissions, finish in-flight work within the deadline,
@@ -540,6 +619,7 @@ class EngineSupervisor:
                 "accepting": snap["accepting"],
                 "resubmitted": self.resubmitted,
                 "recovered_tokens": self.recovered_tokens,
+                "adopted": self.adopted,
                 "completed": self.completed,
                 "crashes": list(self.crashes[-4:]),
             }

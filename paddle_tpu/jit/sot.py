@@ -204,35 +204,139 @@ _MUTATING_METHODS = {"append", "extend", "insert", "update", "setdefault",
 
 def _container_mutated_names(code) -> set:
     """Names of GLOBAL/CLOSURE variables the code mutates through
-    subscript stores or mutating method calls — a short-window bytecode
-    heuristic: a LOAD_GLOBAL/LOAD_DEREF of the name followed within a few
-    instructions by STORE_SUBSCR / DELETE_SUBSCR / a mutating method load
-    marks the name. Local-variable mutations (LOAD_FAST ...) do NOT mark
-    anything, so building a local list does not disable guards on an
-    unrelated global config (r5 review fix)."""
+    subscript stores or mutating method calls, found by tracking the
+    loaded object through a SYMBOLIC stack: a STORE_SUBSCR marks a name
+    only when its container operand actually originates from that
+    LOAD_GLOBAL/LOAD_DEREF (directly, or via a chained subscript/attr —
+    ``cfg[i][j] = v`` and ``cfg.data[k] = v`` still count as mutating
+    ``cfg``). The earlier flat 12-instruction window marked a container
+    whenever ANY subscript store followed its load, so ``x = cfg[k];
+    buf[i] = x`` dropped the guard on the read-only global ``cfg`` and
+    external mutation of it served a stale compiled path (ADVICE r5).
+    Unmodeled opcodes conservatively clear every tag: a false NEGATIVE
+    only keeps a guard alive (worst case a recompile); a false positive
+    would silently disable stale-path protection."""
     names = set()
-    stack = [code]
-    WINDOW = 12
-    while stack:
-        c = stack.pop()
-        ins_list = list(dis.get_instructions(c))
-        for i, ins in enumerate(ins_list):
-            if ins.opname in ("LOAD_GLOBAL", "LOAD_DEREF",
-                              "LOAD_CLASSDEREF"):
-                for j in range(i + 1, min(i + 1 + WINDOW, len(ins_list))):
-                    nxt = ins_list[j]
-                    if nxt.opname in ("STORE_SUBSCR", "DELETE_SUBSCR"):
-                        names.add(ins.argval)
-                        break
-                    if nxt.opname in ("LOAD_METHOD", "LOAD_ATTR") and                             nxt.argval in _MUTATING_METHODS and j == i + 1:
-                        names.add(ins.argval)
-                        break
-            elif ins.opname in ("STORE_GLOBAL", "DELETE_GLOBAL"):
-                names.add(ins.argval)
+    codes = [code]
+    while codes:
+        c = codes.pop()
+        _scan_container_mutations(c, names)
         for const in c.co_consts:
             if hasattr(const, "co_code"):
-                stack.append(const)
+                codes.append(const)
     return names
+
+
+def _scan_container_mutations(c, names: set) -> None:
+    sym: list = []          # one entry per stack slot: a name tag or None
+
+    def pop(n):
+        del sym[len(sym) - n:]
+
+    for ins in dis.get_instructions(c):
+        op = ins.opname
+        if ins.is_jump_target:
+            sym = [None] * len(sym)       # merged control flow: unknown
+        if op in ("STORE_GLOBAL", "DELETE_GLOBAL"):
+            names.add(ins.argval)
+            if op == "STORE_GLOBAL":
+                pop(1)
+            continue
+        if op in ("LOAD_GLOBAL", "LOAD_DEREF", "LOAD_CLASSDEREF"):
+            # 3.11+ LOAD_GLOBAL may push NULL below the value (eff 2)
+            try:
+                eff = dis.stack_effect(ins.opcode, ins.arg)
+            except ValueError:
+                eff = 1
+            sym.extend([None] * (eff - 1) + [ins.argval])
+            continue
+        if op in ("LOAD_CONST", "LOAD_FAST", "LOAD_SMALL_INT"):
+            sym.append(None)
+            continue
+        if op in ("LOAD_ATTR", "LOAD_METHOD"):
+            owner = sym[-1] if sym else None
+            if owner is not None and ins.argval in _MUTATING_METHODS:
+                names.add(owner)
+            pop(1)
+            try:
+                eff = dis.stack_effect(ins.opcode, ins.arg)
+            except ValueError:
+                eff = 0
+            # attribute access propagates the tag: mutating cfg.data
+            # mutates what the digest of cfg covers
+            sym.extend([None] * eff + [owner])
+            continue
+        if op == "BINARY_SUBSCR":
+            tag = sym[-2] if len(sym) >= 2 else None
+            pop(2)
+            sym.append(tag)               # cfg[i] is still "part of" cfg
+            continue
+        if op == "BINARY_SLICE":          # 3.12+: TOS2[TOS1:TOS], pops 3
+            tag = sym[-3] if len(sym) >= 3 else None
+            pop(3)
+            sym.append(tag)
+            continue
+        if op == "STORE_SLICE":           # 3.12+: TOS2[TOS1:TOS] = TOS3
+            if len(sym) >= 3 and sym[-3] is not None:
+                names.add(sym[-3])
+            pop(4)
+            continue
+        if op == "STORE_SUBSCR":
+            if len(sym) >= 2 and sym[-2] is not None:
+                names.add(sym[-2])
+            pop(3)
+            continue
+        if op == "DELETE_SUBSCR":
+            if len(sym) >= 2 and sym[-2] is not None:
+                names.add(sym[-2])
+            pop(2)
+            continue
+        if op == "POP_TOP":
+            pop(1)
+            continue
+        if op == "DUP_TOP":
+            sym.append(sym[-1] if sym else None)
+            continue
+        if op == "DUP_TOP_TWO":
+            sym.extend(sym[-2:] if len(sym) >= 2 else [None, None])
+            continue
+        if op in ("ROT_TWO", "ROT_THREE", "ROT_FOUR"):
+            n = {"ROT_TWO": 2, "ROT_THREE": 3, "ROT_FOUR": 4}[op]
+            if len(sym) >= n:
+                sym[-n:] = [sym[-1]] + sym[-n:-1]
+            continue
+        if op == "COPY":                  # 3.11+
+            i = ins.arg or 1
+            sym.append(sym[-i] if len(sym) >= i else None)
+            continue
+        if op == "SWAP":                  # 3.11+
+            i = ins.arg or 1
+            if len(sym) >= i:
+                sym[-1], sym[-i] = sym[-i], sym[-1]
+            continue
+        if op == "BINARY_OP" or op.startswith(("BINARY_", "INPLACE_")):
+            pop(2)
+            sym.append(None)              # a fresh (or consumed) value
+            continue
+        if op.startswith("UNARY_"):
+            if sym:
+                sym[-1] = None            # pop 1 push 1, tag dropped
+            continue
+        if op in ("STORE_FAST", "STORE_DEREF", "STORE_NAME", "STORE_ATTR"):
+            try:
+                eff = dis.stack_effect(ins.opcode, ins.arg)
+            except ValueError:
+                eff = -1
+            pop(-eff)
+            continue
+        # anything else: keep the depth honest, drop every tag — an
+        # unmodeled opcode may have rearranged the stack arbitrarily
+        try:
+            eff = dis.stack_effect(ins.opcode, ins.arg)
+        except ValueError:
+            sym = []
+            continue
+        sym = [None] * max(0, len(sym) + eff)
 
 
 def _detect_side_effects(fn: Callable) -> Optional[str]:

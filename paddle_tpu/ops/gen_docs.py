@@ -146,8 +146,98 @@ def generate(out_path: str = "docs/OPS.md") -> str:
         "",
         "### Server / serving flags",
         ""]
-    lines += flags_table(sorted(n for n in get_flags()
-                                if n.startswith("FLAGS_serving_")))
+    lines += flags_table(sorted(
+        n for n in get_flags()
+        if n.startswith("FLAGS_serving_")
+        and not n.startswith("FLAGS_serving_router_")))
+    # serving fleet (ISSUE 9): the multi-replica router tier — breaker
+    # states, failover/rolling-restart runbooks, the router snapshot
+    # registry and the router flag table, all from the live registries
+    from paddle_tpu.inference.serving.router import ROUTER_HEALTH_FIELDS
+    lines += [
+        "",
+        "## Serving fleet (`inference.serving.router`)",
+        "",
+        "`ServingRouter` fronts N in-process replicas — each a full "
+        "supervisor/server stack — sharing ONE set of params and ONE "
+        "compiled `EnginePrograms` (spawning or rebuilding a replica "
+        "never recompiles). Every submit probes the candidates "
+        "(`/readyz` predicate + `health_snapshot()`; a raising probe is "
+        "a breaker failure) and picks by power-of-two-choices on queue "
+        "depth, with tenant/prefix-affinity stickiness keeping "
+        "shared-prefix traffic on the replica that holds its cached KV "
+        "blocks. `ServingServer` front-lines a router exactly as it "
+        "front-lines one supervisor — same endpoints, same SSE streams.",
+        "",
+        "### Circuit breaker states",
+        "",
+        "| state | traffic | transition |",
+        "|---|---|---|",
+        "| `closed` | flows; consecutive failures counted | "
+        "`FLAGS_serving_router_breaker_threshold` failures in a row "
+        "(probe raises, submit unavailability, supervisor restarts) "
+        "-> `open`; a replica going BROKEN trips it immediately |",
+        "| `open` | none — the router routes around the replica and "
+        "EVACUATES its in-flight requests (failover from delivered "
+        "tokens, bit-exact) | after "
+        "`FLAGS_serving_router_breaker_cooldown_s` the next routing "
+        "decision runs a half-open probe |",
+        "| `half_open` | one health probe, no user traffic at risk | "
+        "probe success -> `closed` (the replica rejoins); failure -> "
+        "`open` with a fresh cooldown |",
+        "",
+        "### Failover runbook",
+        "",
+        "A replica that exhausts its restart budget (`broken`) or opens "
+        "its breaker loses its traffic: every non-terminal request is "
+        "resubmitted to a healthy replica from `prompt + tokens "
+        "delivered so far` (`EngineSupervisor.resubmit`, the "
+        "preemption-recompute path) — greedy outputs stay bit-identical "
+        "and no delivered token repeats. With NO routable replica left "
+        "the request goes state `failed` (partial readable) and "
+        "`counters.failed` increments — page on it. Watch: "
+        "`counters.failovers` climbing (a replica is flapping), "
+        "`fleet.routable` vs `fleet.size` (capacity lost), "
+        "`replicas.<rid>.breaker.state` (who is walled off).",
+        "",
+        "### Rolling-restart runbook (deploys)",
+        "",
+        "`start_rolling_restart()` (or the blocking `rolling_restart()`)"
+        " drains ONE replica at a time — admissions shift to the rest of "
+        "the fleet, in-flight work finishes (or fails over at the drain "
+        "deadline), the replica rebuilds from the shared programs "
+        "(generation bumps, breaker resets), and the roll moves on. A "
+        "live trace served across the roll completes with ZERO failed "
+        "requests — `counters.failed` staying 0 is the acceptance "
+        "invariant. A `broken` replica is healed by the roll: its "
+        "rebuild gets a fresh restart budget.",
+        "",
+        "### Autoscale actuation",
+        "",
+        "`router.autoscale()` acts on the fleet-aggregated "
+        "`autoscale_signal()`: scale-up SPAWNS a replica (up to "
+        "`FLAGS_serving_router_max_replicas`) and optionally writes the "
+        "elastic launcher's `--elastic_rejoin_file`; scale-in DRAINS the "
+        "least-loaded replica (never below one). `router.poll_rejoin()` "
+        "consumes the same file format back "
+        "(`distributed.launch.main.consume_rejoin_file`), so an external "
+        "autoscaler can drive fleet size through one file.",
+        "",
+        "### Router health surface",
+        "",
+        "`ServingRouter.health_snapshot()` — keys pinned to "
+        "`ROUTER_HEALTH_FIELDS` by the snapshot test:",
+        "",
+        "| field | meaning |",
+        "|---|---|"]
+    lines += [f"| `{k}` | {v} |" for k, v in ROUTER_HEALTH_FIELDS.items()]
+    lines += [
+        "",
+        "### Router flags",
+        ""]
+    lines += flags_table(sorted(
+        n for n in get_flags()
+        if n.startswith("FLAGS_serving_router_")))
     lines += ["",
               "## Op table",
               "",

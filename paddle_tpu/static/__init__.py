@@ -21,12 +21,13 @@ from __future__ import annotations
 import warnings
 
 from ..jit.api import InputSpec
-from .program import (Executor, Program, append_backward, data,
-                      default_main_program, default_startup_program,
+from .program import (Executor, MissingFeedError, Program, append_backward,
+                      data, default_main_program, default_startup_program,
                       program_guard)
 
 __all__ = ["InputSpec", "enable_static", "disable_static", "Program",
-           "Executor", "data", "append_backward", "default_main_program",
+           "Executor", "MissingFeedError", "data",
+           "append_backward", "default_main_program",
            "default_startup_program", "program_guard",
            "save_inference_model", "load_inference_model",
            "name_scope", "device_guard", "nn"]

@@ -43,8 +43,28 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Program", "Executor", "data", "default_main_program",
-           "default_startup_program", "program_guard", "append_backward"]
+__all__ = ["Program", "Executor", "MissingFeedError", "data",
+           "default_main_program", "default_startup_program",
+           "program_guard", "append_backward"]
+
+
+class MissingFeedError(KeyError):
+    """Executor.run was asked to fetch something that depends on a feed
+    placeholder with no entry in ``feed`` (ADVICE r5: the replay used to
+    silently substitute the construction-time placeholder — zeros, with
+    dynamic dims materialized as 1 — so a typo'd feed name produced wrong
+    numerics instead of the reference Executor's missing-feed error).
+    ``missing`` carries the placeholder names the fetch needs."""
+
+    def __init__(self, missing):
+        self.missing = sorted(missing)
+        super().__init__(
+            f"feed is missing placeholder(s) {self.missing} that the "
+            f"fetched subgraph depends on; pass them in `feed` "
+            f"(check for typo'd feed names)")
+
+    def __str__(self):           # KeyError quotes repr(args[0]) by default
+        return self.args[0]
 
 
 _main_program: Optional["Program"] = None
@@ -209,6 +229,15 @@ class Executor:
 
         from ..core.tensor import Tensor, to_tensor
 
+        # a placeholder the FETCHED subgraph needs must be fed — silently
+        # replaying the construction-time placeholder (zeros, dynamic dims
+        # materialized as 1) turns a typo'd feed name into wrong numerics
+        needed = self._needed_placeholders(prog, fetch_list)
+        missing = [name for name, ph in prog.feeds.items()
+                   if id(ph) in needed and name not in feed]
+        if missing:
+            raise MissingFeedError(missing)
+
         # map feed names -> placeholder ids -> fed values
         env: Dict[int, Any] = {}
         for name, ph in prog.feeds.items():
@@ -238,6 +267,21 @@ class Executor:
             t = outs.get(id(f), f)
             results.append(np.asarray(t.numpy()) if return_numpy else t)
         return results
+
+    @staticmethod
+    def _needed_placeholders(prog: Program, fetch_list) -> set:
+        """Ids of every variable the fetches (and, for a training program,
+        the loss) transitively depend on: walk the tape backward, growing
+        the needed set through each record whose outputs intersect it. A
+        fetched placeholder itself counts (the passthrough-fetch case)."""
+        needed = {id(f) for f in fetch_list}
+        if prog.train_spec and prog.train_spec[1] is not None:
+            needed.add(id(prog.train_spec[1]))       # loss drives backward
+        for rec in reversed(prog.ops):
+            if any(oid in needed for oid in rec.out_ids):
+                needed.update(ref for kind, ref in rec.arg_ids
+                              if kind == "var")
+        return needed
 
     def _replay(self, prog: Program, env: Dict[int, Any]) -> Dict[int, Any]:
         """Walk the tape; every op re-dispatches through forward_op with

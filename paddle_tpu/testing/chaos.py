@@ -41,6 +41,15 @@ injector               fault it models
                        engine produces: the bounded per-client buffer
                        overflows and the server must disconnect it
                        through engine.cancel (KV freed, not pinned)
+``replica_kill``       a fleet replica dying for good mid-trace (host
+                       loss, restart budget gone) — the router must fail
+                       its requests over to a healthy replica bit-exactly
+``slow_replica``       a replica alive but making no progress (wedged
+                       accelerator, swap storm): TTFT stalls, hedged
+                       retries fire, the breaker eventually opens
+``flaky_probe``        a replica whose health/ops surface raises while
+                       the engine may be fine — the router's probe path
+                       must route around it and charge its breaker
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -64,7 +73,8 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "stall_heartbeat", "kill_self", "nan_payload", "bad_sample",
            "dead_worker", "stalled_consumer", "poison_prompt",
            "flood_tenant", "engine_crash", "disconnect_mid_stream",
-           "slow_client", "INJECTORS"]
+           "slow_client", "replica_kill", "slow_replica", "flaky_probe",
+           "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -430,6 +440,98 @@ async def slow_client(server, prompt, read_events: int = 1,
             "disconnected": disconnected, "rid": srid}
 
 
+# ---------------------------------------------------------------------------
+# serving-fleet injectors (inference.serving.router/replica; ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _fleet_sup(target, rid=None):
+    """Resolve (supervisor, rid) from a ServingRouter (+ optional replica
+    rid), a Replica, or a bare EngineSupervisor."""
+    if hasattr(target, "_replicas"):          # ServingRouter
+        reps = target._replicas
+        rid = next(iter(reps)) if rid is None else rid
+        return reps[rid].sup, rid
+    if hasattr(target, "sup"):                # Replica
+        return target.sup, getattr(target, "rid", None)
+    return target, rid                        # EngineSupervisor
+
+
+def replica_kill(target, rid=None,
+                 exc: Optional[BaseException] = None) -> Optional[int]:
+    """Kill one fleet replica FOR GOOD mid-trace — host loss / a crash
+    loop that exhausts the restart budget. Arms the replica's engine to
+    crash on its next step with the supervisor's remaining restart budget
+    zeroed, so that crash flips the replica ``broken`` (in-flight
+    requests FAILED, partials readable) exactly like a real budget
+    exhaustion. The recovery proof: the router fails every non-terminal
+    request over to a healthy replica and final outputs stay bit-identical
+    to a single-replica oracle with no delivered-token repeats. ``target``
+    is a :class:`ServingRouter` (``rid`` picks the victim; default the
+    first replica), a :class:`Replica`, or a bare supervisor. Returns the
+    killed replica's rid."""
+    sup, rid = _fleet_sup(target, rid)
+    sup.max_restarts = sup.restarts           # budget: already spent
+    engine_crash(sup, at_step=1, exc=exc)
+    if not sup.pending:
+        # an idle replica's step loop never runs through the router, so
+        # the armed crash would never fire: detonate now (the supervised
+        # step hits the barrier, budget is spent -> broken immediately)
+        sup.step()
+    return rid
+
+
+def slow_replica(target, rid=None, stall_steps: int = 3,
+                 delay_s: float = 0.02) -> dict:
+    """A replica that is alive but making NO progress (wedged
+    accelerator, swap storm): its next ``stall_steps`` engine iterations
+    sleep ``delay_s`` and return nothing, then the replica heals. TTFT
+    on its requests stalls, so the router's hedged retry fires (and a
+    long enough stall opens the breaker). The patch rides the ENGINE
+    instance — a supervisor rebuild sheds it. Returns the shared state
+    dict (``calls`` counts stalled iterations)."""
+    import time as _time
+    sup, rid = _fleet_sup(target, rid)
+    eng = sup.engine
+    real = eng._step
+    state = {"calls": 0, "rid": rid}
+
+    def stalled(max_iters=None):
+        if state["calls"] < max(0, int(stall_steps)):
+            state["calls"] += 1
+            _time.sleep(max(0.0, float(delay_s)))
+            return {}
+        return real(max_iters)
+
+    eng._step = stalled
+    return state
+
+
+def flaky_probe(target, rid=None, fails: int = 3,
+                exc: Optional[BaseException] = None) -> dict:
+    """A replica whose health/ops surface is wedged while the engine may
+    be fine: the next ``fails`` ``health_snapshot()`` calls raise, then
+    the surface heals. The router's probe path must route traffic around
+    it, charge its circuit breaker per failure, and — once the breaker
+    opens — re-probe half-open after the cooldown so the healed replica
+    REJOINS. Patches the supervisor instance (a rolling-restart rebuild
+    sheds it). Returns the shared state dict (``calls`` counts raised
+    probes)."""
+    sup, rid = _fleet_sup(target, rid)
+    err = exc if exc is not None else RuntimeError(
+        "chaos: injected flaky health probe")
+    real = sup.health_snapshot
+    state = {"calls": 0, "rid": rid}
+
+    def shim():
+        if state["calls"] < max(0, int(fails)):
+            state["calls"] += 1
+            raise err
+        return real()
+
+    sup.health_snapshot = shim
+    return state
+
+
 # name -> injector; docs/FAULT_TOLERANCE.md's generated injector count
 # (tools/refresh_docs.py) reads this registry
 INJECTORS = {
@@ -448,4 +550,7 @@ INJECTORS = {
     "engine_crash": engine_crash,
     "disconnect_mid_stream": disconnect_mid_stream,
     "slow_client": slow_client,
+    "replica_kill": replica_kill,
+    "slow_replica": slow_replica,
+    "flaky_probe": flaky_probe,
 }

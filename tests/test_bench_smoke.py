@@ -113,6 +113,20 @@ def test_bench_serve_entry_point():
     assert detail["frontline_leaked_blocks"] == 0
     assert detail["frontline_tok_s"] > 0
     assert detail["autoscale_action"] == "scale_up"
+    # fleet row (ISSUE 9): replica_kill mid-trace through the 2-replica
+    # router — failover bit-parity, zero router-failed requests, zero
+    # leaked blocks on EVERY replica, a rolling restart that rebuilds the
+    # whole fleet under live traffic, and no recompile anywhere (shared
+    # EnginePrograms). The asserts also live in-section; the smoke pins
+    # the detail record so the row can't silently vanish.
+    assert detail["router_outputs_match"] is True
+    assert detail["router_failovers"] >= 1
+    assert detail["router_failed"] == 0
+    assert detail["router_leaked_blocks"] == 0
+    assert detail["router_roll_outputs_match"] is True
+    assert detail["router_roll_restarts"] >= detail["router_replicas"]
+    assert detail["router_recompiles_constant"] is True
+    assert detail["router_tok_s"] > 0
 
 
 def test_bench_health_entry_point():

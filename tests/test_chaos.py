@@ -965,3 +965,100 @@ class TestFrontlineChaos:
         assert r["dropped"] is True and r["disconnected"] is True
         assert sup.engine.stats()["cancelled"] >= 1
         _assert_recovered(sup.engine, params, cfg, prompts[0])
+
+
+class TestFleetChaos:
+    """ISSUE 9: the serving-fleet injector trio (16-18) through the
+    multi-replica router. Depth coverage lives in tests/test_router.py;
+    here each injector proves the standard chaos recovery oracle — the
+    fleet keeps serving bit-exactly with every replica's pool balanced."""
+
+    def test_injector_registry_has_fleet_trio(self):
+        for name in ("replica_kill", "slow_replica", "flaky_probe"):
+            assert name in chaos.INJECTORS
+        assert len(chaos.INJECTORS) == 18
+
+    def _router(self, params, cfg, **kw):
+        from paddle_tpu.inference.serving import ServingConfig, ServingRouter
+        base = dict(block_size=4, max_slots=2, max_model_len=32,
+                    decode_chunk=2, queue_depth=8)
+        rkw = {k: kw.pop(k) for k in list(kw)
+               if k in ("replicas", "router_config", "programs")}
+        base.update(kw)
+        if "router_config" not in rkw:
+            rkw.setdefault("replicas", 2)
+        return ServingRouter(params, cfg, ServingConfig(**base), **rkw)
+
+    def _balanced(self, router):
+        for rid, part in router.block_partitions().items():
+            assert part["in_use"] == 0, (rid, part)
+            assert part["free"] + part["evictable"] + part["in_use"] == \
+                part["usable"], (rid, part)
+
+    def test_replica_kill_router_fails_over_bit_exact(self, serving_setup):
+        """INJECTOR 16: a replica dies for good mid-trace — the router
+        resubmits its requests to the healthy replica from the delivered
+        tokens, outputs bit-identical, zero failed."""
+        cfg, params, prompts = serving_setup
+        r = self._router(params, cfg)
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        r.step(2)
+        chaos.replica_kill(r, rid=r.replicas[0])
+        while r.pending:
+            r.step(2)
+        snap = r.health_snapshot()
+        assert snap["counters"]["failovers"] >= 1
+        assert snap["counters"]["failed"] == 0
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          _dense(params, cfg, p, 8))
+        self._balanced(r)
+
+    def test_slow_replica_hedge_recovers(self, serving_setup):
+        """INJECTOR 17: a stalled replica trips the hedged retry; the
+        healthy copy wins, the loser cancels, output exact-once."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts = serving_setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=2.0,
+                          ttft_slo_s=0.01, seed=1)
+        r = self._router(params, cfg, router_config=rc)
+        chaos.slow_replica(r, rid=r.replicas[0], stall_steps=100,
+                           delay_s=0.01)
+        frid = r.submit(prompts[0], max_new_tokens=6, eos_token_id=None,
+                        replica=r.replicas[0])
+        steps = 0
+        while r.pending and steps < 300:
+            r.step(2)
+            steps += 1
+        snap = r.health_snapshot()
+        assert snap["counters"]["hedges"] >= 1
+        assert snap["counters"]["hedges_cancelled"] >= 1
+        np.testing.assert_array_equal(r.result(frid),
+                                      _dense(params, cfg, prompts[0], 6))
+        self._balanced(r)
+
+    def test_flaky_probe_breaker_opens_and_rejoins(self, serving_setup):
+        """INJECTOR 18: a wedged ops surface routes traffic around the
+        replica (breaker opens); once healed, the half-open probe lets it
+        rejoin and serve bit-exactly."""
+        cfg, params, prompts = serving_setup
+        r = self._router(params, cfg)
+        rep0 = r._replicas[r.replicas[0]]
+        rep0.breaker.cooldown_s = 60.0
+        chaos.flaky_probe(r, rid=rep0.rid, fails=3)
+        for _ in range(3):
+            f = r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+            assert r.request(f).replica != rep0.rid
+            while r.pending:
+                r.step()
+        assert rep0.breaker.state == "open"
+        rep0.breaker.cooldown_s = 0.02
+        time.sleep(0.03)
+        f = r.submit(prompts[1], max_new_tokens=3, eos_token_id=None)
+        while r.pending:
+            r.step()
+        assert rep0.breaker.state == "closed"       # healed: rejoined
+        np.testing.assert_array_equal(r.result(f),
+                                      _dense(params, cfg, prompts[1], 3))
+        self._balanced(r)

@@ -195,3 +195,50 @@ def test_model_average_apply_restore():
     np.testing.assert_allclose(lin.weight.numpy(), np.mean(vals), rtol=1e-6)
     ma.restore()
     np.testing.assert_allclose(lin.weight.numpy(), before)
+
+
+def test_lookahead_anchors_lazily_after_checkpoint_load():
+    """ADVICE r5: LookAhead snapshotted slow weights at CONSTRUCTION, so a
+    checkpoint loaded into the parameters afterwards made the first k-step
+    sync interpolate the live weights back toward the stale pre-load
+    values. Slow copies must anchor lazily on the first step() and
+    re-anchor in set_state_dict when no 'slow' entry is present."""
+    from paddle_tpu.incubate import LookAhead
+    paddle.seed(0)
+    lin = nn.Linear(3, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.0,   # lr 0: params frozen
+                                 parameters=lin.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=1)            # sync EVERY step
+    # "checkpoint load" after construction: overwrite the weights
+    loaded_w = np.full((3, 1), 7.0, np.float32)
+    lin.weight.set_value(loaded_w)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt.step()                                        # k=1 -> sync fires
+    opt.clear_grad()
+    # with lr 0 the fast weights never moved, so the sync must be a no-op:
+    # the old construction-time anchor pulled them toward the init values
+    np.testing.assert_allclose(lin.weight.numpy(), loaded_w)
+
+    # set_state_dict WITHOUT a slow entry must drop any existing anchor
+    opt2 = LookAhead(paddle.optimizer.SGD(learning_rate=0.0,
+                                          parameters=lin.parameters()),
+                     alpha=0.5, k=1)
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt2.step()                                       # anchors at 7.0
+    opt2.clear_grad()
+    opt2.set_state_dict({"inner": {}, "step_count": 0})
+    lin.weight.set_value(np.full((3, 1), -3.0, np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt2.step()                                       # re-anchors at -3.0
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.full((3, 1), -3.0, np.float32))
+
+    # a saved 'slow' entry still round-trips verbatim
+    sd = opt2.state_dict()
+    assert "slow" in sd and len(sd["slow"]) == len(list(lin.parameters()))
+    opt2.set_state_dict(sd)
+    assert opt2._slow is not None

@@ -1,0 +1,873 @@
+"""Serving fleet tests (ISSUE 9): health-aware router over N supervised
+replicas — power-of-two-choices routing with prefix/tenant affinity,
+cross-replica failover, circuit breakers, hedged retries, rolling
+restarts, autoscale actuation.
+
+Oracle pattern (same as test_server.py): the dense KV-cache path stays
+the numerics reference — whatever the fleet survives (replica kills, slow
+replicas, flaky probes, rolling restarts), every request's greedy tokens
+must equal the dense run bit for bit with no delivered-token repeats, and
+EVERY replica's BlockManager partition (free + evictable + in-use ==
+usable) must balance.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import generation as G
+from paddle_tpu.models.llama import LlamaConfig, init_params
+from paddle_tpu.testing import chaos
+
+
+def tiny_cfg():
+    return LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=96,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=64)
+
+
+BASE = dict(block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+            queue_depth=8)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """Params + prompts + a compiled-programs donor shared by every
+    router in the module (the same EnginePrograms sharing the fleet
+    itself relies on — one compile for all replicas and all tests)."""
+    from paddle_tpu.inference.serving import ServingConfig, ServingRouter
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 97, (s,)).astype(np.int32)
+               for s in [9, 5, 12, 7]]
+    donor = ServingRouter(params, cfg, ServingConfig(**BASE), replicas=1)
+    donor.run(prompts[:2], max_new_tokens=[2] * 2, eos_token_id=None)
+    return cfg, params, prompts, donor._programs
+
+
+def dense(params, cfg, p, n):
+    return np.asarray(G.generate(params, jnp.asarray(p[None]), cfg,
+                                 max_new_tokens=int(n)))[0]
+
+
+def mk_router(setup, replicas=2, router_config=None, **sc_kw):
+    from paddle_tpu.inference.serving import ServingConfig, ServingRouter
+    cfg, params, _, programs = setup
+    sc = dict(BASE)
+    sc.update(sc_kw)
+    share = all(sc[k] == BASE[k] for k in ("block_size", "max_slots",
+                                           "max_model_len"))
+    return ServingRouter(
+        params, cfg, ServingConfig(**sc),
+        router_config=router_config,
+        replicas=None if router_config is not None else replicas,
+        programs=programs if share else None)
+
+
+def assert_partitions(router):
+    for rid, part in router.block_partitions().items():
+        assert part["free"] + part["evictable"] + part["in_use"] == \
+            part["usable"], (rid, part)
+
+
+def assert_balanced(router):
+    for rid, part in router.block_partitions().items():
+        assert part["in_use"] == 0, (rid, part)
+    assert_partitions(router)
+
+
+# ---------------------------------------------------------------------------
+# routing: health-probed picks, P2C load balance, affinity stickiness
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_fleet_parity_and_one_compile(self, setup):
+        """N replicas behind run(): outputs bit-equal to dense, and the
+        WHOLE fleet shares one decode executable (the donor's — spawning
+        replicas never recompiles)."""
+        cfg, params, prompts, programs = setup
+        r = mk_router(setup, replicas=3)
+        traces0 = programs.stats["decode_traces"]
+        outs = r.run(prompts, max_new_tokens=8, eos_token_id=None)
+        for o, p in zip(outs, prompts):
+            np.testing.assert_array_equal(o, dense(params, cfg, p, 8))
+        assert programs.stats["decode_traces"] == traces0
+        assert_balanced(r)
+        # work actually spread: more than one replica admitted something
+        admitted = [rep.sup.engine.stats()["admitted"]
+                    for rep in r._replicas.values()]
+        assert sum(1 for a in admitted if a) >= 2, admitted
+
+    def test_prefix_affinity_sticks_to_cache_holder(self, setup):
+        """Requests sharing a block-aligned prompt prefix land on the
+        SAME replica, so the second wave hits its prefix cache instead of
+        re-prefilling on a cold one."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        rng = np.random.default_rng(3)
+        prefix = rng.integers(0, 97, (8,)).astype(np.int32)
+        wave = [np.concatenate([prefix, rng.integers(0, 97, (3,))
+                                .astype(np.int32)]) for _ in range(4)]
+        frids = []
+        for p in wave:
+            frids.append(r.submit(p, max_new_tokens=2, eos_token_id=None))
+            while r.pending:
+                r.step()
+        homes = {r.request(f).replica for f in frids}
+        assert len(homes) == 1                      # all stuck together
+        snap = r.health_snapshot()
+        assert snap["counters"]["sticky_hits"] >= 3
+        home = r._replicas[homes.pop()]
+        assert home.sup.engine.stats()["prefix_hit_tokens"] > 0
+        for f, p in zip(frids, wave):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 2))
+
+    def test_p2c_prefers_shallower_replica(self, setup):
+        """With one replica loaded and one idle, the two-choice pick
+        lands new work on the idle one."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2, queue_depth=16)
+        rid0, rid1 = r.replicas
+        for _ in range(6):                          # pile work on rid0
+            r.submit(prompts[0], max_new_tokens=8, eos_token_id=None,
+                     replica=rid0)
+        frid = r.submit(prompts[1], max_new_tokens=2, eos_token_id=None)
+        assert r.request(frid).replica == rid1
+        while r.pending:
+            r.step()
+        assert_balanced(r)
+
+    def test_no_replica_raises_structured_503(self, setup):
+        from paddle_tpu.inference.serving import ServingUnavailable
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        for rid in list(r.replicas):
+            chaos.replica_kill(r, rid=rid)
+        r.step()                                    # both crash -> broken
+        r.step()
+        with pytest.raises(ServingUnavailable) as ei:
+            r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        assert ei.value.reason == "no_replica"
+        snap = r.health_snapshot()
+        assert snap["accepting"] is False
+        assert snap["supervisor"]["broken"] is True
+
+
+# ---------------------------------------------------------------------------
+# failover: replica death mid-stream
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_replica_kill_mid_stream_bit_exact_no_repeats(self, setup):
+        """The tentpole proof: a replica dying for good with requests
+        queued AND decoding fails everything over to the healthy replica;
+        per-step deliveries concatenate to the dense oracle exactly once
+        (no repeats, no gaps), pools balance on every replica, and
+        /readyz tells the degraded-then-recovered story."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        delivered = {f: [] for f in frids}
+
+        def pump(out):
+            for f, toks in out.items():
+                delivered[f].extend(toks)
+
+        pump(r.step(2))                             # progress everywhere
+        victim = chaos.replica_kill(r, rid=r.replicas[0])
+        steps = 0
+        while r.pending and steps < 300:
+            pump(r.step(2))
+            assert_partitions(r)
+            steps += 1
+        snap = r.health_snapshot()
+        assert snap["counters"]["failovers"] >= 1
+        assert snap["counters"]["failed"] == 0
+        assert snap["replicas"][str(victim)]["broken"] is True
+        assert snap["ok"] is True                   # fleet still serves
+        assert snap["accepting"] is True            # recovered
+        for f, p in zip(frids, prompts):
+            oracle = dense(params, cfg, p, 8)
+            np.testing.assert_array_equal(
+                np.asarray(delivered[f], np.int32), oracle)
+            np.testing.assert_array_equal(r.result(f), oracle)
+        assert_balanced(r)
+
+    def test_failover_request_finished_by_delivered_tokens(self, setup):
+        """A request whose delivered tokens already complete it when its
+        replica dies is recorded FINISHED, never re-run."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        frid = r.submit(prompts[1], max_new_tokens=2, eos_token_id=None,
+                        replica=r.replicas[0])
+        got = []
+        steps = 0
+        while len(got) < 2 and steps < 50:
+            got += r.step(1).get(frid, [])
+            steps += 1
+        assert len(got) == 2                        # budget delivered...
+        if not r.request(frid).terminal:            # ...but maybe unswept
+            chaos.replica_kill(r, rid=r.replicas[0])
+            while r.pending:
+                r.step()
+        req = r.request(frid)
+        assert req.state == "finished"
+        np.testing.assert_array_equal(r.result(frid),
+                                      dense(params, cfg, prompts[1], 2))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: open -> half-open probe -> rejoin
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_flaky_probe_opens_half_open_reprobes_rejoins(self, setup):
+        """The acceptance sequence: consecutive probe failures OPEN the
+        breaker (no traffic lands while open), the cooldown triggers a
+        HALF-OPEN re-probe, and a healed probe CLOSES it — the replica
+        rejoins and serves bit-exactly. Counters land in
+        health_snapshot()."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        rid0 = r.replicas[0]
+        rep0 = r._replicas[rid0]
+        rep0.breaker.cooldown_s = 60.0     # no half-open during phase 1
+        st = chaos.flaky_probe(r, rid=rid0, fails=3)
+        homes = []
+        for _ in range(4):
+            f = r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+            homes.append(r.request(f).replica)
+            while r.pending:
+                r.step()
+        assert rep0.breaker.state == "open"
+        assert all(h != rid0 for h in homes)        # probes routed around
+        # while open: pinning to the broken-off replica is refused too
+        from paddle_tpu.inference.serving import ServingUnavailable
+        with pytest.raises(ServingUnavailable):
+            r.submit(prompts[0], max_new_tokens=2, eos_token_id=None,
+                     replica=rid0)
+        snap = r.health_snapshot()
+        b = snap["replicas"][str(rid0)]["breaker"]
+        assert b["state"] == "open" and b["opens"] >= 1
+        assert snap["counters"]["probe_failures"] >= 3
+        # cooldown -> half-open probe; the probe has HEALED (fails=3 all
+        # consumed) so the replica rejoins
+        rep0.breaker.cooldown_s = 0.05
+        time.sleep(0.07)
+        f = r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        while r.pending:
+            r.step()
+        b = rep0.breaker.snapshot()
+        assert b["state"] == "closed"
+        assert b["half_open_probes"] >= 1 and b["reclosures"] >= 1
+        # and it takes traffic again, bit-exactly
+        f = r.submit(prompts[2], max_new_tokens=3, eos_token_id=None,
+                     replica=rid0)
+        while r.pending:
+            r.step()
+        np.testing.assert_array_equal(r.result(f),
+                                      dense(params, cfg, prompts[2], 3))
+        assert st["calls"] == 3
+
+    def test_half_open_failure_reopens(self, setup):
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        rid0 = r.replicas[0]
+        rep0 = r._replicas[rid0]
+        rep0.breaker.cooldown_s = 0.05
+        chaos.flaky_probe(r, rid=rid0, fails=100)   # never heals
+        for _ in range(3):
+            r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+            while r.pending:
+                r.step()
+        assert rep0.breaker.state == "open"
+        opens0 = rep0.breaker.opens
+        time.sleep(0.07)
+        r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        while r.pending:
+            r.step()
+        b = rep0.breaker.snapshot()
+        assert b["state"] == "open"                 # probe failed: re-open
+        assert b["opens"] > opens0 and b["half_open_probes"] >= 1
+
+    def test_crash_loop_opens_breaker_and_evacuates(self, setup):
+        """Supervisor restarts count as breaker failures: a replica that
+        crashes every step (budget NOT yet exhausted) trips the breaker
+        and its in-flight work moves to a healthy replica bit-exactly."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        rid0 = r.replicas[0]
+        sup0 = r._replicas[rid0].sup
+        sup0.max_restarts = 10                      # plenty of budget
+        frid = r.submit(prompts[0], max_new_tokens=6, eos_token_id=None,
+                        replica=rid0)
+        r.step(1)
+        # re-arm a crash after every recovery: a genuine crash LOOP
+        for _ in range(r.config.breaker_threshold):
+            chaos.engine_crash(sup0, at_step=1)
+            r.step(1)
+        snap = r.health_snapshot()
+        assert snap["replicas"][str(rid0)]["breaker"]["state"] == "open"
+        assert snap["counters"]["failovers"] >= 1
+        while r.pending:
+            r.step()
+        np.testing.assert_array_equal(r.result(frid),
+                                      dense(params, cfg, prompts[0], 6))
+        assert_balanced(r)
+
+
+# ---------------------------------------------------------------------------
+# hedged retries
+# ---------------------------------------------------------------------------
+
+class TestHedging:
+    def test_slow_replica_hedges_first_token_wins_no_leak(self, setup):
+        """A stalled replica trips the TTFT hedge: the copy on the
+        healthy replica emits first and wins, the loser is cancelled
+        through the lifecycle path (KV freed), output bit-exact, exactly
+        once."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=2.0,
+                          ttft_slo_s=0.01, seed=1)
+        r = mk_router(setup, router_config=rc)
+        chaos.slow_replica(r, rid=r.replicas[0], stall_steps=100,
+                           delay_s=0.01)
+        frid = r.submit(prompts[0], max_new_tokens=6, eos_token_id=None,
+                        replica=r.replicas[0])
+        delivered = []
+        steps = 0
+        while r.pending and steps < 300:
+            delivered += r.step(2).get(frid, [])
+            steps += 1
+        snap = r.health_snapshot()
+        assert snap["counters"]["hedges"] == 1
+        assert snap["counters"]["hedge_wins"] == 1
+        assert snap["counters"]["hedges_cancelled"] == 1
+        oracle = dense(params, cfg, prompts[0], 6)
+        np.testing.assert_array_equal(np.asarray(delivered, np.int32),
+                                      oracle)
+        np.testing.assert_array_equal(r.result(frid), oracle)
+        assert r.request(frid).replica == r.replicas[1]
+        assert_balanced(r)
+
+    def test_fast_primary_cancels_hedge(self, setup):
+        """When the primary emits first, the hedge copy is the loser —
+        cancelled through the lifecycle path (blocks freed while it was
+        still queued behind the other replica's work), and the stream is
+        the primary's."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=1.0,
+                          ttft_slo_s=0.001, seed=1)   # hedge immediately
+        r = mk_router(setup, router_config=rc, queue_depth=16)
+        rid0, rid1 = r.replicas
+        # rid1 is BUSY (both slots held for many steps), so the hedge
+        # copy queues behind; rid0 stalls exactly one step, so the hedge
+        # fires, then the healed primary emits first and wins
+        fillers = [r.submit(prompts[2], max_new_tokens=20,
+                            eos_token_id=None, replica=rid1)
+                   for _ in range(2)]
+        chaos.slow_replica(r, rid=rid0, stall_steps=1, delay_s=0.002)
+        frid = r.submit(prompts[0], max_new_tokens=4, eos_token_id=None,
+                        replica=rid0)
+        time.sleep(0.005)
+        delivered = []
+        while r.pending:
+            delivered += r.step(1).get(frid, [])
+        snap = r.health_snapshot()
+        assert snap["counters"]["hedges"] == 1
+        assert snap["counters"]["hedge_wins"] == 0    # primary won
+        assert snap["counters"]["hedges_cancelled"] == 1
+        np.testing.assert_array_equal(np.asarray(delivered, np.int32),
+                                      dense(params, cfg, prompts[0], 4))
+        assert r.request(frid).replica == rid0
+        for f in fillers:
+            np.testing.assert_array_equal(
+                r.result(f), dense(params, cfg, prompts[2], 20))
+        assert_balanced(r)
+
+    def test_hedging_off_by_default(self, setup):
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        assert r.config.hedge_after_s is None
+        r.run(prompts[:2], max_new_tokens=2, eos_token_id=None)
+        assert r.health_snapshot()["counters"]["hedges"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rolling restarts
+# ---------------------------------------------------------------------------
+
+class TestRollingRestart:
+    def test_roll_serves_live_trace_zero_failed(self, setup):
+        """The acceptance proof: a rolling restart across every replica
+        while a live trace is in flight — all requests FINISH bit-exactly
+        (zero failed), every replica rebuilds (generation bumps), and the
+        shared programs mean the roll never recompiles."""
+        cfg, params, prompts, programs = setup
+        r = mk_router(setup, replicas=2)
+        traces0 = programs.stats["decode_traces"]
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        r.start_rolling_restart()
+        submitted_mid = False
+        steps = 0
+        while (r.pending or r.rolling) and steps < 500:
+            r.step(2)
+            assert_partitions(r)
+            if not submitted_mid and r.rolling:
+                # live traffic lands DURING the roll too
+                frids.append(r.submit(prompts[0], max_new_tokens=4,
+                                      eos_token_id=None))
+                submitted_mid = True
+            steps += 1
+        assert submitted_mid and not r.rolling
+        snap = r.health_snapshot()
+        assert snap["counters"]["replica_restarts"] == 2
+        assert snap["counters"]["rolls_completed"] == 1
+        assert snap["counters"]["failed"] == 0
+        for rep in snap["replicas"].values():
+            assert rep["generation"] == 1
+        for f, n in zip(frids, [8, 8, 8, 8, 4]):
+            req = r.request(f)
+            assert req.state == "finished"
+            np.testing.assert_array_equal(
+                r.result(f), dense(params, cfg, req.prompt, n))
+        assert programs.stats["decode_traces"] == traces0
+        assert_balanced(r)
+
+    def test_roll_deadline_fails_over_stragglers(self, setup):
+        """A drain deadline of ~0 forces the roll to move in-flight work
+        instead of finishing it in place — still zero failed requests and
+        full bit-exact outputs."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        r.step(1)                                   # some tokens out
+        r.start_rolling_restart(drain_deadline_s=0.0)
+        steps = 0
+        while (r.pending or r.rolling) and steps < 500:
+            r.step(2)
+            steps += 1
+        snap = r.health_snapshot()
+        assert snap["counters"]["failed"] == 0
+        assert snap["counters"]["replica_restarts"] == 2
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 8))
+        assert_balanced(r)
+
+
+# ---------------------------------------------------------------------------
+# autoscale actuation + rejoin-file handshake
+# ---------------------------------------------------------------------------
+
+class TestAutoscale:
+    def test_scale_up_spawns_and_writes_rejoin_file(self, setup, tmp_path):
+        from paddle_tpu.distributed.launch.main import read_rejoin_count
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=1, max_replicas=3, seed=0)
+        r = mk_router(setup, router_config=rc)
+        for p in prompts * 2:                       # queue past high water
+            r.submit(p, max_new_tokens=4, eos_token_id=None)
+        path = str(tmp_path / "rejoin")
+        sig = r.autoscale(rejoin_file=path, workers=2)
+        assert sig["action"] == "scale_up"
+        assert sig.get("spawned") is not None
+        assert len(r.replicas) == 2
+        assert read_rejoin_count(path) == 2         # launcher-readable
+        while r.pending:
+            r.step()
+        assert_balanced(r)
+
+    def test_scale_in_drains_least_loaded_never_below_one(self, setup):
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, seed=0)
+        r = mk_router(setup, router_config=rc)
+        r.run(prompts[:2], max_new_tokens=2, eos_token_id=None)
+        sig = r.autoscale()                         # idle fleet
+        assert sig["action"] == "scale_in"
+        for _ in range(5):
+            r.step()
+        assert len(r.replicas) == 1
+        sig = r.autoscale()
+        assert "retiring" not in sig                # floor: one replica
+        assert len(r.replicas) == 1
+        # the survivor still serves bit-exactly
+        out = r.run([prompts[0]], max_new_tokens=3, eos_token_id=None)[0]
+        cfg_, params_ = setup[0], setup[1]
+        np.testing.assert_array_equal(out, dense(params_, cfg_,
+                                                 prompts[0], 3))
+
+    def test_poll_rejoin_consumes_signal(self, setup, tmp_path):
+        from paddle_tpu.distributed.launch.main import write_rejoin_file
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=1, max_replicas=2, seed=0)
+        r = mk_router(setup, router_config=rc)
+        path = str(tmp_path / "rejoin")
+        write_rejoin_file(path, 5)                  # offer more than cap
+        spawned = r.poll_rejoin(path)
+        assert spawned and len(r.replicas) == 2     # bounded by the cap
+        assert not os.path.exists(path)             # consumed
+        assert r.poll_rejoin(path) == []            # idempotent
+
+
+# ---------------------------------------------------------------------------
+# snapshot registry + server front line over the router
+# ---------------------------------------------------------------------------
+
+class TestRouterSnapshotAndServer:
+    def test_snapshot_pinned_to_registry_and_serializable(self, setup):
+        from paddle_tpu.inference.serving import ROUTER_HEALTH_FIELDS
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        r.run(prompts[:2], max_new_tokens=2, eos_token_id=None)
+        snap = r.health_snapshot()
+        assert set(snap) == set(ROUTER_HEALTH_FIELDS)
+        json.dumps(snap)
+
+    def test_server_front_lines_router_bit_exact(self, setup):
+        """ONE ServingServer serves the whole fleet through the same
+        handle()/agenerate() surface a single supervisor gets."""
+        from paddle_tpu.inference.serving import ServingServer
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        srv = ServingServer(r)
+
+        async def main():
+            outs = [None] * len(prompts)
+            async with srv.running():
+                code, ready = await srv.handle("GET", "/readyz")
+                assert code == 200 and ready["ready"]
+
+                async def one(i):
+                    toks = []
+                    async for ev in srv.agenerate(prompts[i],
+                                                  max_new_tokens=6,
+                                                  eos_token_id=None):
+                        if ev["type"] == "token":
+                            toks.append(ev["token"])
+                    outs[i] = toks
+
+                await asyncio.gather(*(one(i)
+                                       for i in range(len(prompts))))
+                code, metrics = await srv.handle("GET", "/metrics")
+                assert code == 200 and "replicas" in metrics
+                code, health = await srv.handle("GET", "/healthz")
+                assert code == 200 and health["ok"]
+            return outs
+
+        outs = asyncio.run(asyncio.wait_for(main(), timeout=120.0))
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(
+                np.asarray(o, np.int32), dense(params, cfg, prompts[i], 6))
+        assert srv.drain_report["leaked_blocks"] == 0
+
+    def test_server_readyz_degraded_then_recovered(self, setup):
+        """/readyz over the router reflects the fleet: 503 when every
+        replica is out, 200 again once capacity is back."""
+        from paddle_tpu.inference.serving import ServingServer
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        for rid in list(r.replicas):
+            chaos.replica_kill(r, rid=rid)
+        r.step()
+        r.step()
+        srv = ServingServer(r)
+
+        async def main():
+            code, body = await srv.handle("GET", "/readyz")
+            assert code == 503 and body["broken"]
+            r.spawn_replica()                       # capacity restored
+            code, body = await srv.handle("GET", "/readyz")
+            return code, body
+
+        code, body = asyncio.run(asyncio.wait_for(main(), timeout=60.0))
+        assert code == 200 and body["ready"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: randomized failover fuzz at every lifecycle point
+# ---------------------------------------------------------------------------
+
+class TestFailoverFuzz:
+    @pytest.mark.parametrize("trial", range(5))
+    def test_fault_at_every_lifecycle_point(self, setup, trial):
+        """Kill / stall / flaky-probe a replica while its requests sit at
+        randomized lifecycle points — queued, mid-chunked-prefill,
+        decoding, preempted (undersized pool), and draining (a roll in
+        flight) — asserting the free+evictable+in-use partition on every
+        surviving replica after EVERY step, no duplicate delivered
+        tokens, and survivor outputs bit-exact vs the single-replica
+        oracle."""
+        cfg, params, prompts, _ = setup
+        rng = np.random.default_rng(100 + trial)
+        # undersized pool + chunked prefill: preemption and mid-prefill
+        # states occur naturally; long prompts exercise the chunk path
+        r = mk_router(setup, replicas=2, num_blocks=10, prefill_chunk=4,
+                      queue_depth=16)
+        long_prompt = rng.integers(0, 97, (14,)).astype(np.int32)
+        reqs = {}
+        for i in range(6):
+            p = long_prompt if i % 3 == 0 else prompts[i % 4]
+            n = int(rng.integers(2, 9))
+            frid = r.submit(p, max_new_tokens=n, eos_token_id=None)
+            reqs[frid] = (p, n, [])
+        # walk to a random lifecycle point, then inject a random fault
+        for _ in range(int(rng.integers(0, 6))):
+            for f, toks in r.step(1).items():
+                reqs[f][2].extend(toks)
+            assert_partitions(r)
+        fault = ["kill", "slow", "flaky", "roll"][int(rng.integers(0, 4))]
+        victim = r.replicas[int(rng.integers(0, 2))]
+        if fault == "kill":
+            chaos.replica_kill(r, rid=victim)
+        elif fault == "slow":
+            chaos.slow_replica(r, rid=victim, stall_steps=3,
+                               delay_s=0.002)
+        elif fault == "flaky":
+            r._replicas[victim].breaker.cooldown_s = 0.02
+            chaos.flaky_probe(r, rid=victim, fails=4)
+        else:                                       # the draining point
+            r.start_rolling_restart()
+        # late traffic lands mid-fault too
+        frid = r.submit(prompts[0], max_new_tokens=3, eos_token_id=None)
+        reqs[frid] = (prompts[0], 3, [])
+        steps = 0
+        while (r.pending or r.rolling) and steps < 600:
+            for f, toks in r.step(1).items():
+                reqs[f][2].extend(toks)
+            assert_partitions(r)
+            steps += 1
+        assert steps < 600
+        snap = r.health_snapshot()
+        assert snap["counters"]["failed"] == 0
+        preempted = any(rep.sup.engine.stats()["preemptions"] > 0
+                        for rep in r._replicas.values())
+        for f, (p, n, delivered) in reqs.items():
+            oracle = dense(params, cfg, p, n)
+            np.testing.assert_array_equal(
+                np.asarray(delivered, np.int32), oracle,
+                err_msg=f"frid {f} fault {fault} (dup or gap)")
+            np.testing.assert_array_equal(r.result(f), oracle)
+        assert_balanced(r)
+        # the trace genuinely exercised paging machinery at least once
+        # across trials; per-trial we only require accounting to balance
+        del preempted
+
+
+# ---------------------------------------------------------------------------
+# review regressions: record retention + stale hedge across a roll rebuild
+# ---------------------------------------------------------------------------
+
+class TestReviewRegressions:
+    def test_terminal_records_bounded_recent_results_readable(self, setup):
+        """A long-lived router must not retain every request ever routed:
+        past the retention bound the OLDEST terminal records evict while
+        recent results stay readable and live requests are never
+        touched."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        r._keep_finished = 3                  # tiny bound for the test
+        frids = []
+        for i in range(6):
+            frids.append(r.submit(prompts[i % 4], max_new_tokens=2,
+                                  eos_token_id=None))
+            while r.pending:
+                r.step()
+        assert len(r._reqs) <= 3 + len(r._active)
+        assert frids[0] not in r._reqs        # oldest evicted
+        np.testing.assert_array_equal(       # newest still readable
+            r.result(frids[-1]), dense(params, cfg, prompts[5 % 4], 2))
+
+    def test_roll_deadline_drops_hedge_copy_cleanly(self, setup):
+        """Review fix: a hedge copy whose host replica hits the roll's
+        drain deadline must be CLEARED (not left dangling) — a later
+        primary failover must resubmit, never promote a stale srid of
+        the rebuilt supervisor (which would strand the request
+        non-terminal forever)."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=2.0,
+                          ttft_slo_s=0.005, seed=1)
+        r = mk_router(setup, router_config=rc)
+        rid0, rid1 = r.replicas
+        # primary on rid1, stalled long -> the hedge lands on rid0
+        chaos.slow_replica(r, rid=rid1, stall_steps=1000, delay_s=0.002)
+        frid = r.submit(prompts[0], max_new_tokens=4, eos_token_id=None,
+                        replica=rid1)
+        time.sleep(0.01)
+        steps = 0
+        while r.request(frid).hedge is None and steps < 50:
+            r.step(1)
+            steps += 1
+        req = r.request(frid)
+        assert req.hedge is not None and req.hedge[0] == rid0
+        # the roll's first target is rid0 — the HEDGE host — with a zero
+        # drain deadline, so the copy is dropped and rid0 rebuilt;
+        # advancing the roll takes a couple of steps (hedge tokens may
+        # win the request outright on a step in between, which is fine —
+        # the invariant under test is no stale-promotion hang)
+        r.start_rolling_restart(drain_deadline_s=0.0)
+        for _ in range(3):
+            r.step(1)
+        assert r.request(frid).hedge is None   # never left dangling
+        # now lose the primary: failover must RESUBMIT (or have finished
+        # via the promoted hedge), never strand the request
+        chaos.replica_kill(r, rid=rid1)
+        steps = 0
+        while (r.pending or r.rolling) and steps < 400:
+            r.step(1)
+            steps += 1
+        assert steps < 400                     # no stranded non-terminal
+        assert r.request(frid).state == "finished"
+        np.testing.assert_array_equal(r.result(frid),
+                                      dense(params, cfg, prompts[0], 4))
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        assert_balanced(r)
+
+    def test_fleet_wide_queue_full_sheds_429_not_503(self, setup):
+        """Review fix: healthy replicas whose only problem is a FULL
+        admission queue must shed with the structured ServingQueueFull
+        (the 429 a single supervisor gives, counted as shed), never a
+        misleading 'broken/circuit-broken' 503."""
+        from paddle_tpu.inference.serving import ServingQueueFull
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2, queue_depth=1, max_slots=1)
+        # no steps run between submits, so each replica's capacity is its
+        # queue bound (1): two submits saturate the fleet
+        for _ in range(2):
+            r.submit(prompts[0], max_new_tokens=8, eos_token_id=None)
+        with pytest.raises(ServingQueueFull) as ei:
+            r.submit(prompts[1], max_new_tokens=2, eos_token_id=None)
+        assert ei.value.retry_after_s is not None
+        shed = sum(rep.sup.engine.stats()["shed"]
+                   for rep in r._replicas.values())
+        assert shed >= 1                       # the reject was COUNTED
+        while r.pending:
+            r.step()
+        assert_balanced(r)
+
+    def test_scale_in_never_drains_last_healthy_replica(self, setup):
+        """Review fix: with one healthy and one BROKEN replica, an idle
+        scale-in must not pick the healthy replica (the broken one's
+        sentinel depth made it the min-depth victim) — the floor is one
+        HEALTHY replica, not one replica."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, seed=0)
+        r = mk_router(setup, router_config=rc)
+        r.run(prompts[:2], max_new_tokens=2, eos_token_id=None)
+        chaos.replica_kill(r, rid=r.replicas[0])
+        r.step()
+        sig = r.autoscale()                    # idle -> wants scale_in
+        assert "retiring" not in sig           # sole healthy survivor
+        for _ in range(3):
+            r.step()
+        # the healthy replica still serves
+        out = r.run([prompts[0]], max_new_tokens=3, eos_token_id=None)[0]
+        np.testing.assert_array_equal(out, dense(params, cfg,
+                                                 prompts[0], 3))
+
+    def test_roll_reaches_broken_replica_behind_last_routable_head(
+            self, setup):
+        """Review fix: when the SECOND replica in roll order is the
+        broken one, the head is the last routable replica — the roll
+        must pick the broken (traffic-free) replica first instead of
+        stalling forever, and heal it."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        rid0, rid1 = r.replicas
+        chaos.replica_kill(r, rid=rid1)        # the LATER roll entry
+        r.step()
+        assert r._replicas[rid1].sup.broken
+        n = r.rolling_restart()                # must terminate
+        assert n == 2
+        snap = r.health_snapshot()
+        assert snap["counters"]["failed"] == 0
+        assert snap["fleet"]["routable"] == 2  # broken replica healed
+        out = r.run([prompts[0]], max_new_tokens=3, eos_token_id=None)[0]
+        np.testing.assert_array_equal(out, dense(params, cfg,
+                                                 prompts[0], 3))
+
+    def test_half_open_probe_bypasses_probe_cache(self, setup):
+        """Review fix: with probe_ttl_s > 0, the half-open decision must
+        hit the REAL probe — a cached pre-failure success snapshot must
+        not close the breaker on a still-sick replica."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        rc = RouterConfig(replicas=2, seed=0, probe_ttl_s=60.0)
+        r = mk_router(setup, router_config=rc)
+        rid0 = r.replicas[0]
+        rep0 = r._replicas[rid0]
+        # a routing probe caches a healthy snapshot...
+        r.run([prompts[0]], max_new_tokens=2, eos_token_id=None)
+        assert rep0.probe_cache is not None
+        # ...then the replica's ops surface wedges and the breaker opens
+        rep0.breaker.cooldown_s = 0.01
+        st = chaos.flaky_probe(r, rid=rid0, fails=100)   # never heals
+        rep0.breaker.trip()
+        rep0.probe_cache = {"accepting": True}  # poisoned stale cache
+        time.sleep(0.02)
+        r.submit(prompts[0], max_new_tokens=2, eos_token_id=None)
+        while r.pending:
+            r.step()
+        assert st["calls"] >= 1                # a REAL probe ran
+        assert rep0.breaker.state == "open"    # and kept it walled off
+
+    def test_zero_count_rejoin_file_is_consumed(self, setup, tmp_path):
+        """Review fix: a rejoin file holding \"0\" is legal output of
+        write_rejoin_file(path, 0) — consume must still remove it, or
+        every later poll re-reads the stale signal forever."""
+        from paddle_tpu.distributed.launch.main import (
+            consume_rejoin_file, write_rejoin_file)
+        path = str(tmp_path / "rejoin0")
+        write_rejoin_file(path, 0)
+        assert consume_rejoin_file(path) == 0
+        assert not os.path.exists(path)
+
+    def test_lifetime_counters_survive_roll_and_scale_in(self, setup):
+        """Review fix: breaker_opens and supervisor.restarts are
+        documented lifetime totals — a rolling-restart rebuild (which
+        resets each supervisor's counter) or a scale-in removal (which
+        drops the replica's breaker) must never make them go
+        backwards."""
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup, replicas=2)
+        # one recoverable crash -> restarts 1; one breaker trip
+        sup0 = r._replicas[r.replicas[0]].sup
+        sup0.max_restarts = 5
+        f = r.submit(prompts[0], max_new_tokens=4, eos_token_id=None,
+                     replica=r.replicas[0])
+        chaos.engine_crash(sup0, at_step=1)
+        while r.pending:
+            r.step(1)
+        r._replicas[r.replicas[1]].breaker.trip()
+        before = r.health_snapshot()
+        assert before["supervisor"]["restarts"] >= 1
+        assert before["counters"]["breaker_opens"] >= 1
+        r._replicas[r.replicas[1]].breaker.record_success()  # heal
+        r.rolling_restart()                    # resets every supervisor
+        r.drain_replica(r.replicas[1])         # and drop a replica
+        for _ in range(3):
+            r.step()
+        after = r.health_snapshot()
+        assert after["supervisor"]["restarts"] >= \
+            before["supervisor"]["restarts"]
+        assert after["counters"]["breaker_opens"] >= \
+            before["counters"]["breaker_opens"]
+        del f

@@ -508,9 +508,14 @@ class TestServerInProcess:
         async def main():
             srv = ServingServer(sup)
             async with srv.running():
-                # fill the slot + the queue synchronously on the pump
+                # fill the slot, WAIT for the pump to admit it (on a
+                # loaded host the second submit can reach the pump's cmd
+                # drain before a step ran, and queue_depth=1 would shed
+                # the wrong request), then fill the queue
                 await srv.submit(prompt=prompts[0].tolist(),
                                  max_new_tokens=8, eos_token_id=None)
+                while len(sup.engine._sched.queue):
+                    await asyncio.sleep(0.005)
                 await srv.submit(prompt=prompts[1].tolist(),
                                  max_new_tokens=8, eos_token_id=None)
                 st, body = await srv.handle(
@@ -810,3 +815,24 @@ class TestServerTCP:
                     toks.append(ev["token"])
         np.testing.assert_array_equal(np.asarray(toks, np.int32),
                                       dense(params, cfg, prompts[0], 4))
+
+
+class TestSupervisorRecordRetention:
+    def test_terminal_tracked_requests_bounded(self, setup):
+        """Review fix (PR 9): a long-lived replica must not retain a
+        TrackedRequest for every request it ever served — terminal
+        records evict past the scheduler's own retention bound while
+        recent results stay readable."""
+        cfg, params, prompts, _ = setup
+        sup = mk_sup(setup, queue_depth=2, max_slots=1)
+        keep = sup._keep_finished
+        assert keep == sup.engine._sched.keep_finished
+        last = None
+        for i in range(keep + 4):
+            last = sup.submit(prompts[i % 4], max_new_tokens=2,
+                              eos_token_id=None)
+            while sup.pending:
+                sup.step()
+        assert len(sup._reqs) <= keep + len(sup._by_erid)
+        assert 0 not in sup._reqs              # oldest evicted
+        assert len(sup.result(last)) == 2      # newest readable

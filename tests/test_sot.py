@@ -472,3 +472,47 @@ class TestR5Hardening:
             sf(x)
             sf(x)
         assert count[0] == n             # compiled replays skip the body
+
+    def test_unrelated_local_subscr_store_keeps_container_guard(self):
+        """ADVICE r5 (medium): `x = cfg[k]; buf[i] = x` — a subscript
+        store into an unrelated LOCAL right after a container load — must
+        NOT drop the guard on the read-only global: external mutation of
+        the container must invalidate the compiled path, not serve it
+        stale."""
+        from paddle_tpu.jit.sot import _container_mutated_names
+        cfg = [2.0]
+
+        def f(x):
+            scale = cfg[0]               # read-only use of the closure
+            buf = {}
+            buf[0] = scale               # store targets the LOCAL buf
+            if x.sum() > 0:
+                return x * buf[0]
+            return x
+
+        assert "cfg" not in _container_mutated_names(f.__code__)
+        sf = to_static(f, backend="sot")
+        x = t([3.0])
+        np.testing.assert_allclose(sf(x).numpy(), [6.0])
+        np.testing.assert_allclose(sf(x).numpy(), [6.0])  # compiled replay
+        cfg[0] = 5.0                     # external mutation
+        # the old 12-instruction window marked cfg as self-mutated here,
+        # suppressed its guard, and replayed the stale 2.0 path (-> 6.0)
+        np.testing.assert_allclose(sf(x).numpy(), [15.0])
+
+    def test_chained_subscript_store_still_marks_container(self):
+        """The symbolic-stack scan must keep the TRUE positives: a store
+        through a chained subscript/attr (`cfg[i][j] = v`) and a mutating
+        method load still mark the container, so self-mutating code keeps
+        its guard suppression (no thrash-compile)."""
+        from paddle_tpu.jit.sot import _container_mutated_names
+        nested = [[0.0]]
+        log = []
+
+        def g(x):
+            nested[0][0] = float(x.sum())
+            log.append(1)
+            return x
+
+        marked = _container_mutated_names(g.__code__)
+        assert "nested" in marked and "log" in marked

@@ -107,3 +107,61 @@ class TestStaticWorkflow:
             _w.simplefilter("always")
             F.dropout(x, 0.5, training=True)
         assert any("construction-time state" in str(m.message) for m in w)
+
+
+class TestMissingFeed:
+    """ADVICE r5: Executor.run silently substituted the construction-time
+    placeholder (zeros, dynamic dims as 1) for any placeholder missing
+    from `feed` — a typo'd feed name yielded wrong numerics. A placeholder
+    the FETCHED subgraph depends on must now raise a structured error."""
+
+    def test_missing_feed_raises_with_name(self):
+        from paddle_tpu.static import MissingFeedError
+        x = paddle.static.data("x", [None, 4], "float32")
+        out = paddle.matmul(x, paddle.to_tensor(
+            np.ones((4, 2), np.float32)))
+        exe = paddle.static.Executor()
+        with pytest.raises(MissingFeedError) as ei:
+            exe.run(feed={"X_typo": np.ones((3, 4), np.float32)},
+                    fetch_list=[out])
+        assert ei.value.missing == ["x"]
+        assert "x" in str(ei.value)
+
+    def test_unrelated_placeholder_may_stay_unfed(self):
+        """Only placeholders the fetch NEEDS are required: a second
+        placeholder feeding a different head does not block fetching the
+        first head."""
+        x = paddle.static.data("x", [2], "float32")
+        y = paddle.static.data("y", [2], "float32")
+        out_x = x * 2.0
+        _out_y = y + 1.0                     # other head, not fetched
+        exe = paddle.static.Executor()
+        (res,) = exe.run(feed={"x": np.array([1.0, 2.0], np.float32)},
+                         fetch_list=[out_x])
+        np.testing.assert_allclose(res, [2.0, 4.0])
+
+    def test_training_program_requires_loss_feeds(self):
+        """A training program's loss drives backward even when only a
+        non-label fetch is requested — its placeholders are needed too."""
+        from paddle_tpu.static import MissingFeedError
+        paddle.seed(0)
+        x = paddle.static.data("x", [None, 3], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        lin = nn.Linear(3, 1)
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+        exe = paddle.static.Executor()
+        with pytest.raises(MissingFeedError) as ei:
+            exe.run(feed={"x": np.ones((4, 3), np.float32)},
+                    fetch_list=[pred])
+        assert ei.value.missing == ["y"]
+
+    def test_passthrough_fetch_of_unfed_placeholder_raises(self):
+        from paddle_tpu.static import MissingFeedError
+        x = paddle.static.data("x", [2], "float32")
+        exe = paddle.static.Executor()
+        with pytest.raises(MissingFeedError):
+            exe.run(feed={}, fetch_list=[x])
