@@ -158,7 +158,8 @@ def sep_parallel_attention(q, k, v, causal: bool = False,
     """
     qt, kt, vt = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
     if use_kernels is None:
-        use_kernels = jax.default_backend() == "tpu"
+        from ..kernels.dispatch import on_tpu
+        use_kernels = on_tpu()
     fn = {"ring": ring_flash_attention,
           "ulysses": ulysses_attention}.get(impl)
     if fn is None:

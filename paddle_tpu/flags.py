@@ -282,6 +282,27 @@ define_flag("FLAGS_serving_preempt", True,
             "(prompt + max_new - 1 KV entries charged up front, "
             "conservative admission, no preemption).", bool)
 
+define_flag("FLAGS_serving_paged_kernel", "auto",
+            "Decode attention path for the paged serving engine "
+            "(ServingConfig.paged_kernel): 'auto' runs the Pallas "
+            "flash-decoding paged-attention kernel on TPU (block tables "
+            "consumed in-kernel via scalar prefetch — no dense gather of "
+            "the KV blocks is ever materialized; GQA grouped in-kernel; "
+            "int8 dequant fused into the block loads) and the XLA "
+            "gather + masked-softmax fallback elsewhere; 'on' forces the "
+            "kernel (interpret mode off-TPU — how tier-1 exercises the "
+            "real kernel path on CPU); 'off' forces the gather fallback "
+            "(the parity oracle).", str)
+define_flag("FLAGS_serving_kv_quant", "",
+            "Paged KV-cache quantization (ServingConfig.kv_quant): "
+            "'int8' stores K/V blocks as int8 with per-token-per-head "
+            "fp32 scales alongside the pool — ~2-4x more usable blocks "
+            "at a fixed byte budget, multiplying concurrent sequences, "
+            "prefix-cache value and preemption headroom at once; "
+            "dequantization is fused into the paged kernel's K/V loads "
+            "(the gather fallback dequantizes after its gather). '' = "
+            "fp pool at the model/cache dtype. Composes with the "
+            "weight-only quantize='int8' path.", str)
 define_flag("FLAGS_serving_policy", "fifo",
             "Default admission policy for ServingEngine (ServingConfig."
             "policy): fifo (submission order — the parity baseline), "

@@ -103,7 +103,19 @@ HEALTH_SNAPSHOT_FIELDS = {
     "max_slots": "slot-table width (the compiled decode batch dim)",
     "free_blocks": "KV blocks allocatable right now (free list + "
                    "evictable refcount-0 cached blocks)",
-    "usable_blocks": "pool size excluding the reserved null block",
+    "usable_blocks": "pool size excluding the reserved null block — the "
+                     "EFFECTIVE capacity: at a fixed byte budget an int8 "
+                     "pool holds ~2-4x the blocks of an fp one",
+    "kv_pool_bytes": "device bytes the KV pool holds (K + V + the scale "
+                     "planes on quantized layouts) — the denominator of "
+                     "the int8 capacity win",
+    "kv_quant": "KV-pool quantization mode (null = fp at the model/cache "
+                "dtype; 'int8' = int8 blocks + per-token-per-head fp32 "
+                "scales, dequant fused into the kernel's loads)",
+    "paged_kernel": "decode attention path: true = the Pallas "
+                    "flash-decoding paged-attention kernel (block tables "
+                    "consumed in-kernel), false = the XLA gather + masked-"
+                    "softmax fallback (FLAGS_serving_paged_kernel)",
     "retry_after_s": "suggested client backoff when shedding: the mean "
                      "recent retirement interval (the conservative "
                      "FLAGS_serving_retry_after_s default before two "
@@ -173,6 +185,17 @@ class ServingConfig:
     num_blocks: int = 0              # 0 = auto (max_slots full sequences)
     quantize: Optional[str] = None   # "int8" -> weight-only decode path
     cache_dtype: Any = None          # None -> model activation dtype
+    kv_quant: Any = _UNSET           # "int8" -> quantized KV pool (int8
+    #                                  blocks + per-token-per-head scales);
+    #                                  unset -> FLAGS_serving_kv_quant;
+    #                                  None/"" = fp pool. Composes with
+    #                                  quantize="int8" (weights).
+    paged_kernel: Any = _UNSET       # decode attention path: True/"on" =
+    #                                  Pallas flash-decoding kernel
+    #                                  (interpret off-TPU), False/"off" =
+    #                                  XLA gather fallback, "auto" = kernel
+    #                                  on TPU only; unset ->
+    #                                  FLAGS_serving_paged_kernel
     prefix_cache: Any = _UNSET       # bool; None/False = off
     prefill_chunk: Any = _UNSET      # tokens/chunk; None/0 = whole prompt
     preempt: Any = _UNSET            # bool; None/False = legacy reservation
@@ -213,10 +236,19 @@ class ServingConfig:
                                    if self.tenant_cache_quota else None)
         if self.policy is None:
             self.policy = str(flag("FLAGS_serving_policy"))
-        from ...models.llama import QUANTIZE_MODES
-        if self.quantize not in QUANTIZE_MODES:
-            raise ValueError(f"unknown quantize mode {self.quantize!r}; "
-                             f"options: {QUANTIZE_MODES}")
+        from ...models.llama import (KV_QUANT_MODES, QUANTIZE_MODES,
+                                     validate_quant_mode)
+        validate_quant_mode(self.quantize, QUANTIZE_MODES)
+        if self.kv_quant == _UNSET:
+            self.kv_quant = str(flag("FLAGS_serving_kv_quant"))
+        self.kv_quant = self.kv_quant or None      # ""/False -> fp pool
+        validate_quant_mode(self.kv_quant, KV_QUANT_MODES, "kv_quant")
+        if self.paged_kernel == _UNSET:
+            self.paged_kernel = str(flag("FLAGS_serving_paged_kernel"))
+        from ...kernels.dispatch import use_pallas
+        # resolve once at construction (structured error on bad knobs);
+        # the resolved bool keys the compiled-program signature
+        self.paged_kernel = use_pallas(self.paged_kernel)
 
 
 class ServingEngine:
@@ -243,7 +275,8 @@ class ServingEngine:
                                   self.config.num_blocks,
                                   dtype=self.config.cache_dtype,
                                   prefix_cache=self.config.prefix_cache,
-                                  tenant_quota=self.config.tenant_cache_quota)
+                                  tenant_quota=self.config.tenant_cache_quota,
+                                  kv_quant=self.config.kv_quant)
         self._policy = resolve_policy(
             self.config.policy,
             ttft_slo_s=float(flag("FLAGS_serving_ttft_slo_s")))
@@ -271,7 +304,8 @@ class ServingEngine:
         self._jax = jax
         key = (model_config, self.config.block_size, self.config.max_slots,
                self.config.max_model_len, self.config.quantize,
-               str(self.config.cache_dtype))
+               str(self.config.cache_dtype), self.config.kv_quant,
+               self.config.paged_kernel)
         if programs is not None:
             if programs.key != key:
                 raise ValueError(
@@ -314,6 +348,8 @@ class ServingEngine:
             return G.paged_prefill_chunk(params, cfg, ids, start, chunk_len,
                                          block_tables, pool)
 
+        use_kernel = self.config.paged_kernel
+
         def decode_fn(params, pool, tokens, seq_lens, steps_left, done,
                       block_tables, eos_ids, limit):
             stats["decode_traces"] += 1            # trace-time only
@@ -330,7 +366,7 @@ class ServingEngine:
                 active = (~done) & (steps_left > 0)
                 logits, pool, _drops = G.paged_decode_step(
                     params, cfg, tokens, seq_lens, block_tables, pool,
-                    active)
+                    active, use_kernel=use_kernel)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 nxt = jnp.where(active, nxt, tokens)
                 done = done | (active & (nxt == eos_ids))
@@ -915,6 +951,10 @@ class ServingEngine:
                 "oom_truncated": self._sched.oom_truncated,
                 "cached_blocks": self.cache.manager.cached_blocks,
                 "evictions": self.cache.manager.evictions,
+                "usable_blocks": self.cache.manager.num_blocks - 1,
+                "kv_quant": self.config.kv_quant,
+                "paged_kernel": self.config.paged_kernel,
+                "kv_pool_bytes": self.cache.kv_bytes(),
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}
 
     def health_snapshot(self) -> Dict[str, Any]:
@@ -982,6 +1022,9 @@ class ServingEngine:
             "max_slots": self.config.max_slots,
             "free_blocks": self.cache.free_blocks,
             "usable_blocks": self.cache.manager.num_blocks - 1,
+            "kv_pool_bytes": self.cache.kv_bytes(),
+            "kv_quant": self.config.kv_quant,
+            "paged_kernel": self.config.paged_kernel,
             "retry_after_s": sched.retry_after_s(),
             "counters": {
                 "admitted": sched.admitted, "retired": sched.retired,

@@ -254,17 +254,24 @@ class PagedKVCache:
     def __init__(self, model_config, max_slots: int, max_model_len: int,
                  block_size: int, num_blocks: int = 0, dtype=None,
                  prefix_cache: bool = True,
-                 tenant_quota: Optional[int] = None):
+                 tenant_quota: Optional[int] = None, kv_quant=None):
         from ...models.generation import init_paged_pool
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
         self.prefix_cache = bool(prefix_cache)
+        self.kv_quant = kv_quant
         self.blocks_per_seq = max(1, math.ceil(max_model_len / block_size))
         if num_blocks <= 0:
             # auto-size: every slot can hold a full-length sequence, +1 null
             num_blocks = max_slots * self.blocks_per_seq + 1
+        # kv_quant="int8": int8 K/V blocks + per-token-per-head fp32 scale
+        # planes ride in the same pool pytree — every host-side structure
+        # here (block manager, tables, prefix-cache keys over TOKEN IDS)
+        # is layout-agnostic, so int8 blocks hash/hit/evict exactly like
+        # fp blocks; only the device pool layout changes
         self.pool: Dict = init_paged_pool(model_config, num_blocks,
-                                          block_size, dtype)
+                                          block_size, dtype,
+                                          kv_quant=kv_quant)
         self.manager = BlockManager(num_blocks, block_size,
                                     tenant_quota=tenant_quota)
         self.tables = np.zeros((max_slots, self.blocks_per_seq), np.int32)
@@ -373,5 +380,7 @@ class PagedKVCache:
         self.tables[slot] = 0
 
     def kv_bytes(self) -> int:
-        k = self.pool["k"]
-        return 2 * k.size * k.dtype.itemsize
+        """Device bytes the pool holds — every leaf (K + V, plus the scale
+        planes on quantized layouts), the number capacity planning and the
+        ``kv_pool_bytes`` ops field report."""
+        return sum(a.size * a.dtype.itemsize for a in self.pool.values())

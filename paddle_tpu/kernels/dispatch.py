@@ -1,0 +1,64 @@
+"""One backend gate for every Pallas kernel in :mod:`paddle_tpu.kernels`.
+
+Before this module each kernel file carried its own copy of the backend
+check (a private ``_interpret()``), and the serving/model layers re-derived
+``jax.default_backend() == "tpu"`` wherever they chose between a kernel and
+its XLA fallback. Those copies could — and did — drift. This is now the ONE
+place the platform / flag / interpret-mode resolution lives:
+
+* :func:`interpret` — whether ``pl.pallas_call`` should run in interpret
+  mode: kernels compile natively on TPU and run interpreted everywhere else,
+  so tier-1 (CPU) exercises the REAL kernel code paths.
+* :func:`on_tpu` — the raw platform predicate, for callers that pick an
+  entirely different implementation off-TPU (e.g. the weight-only matmul's
+  XLA dequant fallback).
+* :func:`use_pallas` — resolve an on/off/auto knob (a ``FLAGS_*`` value or
+  config field) to a kernel-dispatch decision. ``"auto"`` means "kernel on
+  TPU, fallback elsewhere"; ``True``/``"on"`` forces the kernel (interpret
+  mode off-TPU — how tests pin the kernel path on CPU); ``False``/``None``/
+  ``"off"`` forces the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["on_tpu", "interpret", "use_pallas"]
+
+_ON = (True, 1, "on", "1", "true", "yes")
+_OFF = (None, False, 0, "off", "0", "false", "no", "none", "")
+
+
+def on_tpu() -> bool:
+    """Whether the default jax backend is a TPU."""
+    return jax.default_backend() == "tpu"
+
+
+def interpret() -> bool:
+    """Pallas interpret-mode switch: compile natively on TPU, interpret
+    elsewhere (same kernel code, testable on the CPU mesh)."""
+    return not on_tpu()
+
+
+def use_pallas(knob: Any = "auto") -> bool:
+    """Resolve a kernel on/off/auto knob to a dispatch decision.
+
+    ``True``/``"on"`` -> run the Pallas kernel (interpret mode off-TPU);
+    ``False``/``None``/``"off"``/``""`` -> run the XLA fallback;
+    ``"auto"`` -> kernel on TPU, fallback elsewhere. Unknown values raise
+    a structured error naming the options.
+    """
+    k = knob.strip().lower() if isinstance(knob, str) else knob
+    if isinstance(k, str):
+        if k == "auto":
+            return on_tpu()
+        if k in _ON:
+            return True
+        if k in _OFF:
+            return False
+    elif k in (True, False, None) or isinstance(k, int):
+        return bool(k)
+    raise ValueError(f"unknown kernel-dispatch knob {knob!r}; options: "
+                     f"True/'on', False/'off'/None, 'auto'")

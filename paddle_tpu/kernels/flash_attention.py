@@ -27,13 +27,11 @@ try:  # pltpu imports fail on non-TPU builds only at kernel-feature use time
 except Exception:  # pragma: no cover
     pltpu = None
 
+from .dispatch import interpret as _interpret
+
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu",)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,197 @@
+"""Pallas flash-decoding paged-attention kernel (the serving decode hot op).
+
+Parity target: the reference's fused paged/block-attention inference kernels
+(Paddle Inference's ``block_multihead_attention`` / Phi fusion ops — the
+layer PAPER.md credits for production decode speed) and the vLLM/
+flash-decoding idiom they implement. The serving engine's XLA fallback path
+(``models.generation.paged_decode_step`` gather + ``llama._masked_sdpa``)
+materializes a dense ``[slots, W * block_size, Hk, D]`` gather of every
+sequence's blocks and then masks most of it away — at long contexts decode
+is bandwidth-bound on KV bytes the mask immediately discards.
+
+TPU redesign, not a translation:
+
+* **Block tables consumed IN-KERNEL.** The ``[M, W]`` block table and
+  ``[M]`` sequence lengths ride in as scalar-prefetch operands
+  (``pltpu.PrefetchScalarGridSpec``), so each grid step's K/V BlockSpec
+  index map reads ``table[m, w]`` and DMAs exactly that physical block from
+  the pool — the ``[slots, W*bs, ...]`` gather is never materialized in HBM.
+* **Split-K across KV blocks, online-softmax merge.** The grid is
+  ``(M, Hk, W)`` with the KV-block dimension innermost: each (slot, kv-head)
+  cell streams its blocks through VMEM accumulators (running max ``m``,
+  normalizer ``l``, weighted-value ``acc``) and merges partials with the
+  flash-decoding rescale ``alpha = exp(m_prev - m_cur)`` — the sequential
+  spelling of split-K whose parallelism lives in the ``M x Hk`` grid cells
+  (the same accumulator scheme as ``flash_attention.py``'s fwd kernel).
+* **GQA grouped IN-KERNEL.** Queries arrive as ``[M, Hk, G, D]`` (the
+  ``G = H // Hk`` query heads sharing one kv head form one tile), so each
+  K/V block is read ONCE per kv head and scored against all its query heads
+  — the gather path pays the ``jnp.repeat`` expansion instead.
+* **int8 KV dequant fused into the loads.** Quantized pools
+  (``kv_quant="int8"``: int8 blocks + per-token-per-head fp32 scales stored
+  alongside, see ``models.generation.init_paged_pool``) dequantize in VMEM
+  right after the block DMA — HBM only ever streams the int8 bytes, which
+  is the capacity AND bandwidth win at once. A dense dequantized pool never
+  exists anywhere.
+* **Poison containment.** V rows at positions no query may attend
+  (``j > seq_len``: the null block, stale tails of reused blocks) are
+  zeroed before the PV matmul — the same containment contract as
+  ``llama._masked_sdpa`` (0-weight * NaN would otherwise wipe the row), and
+  bit-invisible for finite KV since those weights are exact 0.0.
+
+Interpret mode (CPU testing) is selected automatically off the backend via
+:mod:`paddle_tpu.kernels.dispatch`, so tier-1 exercises this exact kernel.
+Scale layout note: scales are stored ``[N, bs, Hk]`` to match the scatter
+writes; on a real TPU the trailing ``Hk`` lane dim is narrow — revisit the
+layout if the scale DMA ever shows up in profiles (the K/V streams dominate
+by ``D/4``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+try:  # pltpu imports fail on non-TPU builds only at kernel-feature use time
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from .dispatch import interpret as _interpret
+
+__all__ = ["paged_attention"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(tbl_ref, sl_ref, *refs, bs, num_blocks_per_seq, scale, quant):
+    if quant:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = \
+            refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    m = pl.program_id(0)
+    w = pl.program_id(2)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    sl = sl_ref[m]
+    base = w * bs
+
+    # skip blocks entirely past the sequence (their table entries point at
+    # the null block; compute is gated, the accumulators pass through)
+    @pl.when(base <= sl)
+    def _run():
+        q = q_ref[0, 0].astype(jnp.float32)              # [G, D]
+        k = k_ref[0, :, 0].astype(jnp.float32)           # [bs, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        if quant:                      # dequant fused into the block load
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        j = base + jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)[:, 0]
+        valid = j <= sl                                  # [bs]
+        # containment: V at never-attendable positions must be ZEROED, not
+        # merely zero-weighted — a poisoned request can park NaN there
+        # (see llama._masked_sdpa); exact 0.0 weights make this bit-invisible
+        # for finite KV
+        v = jnp.where(valid[:, None], v, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[None, :], s, _NEG_INF)       # [G, bs]
+        m_prev = m_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_cur
+
+    @pl.when(w == num_blocks_per_seq - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                    k_scale=None, v_scale=None,
+                    scale: Optional[float] = None, out_dtype=None):
+    """Decode attention for ``M`` serving slots straight off the block pool.
+
+    ``q [M, H, D]`` — one query token per slot; ``k_pool``/``v_pool``
+    ``[N, bs, Hk, D]`` — ONE layer's physical block pool (fp, or int8 with
+    ``k_scale``/``v_scale [N, bs, Hk]`` fp32 per-token-per-head scales);
+    ``block_tables [M, W]`` int32 — slot ``m``'s KV position ``j`` lives in
+    physical block ``block_tables[m, j // bs]`` at offset ``j % bs``;
+    ``seq_lens [M]`` int32 — slot ``m`` attends positions ``j <=
+    seq_lens[m]`` (its new token's KV was just scattered at ``seq_lens[m]``).
+    Unassigned table entries must point at the null block 0. Returns
+    ``[M, H, D]`` in ``out_dtype`` (default: the pool dtype for fp pools,
+    fp32 for int8 pools — matching the gather path's ``_masked_sdpa``
+    output dtype).
+    """
+    M, H, D = q.shape
+    N, bs, Hk, _ = k_pool.shape
+    W = block_tables.shape[1]
+    if H % Hk:
+        raise ValueError(f"paged_attention: {H} query heads not divisible "
+                         f"by {Hk} kv heads")
+    G = H // Hk
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("paged_attention: k_scale and v_scale must be "
+                         "given together")
+    if out_dtype is None:
+        out_dtype = jnp.float32 if quant else k_pool.dtype
+    scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
+    # GQA grouping: query head h = kh * G + g shares kv head kh — exactly
+    # the jnp.repeat(k, G, axis=heads) correspondence the fallback expands
+    qg = q.reshape(M, Hk, G, D)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), lambda m, h, w, tbl, sl: (m, h, 0, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda m, h, w, tbl, sl: (tbl[m, w], 0, h, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda m, h, w, tbl, sl: (tbl[m, w], 0, h, 0)),
+    ]
+    ops = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1),
+                         lambda m, h, w, tbl, sl: (tbl[m, w], 0, h)),
+            pl.BlockSpec((1, bs, 1),
+                         lambda m, h, w, tbl, sl: (tbl[m, w], 0, h)),
+        ]
+        ops += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(M, Hk, W),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda m, h, w, tbl, sl: (m, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, bs=bs, num_blocks_per_seq=W, scale=scale,
+                          quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, Hk, G, D), out_dtype),
+        interpret=_interpret(),
+    )(tbl, sl, *ops)
+    return out.reshape(M, H, D)

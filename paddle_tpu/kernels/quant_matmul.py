@@ -28,11 +28,9 @@ try:
 except Exception:  # pragma: no cover
     pltpu = None
 
+from .dispatch import interpret as _interpret
+
 __all__ = ["quantize_weights", "weight_only_matmul"]
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu",)
 
 
 def quantize_weights(w) -> Tuple[jax.Array, jax.Array]:

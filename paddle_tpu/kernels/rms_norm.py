@@ -19,11 +19,9 @@ try:
 except Exception:  # pragma: no cover
     pltpu = None
 
+from .dispatch import interpret as _interpret
+
 __all__ = ["rms_norm"]
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu",)
 
 
 def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):

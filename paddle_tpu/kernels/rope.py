@@ -17,11 +17,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .dispatch import interpret as _interpret
+
 __all__ = ["apply_rope", "rope_cos_sin"]
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu",)
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):

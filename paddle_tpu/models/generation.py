@@ -60,8 +60,8 @@ from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
 
 __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
-           "init_paged_pool", "paged_prefill", "paged_prefill_chunk",
-           "paged_decode_step"]
+           "init_paged_pool", "paged_pool_block_bytes", "paged_prefill",
+           "paged_prefill_chunk", "paged_decode_step"]
 
 
 # ---------------------------------------------------------------------------
@@ -483,7 +483,7 @@ class DecodeSession:
 # ---------------------------------------------------------------------------
 
 def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
-                    dtype=None) -> Dict:
+                    dtype=None, kv_quant=None) -> Dict:
     """Physical KV block pool ``{"k","v": [L, num_blocks, block_size, Hk,
     D]}`` shared by every sequence the serving engine runs (PagedAttention
     layout): a sequence holds only the blocks its block table points at,
@@ -492,11 +492,98 @@ def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
     block — the scatter target for masked lanes (padded prefill positions,
     retired slots) — and is never handed out by the block manager
     (``inference.serving.paged_cache``).
+
+    ``kv_quant="int8"`` stores K/V as int8 with PER-TOKEN-PER-HEAD fp32
+    scales alongside (``{"k","v": int8, "k_scale","v_scale": [L, N, bs,
+    Hk]}``): each KV entry quantizes independently at write time, so
+    incremental decode scatters never re-quantize a block, preemption
+    recompute reproduces bit-identical int8 entries, and the prefix cache
+    shares quantized blocks exactly like fp ones (content keys hash token
+    ids, not bytes). At ~``(D+4)/(4*D)`` the bytes of an fp32 pool this
+    multiplies usable blocks at a fixed byte budget ~3.5x — more
+    concurrent sequences, more cached prefixes, more preemption headroom.
+    Dequantization happens inside the consumers (fused into the Pallas
+    kernel's block loads; the XLA gather fallback dequantizes after its
+    gather) — a dense fp copy of the pool never exists.
     """
+    from .llama import KV_QUANT_MODES, validate_quant_mode
+    validate_quant_mode(kv_quant, KV_QUANT_MODES, "kv_quant")
     dt = dtype if dtype is not None else cfg.dtype
     shape = (cfg.num_hidden_layers, num_blocks, block_size, cfg.kv_heads,
              cfg.head_dim)
+    if kv_quant == "int8":
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_pool_block_bytes(cfg: LlamaConfig, block_size: int, dtype=None,
+                           kv_quant=None) -> int:
+    """Bytes ONE physical block costs across all layers (K + V + scales) —
+    the capacity-planning arithmetic behind sizing ``num_blocks`` to a
+    byte budget (``bench --serve``'s int8-vs-fp capacity row divides a
+    fixed budget by this per layout)."""
+    import numpy as _np
+    L, bs = cfg.num_hidden_layers, int(block_size)
+    Hk, D = cfg.kv_heads, cfg.head_dim
+    if kv_quant == "int8":
+        return L * bs * Hk * (2 * D * 1 + 2 * 4)
+    dt = dtype if dtype is not None else cfg.dtype
+    return L * bs * Hk * 2 * D * _np.dtype(dt).itemsize
+
+
+def _kv_quantize(x):
+    """Symmetric per-token-per-head int8: ``x [..., Hk, D]`` fp ->
+    ``(q int8 [..., Hk, D], scale fp32 [..., Hk])`` with ``x ~= q *
+    scale``. Non-finite inputs (a poisoned request's NaN K/V) yield NaN
+    scales, so dequantized reads stay NaN — quantization never LAUNDERS
+    poison into plausible values; containment stays with the attention
+    mask exactly as on fp pools."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _kv_store(p: Dict, phys, off, k, v):
+    """Scatter freshly computed ``k``/``v [..., Hk, D]`` into one layer's
+    pool slice at ``(phys, off)`` (quantizing when the pool is int8).
+    Returns ``(new_pool_layer, k_attend, v_attend)`` — the attend pair is
+    what LATER READS of these entries will observe (identity for fp pools,
+    the int8 round-trip for quantized ones), so the batched prefill can
+    attend exactly the values decode will gather back and every engine
+    path sees ONE consistent view of a KV entry."""
+    out = dict(p)
+    if "k_scale" in p:
+        qk, sk = _kv_quantize(k)
+        qv, sv = _kv_quantize(v)
+        out["k"] = p["k"].at[phys, off].set(qk)
+        out["v"] = p["v"].at[phys, off].set(qv)
+        out["k_scale"] = p["k_scale"].at[phys, off].set(sk)
+        out["v_scale"] = p["v_scale"].at[phys, off].set(sv)
+        return out, qk.astype(jnp.float32) * sk[..., None], \
+            qv.astype(jnp.float32) * sv[..., None]
+    out["k"] = p["k"].at[phys, off].set(k.astype(p["k"].dtype))
+    out["v"] = p["v"].at[phys, off].set(v.astype(p["v"].dtype))
+    return out, k, v
+
+
+def _kv_gather(p: Dict, block_tables, B: int, C: int, Hk: int, D: int):
+    """Gather one layer's pool through the block tables into logical order
+    ``[B, C, Hk, D]``, dequantizing int8 pools after the gather — the XLA
+    FALLBACK path (``_masked_sdpa`` consumes the result). The Pallas
+    kernel (``kernels.paged_attention``) never materializes this."""
+    kk = p["k"][block_tables].reshape(B, C, Hk, D)
+    vv = p["v"][block_tables].reshape(B, C, Hk, D)
+    if "k_scale" in p:
+        ks = p["k_scale"][block_tables].reshape(B, C, Hk)
+        vs = p["v_scale"][block_tables].reshape(B, C, Hk)
+        kk = kk.astype(jnp.float32) * ks[..., None]
+        vv = vv.astype(jnp.float32) * vs[..., None]
+    return kk, vv
 
 
 def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
@@ -512,7 +599,11 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
     BUCKET count alone, and inactive pad rows scatter into the null block.
     Right-padding keeps RoPE positions at the plain ``0..Sb-1`` table and
     the causal mask makes each row's pad tail invisible to its real
-    positions; pad-position K/V also scatter into the null block. Returns
+    positions; pad-position K/V also scatter into the null block. On int8
+    pools the attention reads the QUANTIZED round-trip of this chunk's
+    K/V (``_kv_store``'s attend view), so prefill attends exactly the
+    values decode/chunk dispatches will later gather — cold and
+    prefix-hit requests see one consistent quantized history. Returns
     (next-token logits ``[B, V]`` read at each row's ``prompt_len - 1``,
     pool, dropped_tokens).
     """
@@ -533,25 +624,23 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
     x = jnp.take(params["embed"], ids, axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pk, pv = xs
+        lp, pz = xs
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
         q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
         k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
         v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
-        pk = pk.at[phys, off].set(k.astype(pk.dtype))
-        pv = pv.at[phys, off].set(v.astype(pv.dtype))
-        o = _masked_sdpa(q, k, v, kv_mask)
+        pz, ka, va = _kv_store(pz, phys, off, k, v)
+        o = _masked_sdpa(q, ka, va, kv_mask)
         h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
-        return h, (pk, pv, drops)
+        return h, (pz, drops)
 
-    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
-                                            pool["v"]))
+    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
     idx = jnp.maximum(prompt_lens - 1, 0)[:, None, None]
     last = jnp.take_along_axis(x, idx, axis=1)          # [B, 1, E]
-    return _lm_head(params, cfg, last), {"k": pk, "v": pv}, drops.sum()
+    return _lm_head(params, cfg, last), pool, drops.sum()
 
 
 def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
@@ -600,41 +689,51 @@ def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
     x = jnp.take(params["embed"], ids, axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pk, pv = xs
+        lp, pz = xs
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
         q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
         k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
         v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
-        pk = pk.at[phys, off].set(k.astype(pk.dtype))
-        pv = pv.at[phys, off].set(v.astype(pv.dtype))
-        kk = pk[block_tables].reshape(B, C, Hk, D)
-        vv = pv[block_tables].reshape(B, C, Hk, D)
+        pz, _, _ = _kv_store(pz, phys, off, k, v)
+        kk, vv = _kv_gather(pz, block_tables, B, C, Hk, D)
         o = _masked_sdpa(q, kk, vv, kv_mask)
         h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
-        return h, (pk, pv, drops)
+        return h, (pz, drops)
 
-    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
-                                            pool["v"]))
+    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
     idx = jnp.full((B, 1, 1), jnp.maximum(chunk_len - 1, 0))
     last = jnp.take_along_axis(x, idx, axis=1)           # [1, 1, E]
-    return _lm_head(params, cfg, last), {"k": pk, "v": pv}, drops.sum()
+    return _lm_head(params, cfg, last), pool, drops.sum()
 
 
 def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
-                      block_tables, pool: Dict, active):
+                      block_tables, pool: Dict, active,
+                      use_kernel: bool = False):
     """One decode iteration over ``M`` serving slots against the block pool.
 
     ``tokens [M]`` the last sampled token per slot; ``seq_lens [M]`` the KV
     entries already written (= the new token's position); ``block_tables
     [M, W]``; ``active [M]`` bool — inactive slots (empty, retired, past
     their budget) scatter their K/V into the null block and their logits
-    are garbage the scheduler ignores. Attention gathers each slot's blocks
-    ``pool[block_tables]`` into logical order — a sequence touches only the
-    blocks it owns — and masks gathered positions ``> seq_len``. Returns
-    (logits ``[M, V]``, pool, dropped_tokens).
+    are garbage the scheduler ignores. Attention reads each slot's own
+    blocks and masks positions ``> seq_len``, through one of two paths:
+
+    * ``use_kernel=False`` — the XLA gather fallback: ``pool[block_tables]``
+      materializes the ``[M, W*bs, Hk, D]`` logical view (dequantized for
+      int8 pools), then ``_masked_sdpa`` runs the masked softmax. The
+      reference oracle, and the runtime path off-TPU by default.
+    * ``use_kernel=True`` — the Pallas flash-decoding kernel
+      (:func:`paddle_tpu.kernels.paged_attention`): block tables are
+      consumed inside the kernel (each K/V block DMA'd once per kv head,
+      int8 dequant fused into the load), split-K over KV blocks with the
+      online-softmax merge. No gather is ever materialized — the
+      long-context bandwidth win. STATIC: bake it per compiled program
+      (``ServingConfig.paged_kernel`` / ``FLAGS_serving_paged_kernel``).
+
+    Returns (logits ``[M, V]``, pool, dropped_tokens).
     """
     M = tokens.shape[0]
     H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
@@ -654,22 +753,25 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pk, pv = xs
+        lp, pz = xs
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
         q = _mm(hh, lp, "wq", dt).reshape(M, 1, H, D)
         k = _mm(hh, lp, "wk", dt).reshape(M, 1, Hk, D)
         v = _mm(hh, lp, "wv", dt).reshape(M, 1, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
-        pk = pk.at[phys, off].set(k[:, 0].astype(pk.dtype))
-        pv = pv.at[phys, off].set(v[:, 0].astype(pv.dtype))
-        kk = pk[block_tables].reshape(M, C, Hk, D)
-        vv = pv[block_tables].reshape(M, C, Hk, D)
-        o = _masked_sdpa(q, kk, vv, kv_mask)
+        pz, _, _ = _kv_store(pz, phys, off, k[:, 0], v[:, 0])
+        if use_kernel:
+            from ..kernels.paged_attention import paged_attention
+            o = paged_attention(q[:, 0], pz["k"], pz["v"], block_tables,
+                                seq_lens, k_scale=pz.get("k_scale"),
+                                v_scale=pz.get("v_scale"))[:, None]
+        else:
+            kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
+            o = _masked_sdpa(q, kk, vv, kv_mask)
         h = h + _mm(o.reshape(M, 1, H * D).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
-        return h, (pk, pv, drops)
+        return h, (pz, drops)
 
-    x, (pk, pv, drops) = lax.scan(body, x, (params["layers"], pool["k"],
-                                            pool["v"]))
-    return _lm_head(params, cfg, x), {"k": pk, "v": pv}, drops.sum()
+    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    return _lm_head(params, cfg, x), pool, drops.sum()

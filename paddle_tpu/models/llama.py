@@ -404,7 +404,8 @@ def _mm(h, lp, name, dt):
         return h @ w.astype(dt)
     lead = h.shape[:-1]
     h2 = h.reshape(-1, h.shape[-1])
-    if jax.default_backend() == "tpu":
+    from ..kernels.dispatch import on_tpu
+    if on_tpu():
         from ..kernels.quant_matmul import weight_only_matmul
         out = weight_only_matmul(h2, w, s, out_dtype=dt)
     else:
@@ -436,7 +437,25 @@ def quantize_params(params: Dict) -> Dict:
     return qp
 
 
-QUANTIZE_MODES = (None, "int8")
+QUANTIZE_MODES = (None, "int8")     # weight-only (ensure_quantized)
+KV_QUANT_MODES = (None, "int8")     # paged KV-cache pools (generation.
+#                                     init_paged_pool / ServingConfig.
+#                                     kv_quant). Orthogonal to the weight
+#                                     modes: quantize="int8" (weights) and
+#                                     kv_quant="int8" (KV blocks) COMPOSE —
+#                                     int8 weight streaming + int8 KV pools
+#                                     on one engine.
+
+
+def validate_quant_mode(mode, modes, what: str = "quantize"):
+    """The one unknown-quantize-mode error: a structured ValueError naming
+    the supported modes (never a bare KeyError/assert), shared by the
+    weight-only path (:func:`ensure_quantized`), the KV-pool path
+    (``generation.init_paged_pool``) and the serving config."""
+    if mode not in modes:
+        raise ValueError(f"unknown {what} mode {mode!r}; "
+                         f"options: {modes}")
+    return mode
 
 
 def ensure_quantized(params: Dict, mode) -> Dict:
@@ -445,10 +464,9 @@ def ensure_quantized(params: Dict, mode) -> Dict:
     :func:`quantize_params` unless the tree already carries the scale
     leaves (``wq_s``). The one place the accepted-modes list and the
     already-quantized marker live — every decode tier (predictor, serving
-    engine) resolves through here."""
-    if mode not in QUANTIZE_MODES:
-        raise ValueError(f"unknown quantize mode {mode!r}; "
-                         f"options: {QUANTIZE_MODES}")
+    engine) resolves through here. KV-cache quantization is a separate,
+    composable knob (:data:`KV_QUANT_MODES`)."""
+    validate_quant_mode(mode, QUANTIZE_MODES)
     if mode == "int8" and "wq_s" not in params.get("layers", {}):
         return quantize_params(params)
     return params

@@ -315,8 +315,8 @@ def weight_only_linear(x, weight, scale, bias=None, weight_dtype="int8",
     def impl(xv, wv, sv, *b):
         lead = xv.shape[:-1]
         x2 = xv.reshape(-1, xv.shape[-1])
-        import jax as _jax
-        if _jax.default_backend() == "tpu":
+        from ..kernels.dispatch import on_tpu
+        if on_tpu():
             from ..kernels.quant_matmul import weight_only_matmul
             out = weight_only_matmul(x2, wv, sv,
                                      out_dtype=x2.dtype).astype(x2.dtype)

@@ -289,6 +289,134 @@ class TestQuantMatmul:
         np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
 
 
+class TestPagedAttention:
+    """Flash-decoding paged-attention kernel (ISSUE 10) vs the serving
+    engine's XLA fallback oracle (block-table gather + ``_masked_sdpa``),
+    interpret mode on CPU. The fuzz sweeps GQA group counts, block sizes,
+    ragged sequence lengths pinned to block boundaries +-1, fp and int8
+    pools, and NaN-poisoned free blocks — the whole matrix the engine can
+    hand the kernel."""
+
+    @staticmethod
+    def _oracle(q, pool, tbl, sl):
+        from paddle_tpu.models.generation import _kv_gather
+        from paddle_tpu.models.llama import _masked_sdpa
+        M = q.shape[0]
+        N, bs, Hk, D = pool["k"].shape
+        C = tbl.shape[1] * bs
+        kk, vv = _kv_gather(pool, tbl, M, C, Hk, D)
+        mask = (jnp.arange(C)[None, :] <= sl[:, None])[:, None, :]
+        return _masked_sdpa(q[:, None], kk, vv, mask)[:, 0]
+
+    @staticmethod
+    def _quantize(x):
+        from paddle_tpu.models.generation import _kv_quantize
+        return _kv_quantize(x)
+
+    def _case(self, rng, quant: bool, poison: bool):
+        from paddle_tpu.kernels.paged_attention import paged_attention
+        bs = int(rng.choice([4, 8, 16]))
+        Hk = int(rng.choice([1, 2, 4]))
+        G = int(rng.choice([1, 2, 4]))          # GQA group size (H = Hk*G)
+        D = int(rng.choice([8, 16]))
+        M = int(rng.integers(1, 5))
+        W = int(rng.integers(2, 5))
+        N = M * W + 3                            # slack blocks stay free
+        q = jnp.asarray(rng.standard_normal((M, Hk * G, D)), jnp.float32)
+        kf = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        vf = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        # ragged lengths pinned around block boundaries: the off-by-one
+        # regime where a mask bug shows
+        cap = W * bs - 1
+        picks = [bs - 1, bs, bs + 1, int(rng.integers(0, cap + 1))]
+        sl = jnp.asarray([min(cap, picks[int(rng.integers(0, 4))])
+                          for _ in range(M)], jnp.int32)
+        used = rng.choice(np.arange(1, N), size=(M, W), replace=False)
+        tbl = np.zeros((M, W), np.int32)
+        for m in range(M):
+            nb = int(sl[m]) // bs + 1
+            tbl[m, :nb] = used[m, :nb]           # tail entries stay null(0)
+        tbl = jnp.asarray(tbl)
+        if poison:                               # free blocks hold stale NaN
+            free = sorted(set(range(1, N)) - set(tbl.reshape(-1).tolist()))
+            kf = kf.at[jnp.asarray(free)].set(jnp.nan)
+            vf = vf.at[jnp.asarray(free)].set(jnp.nan)
+        if quant:
+            kq, ks = self._quantize(jnp.nan_to_num(kf))
+            vq, vs = self._quantize(jnp.nan_to_num(vf))
+            if poison:                           # poison the QUANT layout
+                free = sorted(set(range(1, N)) -
+                              set(np.asarray(tbl).reshape(-1).tolist()))
+                ks = ks.at[jnp.asarray(free)].set(jnp.nan)
+                vs = vs.at[jnp.asarray(free)].set(jnp.nan)
+            pool = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+            out = paged_attention(q, kq, vq, tbl, sl, k_scale=ks,
+                                  v_scale=vs)
+        else:
+            pool = {"k": kf, "v": vf}
+            out = paged_attention(q, kf, vf, tbl, sl)
+        want = self._oracle(q, pool, tbl, sl)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+
+    @pytest.mark.parametrize("trial", range(4))
+    def test_randomized_parity_fuzz(self, trial):
+        rng = np.random.default_rng(100 + trial)
+        self._case(rng, quant=False, poison=False)
+        self._case(rng, quant=True, poison=False)
+
+    @pytest.mark.parametrize("trial", range(2))
+    def test_poisoned_freed_blocks_stay_contained(self, trial):
+        """Stale NaN in freed/unowned blocks (the PR 6 null-block
+        poisoning regression, kernel edition): outputs must stay finite
+        and bit-match the containment-hardened oracle on fp AND int8
+        pools — in-kernel V zeroing at never-attendable positions is the
+        same contract as ``_masked_sdpa``'s."""
+        rng = np.random.default_rng(200 + trial)
+        self._case(rng, quant=False, poison=True)
+        self._case(rng, quant=True, poison=True)
+
+    def test_masked_tail_positions_ignored(self):
+        """KV garbage WITHIN an owned block beyond seq_len (a reused
+        block's stale tail) must not leak into the output: filling the
+        tail with NaN leaves the result unchanged."""
+        from paddle_tpu.kernels.paged_attention import paged_attention
+        rng = np.random.default_rng(7)
+        M, H, Hk, D, bs, W, N = 2, 4, 2, 8, 4, 3, 8
+        q = jnp.asarray(rng.standard_normal((M, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        tbl = jnp.asarray([[1, 2, 0], [3, 4, 5]], jnp.int32)
+        sl = jnp.asarray([5, 9], jnp.int32)
+        base = paged_attention(q, k, v, tbl, sl)
+        # poison every position past each row's seq_len in its own blocks
+        k2, v2 = k, v
+        for m, (blocks, s) in enumerate((([1, 2], 5), ([3, 4, 5], 9))):
+            for i, b in enumerate(blocks):
+                for off in range(bs):
+                    if i * bs + off > s:
+                        k2 = k2.at[b, off].set(jnp.nan)
+                        v2 = v2.at[b, off].set(jnp.nan)
+        out = paged_attention(q, k2, v2, tbl, sl)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+    def test_use_pallas_knob_resolution(self):
+        """The ONE kernel-dispatch gate (ISSUE 10 satellite): on/off/auto
+        resolution shared by every kernel entry point."""
+        from paddle_tpu.kernels import interpret, on_tpu, use_pallas
+        assert use_pallas(True) is True
+        assert use_pallas("on") is True
+        assert use_pallas(False) is False
+        assert use_pallas(None) is False
+        assert use_pallas("off") is False
+        assert use_pallas("") is False
+        assert use_pallas("auto") == on_tpu()
+        assert interpret() == (not on_tpu())
+        with pytest.raises(ValueError, match="options"):
+            use_pallas("sometimes")
+
+
 class TestVarlenBlockSkip:
     """r3: segment-disjoint tiles are SKIPPED (splash-style sparsity).
     The skip predicate is range-based, so it must stay CORRECT for

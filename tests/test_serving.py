@@ -1514,3 +1514,273 @@ class TestHealthSnapshot:
         while eng.pending:
             eng.step()
         assert eng.health_snapshot()["accepting"] is True
+
+
+class TestPagedKernelEngine:
+    """ISSUE 10 tentpole: the Pallas flash-decoding paged-attention kernel
+    (``paged_kernel=True`` — interpret mode on CPU, so tier-1 runs the REAL
+    kernel) vs the gather/_masked_sdpa fallback and the dense oracle, across
+    the serving trace matrix: mixed lengths, GQA, prefix hits, preemption,
+    EOS retirement — with the compile-once decode contract intact."""
+
+    def test_mixed_trace_matches_dense_and_compiles_once(self, setup):
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg, paged_kernel=True)
+        got = eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts, outs)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        st = eng.stats()
+        assert st["decode_traces"] == 1
+        assert st["paged_kernel"] is True
+        # a second identical trace (now prefix-hitting) adds zero decode
+        # traces — the kernel path keeps the device-scalar dispatch bound
+        eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        assert eng.stats()["decode_traces"] == 1
+        assert eng.stats()["prefix_hit_tokens"] > 0
+
+    @pytest.mark.parametrize("kvh", [4, 1])   # MHA and max-GQA
+    def test_gqa_grouping_in_kernel(self, setup, kvh):
+        _, _, prompts, _ = setup
+        cfg = tiny_cfg(num_key_value_heads=kvh)
+        params = init_params(cfg, jax.random.PRNGKey(1))
+        eng = make_engine(params, cfg, max_slots=2, paged_kernel=True)
+        got = eng.run(prompts[:4], max_new_tokens=4, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts[:4], [4] * 4)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_preemption_pressure_stays_exact(self, setup):
+        """Undersized pool: preempt-and-recompute through the kernel path
+        must stay bit-identical to the dense oracle (recomputed KV takes
+        the same scatter path the kernel reads back)."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, num_blocks=9, prefix_cache=None,
+                          paged_kernel=True)
+        got = eng.run(prompts[:5], max_new_tokens=8, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts[:5], [8] * 5)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        assert eng.stats()["preemptions"] >= 1
+
+    def test_eos_retirement(self, setup):
+        cfg, params, prompts, _ = setup
+        oracle = dense_rows(params, cfg, prompts[:1], [6])[0]
+        eos = int(oracle[1])
+        stop = int(np.argmax(oracle == eos))
+        eng = make_engine(params, cfg, paged_kernel=True)
+        out = eng.run([prompts[0]], max_new_tokens=6, eos_token_id=eos)[0]
+        np.testing.assert_array_equal(np.asarray(out), oracle[:stop + 1])
+
+    def test_randomized_trace_fuzz_kernel_vs_gather(self, setup):
+        """Random ragged traces (lengths crossing block boundaries +-1)
+        through a kernel engine and a gather engine with IDENTICAL
+        schedules: token streams must match exactly."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(42)
+        for trial in range(2):
+            bs = int(rng.choice([2, 4]))
+            lens = [int(rng.choice([bs - 1, bs, bs + 1, 2 * bs + 1]) + 1)
+                    for _ in range(5)]
+            prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                       for n in lens]
+            outs = [int(rng.integers(1, 8)) for _ in prompts]
+            kw = dict(block_size=bs, max_slots=2, max_model_len=32)
+            ek = make_engine(params, cfg, paged_kernel=True, **kw)
+            eg = make_engine(params, cfg, paged_kernel=False, **kw)
+            gk = ek.run(prompts, max_new_tokens=outs, eos_token_id=None)
+            gg = eg.run(prompts, max_new_tokens=outs, eos_token_id=None)
+            for a, b in zip(gk, gg):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_poisoned_request_contained_under_kernel(self, setup):
+        """PR 6 null-block poisoning regression, kernel edition: an
+        out-of-vocab prompt scatters NaN K/V through masked lanes; the
+        kernel's in-load V zeroing must contain it — co-scheduled clean
+        requests stay bit-exact, and a follow-up wave reusing the
+        poisoned request's freed blocks stays bit-exact too."""
+        from paddle_tpu.testing.chaos import poison_prompt
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, paged_kernel=True)
+        bad = poison_prompt(prompts[2], cfg.vocab_size, mode="oov")
+        rid_bad = eng.submit(bad, max_new_tokens=6, eos_token_id=None)
+        rid_ok = eng.submit(prompts[0], max_new_tokens=6, eos_token_id=None)
+        while eng.pending:
+            eng.step()
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(rid_ok).output()),
+            dense_rows(params, cfg, prompts[:1], [6])[0])
+        assert len(eng.request(rid_bad).tokens) == 6   # served, contained
+        outs = eng.run(prompts[:4], max_new_tokens=6, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts[:4], [6] * 4)
+        for o, w in zip(outs, want):
+            np.testing.assert_array_equal(np.asarray(o), w)
+
+    def test_paged_kernel_knob_resolution(self, setup):
+        """'auto' resolves off the platform (gather on CPU), flags feed the
+        default, unknown values raise the structured dispatch error."""
+        from paddle_tpu import flags as F
+        from paddle_tpu.inference.serving import ServingConfig
+        assert ServingConfig(paged_kernel="auto").paged_kernel is \
+            (jax.default_backend() == "tpu")
+        assert ServingConfig(paged_kernel="on").paged_kernel is True
+        assert ServingConfig(paged_kernel=None).paged_kernel is False
+        assert ServingConfig().paged_kernel is \
+            (jax.default_backend() == "tpu")     # FLAGS default "auto"
+        with pytest.raises(ValueError, match="options"):
+            ServingConfig(paged_kernel="maybe")
+
+
+class TestKVQuantInt8:
+    """ISSUE 10: int8 KV-cache quantization — int8 blocks + per-token-
+    per-head scales alongside the pool, dequant fused into the kernel's
+    loads (never materialized dense on that path), prefix cache and
+    preemption layout-agnostic, ~3.2x smaller pool at this config."""
+
+    def test_kernel_vs_gather_exact_on_int8_pool(self, setup):
+        """The kernel's fused dequant vs the gather fallback's post-gather
+        dequant read the SAME quantized entries: greedy streams match
+        exactly."""
+        cfg, params, prompts, outs = setup
+        ek = make_engine(params, cfg, kv_quant="int8", paged_kernel=True)
+        eg = make_engine(params, cfg, kv_quant="int8", paged_kernel=False)
+        gk = ek.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        gg = eg.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        for a, b in zip(gk, gg):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert ek.stats()["decode_traces"] == 1
+        assert ek.stats()["kv_quant"] == "int8"
+
+    def test_trace_agreement_and_length_parity_vs_fp(self, setup):
+        """The fp-vs-int8 oracle: exact LENGTH parity on the trace, token
+        agreement within the stated tolerance (>= 0.9; measured 1.0 on
+        the CPU mesh at this config), and a ~3x smaller pool."""
+        cfg, params, prompts, outs = setup
+        e8 = make_engine(params, cfg, kv_quant="int8")
+        ef = make_engine(params, cfg)
+        g8 = e8.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        gf = ef.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        agree = []
+        for a, b in zip(g8, gf):
+            a, b = np.asarray(a), np.asarray(b)
+            assert len(a) == len(b)
+            agree.append(float(np.mean(a == b)))
+        assert np.mean(agree) >= 0.9, agree
+        assert e8.cache.kv_bytes() * 2 < ef.cache.kv_bytes()
+
+    def test_eos_retirement_parity_vs_fp(self, setup):
+        """EOS agreement: the int8 engine must retire at the same token
+        and length as the fp engine on an eos-bearing request."""
+        cfg, params, prompts, _ = setup
+        oracle = dense_rows(params, cfg, prompts[:1], [6])[0]
+        eos = int(oracle[1])
+        ef = make_engine(params, cfg)
+        e8 = make_engine(params, cfg, kv_quant="int8")
+        of = ef.run([prompts[0]], max_new_tokens=6, eos_token_id=eos)[0]
+        o8 = e8.run([prompts[0]], max_new_tokens=6, eos_token_id=eos)[0]
+        np.testing.assert_array_equal(np.asarray(o8), np.asarray(of))
+
+    def test_prefix_cache_hits_int8_blocks_exactly(self, setup):
+        """Cached int8 blocks must hit and verify exactly like fp blocks
+        (content keys hash token ids, not bytes), and — because every
+        path reads KV through the SAME quantized view — a prefix-hit
+        rerun reproduces the cold run's tokens bit-exactly."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, kv_quant="int8", paged_kernel=True)
+        cold = eng.run(prompts[:3], max_new_tokens=5, eos_token_id=None)
+        assert eng.stats()["prefix_hit_tokens"] == 0
+        assert eng.stats()["cached_blocks"] > 0
+        hit = eng.run(prompts[:3], max_new_tokens=5, eos_token_id=None)
+        assert eng.stats()["prefix_hit_tokens"] > 0
+        for c, h in zip(cold, hit):
+            np.testing.assert_array_equal(np.asarray(c), np.asarray(h))
+
+    def test_preemption_recompute_int8_exact(self, setup):
+        """Preempt-and-recompute on an int8 pool: re-quantizing the same
+        fp values is deterministic, so a pressured engine's outputs match
+        an unpressured int8 engine's bit-exactly."""
+        cfg, params, prompts, _ = setup
+        calm = make_engine(params, cfg, kv_quant="int8", prefix_cache=None)
+        tight = make_engine(params, cfg, kv_quant="int8", num_blocks=9,
+                            prefix_cache=None, paged_kernel=True)
+        want = calm.run(prompts[:5], max_new_tokens=8, eos_token_id=None)
+        got = tight.run(prompts[:5], max_new_tokens=8, eos_token_id=None)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        assert tight.stats()["preemptions"] >= 1
+        assert tight.stats()["oom_truncated"] == 0
+
+    def test_weight_int8_composes_with_kv_int8(self, setup):
+        """quantize='int8' (weights) + kv_quant='int8' (KV pool) on one
+        engine — the two modes are orthogonal and must compose; oracle =
+        the same composition through the gather path."""
+        cfg, params, prompts, _ = setup
+        ek = make_engine(params, cfg, quantize="int8", kv_quant="int8",
+                         paged_kernel=True)
+        eg = make_engine(params, cfg, quantize="int8", kv_quant="int8")
+        assert ek._params["layers"]["wq"].dtype == jnp.int8
+        assert ek.cache.pool["k"].dtype == jnp.int8
+        gk = ek.run(prompts[:3], max_new_tokens=6, eos_token_id=None)
+        gg = eg.run(prompts[:3], max_new_tokens=6, eos_token_id=None)
+        for a, b in zip(gk, gg):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_decode_logits_within_tolerance_of_fp(self, setup):
+        """Direct numeric bound: one decode dispatch over the same KV
+        history, int8 pool vs fp pool — logits within 5% relative."""
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.models import generation as G
+        bs, W = 4, 3
+        p = prompts[2][:10]
+        pool_f = G.init_paged_pool(cfg, 8, bs)
+        pool_8 = G.init_paged_pool(cfg, 8, bs, kv_quant="int8")
+        tables = jnp.asarray([[1, 2, 3]], jnp.int32)
+        ids = jnp.asarray(p[None])
+        plens = jnp.asarray([len(p)], jnp.int32)
+        act = jnp.asarray([True])
+        _, pool_f, _ = G.paged_prefill(params, cfg, ids, plens, tables,
+                                       pool_f, act)
+        _, pool_8, _ = G.paged_prefill(params, cfg, ids, plens, tables,
+                                       pool_8, act)
+        tok = jnp.asarray([int(p[-1])], jnp.int32)
+        sl = jnp.asarray([len(p)], jnp.int32)
+        lf, _, _ = G.paged_decode_step(params, cfg, tok, sl, tables,
+                                       pool_f, act)
+        l8, _, _ = G.paged_decode_step(params, cfg, tok, sl, tables,
+                                       pool_8, act)
+        scale = float(jnp.max(jnp.abs(lf)))
+        assert float(jnp.max(jnp.abs(l8 - lf))) < 0.05 * scale
+
+    def test_unknown_modes_raise_structured(self, setup):
+        """Unknown quantize/kv_quant modes raise the shared structured
+        error naming the supported modes — never a bare KeyError."""
+        from paddle_tpu.inference.serving import ServingConfig
+        from paddle_tpu.models import generation as G
+        from paddle_tpu.models.llama import ensure_quantized
+        cfg, params, _, _ = setup
+        with pytest.raises(ValueError, match="kv_quant.*options"):
+            ServingConfig(kv_quant="int4")
+        with pytest.raises(ValueError, match="quantize.*options"):
+            ServingConfig(quantize="fp8")
+        with pytest.raises(ValueError, match="kv_quant.*options"):
+            G.init_paged_pool(cfg, 4, 4, kv_quant="nvfp4")
+        with pytest.raises(ValueError, match="quantize.*options"):
+            ensure_quantized(params, "int4")
+
+    def test_observability_fields(self, setup):
+        """stats()/health_snapshot() report kv_pool_bytes / kv_quant /
+        paged_kernel / usable_blocks, registry-pinned via
+        HEALTH_SNAPSHOT_FIELDS (the OPS.md table renders from it)."""
+        from paddle_tpu.inference.serving.engine import \
+            HEALTH_SNAPSHOT_FIELDS
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg, kv_quant="int8")
+        st = eng.stats()
+        assert st["kv_pool_bytes"] == eng.cache.kv_bytes() > 0
+        assert st["kv_quant"] == "int8"
+        assert st["paged_kernel"] is False
+        assert st["usable_blocks"] == eng.cache.manager.num_blocks - 1
+        snap = eng.health_snapshot()
+        for k in ("kv_pool_bytes", "kv_quant", "paged_kernel"):
+            assert k in HEALTH_SNAPSHOT_FIELDS
+            assert snap[k] == st[k]
