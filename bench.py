@@ -926,6 +926,19 @@ def bench_serve(backend):
     deliberately loose; observed ~0.83 on CPU, with the tight per-dispatch
     logit bound pinned in tests/test_serving.py).
 
+    The ISSUE 11 SPEC-DECODE row sweeps acceptance rate: a
+    high-acceptance trace (self-continuation prompts — the n-gram
+    prompt-lookup drafter hits the stream's own cycles, so each
+    multi-query verify dispatch retires several tokens) vs a
+    low-acceptance trace (incoherent random prompts — no n-gram
+    reoccurs, every step falls through to the plain decode loop).
+    Asserted: spec output bit-identical to plain greedy decode on BOTH
+    traces, drafts accepted on the high trace, ONE verify executable,
+    zero blocks in use after rollback, and the low-acceptance ratio
+    >= 0.9x (bounded drafting overhead). The high-acceptance speedup is
+    emitted as serving_spec_speedup (anchor = the 1.3x acceptance
+    bound).
+
     The ISSUE 9 FLEET row serves a trace through a 2-replica
     ServingRouter (both replicas sharing the overload row's compiled
     programs) with ``replica_kill`` fired mid-trace: the router must fail
@@ -1219,6 +1232,105 @@ def bench_serve(backend):
     else:
         cap_eos_parity = None
 
+    # ---- spec-decode row: n-gram drafting + paged verify (ISSUE 11) -----
+    # tok/s across an acceptance-rate sweep: a HIGH-acceptance trace
+    # (self-continuation prompts — each prompt is seeded with the model's
+    # own greedy stream, so the prompt-lookup drafter finds the stream's
+    # cycles and the verify accepts several tokens per dispatch) vs a
+    # LOW-acceptance trace (the incoherent random prompts: no n-gram
+    # reoccurs, every step falls through to the plain decode loop, so the
+    # only cost is the host-side lookup scan). Interleaved rounds, median
+    # of per-round ratios — the same drift-immune methodology as the
+    # mixed/prefix rows. In-section asserts: greedy spec output is
+    # BIT-IDENTICAL to plain greedy decode on both traces (the
+    # acceptance-agnostic correctness oracle), drafts were actually
+    # accepted on the high trace, the verify compiled ONCE, zero blocks
+    # remain in use after rollback on every engine, and the low-
+    # acceptance ratio is bounded (>= 0.9x — falling through must not
+    # cost real throughput). The >= 1.3x high-acceptance bound is the
+    # serving_spec_speedup anchor.
+    # the row runs its OWN small-vocab model: a random-init vocab-2048
+    # model's greedy streams never revisit an n-gram inside a bench-sized
+    # window (no trained induction behavior), so NO prompt-lookup system
+    # would find drafts there — at vocab 128 greedy streams fall into
+    # cycles (measured), which is the repetitive regime spec decoding
+    # exists for. The seeds below were SCREENED against the simulated
+    # drafter (acceptance > 0.75 over the served window); the in-section
+    # acceptance assert re-verifies them on every run, so a model-init
+    # change fails loudly instead of silently measuring a no-draft trace.
+    from paddle_tpu.models.llama import LlamaConfig as _LC
+    sp_cfg = _LC(vocab_size=128, hidden_size=256, intermediate_size=768,
+                 num_hidden_layers=3, num_attention_heads=8,
+                 num_key_value_heads=4, max_position_embeddings=128)
+    sp_params = llama.init_params(sp_cfg, jax.random.PRNGKey(0))
+    sp_seeds = [12, 17, 24, 67]
+    if backend == "tpu":
+        sp_pre, sp_out, sp_k, sp_slots = 32, 32, 6, 8
+    else:
+        sp_pre, sp_out, sp_k, sp_slots = 32, 32, 6, 4
+    sp_base = [np.random.default_rng(s).integers(0, 128, (8,))
+               .astype(np.int32) for s in sp_seeds]
+    sp_longs = [np.asarray(G.generate(sp_params, jnp.asarray(b[None]),
+                                      sp_cfg,
+                                      max_new_tokens=sp_pre + sp_out))[0]
+                for b in sp_base]
+    sp_hi = [np.concatenate([b, l[:sp_pre]])
+             for b, l in zip(sp_base, sp_longs)]
+    sp_lo = [rng.integers(0, 128, (sp_pre + 8,)).astype(np.int32)
+             for _ in sp_seeds]
+
+    def mk_spec_engine(k):
+        return ServingEngine(sp_params, sp_cfg, ServingConfig(
+            block_size=8, max_slots=sp_slots, max_model_len=128,
+            decode_chunk=chunk, queue_depth=len(sp_hi), prefix_cache=None,
+            spec_decode=k, spec_ngram=2))
+
+    def run_spec(eng, trace):
+        t0 = time.time()
+        outs = eng.run(trace, max_new_tokens=sp_out, eos_token_id=None)
+        return outs, time.time() - t0
+
+    eng_sp, eng_ns = mk_spec_engine(sp_k), mk_spec_engine(None)
+    sp_rounds, lo_rounds = [], []
+    sp_match = lo_match = True
+    sp_leaked = 0
+    for trace, rounds in ((sp_hi, sp_rounds), (sp_lo, lo_rounds)):
+        run_spec(eng_ns, trace)                        # warm/compile
+        run_spec(eng_sp, trace)                        # warm/compile
+        for _ in range(5):
+            o_ns, t_ns = run_spec(eng_ns, trace)
+            o_sp, t_sp = run_spec(eng_sp, trace)
+            rounds.append((t_ns, t_sp))
+            ok = all(np.array_equal(np.asarray(a), np.asarray(b))
+                     for a, b in zip(o_sp, o_ns))
+            if trace is sp_hi:
+                sp_match &= ok
+            else:
+                lo_match &= ok
+            sp_leaked += eng_sp.cache.manager.blocks_in_use
+            sp_leaked += eng_ns.cache.manager.blocks_in_use
+    spst = eng_sp.stats()
+    spec_speedup = float(np.median([a / b for a, b in sp_rounds]))
+    spec_lo_ratio = float(np.median([a / b for a, b in lo_rounds]))
+    spec_tok_s = len(sp_hi) * sp_out / float(np.median(
+        [b for _, b in sp_rounds]))
+    spec_accept_rate = (spst["spec_accepted"] / spst["spec_drafted"]
+                        if spst["spec_drafted"] else 0.0)
+    assert sp_match and lo_match, "spec-decode output diverged from " \
+        "plain greedy decode"
+    # the screened seeds must still be the high-acceptance regime —
+    # a model-init change that kills the cycles fails loudly here
+    assert spec_accept_rate >= 0.5, spec_accept_rate
+    assert spst["spec_traces"] == 1, spst["spec_traces"]
+    assert sp_leaked == 0, f"{sp_leaked} blocks leaked after rollback"
+    assert spec_lo_ratio >= 0.9, \
+        f"low-acceptance trace paid {spec_lo_ratio:.3f}x (bound 0.9)"
+    # the 1.3x acceptance bound is the serving_spec_speedup anchor; the
+    # in-section floor guards gross regressions without making tier-1
+    # hostage to host-load noise (measured 1.6-1.8x median on CPU)
+    assert spec_speedup >= 1.1, \
+        f"high-acceptance trace only {spec_speedup:.3f}x (floor 1.1)"
+
     # ---- overload row: 2x-capacity arrivals, EDF vs FIFO (ISSUE 6) ------
     # the same burst of requests hits both engines; the FIFO engine is the
     # status quo (no lifecycle — every request eventually served, TTFT
@@ -1414,6 +1526,21 @@ def bench_serve(backend):
         "kv_token_agreement": round(cap_agree, 4),
         "kv_eos_parity": bool(cap_eos_parity),
         "kv_int8_pool_bytes": eng_c8.cache.kv_bytes(),
+        # spec-decode row (ISSUE 11): n-gram drafting + multi-query verify
+        # vs the same engine without speculation — output bit-parity on
+        # BOTH traces, acceptance > 0, one verify executable and zero
+        # leaked blocks are asserted in-section; the high-acceptance
+        # speedup is the serving_spec_speedup metric (anchor/bound 1.3)
+        "spec_speedup": round(spec_speedup, 3),
+        "spec_low_accept_ratio": round(spec_lo_ratio, 3),
+        "spec_tok_s": round(spec_tok_s, 1),
+        "spec_outputs_match": bool(sp_match and lo_match),
+        "spec_accept_rate": round(spec_accept_rate, 3),
+        "spec_drafted": spst["spec_drafted"],
+        "spec_accepted": spst["spec_accepted"],
+        "spec_steps": spst["spec_steps"],
+        "spec_traces": spst["spec_traces"],
+        "spec_leaked_blocks": int(sp_leaked),
         # overload row (EDF + TTFT SLOs + shedding vs status-quo FIFO)
         "overload_requests": ov_n,
         # pct() already converts to ms
@@ -1535,6 +1662,12 @@ _R2_ANCHORS = {
     # acceptance bound (>= 2x; arithmetic gives ~3.5x for fp32 pools and
     # the in-section assert enforces the 2x floor)
     "serving_kv_capacity_ratio": 2.0,
+    # spec-decode row (ISSUE 11): tok/s with n-gram drafting + multi-
+    # query verify vs the same engine without speculation on the
+    # high-acceptance (self-continuation) trace — the anchor IS the
+    # acceptance bound (>= 1.3x; the low-acceptance trace's >= 0.9x
+    # fall-through bound and output bit-parity are asserted in-section)
+    "serving_spec_speedup": 1.3,
 }
 
 
@@ -1633,12 +1766,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0, "health": 45.0, "serve": 150.0} if _warm else
+                  "input": 20.0, "health": 45.0, "serve": 160.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0, "health": 90.0, "serve": 240.0})
+                  "input": 30.0, "health": 90.0, "serve": 280.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -1914,6 +2047,8 @@ def main():
                   _R2_ANCHORS["serving_overload_p99_ratio"])
             _emit("serving_router_tok_s", s["router_tok_s"], "tok/s",
                   s["router_tok_s"] / _R2_ANCHORS["serving_router_tok_s"])
+            _emit("serving_spec_speedup", s["spec_speedup"], "x",
+                  s["spec_speedup"] / _R2_ANCHORS["serving_spec_speedup"])
             _emit("serving_kv_capacity_ratio", s["kv_capacity_ratio"],
                   "x", s["kv_capacity_ratio"] /
                   _R2_ANCHORS["serving_kv_capacity_ratio"])

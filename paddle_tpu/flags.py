@@ -303,6 +303,25 @@ define_flag("FLAGS_serving_kv_quant", "",
             "(the gather fallback dequantizes after its gather). '' = "
             "fp pool at the model/cache dtype. Composes with the "
             "weight-only quantize='int8' path.", str)
+define_flag("FLAGS_serving_spec_decode", 0,
+            "Speculative decoding for the paged serving engine "
+            "(ServingConfig.spec_decode): tokens DRAFTED per verify "
+            "dispatch via n-gram prompt lookup (no second model — drafts "
+            "come from the request's own prompt + generated context). "
+            "Each verify runs ONE multi-query decode dispatch over the "
+            "drafts and emits every accepted token plus the corrected "
+            "next token, so a repetitive/shared-suffix stream retires "
+            "several tokens per dispatch; sampled and greedy streams are "
+            "BIT-IDENTICAL to non-speculative decode (per-token-index "
+            "PRNG keys make acceptance exact, not approximate). 0 "
+            "disables (the default).", int)
+define_flag("FLAGS_serving_spec_ngram", 3,
+            "n-gram length the prompt-lookup drafter matches: a draft is "
+            "proposed when the last n generated/prompt tokens reoccur "
+            "earlier in the request's context, continuing from the most "
+            "recent prior occurrence. Smaller n drafts more aggressively "
+            "(more speculation, lower acceptance on incoherent text); "
+            "larger n drafts only on strong repetition.", int)
 define_flag("FLAGS_serving_policy", "fifo",
             "Default admission policy for ServingEngine (ServingConfig."
             "policy): fifo (submission order — the parity baseline), "

@@ -51,7 +51,11 @@ class GenerationPredictor:
         self._quantize = quantize
         self._engine = None
 
-    def generate(self, input_ids, prompt_lens=None, seed: int = 0):
+    def generate(self, input_ids, prompt_lens=None,
+                 seed: Optional[int] = None):
+        """Batch decode; ``seed`` overrides ``gen_config.seed`` (the one
+        config both the dense and the serving tier resolve their PRNG
+        from)."""
         import jax
         from ..models.generation import generate
         g = self._gen
@@ -61,7 +65,8 @@ class GenerationPredictor:
                        top_k=g.top_k, top_p=g.top_p,
                        eos_token_id=g.eos_token_id,
                        pad_token_id=g.pad_token_id,
-                       key=jax.random.PRNGKey(seed))
+                       key=jax.random.PRNGKey(
+                           seed if seed is not None else g.seed))
         return np.asarray(out)
 
     def stream(self, input_ids, prompt_lens=None):
@@ -89,7 +94,7 @@ class GenerationPredictor:
                 logits = sess.step(jnp.asarray(tok))
 
     def serve(self, prompts, max_new_tokens=None, serving_config=None):
-        """Continuous-batching greedy decode of a request list: each prompt
+        """Continuous-batching decode of a request list: each prompt
         is its own variable-length sequence (no batch padding), admitted to
         the engine's slot table as capacity frees up. Returns one
         variable-length token array per prompt (eos included, no pad tail).

@@ -49,9 +49,30 @@ Design (docs/SERVING.md):
   blocking, and ``health_snapshot()`` + the global hang watchdog
   (``serving.step``/``serving.prefill``/``serving.decode`` sections)
   expose the whole thing to ops endpoints.
-* **Greedy (v1).** The engine samples by argmax on device; temperature /
-  top-k/top-p serving stays on the batch ``generate()`` tier. int8
-  weight-only decode rides transparently via ``quantize="int8"``.
+* **On-device sampling.** Per-request temperature / top-k / top-p ride
+  the compiled decode step as DEVICE OPERANDS in the slot table (one
+  compile serves every request mix — no per-request executables), with
+  per-request PRNG base keys derived from ``seed``: the token at sample
+  index ``t`` is drawn with ``fold_in(seed_key(seed), t)``, so sampled
+  streams are reproducible per ``(request, seed)`` across
+  preemption-recompute, supervisor crash-resubmit and cross-replica
+  failover. ``temperature=0`` (the default) selects the argmax through a
+  ``jnp.where`` and stays BIT-IDENTICAL to the v1 greedy engine — every
+  greedy parity oracle extends unchanged. int8 weight-only decode rides
+  transparently via ``quantize="int8"``.
+* **Speculative decoding.** ``spec_decode=k`` drafts up to ``k`` tokens
+  per step by n-gram prompt lookup (no second model: the draft is the
+  continuation of the last ``spec_ngram`` tokens' most recent earlier
+  occurrence in the request's own context) and VERIFIES them in one
+  multi-query decode dispatch (``models.generation.paged_spec_step``;
+  the PR 10 paged-attention kernel's second entry point). Accepted
+  tokens commit their KV blocks; the rejected tail's surplus blocks
+  free through the same ref-counted paths preemption exercises. Because
+  sampling keys are a pure function of the token index, speculative
+  output is BIT-IDENTICAL to non-speculative decode at every
+  temperature — acceptance only changes speed, never tokens. Steps with
+  no draftable slot fall through to the plain decode dispatch, so
+  incoherent (low-acceptance) traffic pays no verify overhead.
 
 API::
 
@@ -116,6 +137,12 @@ HEALTH_SNAPSHOT_FIELDS = {
                     "flash-decoding paged-attention kernel (block tables "
                     "consumed in-kernel), false = the XLA gather + masked-"
                     "softmax fallback (FLAGS_serving_paged_kernel)",
+    "spec_decode": "speculative-decoding draft width: tokens drafted per "
+                   "verify dispatch via n-gram prompt lookup "
+                   "(FLAGS_serving_spec_decode; 0 = off). Acceptance "
+                   "counters ride stats() as spec_drafted / spec_accepted "
+                   "— output streams are bit-identical to non-speculative "
+                   "decode, so the knob only moves tokens/s",
     "retry_after_s": "suggested client backoff when shedding: the mean "
                      "recent retirement interval (the conservative "
                      "FLAGS_serving_retry_after_s default before two "
@@ -159,9 +186,14 @@ class EnginePrograms:
     prefill: Any
     chunk: Any
     decode: Any
+    spec: Any           # speculative verify (multi-query decode) program
+    sample: Any         # first-token sampler (prefill-logits -> token)
     stats: Dict[str, int]
     prefill_buckets: set
-    key: tuple          # shape signature; reuse under a different one raises
+    key: tuple          # shape signature (incl. the sampling/spec-decode
+    #                     surface: spec_decode widths change the verify
+    #                     program's shapes); reuse under a different one
+    #                     raises
 
 
 @dataclasses.dataclass
@@ -199,6 +231,14 @@ class ServingConfig:
     prefix_cache: Any = _UNSET       # bool; None/False = off
     prefill_chunk: Any = _UNSET      # tokens/chunk; None/0 = whole prompt
     preempt: Any = _UNSET            # bool; None/False = legacy reservation
+    # speculative decoding (ISSUE 11)
+    spec_decode: Any = _UNSET        # draft tokens per verify dispatch
+    #                                  (n-gram prompt lookup); None/0 =
+    #                                  off; unset -> FLAGS_serving_
+    #                                  spec_decode
+    spec_ngram: Any = _UNSET         # n-gram length the drafter matches;
+    #                                  unset/None -> FLAGS_serving_
+    #                                  spec_ngram
     # overload / multi-tenancy (ISSUE 6)
     policy: Any = None               # AdmissionPolicy | "fifo"/"priority"/
     #                                  "fair"/"edf"; None -> FLAGS_serving_
@@ -229,6 +269,18 @@ class ServingConfig:
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1 or None/0 "
                              f"(got {self.prefill_chunk})")
+        if self.spec_decode == _UNSET:
+            self.spec_decode = int(flag("FLAGS_serving_spec_decode"))
+        self.spec_decode = int(self.spec_decode) if self.spec_decode else 0
+        if self.spec_decode < 0:
+            raise ValueError(f"spec_decode must be >= 0 (draft tokens per "
+                             f"verify; 0 = off), got {self.spec_decode}")
+        if self.spec_ngram in (_UNSET, None):
+            self.spec_ngram = int(flag("FLAGS_serving_spec_ngram"))
+        self.spec_ngram = int(self.spec_ngram)
+        if self.spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, "
+                             f"got {self.spec_ngram}")
         if self.tenant_cache_quota == _UNSET:
             self.tenant_cache_quota = int(
                 flag("FLAGS_serving_tenant_cache_quota"))
@@ -259,13 +311,12 @@ class ServingEngine:
                  programs: Optional[EnginePrograms] = None):
         import jax
 
-        from ...models.generation import GenerationConfig
+        from ...models.generation import GenerationConfig, validate_sampling
         self.config = serving_config or ServingConfig()
         self._gen = gen_config or GenerationConfig()
-        if self._gen.temperature:
-            raise ValueError(
-                "ServingEngine is greedy-only (temperature=0); sampling "
-                "serving stays on GenerationPredictor.generate")
+        # the engine-default sampling knobs must themselves be servable
+        # (per-request overrides are validated again at submit)
+        validate_sampling(self._gen)
         from ...models.llama import ensure_quantized
         self._params = ensure_quantized(params, self.config.quantize)
         self._cfg = model_config
@@ -290,6 +341,19 @@ class ServingEngine:
         self._steps_left = np.zeros((M,), np.int32)
         self._done = np.ones((M,), bool)          # empty slots are inactive
         self._eos = np.full((M,), -1, np.int32)
+        # per-slot sampling operands (ISSUE 11): device operands of the
+        # ONE compiled decode program, so a greedy request and a
+        # temperature/top-k/top-p request share an executable. keys hold
+        # each request's PRNG base key; sample_idx the next token index
+        # (the fold_in operand — reproducibility per (request, seed))
+        self._temp = np.zeros((M,), np.float32)
+        self._topk = np.zeros((M,), np.int32)     # 0 = disabled
+        self._topp = np.ones((M,), np.float32)    # 1.0 = disabled
+        self._keys = np.zeros((M, 2), np.uint32)
+        self._sample_idx = np.zeros((M,), np.int32)
+        # speculative decoding (ISSUE 11)
+        self._spec_k = int(self.config.spec_decode)
+        self._spec_n = int(self.config.spec_ngram)
         # every mutation (submit/cancel/step) and every snapshot read runs
         # under this lock, so stats()/health_snapshot() are safe from ANY
         # thread — the metrics endpoint polls while the engine thread
@@ -305,7 +369,7 @@ class ServingEngine:
         key = (model_config, self.config.block_size, self.config.max_slots,
                self.config.max_model_len, self.config.quantize,
                str(self.config.cache_dtype), self.config.kv_quant,
-               self.config.paged_kernel)
+               self.config.paged_kernel, self.config.spec_decode)
         if programs is not None:
             if programs.key != key:
                 raise ValueError(
@@ -317,16 +381,19 @@ class ServingEngine:
             self._prefill_buckets = programs.prefill_buckets
             self._jprefill, self._jchunk, self._jdecode = (
                 programs.prefill, programs.chunk, programs.decode)
+            self._jspec, self._jsample = programs.spec, programs.sample
             self.programs = programs
         else:
             self._stats = {"decode_traces": 0, "prefill_traces": 0,
                            "chunk_prefill_traces": 0, "chunks": 0,
-                           "steps": 0}
+                           "steps": 0, "spec_traces": 0,
+                           "sample_traces": 0, "spec_steps": 0}
             self._prefill_buckets = set()
-            self._jprefill, self._jchunk, self._jdecode = self._build(jax)
+            (self._jprefill, self._jchunk, self._jdecode, self._jspec,
+             self._jsample) = self._build(jax)
             self.programs = EnginePrograms(
-                self._jprefill, self._jchunk, self._jdecode, self._stats,
-                self._prefill_buckets, key)
+                self._jprefill, self._jchunk, self._jdecode, self._jspec,
+                self._jsample, self._stats, self._prefill_buckets, key)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -350,8 +417,22 @@ class ServingEngine:
 
         use_kernel = self.config.paged_kernel
 
+        def _next_tokens(logits, keys, sample_idx, temp, topk, topp):
+            """One compiled sampling step over per-slot DEVICE operands:
+            per-row keys fold the slot's base key with its sample index,
+            then greedy rows take the argmax bitwise (sample_tokens'
+            where-select) — gated behind a runtime cond so an all-greedy
+            dispatch never pays the sampling sort."""
+            kt = jax.vmap(jax.random.fold_in)(keys, sample_idx)
+            return lax.cond(
+                (temp > 0.0).any(),
+                lambda lg: G.sample_tokens(lg, kt, temp, topk, topp),
+                lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                logits)
+
         def decode_fn(params, pool, tokens, seq_lens, steps_left, done,
-                      block_tables, eos_ids, limit):
+                      block_tables, eos_ids, limit, keys, sample_idx,
+                      temp, topk, topp):
             stats["decode_traces"] += 1            # trace-time only
             M = tokens.shape[0]
 
@@ -362,34 +443,84 @@ class ServingEngine:
             # dispatch to the schedule (return at the next budget
             # retirement; drain the tail in one go) without retracing
             def body(carry):
-                i, tokens, seq_lens, steps_left, done, pool, out = carry
+                i, tokens, seq_lens, steps_left, done, sample_idx, pool, \
+                    out = carry
                 active = (~done) & (steps_left > 0)
                 logits, pool, _drops = G.paged_decode_step(
                     params, cfg, tokens, seq_lens, block_tables, pool,
                     active, use_kernel=use_kernel)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = _next_tokens(logits, keys, sample_idx, temp, topk,
+                                   topp)
                 nxt = jnp.where(active, nxt, tokens)
                 done = done | (active & (nxt == eos_ids))
                 seq_lens = seq_lens + active
+                sample_idx = sample_idx + active
                 steps_left = steps_left - active.astype(jnp.int32)
                 out = lax.dynamic_update_slice(out, nxt[:, None], (0, i))
-                return (i + 1, nxt, seq_lens, steps_left, done, pool, out)
+                return (i + 1, nxt, seq_lens, steps_left, done, sample_idx,
+                        pool, out)
 
             def cond(carry):
-                i, _, _, steps_left, done, _, _ = carry
+                i, _, _, steps_left, done, _, _, _ = carry
                 return (i < limit) & ((~done) & (steps_left > 0)).any()
 
             out0 = jnp.zeros((M, Cmax), jnp.int32)
-            (_, tokens, seq_lens, steps_left, done, pool, out) = \
+            (_, tokens, seq_lens, steps_left, done, _, pool, out) = \
                 lax.while_loop(cond, body, (jnp.int32(0), tokens, seq_lens,
-                                            steps_left, done, pool, out0))
+                                            steps_left, done, sample_idx,
+                                            pool, out0))
             return pool, tokens, seq_lens, steps_left, done, out
+
+        def spec_fn(params, pool, tokens, seq_lens, draft_lens, steps_left,
+                    done, block_tables, keys, sample_idx, temp, topk, topp):
+            """One speculative VERIFY dispatch: multi-query decode over
+            ``tokens [M, Q]`` (last token + drafts), then sample each
+            position with its own per-index key and count the accepted
+            draft prefix. Tokens match non-speculative decode bitwise —
+            index ``t`` is always drawn with ``fold_in(base, t)``."""
+            stats["spec_traces"] += 1              # trace-time only
+            M, Q = tokens.shape
+            active = (~done) & (steps_left > 0)
+            logits, pool, _drops = G.paged_spec_step(
+                params, cfg, tokens, seq_lens, draft_lens, block_tables,
+                pool, active, use_kernel=use_kernel)
+            V = logits.shape[-1]
+            idx = sample_idx[:, None] + jnp.arange(Q)[None, :]   # [M, Q]
+            kt = jax.vmap(jax.vmap(jax.random.fold_in,
+                                   in_axes=(None, 0)))(keys, idx)
+
+            def _sampled(lg):
+                return G.sample_tokens(
+                    lg.reshape(M * Q, V), kt.reshape(M * Q, 2),
+                    jnp.repeat(temp, Q), jnp.repeat(topk, Q),
+                    jnp.repeat(topp, Q)).reshape(M, Q)
+
+            cand = lax.cond(
+                (temp > 0.0).any(), _sampled,
+                lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                logits)
+            # accepted = length of the leading draft prefix the sampled
+            # chain reproduces (cand[q] is the token AFTER tokens[:q+1],
+            # verified against draft tokens[q+1])
+            ok = (cand[:, :-1] == tokens[:, 1:]) & \
+                (jnp.arange(Q - 1)[None, :] < draft_lens[:, None])
+            acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+            return pool, cand, acc
+
+        def sample_fn(logits, keys, idx, temp, topk, topp):
+            """First-token sampler over a prefill wave's logits (one
+            executable per wave-batch bucket, like prefill itself)."""
+            stats["sample_traces"] += 1            # trace-time only
+            kt = jax.vmap(jax.random.fold_in)(keys, idx)
+            return G.sample_tokens(logits, kt, temp, topk, topp)
 
         donate = donation_supported()
         jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
         jchk = jax.jit(chunk_fn, donate_argnums=(5,) if donate else ())
         jdec = jax.jit(decode_fn, donate_argnums=(1,) if donate else ())
-        return jpre, jchk, jdec
+        jspec = jax.jit(spec_fn, donate_argnums=(1,) if donate else ())
+        jsamp = jax.jit(sample_fn)
+        return jpre, jchk, jdec, jspec, jsamp
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -404,10 +535,23 @@ class ServingEngine:
                eos_token_id: Optional[int] = "unset",
                timeout_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               tenant: Optional[str] = None, priority: int = 0) -> int:
+               tenant: Optional[str] = None, priority: int = 0,
+               temperature: Any = "unset", top_k: Any = "unset",
+               top_p: Any = "unset", seed: Any = "unset") -> int:
         """Queue one prompt; returns the request id. ``eos_token_id``
         defaults to the engine's GenerationConfig (pass ``None`` explicitly
         to disable EOS for this request).
+
+        Sampling knobs (ISSUE 11) resolve through the ONE
+        ``GenerationConfig`` struct (left unset -> the engine's
+        ``gen_config`` defaults; explicit ``None`` DISABLES top_k/top_p):
+        ``temperature`` 0 = greedy argmax on device, bit-identical to the
+        greedy-only engine; > 0 samples with per-request PRNG keys
+        derived from ``seed``, so the stream is reproducible per
+        ``(request, seed)`` across preemption, crash resubmit and
+        failover. Genuinely unsupported combinations (negative/non-finite
+        temperature, ``top_k < 1``, ``top_p`` outside ``(0, 1]``) raise a
+        structured ``ValueError`` naming the supported surface.
 
         Lifecycle/policy knobs (ISSUE 6): ``timeout_s`` (relative to now) /
         ``deadline_s`` (absolute ``time.time()``) bound the request's wall
@@ -427,24 +571,35 @@ class ServingEngine:
             t = time.time() + float(timeout_s)
             deadline = t if deadline is None else min(deadline, t)
         req = self._make_request(prompt, max_new_tokens, eos_token_id,
-                                 tenant, priority, deadline)
+                                 tenant, priority, deadline,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed)
         with self._lock:
             return self._sched.submit(req)
 
     def _make_request(self, prompt, max_new_tokens, eos_token_id, tenant,
-                      priority, deadline,
-                      tokens: Sequence[int] = ()) -> Request:
+                      priority, deadline, tokens: Sequence[int] = (),
+                      temperature: Any = "unset", top_k: Any = "unset",
+                      top_p: Any = "unset", seed: Any = "unset") -> Request:
         """One Request from user-facing arguments — the single place
-        submit() and resubmit() resolve GenerationConfig defaults, the
-        eos "unset" sentinel and the tenant key, so fresh and
-        crash-recovered requests can never diverge in defaults."""
-        g = self._gen
+        submit() and resubmit() resolve GenerationConfig defaults (the
+        sampling knobs included), the "unset" sentinels and the tenant
+        key, so fresh and crash-recovered requests can never diverge in
+        defaults."""
+        from ...models.generation import GenerationConfig, validate_sampling
+        g = GenerationConfig.resolve(
+            self._gen, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed)
+        validate_sampling(g)
         req = Request(
             rid=-1, prompt=np.asarray(prompt, np.int32).reshape(-1),
-            max_new_tokens=int(max_new_tokens if max_new_tokens is not None
-                               else g.max_new_tokens),
-            eos_token_id=(g.eos_token_id if eos_token_id == "unset"
-                          else eos_token_id),
+            max_new_tokens=int(g.max_new_tokens),
+            eos_token_id=g.eos_token_id,
+            temperature=float(g.temperature),
+            top_k=int(g.top_k) if g.top_k is not None else None,
+            top_p=float(g.top_p) if g.top_p is not None else None,
+            seed=int(g.seed),
             tenant=str(tenant) if tenant is not None else DEFAULT_TENANT,
             priority=int(priority),
             deadline=float(deadline) if deadline is not None else None)
@@ -462,19 +617,26 @@ class ServingEngine:
                  max_new_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = "unset",
                  deadline: Optional[float] = None,
-                 tenant: Optional[str] = None, priority: int = 0) -> int:
+                 tenant: Optional[str] = None, priority: int = 0,
+                 temperature: Any = "unset", top_k: Any = "unset",
+                 top_p: Any = "unset", seed: Any = "unset") -> int:
         """Re-queue a request recovered from a torn-down engine with the
         tokens it had already emitted — the supervisor's restart path.
         Rides the preemption-recompute machinery: prefill recomputes KV
         for ``prompt + tokens[:-1]`` and decode resumes from the last
-        token, so greedy outputs are bit-identical to an uninterrupted
-        run and the already-delivered tokens are never re-emitted.
-        ``deadline`` is ABSOLUTE (the original request's). Bypasses the
-        queue-depth shed — everything resubmitted was already accepted
-        once, and the recovered set (old queue + old slots) can exceed
-        the admission bound by up to ``max_slots``."""
+        token, so outputs are bit-identical to an uninterrupted run
+        (greedy by determinism; sampled because the per-token key is a
+        pure function of ``(seed, token index)`` — the caller passes the
+        original RESOLVED sampling knobs) and the already-delivered
+        tokens are never re-emitted. ``deadline`` is ABSOLUTE (the
+        original request's). Bypasses the queue-depth shed — everything
+        resubmitted was already accepted once, and the recovered set
+        (old queue + old slots) can exceed the admission bound by up to
+        ``max_slots``."""
         req = self._make_request(prompt, max_new_tokens, eos_token_id,
-                                 tenant, priority, deadline, tokens=tokens)
+                                 tenant, priority, deadline, tokens=tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed)
         if req.finished:
             raise ValueError(
                 f"request is already finished ({len(req.tokens)} tokens of "
@@ -533,6 +695,11 @@ class ServingEngine:
         self._steps_left[m] = 0
         self._done[m] = True
         self._eos[m] = -1
+        self._temp[m] = 0.0
+        self._topk[m] = 0
+        self._topp[m] = 1.0
+        self._keys[m] = 0
+        self._sample_idx[m] = 0
 
     def _terminate(self, req: Request, state: str) -> None:
         m = req.slot
@@ -581,13 +748,21 @@ class ServingEngine:
     def _start_decode(self, req: Request) -> None:
         """Move a request whose prefill just completed into the decode slot
         arrays. Fresh requests enter with their first sampled token already
-        in ``tokens``; readmitted ones resume from their last token."""
+        in ``tokens``; readmitted ones resume from their last token — and
+        from their next SAMPLE INDEX, so the per-index PRNG keys line up
+        with an uninterrupted run."""
+        from ...models.generation import seed_key
         m = req.slot
         self._tokens[m] = req.tokens[-1]
         self._seq_lens[m] = req.prompt_len + len(req.tokens) - 1
         self._steps_left[m] = req.max_new_tokens - len(req.tokens)
         self._done[m] = False
         self._eos[m] = -1 if req.eos_token_id is None else req.eos_token_id
+        self._temp[m] = req.temperature
+        self._topk[m] = req.top_k if req.top_k is not None else 0
+        self._topp[m] = req.top_p if req.top_p is not None else 1.0
+        self._keys[m] = seed_key(req.seed)
+        self._sample_idx[m] = len(req.tokens)
 
     def _emit_first(self, req: Request, tok0: int, now: float,
                     emitted: Dict[int, List[int]]) -> None:
@@ -640,7 +815,7 @@ class ServingEngine:
                 logits, self.cache.pool, _ = self._jprefill(
                     self._params, jnp.asarray(ids), jnp.asarray(plens),
                     jnp.asarray(tables), self.cache.pool, jnp.asarray(act))
-                first = np.argmax(np.asarray(logits), axis=-1)
+                first = self._first_tokens(logits, group, Bb)
             now = time.time()
             for r, req in enumerate(group):
                 req.num_computed = req.prompt_len
@@ -683,8 +858,32 @@ class ServingEngine:
             if req.tokens:                        # readmission: resume
                 self._start_decode(req)
             else:
-                tok0 = int(np.argmax(np.asarray(logits)[0]))
+                tok0 = int(self._first_tokens(logits, [req], 1)[0])
                 self._emit_first(req, tok0, time.time(), emitted)
+
+    def _first_tokens(self, logits, group, Bb: int) -> np.ndarray:
+        """Sample each admitted request's FIRST token (sample index 0)
+        from its prefill logits. All-greedy waves take the literal host
+        argmax (the v1 path, bitwise); a wave with any sampling row runs
+        the compiled per-row sampler — greedy rows inside it still argmax
+        through sample_tokens' where-select."""
+        if all(r.temperature == 0.0 for r in group):
+            return np.argmax(np.asarray(logits), axis=-1)
+        import jax.numpy as jnp
+
+        from ...models.generation import seed_key
+        keys = np.zeros((Bb, 2), np.uint32)
+        temp = np.zeros((Bb,), np.float32)
+        topk = np.zeros((Bb,), np.int32)
+        topp = np.ones((Bb,), np.float32)
+        for r, req in enumerate(group):
+            keys[r] = seed_key(req.seed)
+            temp[r] = req.temperature
+            topk[r] = req.top_k if req.top_k is not None else 0
+            topp[r] = req.top_p if req.top_p is not None else 1.0
+        return np.asarray(self._jsample(
+            logits, jnp.asarray(keys), jnp.zeros((Bb,), jnp.int32),
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp)))
 
     # ---- decode dispatch sizing -------------------------------------------
 
@@ -753,23 +952,215 @@ class ServingEngine:
                 else:
                     return lo
                 continue
-            victim = self._sched.preempt_victim()
-            if victim is not None:
-                self._preempt(victim)
-                continue
-            # sole oldest request and the pool STILL can't cover one more
-            # block: its budget exceeds the whole pool. Truncate — retire
-            # with the tokens it has — instead of hanging the drain loop.
-            r = decoding[0]
-            r.oom_truncated = True
-            self._sched.oom_truncated += 1
-            self._done[r.slot] = True
-            return 0
+            if not self._relieve_pressure(decoding):
+                return 0
+
+    def _relieve_pressure(self, decoding: List[Request]) -> bool:
+        """The pool can't cover even the minimal next dispatch: preempt
+        the newest-admitted live request (never the oldest — the
+        no-livelock proof) and return True so the caller replans; with
+        nothing left to preempt the sole survivor's budget exceeds the
+        whole pool — truncate it (retire with the tokens it has, never
+        hang the drain loop) and return False. The ONE preempt/truncate
+        ladder the decode and spec block planners share."""
+        victim = self._sched.preempt_victim()
+        if victim is not None:
+            self._preempt(victim)
+            return True
+        r = decoding[0]
+        r.oom_truncated = True
+        self._sched.oom_truncated += 1
+        self._done[r.slot] = True
+        return False
 
     def _preempt(self, req: Request) -> None:
         m = req.slot
         self._sched.preempt(req)
         self._clear_slot(m)
+
+    # ---- speculative decoding (ISSUE 11) ----------------------------------
+
+    def _ctx_at(self, req: Request, i: int) -> int:
+        """Token backing context position ``i`` (prompt, then generated)
+        without materializing the concatenation."""
+        pl = req.prompt_len
+        return int(req.prompt[i]) if i < pl else int(req.tokens[i - pl])
+
+    def _draft_tokens(self, req: Request) -> List[int]:
+        """n-gram prompt-lookup drafting (no second model): when the last
+        ``spec_ngram`` tokens of the request's context (prompt +
+        generated) reoccur earlier, propose the continuation of the most
+        recent PRIOR occurrence — preferring one with a full
+        ``spec_decode`` window of continuation. Capped so the verify can
+        never emit past the token budget (``draft <= steps_left - 1``:
+        emission is ``accepted + 1``). Returns [] when nothing matches —
+        the step then falls through to the plain decode dispatch.
+
+        An incremental per-request n-gram presence index (O(1) amortized
+        per generated token) gates the scan: when the trailing n-gram
+        has never occurred before, the miss costs O(ngram), not
+        O(context) — so incoherent/long-context traffic pays nothing per
+        step. The full O(context) occurrence scan (which preserves the
+        exact most-recent/full-window selection) only runs when a draft
+        WILL be proposed — steps where a verify dispatch is about to pay
+        for itself anyway."""
+        k = min(self._spec_k, int(self._steps_left[req.slot]) - 1)
+        if k < 1:
+            return []
+        n = self._spec_n
+        L = req.prompt_len + len(req.tokens)
+        if L <= n:
+            return []
+        st = req.spec_index
+        if st is None:
+            st = req.spec_index = {"end": n - 1, "seen": set()}
+        # index every n-gram ENDING at positions (st["end"], L-1] — one
+        # tuple per newly appended token since the last call
+        for e in range(st["end"] + 1, L):
+            st["seen"].add(tuple(self._ctx_at(req, e - n + j)
+                                 for j in range(n)))
+        st["end"] = L - 1
+        tail = tuple(self._ctx_at(req, L - n + j) for j in range(n))
+        if tail not in st["seen"]:
+            return []
+        ctx = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        pat = ctx[-n:]
+        win = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.nonzero((win == pat).all(axis=1))[0]
+        if not hits.size:                  # unreachable given the index;
+            return []                      # kept as a safety net
+        # prefer the most recent occurrence with k tokens of continuation
+        # inside the context; fall back to the most recent one at all
+        full = hits[hits + n + k <= len(ctx)]
+        j = int(full[-1]) if full.size else int(hits[-1])
+        return [int(t) for t in ctx[j + n:j + n + k]]
+
+    def _ensure_blocks_spec(self, drafts: Dict[int, List[int]]
+                            ) -> List[Request]:
+        """Block planning for one verify dispatch: every decoding slot
+        needs blocks covering ``seq_len + draft_len + 1`` KV entries (the
+        verify writes the last token's KV plus one per draft). When the
+        pool can't cover the drafts they are DROPPED first — the caller
+        then falls through to the plain decode loop, which batches
+        iterations far cheaper than a pad-lane verify would — before any
+        preemption; the preempt/truncate ladder is the shared
+        :meth:`_relieve_pressure`. Returns the decoding set (possibly
+        shrunk by preemption; empty = nothing to do)."""
+        bf = self.cache.manager.blocks_for
+
+        while True:
+            decoding = self._sched.decoding
+            if not decoding:
+                return []
+
+            def need(with_drafts: bool) -> int:
+                tot = 0
+                for r in decoding:
+                    dl = len(drafts.get(r.rid, ())) if with_drafts else 0
+                    e = int(self._seq_lens[r.slot]) + dl + 1
+                    tot += max(0, bf(e) - len(r.blocks))
+                return tot
+
+            avail = self.cache.free_blocks
+            if need(True) <= avail:
+                with_drafts = True
+            elif need(False) <= avail:
+                with_drafts = False
+                drafts.clear()         # pool-pressure fallback: no drafts
+            elif self._relieve_pressure(decoding):
+                continue
+            else:
+                return []
+            for r in decoding:
+                dl = len(drafts.get(r.rid, ())) if with_drafts else 0
+                e = int(self._seq_lens[r.slot]) + dl + 1
+                if self.cache.extend(r.slot, r.blocks, e) is None:
+                    break                     # raced an estimate; retry
+            else:
+                return decoding
+
+    def _rollback_blocks(self, req: Request) -> None:
+        """Free the surplus blocks a verify's REJECTED tail left behind:
+        after acceptance the slot's committed KV spans ``seq_len``
+        entries, so any block past ``blocks_for(seq_len)`` holds only
+        stale draft KV — it returns to the ref-counted manager through
+        the same free path preemption uses (never a registered block:
+        registration stops at the last committed full block). The stale
+        entries INSIDE the kept tail block are overwritten by the next
+        dispatch's write at ``seq_len`` or hidden by the ``j <= seq_len``
+        mask."""
+        keep = self.cache.manager.blocks_for(int(self._seq_lens[req.slot]))
+        tail = req.blocks[keep:]
+        if not tail:
+            return
+        self.cache.manager.free(tail)
+        del req.blocks[keep:]
+        self.cache.tables[req.slot, keep:] = 0
+
+    def _spec_dispatch(self, decoding: List[Request],
+                       drafts: Dict[int, List[int]],
+                       emitted: Dict[int, List[int]]) -> None:
+        """One speculative verify: build the ``[M, Q]`` token matrix
+        (last token + drafts, pad lanes repeat the last token), dispatch
+        the compiled verify program, then commit ``accepted + 1`` tokens
+        per slot (EOS truncates), advance the sampling cursor, register
+        freshly-filled prefix blocks and roll back the rejected tail's
+        surplus blocks."""
+        import jax.numpy as jnp
+        Q = self._spec_k + 1
+        M = self.config.max_slots
+        toks = np.zeros((M, Q), np.int32)
+        dl = np.zeros((M,), np.int32)
+        for req in decoding:
+            m = req.slot
+            d = drafts.get(req.rid, [])
+            toks[m, 0] = self._tokens[m]
+            toks[m, 1:1 + len(d)] = d
+            toks[m, 1 + len(d):] = self._tokens[m]   # pad: a real token
+            dl[m] = len(d)
+        with _watchdog.section("serving.decode"):
+            self.cache.pool, cand, acc = self._jspec(
+                self._params, self.cache.pool, jnp.asarray(toks),
+                jnp.asarray(self._seq_lens), jnp.asarray(dl),
+                jnp.asarray(self._steps_left), jnp.asarray(self._done),
+                jnp.asarray(self.cache.tables), jnp.asarray(self._keys),
+                jnp.asarray(self._sample_idx), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp))
+            cand = np.asarray(cand)
+            acc = np.asarray(acc)
+        for req in decoding:
+            m = req.slot
+            if self._done[m] or self._steps_left[m] <= 0:
+                continue
+            got = [int(t) for t in cand[m, :int(acc[m]) + 1]]
+            eos = req.eos_token_id
+            if eos is not None and eos in got:
+                got = got[:got.index(eos) + 1]
+                self._done[m] = True
+                req.eos_seen = True
+            e = len(got)
+            req.tokens.extend(got)
+            emitted.setdefault(req.rid, []).extend(got)
+            req.spec_drafted += int(dl[m])
+            req.spec_accepted += e - 1
+            self._sched.spec_drafted += int(dl[m])
+            self._sched.spec_accepted += e - 1
+            self._tokens[m] = got[-1]
+            self._seq_lens[m] += e
+            self._steps_left[m] -= e
+            self._sample_idx[m] = len(req.tokens)
+            sl = int(self._seq_lens[m])
+            base = req.reg_state[0] * self.config.block_size
+            if self.config.prefix_cache and \
+                    sl // self.config.block_size > req.reg_state[0]:
+                req.reg_state = self.cache.register_prefix(
+                    self._chain_ids(req, base, sl), req.blocks, sl,
+                    req.reg_state, base=base, tenant=req.tenant)
+            if not req.finished:
+                self._rollback_blocks(req)
+        self._stats["chunks"] += 1
+        self._stats["spec_steps"] += 1
 
     # ---- the scheduler iteration ------------------------------------------
 
@@ -795,6 +1186,24 @@ class ServingEngine:
         self._advance_prefills(emitted)
         k = 0
         decoding = self._sched.decoding
+        if decoding and self._spec_k:
+            # speculative path: draft by prompt lookup; with at least one
+            # draft the step runs ONE multi-query verify dispatch instead
+            # of the decode loop (draft-less slots ride it as a plain
+            # single step). No draft anywhere — none found, or the block
+            # planner DROPPED them under pool pressure — falls through to
+            # the decode loop: a verify with all-pad lanes would pay
+            # ~Q x the FLOPs of a decode iteration to emit one token per
+            # slot, while the loop batches many iterations per dispatch.
+            drafts = {r.rid: self._draft_tokens(r) for r in decoding}
+            if any(drafts.values()):
+                decoding = self._ensure_blocks_spec(drafts)
+                if decoding and any(drafts.values()):
+                    self._spec_dispatch(decoding, drafts, emitted)
+                    self._sched.retire_finished()
+                    self._stats["steps"] += 1
+                    return emitted
+            decoding = self._sched.decoding
         if decoding:
             want = self._limit(decoding, max_iters)
             k = self._ensure_blocks(want)
@@ -814,7 +1223,10 @@ class ServingEngine:
                     jnp.asarray(self._seq_lens),
                     jnp.asarray(self._steps_left),
                     jnp.asarray(self._done), jnp.asarray(self.cache.tables),
-                    jnp.asarray(self._eos), jnp.asarray(k, jnp.int32))
+                    jnp.asarray(self._eos), jnp.asarray(k, jnp.int32),
+                    jnp.asarray(self._keys), jnp.asarray(self._sample_idx),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp))
                 toks = np.asarray(toks)
             # np.array (copy): zero-copy views of jax outputs are read-only,
             # and admission writes these slots in place next step
@@ -829,6 +1241,7 @@ class ServingEngine:
                     continue
                 got = toks[m, :n].tolist()
                 req.tokens.extend(got)
+                self._sample_idx[m] = len(req.tokens)
                 if bool(self._done[m]):
                     req.eos_seen = True
                 emitted.setdefault(req.rid, []).extend(got)
@@ -954,6 +1367,9 @@ class ServingEngine:
                 "usable_blocks": self.cache.manager.num_blocks - 1,
                 "kv_quant": self.config.kv_quant,
                 "paged_kernel": self.config.paged_kernel,
+                "spec_decode": self.config.spec_decode,
+                "spec_drafted": self._sched.spec_drafted,
+                "spec_accepted": self._sched.spec_accepted,
                 "kv_pool_bytes": self.cache.kv_bytes(),
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}
 
@@ -1025,6 +1441,7 @@ class ServingEngine:
             "kv_pool_bytes": self.cache.kv_bytes(),
             "kv_quant": self.config.kv_quant,
             "paged_kernel": self.config.paged_kernel,
+            "spec_decode": self.config.spec_decode,
             "retry_after_s": sched.retry_after_s(),
             "counters": {
                 "admitted": sched.admitted, "retired": sched.retired,

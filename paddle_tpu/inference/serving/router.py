@@ -183,8 +183,16 @@ class RouterRequest:
     tenant: Optional[str]
     priority: int
     deadline: Optional[float]
-    replica: int                      # current primary replica rid
-    srid: int                         # supervisor rid on that replica
+    # RESOLVED sampling knobs (ISSUE 11): a failover/hedge replays them
+    # verbatim — per-token-index PRNG keys keep the sampled stream
+    # bit-identical across replicas, so hedged copies stay
+    # interchangeable and failover never forks a stream
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+    replica: int = -1                 # current primary replica rid
+    srid: int = -1                    # supervisor rid on that replica
     affinity_key: Optional[int] = None
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
@@ -438,7 +446,8 @@ class ServingRouter:
                timeout_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
                tenant: Optional[str] = None, priority: int = 0,
-               replica: Optional[int] = None) -> int:
+               temperature="unset", top_k="unset", top_p="unset",
+               seed="unset", replica: Optional[int] = None) -> int:
         """Route one prompt to a healthy replica; returns the ROUTER
         request id. ``replica`` pins the pick (an ops/canary hook — the
         pinned replica must still be routable). Raises
@@ -479,7 +488,8 @@ class ServingRouter:
                         p, max_new_tokens=max_new_tokens,
                         eos_token_id=eos_token_id, timeout_s=timeout_s,
                         deadline_s=deadline_s, tenant=tenant,
-                        priority=priority)
+                        priority=priority, temperature=temperature,
+                        top_k=top_k, top_p=top_p, seed=seed)
                     rep.breaker.record_success()
                     break
                 except ServingQueueFull as e:   # full: try the next pick
@@ -495,6 +505,8 @@ class ServingRouter:
                 max_new_tokens=rec.max_new_tokens,
                 eos_token_id=rec.eos_token_id, tenant=rec.tenant,
                 priority=rec.priority, deadline=rec.deadline,
+                temperature=rec.temperature, top_k=rec.top_k,
+                top_p=rec.top_p, seed=rec.seed,
                 replica=rep.rid, srid=srid, affinity_key=key,
                 submit_t=now)
             self._next_frid += 1
@@ -649,7 +661,9 @@ class ServingRouter:
                     req.prompt, req.tokens,
                     max_new_tokens=req.max_new_tokens,
                     eos_token_id=req.eos_token_id, deadline=req.deadline,
-                    tenant=req.tenant, priority=req.priority)
+                    tenant=req.tenant, priority=req.priority,
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, seed=req.seed)
             except Exception:          # noqa: BLE001 — raced a drain
                 continue
             self._routes[rep.rid][srid] = req.frid
@@ -758,7 +772,8 @@ class ServingRouter:
                     req.prompt, max_new_tokens=req.max_new_tokens,
                     eos_token_id=req.eos_token_id,
                     deadline_s=req.deadline, tenant=req.tenant,
-                    priority=req.priority)
+                    priority=req.priority, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p, seed=req.seed)
             except Exception:          # noqa: BLE001 — shed: retry later
                 continue
             req.hedge = (rep.rid, srid)

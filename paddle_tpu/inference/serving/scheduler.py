@@ -112,6 +112,18 @@ class Request:
     prompt: np.ndarray                 # [S] int32
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    # sampling knobs (ISSUE 11), RESOLVED through GenerationConfig at
+    # submit: temperature 0 = greedy argmax (bit-identical to the v1
+    # engine); top_k/top_p None = disabled; seed derives the per-request
+    # PRNG base key — the token at sample index t is drawn with
+    # fold_in(seed_key(seed), t), a pure function of (request, seed, t),
+    # so sampled streams reproduce exactly across preemption-recompute,
+    # supervisor crash-resubmit, cross-replica failover AND speculative
+    # verify
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
     # multi-tenancy + lifecycle (ISSUE 6): the tenant key scopes fair-share
     # accounting and cache quotas; priority orders the priority policy;
     # deadline is ABSOLUTE (time.time()) — engine.submit derives it from
@@ -141,6 +153,15 @@ class Request:
     prefix_hit_tokens: int = 0
     preemptions: int = 0
     recomputed_tokens: int = 0
+    spec_drafted: int = 0              # draft tokens verified for this
+    spec_accepted: int = 0             # ... and how many were emitted
+    # incremental n-gram presence index for the prompt-lookup drafter
+    # (engine-owned; see ServingEngine._draft_tokens): {"end": positions
+    # indexed so far, "seen": n-gram tuples ending before the context
+    # end}. Survives preemption (the context it indexes — prompt +
+    # kept tokens — never shrinks); a crash resubmission starts a fresh
+    # Request and rebuilds it lazily.
+    spec_index: Optional[Dict] = None
     computed_hwm: int = 0              # most KV entries ever written; caps
     #                                    the recompute charge on readmission
     #                                    (a mid-prefill preemption only
@@ -256,6 +277,10 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.recomputed_tokens = 0
         self.oom_truncated = 0
+        # speculative-decoding totals (ISSUE 11): drafts verified vs
+        # drafts emitted — the live acceptance-rate signal
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # lifecycle counters (terminal states other than FINISHED)
         self.cancelled = 0
         self.timed_out = 0

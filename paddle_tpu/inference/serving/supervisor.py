@@ -95,6 +95,13 @@ class TrackedRequest:
     tenant: Optional[str]
     priority: int
     deadline: Optional[float]          # absolute, like Request.deadline
+    # RESOLVED sampling knobs (ISSUE 11): resubmission replays them
+    # verbatim, and the per-token-index PRNG keys make the recovered
+    # sampled stream bit-identical to an uninterrupted run
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
     erid: int = -1                     # rid in the CURRENT engine
     tokens: List[int] = dataclasses.field(default_factory=list)
     state: str = QUEUED
@@ -263,9 +270,14 @@ class EngineSupervisor:
                eos_token_id: Optional[int] = "unset",
                timeout_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               tenant: Optional[str] = None, priority: int = 0) -> int:
+               tenant: Optional[str] = None, priority: int = 0,
+               temperature="unset", top_k="unset", top_p="unset",
+               seed="unset") -> int:
         """Queue one prompt; returns the SUPERVISOR request id (stable
-        across engine restarts). Raises :class:`ServingUnavailable` while
+        across engine restarts). Sampling knobs pass through to
+        :meth:`ServingEngine.submit` (resolved once there — the tracked
+        record mirrors the RESOLVED values so a crash resubmission
+        replays them verbatim). Raises :class:`ServingUnavailable` while
         draining or broken (the structured 503) and passes
         :class:`~.scheduler.ServingQueueFull` through (the structured
         shed)."""
@@ -274,7 +286,9 @@ class EngineSupervisor:
             erid = self.engine.submit(
                 prompt, max_new_tokens=max_new_tokens,
                 eos_token_id=eos_token_id, timeout_s=timeout_s,
-                deadline_s=deadline_s, tenant=tenant, priority=priority)
+                deadline_s=deadline_s, tenant=tenant, priority=priority,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed)
             return self._track(erid).srid
 
     def _track(self, erid: int, resubmits: int = 0) -> TrackedRequest:
@@ -288,7 +302,9 @@ class EngineSupervisor:
             srid=self._next_srid, prompt=req.prompt,
             max_new_tokens=req.max_new_tokens,
             eos_token_id=req.eos_token_id, tenant=req.tenant,
-            priority=req.priority, deadline=req.deadline, erid=erid)
+            priority=req.priority, deadline=req.deadline,
+            temperature=req.temperature, top_k=req.top_k,
+            top_p=req.top_p, seed=req.seed, erid=erid)
         rec.tokens = [int(t) for t in req.tokens]
         rec.resubmits = resubmits
         self._next_srid += 1
@@ -314,7 +330,9 @@ class EngineSupervisor:
                  max_new_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = "unset",
                  deadline: Optional[float] = None,
-                 tenant: Optional[str] = None, priority: int = 0) -> int:
+                 tenant: Optional[str] = None, priority: int = 0,
+                 temperature="unset", top_k="unset", top_p="unset",
+                 seed="unset") -> int:
         """ADOPT a request recovered from another replica (the router's
         cross-replica failover): queue it with the tokens the client has
         already been delivered, riding :meth:`ServingEngine.resubmit`'s
@@ -328,7 +346,8 @@ class EngineSupervisor:
             erid = self.engine.resubmit(
                 prompt, tokens, max_new_tokens=max_new_tokens,
                 eos_token_id=eos_token_id, deadline=deadline,
-                tenant=tenant, priority=priority)
+                tenant=tenant, priority=priority, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed)
             rec = self._track(erid, resubmits=1)    # born from a failover
             self.adopted += 1
             self.recovered_tokens += len(rec.tokens)
@@ -477,7 +496,9 @@ class EngineSupervisor:
                 rec.prompt, rec.tokens,
                 max_new_tokens=rec.max_new_tokens,
                 eos_token_id=rec.eos_token_id, deadline=rec.deadline,
-                tenant=rec.tenant, priority=rec.priority)
+                tenant=rec.tenant, priority=rec.priority,
+                temperature=rec.temperature, top_k=rec.top_k,
+                top_p=rec.top_p, seed=rec.seed)
             rec.resubmits += 1
             rec.state = QUEUED
             self.resubmitted += 1

@@ -67,7 +67,21 @@ __all__ = ["paged_attention"]
 _NEG_INF = -1e30
 
 
-def _kernel(tbl_ref, sl_ref, *refs, bs, num_blocks_per_seq, scale, quant):
+def _kernel(*refs, bs, num_blocks_per_seq, scale, quant, G, Q):
+    """One grid cell = (slot m, kv head h, KV block w). ``Q = 1`` is the
+    single-token decode step; ``Q > 1`` is the speculative-verify entry
+    point — the query tile is ``[Q * G, D]`` (Q draft positions x G
+    grouped query heads per kv head) and a third scalar-prefetch operand
+    ``dl_ref`` carries each slot's draft length: query offset ``i``
+    attends ``j <= sl + min(i, dl)`` (its committed KV plus the in-pass
+    draft prefix; garbage rows past ``dl`` cap at ``dl`` so no row's
+    window ever reaches an unwritten position)."""
+    if Q > 1:
+        tbl_ref, sl_ref, dl_ref = refs[:3]
+        refs = refs[3:]
+    else:
+        tbl_ref, sl_ref = refs[:2]
+        refs = refs[2:]
     if quant:
         q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = \
             refs
@@ -83,28 +97,36 @@ def _kernel(tbl_ref, sl_ref, *refs, bs, num_blocks_per_seq, scale, quant):
         l_ref[:] = jnp.zeros_like(l_ref)
 
     sl = sl_ref[m]
+    dl = dl_ref[m] if Q > 1 else 0
     base = w * bs
 
-    # skip blocks entirely past the sequence (their table entries point at
-    # the null block; compute is gated, the accumulators pass through)
-    @pl.when(base <= sl)
+    # skip blocks entirely past the attendable window (their table entries
+    # point at the null block; compute is gated, accumulators pass through)
+    @pl.when(base <= sl + dl)
     def _run():
-        q = q_ref[0, 0].astype(jnp.float32)              # [G, D]
+        q = q_ref[0, 0].astype(jnp.float32)              # [Q*G, D]
         k = k_ref[0, :, 0].astype(jnp.float32)           # [bs, D]
         v = v_ref[0, :, 0].astype(jnp.float32)
         if quant:                      # dequant fused into the block load
             k = k * ks_ref[0, :, 0][:, None]
             v = v * vs_ref[0, :, 0][:, None]
         j = base + jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)[:, 0]
-        valid = j <= sl                                  # [bs]
         # containment: V at never-attendable positions must be ZEROED, not
         # merely zero-weighted — a poisoned request can park NaN there
         # (see llama._masked_sdpa); exact 0.0 weights make this bit-invisible
-        # for finite KV
-        v = jnp.where(valid[:, None], v, 0.0)
+        # for finite KV. The widest window any query row reaches is
+        # j <= sl + dl (every position there was written this dispatch or
+        # earlier), so the union can never touch a stale block tail.
+        v = jnp.where((j <= sl + dl)[:, None], v, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = jnp.where(valid[None, :], s, _NEG_INF)       # [G, bs]
+        if Q > 1:                      # per-query-row causal draft window
+            qi = jax.lax.broadcasted_iota(jnp.int32, (Q * G, 1), 0)[:, 0] // G
+            hi = sl + jnp.minimum(qi, dl)                # [Q*G]
+            valid = j[None, :] <= hi[:, None]            # [Q*G, bs]
+        else:
+            valid = (j <= sl)[None, :]                   # [G, bs]
+        s = jnp.where(valid, s, _NEG_INF)
         m_prev = m_ref[:, 0]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_cur[:, None])
@@ -122,11 +144,17 @@ def _kernel(tbl_ref, sl_ref, *refs, bs, num_blocks_per_seq, scale, quant):
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                    k_scale=None, v_scale=None,
+                    draft_lens=None, k_scale=None, v_scale=None,
                     scale: Optional[float] = None, out_dtype=None):
     """Decode attention for ``M`` serving slots straight off the block pool.
 
-    ``q [M, H, D]`` — one query token per slot; ``k_pool``/``v_pool``
+    ``q [M, H, D]`` — one query token per slot (the decode entry point) —
+    or ``q [M, Q, H, D]`` with ``draft_lens [M]`` — ``Q`` query tokens
+    per slot, the SPECULATIVE-VERIFY entry point: query offset ``i`` of
+    slot ``m`` sits at KV position ``seq_lens[m] + i`` and attends ``j <=
+    seq_lens[m] + min(i, draft_lens[m])`` (committed KV plus the in-pass
+    draft prefix; rows past the slot's real draft cap at ``draft_lens``
+    so no window reaches an unwritten position). ``k_pool``/``v_pool``
     ``[N, bs, Hk, D]`` — ONE layer's physical block pool (fp, or int8 with
     ``k_scale``/``v_scale [N, bs, Hk]`` fp32 per-token-per-head scales);
     ``block_tables [M, W]`` int32 — slot ``m``'s KV position ``j`` lives in
@@ -134,11 +162,23 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
     ``seq_lens [M]`` int32 — slot ``m`` attends positions ``j <=
     seq_lens[m]`` (its new token's KV was just scattered at ``seq_lens[m]``).
     Unassigned table entries must point at the null block 0. Returns
-    ``[M, H, D]`` in ``out_dtype`` (default: the pool dtype for fp pools,
-    fp32 for int8 pools — matching the gather path's ``_masked_sdpa``
-    output dtype).
+    ``[M, H, D]`` (or ``[M, Q, H, D]``) in ``out_dtype`` (default: the
+    pool dtype for fp pools, fp32 for int8 pools — matching the gather
+    path's ``_masked_sdpa`` output dtype).
     """
-    M, H, D = q.shape
+    multi = q.ndim == 4
+    if multi:
+        M, Q, H, D = q.shape
+        if draft_lens is None:
+            raise ValueError("paged_attention: multi-query (verify) calls "
+                             "need draft_lens")
+    else:
+        M, H, D = q.shape
+        Q = 1
+        if draft_lens is not None:
+            raise ValueError("paged_attention: draft_lens given with a "
+                             "single-token q [M, H, D]; the verify entry "
+                             "point takes q [M, Q, H, D]")
     N, bs, Hk, _ = k_pool.shape
     W = block_tables.shape[1]
     if H % Hk:
@@ -153,45 +193,72 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
         out_dtype = jnp.float32 if quant else k_pool.dtype
     scale = float(scale) if scale is not None else 1.0 / (D ** 0.5)
     # GQA grouping: query head h = kh * G + g shares kv head kh — exactly
-    # the jnp.repeat(k, G, axis=heads) correspondence the fallback expands
-    qg = q.reshape(M, Hk, G, D)
+    # the jnp.repeat(k, G, axis=heads) correspondence the fallback expands.
+    # Multi-query tiles stack the Q draft positions above the group: row
+    # q * G + g of kv head kh is query offset q's head kh * G + g.
+    if multi:
+        qg = q.reshape(M, Q, Hk, G, D).transpose(0, 2, 1, 3, 4) \
+              .reshape(M, Hk, Q * G, D)
+    else:
+        qg = q.reshape(M, Hk, G, D)
+    QG = Q * G
     tbl = jnp.asarray(block_tables, jnp.int32)
     sl = jnp.asarray(seq_lens, jnp.int32)
+    # scalar-prefetch operands: (tbl, sl) for decode, + dl for verify —
+    # every index map takes them positionally after the grid indices
+    if multi:
+        scalars = (tbl, sl, jnp.asarray(draft_lens, jnp.int32))
+
+        def qmap(m, h, w, tbl, sl, dl):
+            return (m, h, 0, 0)
+
+        def kvmap(m, h, w, tbl, sl, dl):
+            return (tbl[m, w], 0, h, 0)
+
+        def smap(m, h, w, tbl, sl, dl):
+            return (tbl[m, w], 0, h)
+    else:
+        scalars = (tbl, sl)
+
+        def qmap(m, h, w, tbl, sl):
+            return (m, h, 0, 0)
+
+        def kvmap(m, h, w, tbl, sl):
+            return (tbl[m, w], 0, h, 0)
+
+        def smap(m, h, w, tbl, sl):
+            return (tbl[m, w], 0, h)
 
     in_specs = [
-        pl.BlockSpec((1, 1, G, D), lambda m, h, w, tbl, sl: (m, h, 0, 0)),
-        pl.BlockSpec((1, bs, 1, D),
-                     lambda m, h, w, tbl, sl: (tbl[m, w], 0, h, 0)),
-        pl.BlockSpec((1, bs, 1, D),
-                     lambda m, h, w, tbl, sl: (tbl[m, w], 0, h, 0)),
+        pl.BlockSpec((1, 1, QG, D), qmap),
+        pl.BlockSpec((1, bs, 1, D), kvmap),
+        pl.BlockSpec((1, bs, 1, D), kvmap),
     ]
     ops = [qg, k_pool, v_pool]
     if quant:
-        in_specs += [
-            pl.BlockSpec((1, bs, 1),
-                         lambda m, h, w, tbl, sl: (tbl[m, w], 0, h)),
-            pl.BlockSpec((1, bs, 1),
-                         lambda m, h, w, tbl, sl: (tbl[m, w], 0, h)),
-        ]
+        in_specs += [pl.BlockSpec((1, bs, 1), smap),
+                     pl.BlockSpec((1, bs, 1), smap)]
         ops += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalars),
         grid=(M, Hk, W),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, G, D),
-                               lambda m, h, w, tbl, sl: (m, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, QG, D), qmap),
         scratch_shapes=[
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((QG, D), jnp.float32),
+            pltpu.VMEM((QG, 1), jnp.float32),
+            pltpu.VMEM((QG, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_kernel, bs=bs, num_blocks_per_seq=W, scale=scale,
-                          quant=quant),
+                          quant=quant, G=G, Q=Q),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((M, Hk, G, D), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((M, Hk, QG, D), out_dtype),
         interpret=_interpret(),
-    )(tbl, sl, *ops)
+    )(*scalars, *ops)
+    if multi:
+        return out.reshape(M, Hk, Q, G, D).transpose(0, 2, 1, 3, 4) \
+                  .reshape(M, Q, H, D)
     return out.reshape(M, H, D)

@@ -61,7 +61,8 @@ from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
 __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
            "init_paged_pool", "paged_pool_block_bytes", "paged_prefill",
-           "paged_prefill_chunk", "paged_decode_step"]
+           "paged_prefill_chunk", "paged_decode_step", "paged_spec_step",
+           "sample_tokens", "seed_key", "validate_sampling"]
 
 
 # ---------------------------------------------------------------------------
@@ -86,6 +87,13 @@ class GenerationConfig:
     top_p: Optional[float] = None
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
+    # the PRNG seed every sampling tier resolves (the previously-hardcoded
+    # jax.random.PRNGKey(0) default of the dense generate() path, folded
+    # into the ONE config): dense generate derives its key from it when
+    # the caller passes none, and the serving engine derives each
+    # request's per-slot base key from it — outputs are reproducible per
+    # (request, seed) across preemption, crash resubmit and failover
+    seed: int = 0
 
     def replace(self, **kw) -> "GenerationConfig":
         return dataclasses.replace(self, **kw)
@@ -288,6 +296,91 @@ def _sample(logits, key, temperature: float, top_k: Optional[int],
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def seed_key(seed: int):
+    """The raw uint32[2] PRNG base key for one seed — pure host
+    arithmetic (the threefry key packing ``[seed >> 32, seed & 0xffffffff]``),
+    so the serving engine can stamp per-request base keys into its slot
+    table without a device dispatch per submit. The per-token key for
+    sample index ``t`` is ``jax.random.fold_in(seed_key(seed), t)`` —
+    a pure function of ``(seed, t)``, which is what makes sampled streams
+    reproducible per ``(request, seed)`` across preemption-recompute,
+    crash resubmit, cross-replica failover AND speculative verify (the
+    verify samples index ``t`` with exactly the key the sequential step
+    would have used)."""
+    import numpy as np
+    s = int(seed)
+    return np.array([(s >> 32) & 0xffffffff, s & 0xffffffff], np.uint32)
+
+
+def validate_sampling(g: "GenerationConfig") -> None:
+    """Structured validation of the sampling knobs a serving submit may
+    carry — rejects only genuinely unsupported combinations, naming the
+    supported surface (the ``ServingEngine.submit`` contract)."""
+    import math as _math
+    ok = True
+    t = g.temperature
+    if t is None or not _math.isfinite(float(t)) or float(t) < 0:
+        ok = False
+    if g.top_k is not None and int(g.top_k) < 1:
+        ok = False
+    if g.top_p is not None and not (0.0 < float(g.top_p) <= 1.0):
+        ok = False
+    if not ok:
+        raise ValueError(
+            f"unsupported sampling config (temperature={g.temperature!r}, "
+            f"top_k={g.top_k!r}, top_p={g.top_p!r}); supported knobs: "
+            f"temperature >= 0 (0 = greedy argmax), top_k >= 1 or None "
+            f"(disabled), top_p in (0, 1] or None (disabled), integer "
+            f"seed")
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """Per-row sampling with DEVICE operands — the serving tier's sampler.
+
+    ``logits [B, V]`` fp32; ``keys [B, 2]`` uint32 per-row PRNG keys
+    (already folded to the row's sample index); ``temperature [B]`` fp32;
+    ``top_k [B]`` int32 (``0`` disables); ``top_p [B]`` fp32 (``1.0``
+    disables — and genuinely keeps the full distribution, see below).
+    Every knob is a runtime operand, so ONE compiled program serves every
+    request mix — the static-arg :func:`_sample` above compiles one
+    program per knob setting and stays the dense ``generate()`` tier's
+    spelling.
+
+    Rows with ``temperature == 0`` return ``jnp.argmax(logits)`` selected
+    through a ``jnp.where`` — BIT-IDENTICAL to the greedy path, so every
+    greedy parity oracle (kernel-vs-gather, int8, prefix-hit, resubmit)
+    extends unchanged. Boundary semantics match :func:`_sample` exactly:
+    top-p keeps the smallest prefix of the sorted distribution whose
+    cumulative mass reaches ``p`` (the crossing token stays IN; a token
+    whose preceding cumulative mass already equals ``p`` exactly is out),
+    and ``top_p=1.0`` keeps every positive-probability token.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # branchless per-row knobs: greedy rows run the sampling math on a
+    # safe temperature and are overridden by the final where
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / t
+    srt = jnp.sort(scaled, axis=-1)[..., ::-1]           # descending
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)   # 0 = disabled
+    kth = jnp.take_along_axis(srt, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p over the top-k-surviving tail (the same composition order as
+    # _sample): entries below the kth VALUE drop out of the sorted view
+    # first — a value threshold, not a positional cut, so ties at the
+    # k-th rank survive into the top-p stage exactly as in _sample
+    srt = jnp.where(srt >= kth, srt, -jnp.inf)
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p = jnp.clip(top_p, 0.0, 1.0)[:, None]
+    keep = cum - probs < p
+    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+    masked = jnp.where(masked < cutoff, -jnp.inf, masked)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temperature <= 0.0, greedy,
+                     sampled.astype(jnp.int32))
+
+
 # ---------------------------------------------------------------------------
 # generate: prefill + scan decode in ONE compiled program
 # ---------------------------------------------------------------------------
@@ -364,6 +457,7 @@ def generate(params: Dict, ids, cfg: LlamaConfig, *, max_new_tokens: int,
              prompt_lens=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+             seed: Optional[int] = None,
              key: Optional[jax.Array] = None):
     """Fixed-batch decode convenience wrapper: jit-cached by (cfg,
     sampling knobs, shapes).
@@ -377,7 +471,11 @@ def generate(params: Dict, ids, cfg: LlamaConfig, *, max_new_tokens: int,
     knobs add paged on-demand KV, automatic prefix caching, and chunked
     prefill while staying bit-identical to this path under greedy
     decoding — this function doubles as that parity oracle in the tests
-    and ``bench --serve``."""
+    and ``bench --serve``.
+
+    Sampling randomness resolves through ``seed`` (default: the
+    ``GenerationConfig.seed`` default, 0 — the previously-hardcoded
+    ``PRNGKey(0)``); an explicit ``key`` overrides it."""
     ids = jnp.asarray(ids)
     B, S = ids.shape
     if prompt_lens is None:
@@ -385,7 +483,8 @@ def generate(params: Dict, ids, cfg: LlamaConfig, *, max_new_tokens: int,
     else:
         prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(int(seed) if seed is not None
+                                 else GenerationConfig.seed)
     fn = _jitted_gen(cfg, max_new_tokens, temperature, top_k, top_p,
                      eos_token_id, pad_token_id)
     return fn(params, ids, prompt_lens, key)
@@ -775,3 +874,102 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
 
     x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
     return _lm_head(params, cfg, x), pool, drops.sum()
+
+
+def _lm_head_all(params: Dict, cfg: LlamaConfig, x):
+    """Final norm + LM head over EVERY position of ``x [B, T, E]`` ->
+    fp32 logits ``[B, T, V]`` — the speculative verify needs one
+    next-token distribution per drafted position, not just the last."""
+    x = _rms_norm(x, params["ln_f"], cfg.rms_norm_eps, cfg.use_fused_norm)
+    if cfg.tie_word_embeddings:
+        logits = x @ params["embed"].T.astype(cfg.dtype)
+    else:
+        logits = _mm(x, params, "lm_head", cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
+                    draft_lens, block_tables, pool: Dict, active,
+                    use_kernel: bool = False):
+    """Speculative VERIFY over ``M`` serving slots: one multi-query decode
+    iteration per slot against the block pool.
+
+    ``tokens [M, Q]`` — row ``m`` holds ``[t0, d1, .., d_k, pad..]``: the
+    slot's last sampled token followed by ``draft_lens[m] <= Q - 1``
+    drafted tokens (pad lanes repeat a real token — finite by
+    construction, and their K/V scatter is masked to the null block);
+    ``seq_lens [M]`` — KV entries already committed (= ``t0``'s write
+    position, exactly :func:`paged_decode_step`'s contract); ``active
+    [M]`` bool. The step writes K/V for positions ``seq_lens + q`` for
+    every valid query ``q <= draft_lens`` and returns logits for each:
+    ``logits[m, q]`` is the next-token distribution AFTER
+    ``tokens[m, :q+1]`` — verifying draft ``d_{q+1}`` against the token
+    sampled from ``logits[m, q]`` reproduces the sequential decode stream
+    exactly (query ``q`` attends ``j <= seq_lens[m] + q``: committed KV
+    plus the in-pass draft prefix, the same set the sequential step at
+    that position would see; on int8 pools the attention reads the
+    QUANTIZED round-trip of the in-pass writes, exactly like
+    :func:`paged_prefill_chunk`).
+
+    The engine rolls back on rejection HOST-SIDE: positions past the
+    accepted prefix hold stale draft KV that the next dispatch's write at
+    the new ``seq_len`` overwrites (position ``seq_len``) or the
+    ``j <= seq_len`` mask hides (beyond), and surplus BLOCKS return to
+    the ref-counted manager via the preemption free path. Garbage query
+    rows (``q > draft_lens[m]``) attend the CAPPED window ``j <=
+    seq_lens + draft_lens`` so the union of attendable positions never
+    reaches unwritten block tails — the poison-containment contract
+    (``_masked_sdpa``/kernel V-zeroing) extends unchanged.
+
+    ``use_kernel=True`` runs the Pallas flash-decoding kernel's
+    multi-query entry point (:func:`paddle_tpu.kernels.paged_attention`
+    with ``draft_lens``) — block tables consumed in-kernel, one K/V block
+    DMA per kv head scored against all ``Q`` query rows. Returns
+    (logits ``[M, Q, V]``, pool, dropped_tokens)."""
+    M, Q = tokens.shape
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    W = block_tables.shape[1]
+    C = W * bs
+    dt = cfg.dtype
+    qi = jnp.arange(Q)
+    pos = seq_lens[:, None] + qi[None, :]                # [M, Q] absolute
+    cos, sin = _row_tables(cfg, pos)
+    valid_q = (qi[None, :] <= draft_lens[:, None]) & active[:, None]
+    widx = jnp.minimum(pos // bs, W - 1)
+    phys = jnp.where(valid_q,
+                     jnp.take_along_axis(block_tables, widx, axis=1), 0)
+    off = pos % bs
+    jj = jnp.arange(C)[None, None, :]
+    # query q attends j <= seq_len + min(q, draft_len): its committed KV
+    # plus the in-pass draft prefix; garbage rows cap at draft_len so no
+    # row's mask ever reaches an unwritten position
+    qcap = jnp.minimum(qi[None, :], draft_lens[:, None])  # [M, Q]
+    kv_mask = jj <= (seq_lens[:, None] + qcap)[:, :, None]  # [M, Q, C]
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+
+    def body(h, xs):
+        lp, pz = xs
+        hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
+        q = _mm(hh, lp, "wq", dt).reshape(M, Q, H, D)
+        k = _mm(hh, lp, "wk", dt).reshape(M, Q, Hk, D)
+        v = _mm(hh, lp, "wv", dt).reshape(M, Q, Hk, D)
+        q = _rope(q, cos, sin, False)
+        k = _rope(k, cos, sin, False)
+        pz, _, _ = _kv_store(pz, phys, off, k, v)
+        if use_kernel:
+            from ..kernels.paged_attention import paged_attention
+            o = paged_attention(q, pz["k"], pz["v"], block_tables,
+                                seq_lens, draft_lens=draft_lens,
+                                k_scale=pz.get("k_scale"),
+                                v_scale=pz.get("v_scale"))
+        else:
+            kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
+            o = _masked_sdpa(q, kk, vv, kv_mask)
+        h = h + _mm(o.reshape(M, Q, H * D).astype(dt), lp, "wo", dt)
+        h, drops = _ffn_tail(lp, h, cfg)
+        return h, (pz, drops)
+
+    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    return _lm_head_all(params, cfg, x), pool, drops.sum()

@@ -1004,7 +1004,7 @@ class LlamaForCausalLM:
                          prompt_lens=None, temperature=None,
                          top_k="unset", top_p="unset",
                          eos_token_id="unset",
-                         pad_token_id=None, seed: int = 0,
+                         pad_token_id=None, seed=None,
                          generation_config=None):
                 """KV-cache autoregressive decoding (greedy when
                 ``temperature == 0``, else top-k/top-p sampling); prefill +
@@ -1024,7 +1024,8 @@ class LlamaForCausalLM:
                 g = GenerationConfig.resolve(
                     generation_config, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+                    eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+                    seed=seed)
                 ids = getattr(input_ids, "_value", input_ids)
                 out = _gen(self.params_pytree(), ids, self.config,
                            max_new_tokens=g.max_new_tokens,
@@ -1033,7 +1034,7 @@ class LlamaForCausalLM:
                            temperature=g.temperature, top_k=g.top_k,
                            top_p=g.top_p, eos_token_id=g.eos_token_id,
                            pad_token_id=g.pad_token_id,
-                           key=jax.random.PRNGKey(seed))
+                           key=jax.random.PRNGKey(g.seed))
                 return Tensor(out)
 
         _Llama.__name__ = "LlamaForCausalLM"

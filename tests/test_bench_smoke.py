@@ -112,6 +112,17 @@ def test_bench_serve_entry_point():
     assert detail["kv_eos_parity"] is not False
     assert detail["kv_token_agreement"] >= 0.6
     assert detail["kv_int8_pool_bytes"] <= detail["kv_budget_bytes"]
+    # spec-decode row (ISSUE 11): n-gram drafting + multi-query verify
+    # across the acceptance sweep — bit-parity on BOTH traces, real
+    # acceptance on the high trace, one verify executable, zero leaked
+    # blocks after rollback, and the low-acceptance fall-through bound
+    # are asserted in-section; the smoke pins the detail record
+    assert detail["spec_outputs_match"] is True
+    assert detail["spec_accepted"] > 0
+    assert detail["spec_traces"] == 1
+    assert detail["spec_leaked_blocks"] == 0
+    assert detail["spec_low_accept_ratio"] >= 0.9
+    assert "serving_spec_speedup" in metrics
     # overload row (ISSUE 6): 2x-capacity arrivals through FIFO vs EDF +
     # TTFT-SLO shedding — load was genuinely shed and every NON-shed
     # output stayed bit-identical to the dense oracle (timed-out partials

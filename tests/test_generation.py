@@ -231,3 +231,55 @@ class TestMoeDropDetection:
         toks, drops = gen(params, ids, jnp.array([16, 16]),
                           jax.random.PRNGKey(2))
         assert float(drops) > 0.0
+
+
+class TestSeedConfig:
+    """ISSUE 11 satellite: the dense generate() path's hardcoded
+    PRNGKey(0) default is now GenerationConfig.seed — dense and paged
+    sampling resolve their PRNG through the one config."""
+
+    def test_default_seed_matches_legacy_key_zero(self, setup):
+        cfg, params, ids = setup
+        a = G.generate(params, ids[:1], cfg, max_new_tokens=4,
+                       temperature=0.8)
+        b = G.generate(params, ids[:1], cfg, max_new_tokens=4,
+                       temperature=0.8, key=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_seed_param_equals_explicit_key(self, setup):
+        cfg, params, ids = setup
+        a = G.generate(params, ids[:1], cfg, max_new_tokens=4,
+                       temperature=0.8, seed=123)
+        b = G.generate(params, ids[:1], cfg, max_new_tokens=4,
+                       temperature=0.8, key=jax.random.PRNGKey(123))
+        c = G.generate(params, ids[:1], cfg, max_new_tokens=4,
+                       temperature=0.8, seed=7)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_config_resolve_seed_sentinels(self):
+        base = G.GenerationConfig(seed=5)
+        assert G.GenerationConfig().seed == 0
+        assert G.GenerationConfig.resolve(base).seed == 5
+        assert G.GenerationConfig.resolve(base, seed="unset").seed == 5
+        assert G.GenerationConfig.resolve(base, seed=None).seed == 5
+        assert G.GenerationConfig.resolve(base, seed=9).seed == 9
+
+    def test_seed_key_is_threefry_packing(self):
+        """seed_key matches jax.random.PRNGKey for every 32-bit seed
+        (the host-side packing contract); past 32 bits it keeps the high
+        word where default-config PRNGKey would truncate it, so distinct
+        large seeds stay distinct."""
+        for s in (0, 1, 42, (1 << 31) + 7, (1 << 32) - 1):
+            np.testing.assert_array_equal(
+                G.seed_key(s), np.asarray(jax.random.PRNGKey(s)))
+        assert G.seed_key((1 << 40) + 3)[0] == 256   # high word kept
+
+    def test_validate_sampling_contract(self):
+        G.validate_sampling(G.GenerationConfig())
+        G.validate_sampling(G.GenerationConfig(temperature=2.0, top_k=1,
+                                               top_p=1.0))
+        for bad in (dict(temperature=-1.0), dict(top_k=0),
+                    dict(top_p=0.0), dict(top_p=2.0)):
+            with pytest.raises(ValueError, match="supported"):
+                G.validate_sampling(G.GenerationConfig(**bad))

@@ -401,6 +401,92 @@ class TestPagedAttention:
         out = paged_attention(q, k2, v2, tbl, sl)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
 
+    @staticmethod
+    def _oracle_multi(q, pool, tbl, sl, dl):
+        """Gather + _masked_sdpa with the verify window: query offset i of
+        slot m attends j <= sl[m] + min(i, dl[m])."""
+        from paddle_tpu.models.generation import _kv_gather
+        from paddle_tpu.models.llama import _masked_sdpa
+        M, Q = q.shape[:2]
+        N, bs, Hk, D = pool["k"].shape
+        C = tbl.shape[1] * bs
+        kk, vv = _kv_gather(pool, tbl, M, C, Hk, D)
+        qi = jnp.arange(Q)
+        hi = sl[:, None] + jnp.minimum(qi[None, :], dl[:, None])  # [M, Q]
+        mask = jnp.arange(C)[None, None, :] <= hi[:, :, None]
+        return _masked_sdpa(q, kk, vv, mask)
+
+    @pytest.mark.parametrize("trial", range(3))
+    def test_multiquery_verify_fuzz(self, trial):
+        """The speculative-verify entry point (ISSUE 11): q [M, Q, H, D]
+        with per-slot draft lengths vs the gather oracle, across GQA
+        groups, block sizes, ragged boundary lengths, fp and int8 pools —
+        including dl=0 rows (which must behave exactly like the decode
+        entry point) and windows crossing block boundaries."""
+        from paddle_tpu.kernels.paged_attention import paged_attention
+        rng = np.random.default_rng(300 + trial)
+        bs = int(rng.choice([4, 8]))
+        Hk = int(rng.choice([1, 2]))
+        G = int(rng.choice([1, 2, 4]))
+        D = int(rng.choice([8, 16]))
+        M = int(rng.integers(1, 4))
+        Q = int(rng.choice([2, 4, 5]))
+        W = int(rng.integers(2, 5))
+        N = M * W + 3
+        quant = bool(trial % 2)
+        q = jnp.asarray(rng.standard_normal((M, Q, Hk * G, D)), jnp.float32)
+        kf = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        vf = jnp.asarray(rng.standard_normal((N, bs, Hk, D)), jnp.float32)
+        cap = W * bs - Q                       # room for the draft window
+        sl = jnp.asarray([int(rng.integers(0, cap + 1)) for _ in range(M)],
+                         jnp.int32)
+        dl = jnp.asarray([int(rng.integers(0, Q)) for _ in range(M)],
+                         jnp.int32)
+        used = rng.choice(np.arange(1, N), size=(M, W), replace=False)
+        tbl = np.zeros((M, W), np.int32)
+        for m in range(M):
+            nb = (int(sl[m]) + int(dl[m])) // bs + 1
+            tbl[m, :nb] = used[m, :nb]
+        tbl = jnp.asarray(tbl)
+        if quant:
+            kq, ks = self._quantize(kf)
+            vq, vs = self._quantize(vf)
+            pool = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+            out = paged_attention(q, kq, vq, tbl, sl, draft_lens=dl,
+                                  k_scale=ks, v_scale=vs)
+        else:
+            pool = {"k": kf, "v": vf}
+            out = paged_attention(q, kf, vf, tbl, sl, draft_lens=dl)
+        want = self._oracle_multi(q, pool, tbl, sl, dl)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5)
+        # dl=0 rows of the verify tile must match the decode entry point
+        # on the same pool (row 0 attends exactly j <= sl)
+        if quant:
+            single = paged_attention(q[:, 0], kq, vq, tbl, sl,
+                                     k_scale=ks, v_scale=vs)
+        else:
+            single = paged_attention(q[:, 0], kf, vf, tbl, sl)
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(single), rtol=3e-5,
+                                   atol=3e-5)
+
+    def test_multiquery_requires_draft_lens(self):
+        """Both halves of the entry-point contract: rank-4 q needs
+        draft_lens, and rank-3 q REJECTS one (a silently-discarded
+        draft operand would surface only as wrong attention)."""
+        from paddle_tpu.kernels.paged_attention import paged_attention
+        q = jnp.zeros((1, 2, 2, 8), jnp.float32)
+        k = jnp.zeros((3, 4, 1, 8), jnp.float32)
+        with pytest.raises(ValueError, match="draft_lens"):
+            paged_attention(q, k, k, jnp.zeros((1, 2), jnp.int32),
+                            jnp.zeros((1,), jnp.int32))
+        with pytest.raises(ValueError, match="single-token"):
+            paged_attention(q[:, 0], k, k, jnp.zeros((1, 2), jnp.int32),
+                            jnp.zeros((1,), jnp.int32),
+                            draft_lens=jnp.zeros((1,), jnp.int32))
+
     def test_use_pallas_knob_resolution(self):
         """The ONE kernel-dispatch gate (ISSUE 10 satellite): on/off/auto
         resolution shared by every kernel entry point."""

@@ -871,3 +871,35 @@ class TestReviewRegressions:
         assert after["counters"]["breaker_opens"] >= \
             before["counters"]["breaker_opens"]
         del f
+
+
+class TestSampledFailover:
+    """ISSUE 11: cross-replica failover must preserve SAMPLED streams —
+    the RouterRequest carries the resolved knobs and the per-token-index
+    keys make the adopted continuation bit-identical."""
+
+    def test_replica_kill_sampled_bit_exact(self, setup):
+        cfg, params, prompts, _ = setup
+        kw = dict(max_new_tokens=8, eos_token_id=None, temperature=0.7,
+                  top_p=0.9)
+        ref = mk_router(setup, replicas=2)
+        r_ref = [ref.submit(p, seed=i, **kw)
+                 for i, p in enumerate(prompts)]
+        while ref.pending:
+            ref.step()
+        want = [list(ref.result(f)) for f in r_ref]
+        ref.close()
+
+        r = mk_router(setup, replicas=2)
+        frids = [r.submit(p, seed=i, **kw) for i, p in enumerate(prompts)]
+        r.step(2)                                   # progress everywhere
+        chaos.replica_kill(r, rid=r.replicas[0])
+        while r.pending:
+            r.step()
+        got = [list(r.result(f)) for f in frids]
+        assert got == want
+        snap = r.health_snapshot()
+        assert snap["counters"]["failovers"] >= 1
+        assert snap["counters"]["failed"] == 0
+        assert_balanced(r)
+        r.close()

@@ -836,3 +836,49 @@ class TestSupervisorRecordRetention:
         assert len(sup._reqs) <= keep + len(sup._by_erid)
         assert 0 not in sup._reqs              # oldest evicted
         assert len(sup.result(last)) == 2      # newest readable
+
+
+class TestSampledStreamRecovery:
+    """ISSUE 11: crash-resubmit must preserve SAMPLED streams too — the
+    per-token-index PRNG keys make a recovered temperature>0 request
+    bit-identical to an uninterrupted run, extending the greedy recovery
+    oracle unchanged."""
+
+    def test_crash_mid_sampled_trace_bit_exact(self, setup):
+        cfg, params, prompts, _ = setup
+        kw = dict(max_new_tokens=8, eos_token_id=None, temperature=0.8,
+                  top_k=30, top_p=0.95)
+        ref = mk_sup(setup)
+        want = {}
+        r_ref = [ref.submit(p, seed=i, **kw)
+                 for i, p in enumerate(prompts)]
+        while ref.pending:
+            ref.step(2)
+        want = [list(ref.result(s)) for s in r_ref]
+
+        sup = mk_sup(setup)
+        srids = [sup.submit(p, seed=i, **kw)
+                 for i, p in enumerate(prompts)]
+        emitted = sup.step(2)
+        assert emitted and sup.pending
+        chaos.engine_crash(sup, at_step=1)
+        assert sup.step(2) == {}
+        assert sup.restarts == 1
+        while sup.pending:
+            sup.step(2)
+        got = [list(sup.result(s)) for s in srids]
+        assert got == want
+        assert balanced(sup.engine)
+
+    def test_tracked_record_mirrors_resolved_sampling(self, setup):
+        """TrackedRequest carries the RESOLVED knobs, so a resubmission
+        can never fall back to engine defaults."""
+        cfg, params, prompts, _ = setup
+        sup = mk_sup(setup)
+        srid = sup.submit(prompts[0], max_new_tokens=4, eos_token_id=None,
+                          temperature=0.6, top_k=12, top_p=0.9, seed=77)
+        rec = sup.request(srid)
+        assert (rec.temperature, rec.top_k, rec.top_p, rec.seed) == \
+            (0.6, 12, 0.9, 77)
+        while sup.pending:
+            sup.step()

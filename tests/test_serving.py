@@ -1784,3 +1784,560 @@ class TestKVQuantInt8:
         for k in ("kv_pool_bytes", "kv_quant", "paged_kernel"):
             assert k in HEALTH_SNAPSHOT_FIELDS
             assert snap[k] == st[k]
+
+
+class TestOnDeviceSampling:
+    """ISSUE 11 tentpole (a): per-request temperature/top-k/top-p as
+    DEVICE operands of the one compiled decode program, per-request PRNG
+    keys threaded through the slot table. The contracts: temperature=0
+    stays bit-identical to the greedy argmax path on every pool/kernel
+    combination, sampled streams are reproducible per (request, seed)
+    across engine churn, and nothing recompiles per request."""
+
+    def _sample_engine(self, params, cfg, **kw):
+        return make_engine(params, cfg, **kw)
+
+    @pytest.mark.parametrize("kv_quant,kernel", [
+        (None, False), (None, True), ("int8", False), ("int8", True)])
+    def test_temperature_zero_bitwise_greedy(self, setup, kv_quant, kernel):
+        """An EXPLICIT temperature=0 submit through the sampling surface
+        must reproduce the v1 greedy engine bit for bit — fp32 and int8
+        pools, kernel and gather paths (the acceptance oracle)."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg, kv_quant=kv_quant,
+                          paged_kernel=kernel)
+        ref = make_engine(params, cfg, kv_quant=kv_quant,
+                          paged_kernel=kernel)
+        rids = [eng.submit(p, max_new_tokens=n, eos_token_id=None,
+                           temperature=0.0, seed=i)
+                for i, (p, n) in enumerate(zip(prompts, outs))]
+        while eng.pending:
+            eng.step()
+        want = ref.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        for r, w in zip(rids, want):
+            np.testing.assert_array_equal(
+                np.asarray(eng.request(r).output()), np.asarray(w))
+        assert eng.stats()["decode_traces"] == 1
+
+    def test_same_seed_reproduces_diff_seed_forks(self, setup):
+        cfg, params, prompts, _ = setup
+        outs = {}
+        for trial in range(2):
+            eng = make_engine(params, cfg)
+            rids = [eng.submit(p, max_new_tokens=8, eos_token_id=None,
+                               temperature=0.9, top_k=20, top_p=0.95,
+                               seed=i) for i, p in enumerate(prompts[:4])]
+            while eng.pending:
+                eng.step()
+            outs[trial] = [eng.request(r).tokens for r in rids]
+        assert outs[0] == outs[1]
+        eng = make_engine(params, cfg)
+        rids = [eng.submit(p, max_new_tokens=8, eos_token_id=None,
+                           temperature=0.9, top_k=20, top_p=0.95,
+                           seed=100 + i) for i, p in enumerate(prompts[:4])]
+        while eng.pending:
+            eng.step()
+        assert [eng.request(r).tokens for r in rids] != outs[0]
+
+    def test_mixed_wave_greedy_rows_unperturbed(self, setup):
+        """Greedy and sampling requests co-scheduled in one wave/dispatch:
+        the greedy rows' streams must equal the dense oracle exactly (the
+        sampling rows ride the same executable)."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg)
+        rg = eng.submit(prompts[0], max_new_tokens=8, eos_token_id=None)
+        eng.submit(prompts[1], max_new_tokens=8, eos_token_id=None,
+                   temperature=1.3, seed=3)
+        rg2 = eng.submit(prompts[2], max_new_tokens=8, eos_token_id=None,
+                         temperature=0.0)
+        while eng.pending:
+            eng.step()
+        want = dense_rows(params, cfg, [prompts[0], prompts[2]], [8, 8])
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(rg).output()), want[0])
+        np.testing.assert_array_equal(
+            np.asarray(eng.request(rg2).output()), want[1])
+        assert eng.stats()["decode_traces"] == 1
+
+    def test_reproducible_across_preemption_recompute(self, setup):
+        """Same (request, seed) under a pressured pool (preemption +
+        recompute) must emit the same sampled tokens as a calm engine —
+        the per-token-index fold_in key contract."""
+        cfg, params, prompts, _ = setup
+        calm = make_engine(params, cfg, prefix_cache=None)
+        tight = make_engine(params, cfg, num_blocks=9, prefix_cache=None)
+        kw = dict(max_new_tokens=8, eos_token_id=None, temperature=0.8,
+                  top_p=0.9)
+        r_calm = [calm.submit(p, seed=i, **kw)
+                  for i, p in enumerate(prompts[:5])]
+        while calm.pending:
+            calm.step()
+        r_tight = [tight.submit(p, seed=i, **kw)
+                   for i, p in enumerate(prompts[:5])]
+        while tight.pending:
+            tight.step()
+        for a, b in zip(r_calm, r_tight):
+            assert calm.request(a).tokens == tight.request(b).tokens
+        assert tight.stats()["preemptions"] >= 1
+        assert tight.cache.manager.blocks_in_use == 0
+
+    def test_knobs_resolve_through_gen_config(self, setup):
+        """Engine-level GenerationConfig supplies the sampling defaults;
+        per-request knobs override; explicit None disables top_k/top_p
+        (the one resolve() convention)."""
+        from paddle_tpu.models.generation import GenerationConfig
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.inference.serving import (ServingConfig,
+                                                  ServingEngine)
+        gen = GenerationConfig(temperature=0.7, top_k=10, seed=5)
+        eng = ServingEngine(params, cfg, ServingConfig(
+            block_size=4, max_slots=3, max_model_len=32, decode_chunk=2,
+            queue_depth=8), gen_config=gen)
+        rid = eng.submit(prompts[0], max_new_tokens=4, eos_token_id=None)
+        req = eng._sched.find(rid)
+        assert (req.temperature, req.top_k, req.seed) == (0.7, 10, 5)
+        rid2 = eng.submit(prompts[0], max_new_tokens=4, eos_token_id=None,
+                          temperature=0.0, top_k=None, seed=9)
+        req2 = eng._sched.find(rid2)
+        assert (req2.temperature, req2.top_k, req2.seed) == (0.0, None, 9)
+        while eng.pending:
+            eng.step()
+
+    def test_submit_rejects_unsupported_structured(self, setup):
+        """Only genuinely unsupported combinations are rejected, with a
+        structured error naming the supported knobs (the satellite
+        replacing the blanket temperature reject)."""
+        cfg, params, prompts, _ = setup
+        eng = make_engine(params, cfg)
+        for bad in (dict(temperature=-0.5), dict(temperature=float("nan")),
+                    dict(top_k=0), dict(top_k=-3), dict(top_p=0.0),
+                    dict(top_p=1.5)):
+            with pytest.raises(ValueError, match="supported sampling|"
+                                                 "supported knobs"):
+                eng.submit(prompts[0], max_new_tokens=2, **bad)
+        # boundary values that ARE supported queue fine
+        for ok in (dict(temperature=0.0), dict(temperature=2.5, top_k=1),
+                   dict(top_p=1.0), dict(top_k=10 ** 6)):
+            eng.submit(prompts[0], max_new_tokens=2, eos_token_id=None,
+                       **ok)
+        while eng.pending:
+            eng.step()
+
+    def test_sampling_engine_default_config_still_sane(self, setup):
+        """An engine built with a sampling GenerationConfig no longer
+        raises (the v1 greedy-only reject is gone) and serves."""
+        from paddle_tpu.models.generation import GenerationConfig
+        cfg, params, prompts, _ = setup
+        from paddle_tpu.inference.serving import (ServingConfig,
+                                                  ServingEngine)
+        eng = ServingEngine(params, cfg, ServingConfig(
+            block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+            queue_depth=8), gen_config=GenerationConfig(temperature=0.5))
+        out = eng.run(prompts[:2], max_new_tokens=4, eos_token_id=None)
+        assert all(len(o) == 4 for o in out)
+        with pytest.raises(ValueError, match="supported"):
+            ServingEngine(params, cfg, ServingConfig(
+                block_size=4, max_slots=2, max_model_len=32,
+                decode_chunk=2, queue_depth=8),
+                gen_config=GenerationConfig(temperature=-1.0))
+
+    def test_sampling_compiles_once_across_churn(self, setup):
+        """A full mixed greedy/sampled trace — different knob values per
+        request — still compiles ONE decode program, and a second trace
+        adds zero traces (the device-operand contract)."""
+        cfg, params, prompts, outs = setup
+
+        def trace(eng):
+            rids = []
+            for i, (p, n) in enumerate(zip(prompts, outs)):
+                kw = {}
+                if i % 2:
+                    kw = dict(temperature=0.5 + 0.1 * i, top_k=5 + i,
+                              top_p=0.8 + 0.02 * i, seed=i)
+                rids.append(eng.submit(p, max_new_tokens=n,
+                                       eos_token_id=None, **kw))
+            while eng.pending:
+                eng.step()
+            return rids
+
+        # prefix_cache off: reruns replay the identical admission path,
+        # so every trace counter must freeze after the first pass (with
+        # the cache on, a rerun's first prefix HIT legitimately traces
+        # the chunk program once — that is the hit path's executable,
+        # not a sampling recompile)
+        eng = make_engine(params, cfg, prefix_cache=None)
+        trace(eng)
+        st = eng.stats()
+        assert st["decode_traces"] == 1
+        t0 = (st["decode_traces"], st["prefill_traces"],
+              st["chunk_prefill_traces"], st["sample_traces"])
+        trace(eng)
+        st = eng.stats()
+        assert (st["decode_traces"], st["prefill_traces"],
+                st["chunk_prefill_traces"], st["sample_traces"]) == t0
+
+    def test_lifecycle_fuzz_with_sampling_rows(self, setup):
+        """The ISSUE 6 randomized cancel/timeout fuzz extended with
+        temperature>0 rows (the ISSUE 11 satellite): the block partition
+        must hold every step with sampled and greedy requests churning
+        through cancel/timeout/preemption together, and afterwards the
+        engine still reproduces a seeded sampled stream exactly."""
+        cfg, params, prompts, _ = setup
+        rng = np.random.default_rng(11)
+        eng = make_engine(params, cfg, max_slots=3, num_blocks=12,
+                          prefill_chunk=4, queue_depth=16)
+        bm = eng.cache.manager
+        usable = bm.num_blocks - 1
+        live_rids = []
+        for i in range(60):
+            op = rng.integers(0, 4)
+            if op == 0 and len(eng._sched.queue) < 15:
+                p = prompts[int(rng.integers(0, len(prompts)))]
+                kw = {}
+                if rng.integers(0, 3) == 0:
+                    kw["timeout_s"] = float(rng.uniform(0.0, 0.02))
+                if rng.integers(0, 2) == 0:     # sampled row
+                    kw.update(temperature=float(rng.uniform(0.2, 1.5)),
+                              top_k=int(rng.integers(2, 40)),
+                              top_p=float(rng.uniform(0.5, 1.0)),
+                              seed=int(rng.integers(0, 1000)))
+                try:
+                    live_rids.append(eng.submit(
+                        p, max_new_tokens=int(rng.integers(1, 10)),
+                        eos_token_id=None,
+                        tenant=f"t{int(rng.integers(0, 3))}", **kw))
+                except Exception:
+                    pass
+            elif op == 1 and live_rids:
+                eng.cancel(int(rng.choice(live_rids)))
+            elif eng.pending:
+                eng.step()
+            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
+            assert total == usable, f"leak at iter {i}: {total}"
+        while eng.pending:
+            eng.step()
+        assert bm.blocks_in_use == 0
+        # a seeded sampled stream still reproduces after the storm
+        ref = make_engine(params, cfg)
+        kw = dict(max_new_tokens=6, eos_token_id=None, temperature=0.7,
+                  seed=42)
+        ra = eng.submit(prompts[0], **kw)
+        while eng.pending:
+            eng.step()
+        rb = ref.submit(prompts[0], **kw)
+        while ref.pending:
+            ref.step()
+        assert eng.request(ra).tokens == ref.request(rb).tokens
+
+
+class TestTopPBoundaries:
+    """ISSUE 11 satellite: the top-p boundary semantics, pinned on BOTH
+    samplers — the static-arg dense ``_sample`` and the device-operand
+    serving ``sample_tokens`` (same formula, one contract)."""
+
+    @staticmethod
+    def _dense(logits, key, temperature, top_k, top_p):
+        from paddle_tpu.models.generation import _sample
+        return np.asarray(_sample(jnp.asarray(logits), key, temperature,
+                                  top_k, top_p))
+
+    @staticmethod
+    def _device(logits, key, temperature, top_k, top_p):
+        from paddle_tpu.models.generation import sample_tokens
+        B = logits.shape[0]
+        return np.asarray(sample_tokens(
+            jnp.asarray(logits), jnp.broadcast_to(key, (B, 2)),
+            jnp.full((B,), temperature, jnp.float32),
+            jnp.full((B,), top_k if top_k is not None else 0, jnp.int32),
+            jnp.full((B,), top_p if top_p is not None else 1.0,
+                     jnp.float32)))
+
+    _probs = np.array([0.5, 0.25, 0.125, 0.125], np.float64)
+
+    def _tie_logits(self):
+        # exact powers of two -> exactly representable probabilities and
+        # exact cumulative sums: cum = [0.5, 0.75, 0.875, 1.0]
+        return np.log(self._probs)[None, :].astype(np.float32)
+
+    @pytest.mark.parametrize("sampler", ["dense", "device"])
+    def test_exact_cumulative_tie_excludes_next_token(self, sampler):
+        """top_p=0.75 on probs [.5, .25, .125, .125]: the prefix {0, 1}
+        reaches the mass EXACTLY, so token 2 (whose preceding cumulative
+        mass equals p) is out — the crossing token stays in, a token at
+        an exact tie does not start a new prefix."""
+        fn = getattr(self, "_" + sampler)
+        lg = np.repeat(self._tie_logits(), 64, axis=0)
+        seen = set()
+        for s in range(16):
+            out = fn(lg, jax.random.PRNGKey(s), 1.0, None, 0.75)
+            seen.update(out.tolist())
+        assert seen <= {0, 1}, seen
+        assert seen == {0, 1}    # both survivors actually sampled
+
+    @pytest.mark.parametrize("sampler", ["dense", "device"])
+    def test_crossing_token_stays_in(self, sampler):
+        """top_p=0.6: token 0 (mass .5) does not reach p, token 1 crosses
+        it and STAYS; token 2 is out."""
+        fn = getattr(self, "_" + sampler)
+        lg = np.repeat(self._tie_logits(), 64, axis=0)
+        seen = set()
+        for s in range(16):
+            seen.update(fn(lg, jax.random.PRNGKey(s), 1.0, None,
+                           0.6).tolist())
+        assert seen == {0, 1}, seen
+
+    @pytest.mark.parametrize("sampler", ["dense", "device"])
+    def test_top_p_one_keeps_full_distribution(self, sampler):
+        """top_p=1.0 must behave exactly like top_p disabled — same
+        samples bitwise for the same keys (the full distribution
+        survives the mask)."""
+        fn = getattr(self, "_" + sampler)
+        rng = np.random.default_rng(0)
+        lg = rng.normal(size=(32, 23)).astype(np.float32)
+        for s in range(8):
+            a = fn(lg, jax.random.PRNGKey(s), 1.0, None, 1.0)
+            b = fn(lg, jax.random.PRNGKey(s), 1.0, None, None)
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("sampler", ["dense", "device"])
+    def test_top_k_value_threshold_keeps_ties(self, sampler):
+        """Logits tied at the k-th rank: both samplers apply top-k as a
+        VALUE threshold, so every tied entry survives into the top-p
+        stage — the device sampler may not silently positional-cut where
+        the dense one keeps ties."""
+        fn = getattr(self, "_" + sampler)
+        lg = np.log(np.array([0.5, 0.2, 0.2, 0.1],
+                             np.float64))[None, :].astype(np.float32)
+        lg = np.repeat(lg, 64, axis=0)
+        seen = set()
+        for s in range(24):
+            seen.update(fn(lg, jax.random.PRNGKey(s), 1.0, 2,
+                           None).tolist())
+        assert seen == {0, 1, 2}, seen    # the rank-2 tie stays in
+
+    @pytest.mark.parametrize("sampler", ["dense", "device"])
+    @pytest.mark.parametrize("temperature", [0.1, 1.0, 5.0])
+    def test_top_k_one_is_greedy_bitwise(self, sampler, temperature):
+        fn = getattr(self, "_" + sampler)
+        rng = np.random.default_rng(1)
+        lg = rng.normal(size=(32, 23)).astype(np.float32)
+        want = np.argmax(lg, axis=-1)
+        for s in range(4):
+            out = fn(lg, jax.random.PRNGKey(s), temperature, 1, None)
+            np.testing.assert_array_equal(out, want)
+
+    def test_device_temperature_zero_is_argmax_bitwise(self):
+        rng = np.random.default_rng(2)
+        lg = rng.normal(size=(16, 50)).astype(np.float32)
+        out = self._device(lg, jax.random.PRNGKey(0), 0.0, 7, 0.3)
+        np.testing.assert_array_equal(out, np.argmax(lg, axis=-1))
+
+
+class TestSpeculativeDecoding:
+    """ISSUE 11 tentpole (b): n-gram prompt-lookup drafting + paged-
+    cache-aware verify-and-rollback. The master oracle: speculative
+    output is BIT-IDENTICAL to non-speculative output at every
+    temperature (per-token-index keys make acceptance exact), the verify
+    runs one multi-query program compiled once, and rollback leaks zero
+    blocks."""
+
+    def _cycled_prompts(self, params, cfg, rng, n=3, pre=32):
+        """Self-continuation prompts: seed each prompt with the model's
+        own greedy stream so the n-gram drafter has cycles to hit (the
+        high-acceptance regime); greedy consistency makes the suffix of
+        the long stream the exact continuation oracle."""
+        base = [rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+                for _ in range(n)]
+        longs = [np.asarray(G.generate(params, jnp.asarray(b[None]), cfg,
+                                       max_new_tokens=pre + 16))[0]
+                 for b in base]
+        return [np.concatenate([b, l[:pre]]) for b, l in zip(base, longs)]
+
+    def _spec_engine(self, params, cfg, **kw):
+        base = dict(block_size=4, max_slots=3, max_model_len=96,
+                    decode_chunk=4, queue_depth=16, spec_decode=4,
+                    spec_ngram=2)
+        base.update(kw)
+        return make_engine(params, cfg, **base)
+
+    def test_greedy_spec_bitwise_plain_greedy(self, setup):
+        """THE acceptance-agnostic correctness oracle: greedy spec-decode
+        output equals plain greedy decode bit for bit, with real
+        acceptance (> 0) and zero blocks left after rollback."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(0)
+        prompts = self._cycled_prompts(params, cfg, rng)
+        es = self._spec_engine(params, cfg)
+        en = self._spec_engine(params, cfg, spec_decode=None)
+        gs = es.run(prompts, max_new_tokens=12, eos_token_id=None)
+        gn = en.run(prompts, max_new_tokens=12, eos_token_id=None)
+        for a, b in zip(gs, gn):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        st = es.stats()
+        assert st["spec_accepted"] > 0
+        assert st["spec_traces"] == 1 and st["decode_traces"] <= 1
+        assert es.cache.manager.blocks_in_use == 0
+        assert st["spec_decode"] == 4 and en.stats()["spec_decode"] == 0
+
+    def test_sampled_spec_bitwise_nonspec(self, setup):
+        """Sampling through the verify: same (request, seed) rows emit
+        the same tokens with and without speculation — acceptance is
+        exact because index t is always drawn with fold_in(base, t)."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(1)
+        prompts = self._cycled_prompts(params, cfg, rng)
+        kw = dict(max_new_tokens=10, eos_token_id=None, temperature=0.6,
+                  top_p=0.95)
+        es = self._spec_engine(params, cfg)
+        en = self._spec_engine(params, cfg, spec_decode=None)
+        rs = [es.submit(p, seed=i, **kw) for i, p in enumerate(prompts)]
+        while es.pending:
+            es.step()
+        rn = [en.submit(p, seed=i, **kw) for i, p in enumerate(prompts)]
+        while en.pending:
+            en.step()
+        for a, b in zip(rs, rn):
+            assert es.request(a).tokens == en.request(b).tokens
+        assert es.cache.manager.blocks_in_use == 0
+
+    def test_spec_eos_truncates_like_nonspec(self, setup):
+        """EOS landing mid-verify-window must retire the request at the
+        same token and length as non-speculative decode."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(2)
+        prompts = self._cycled_prompts(params, cfg, rng, n=2)
+        # pick an eos that fires mid-stream from the plain continuation
+        plain = self._spec_engine(params, cfg, spec_decode=None)
+        ref = plain.run(prompts, max_new_tokens=12, eos_token_id=None)
+        eos = int(np.asarray(ref[0])[5])
+        es = self._spec_engine(params, cfg)
+        en = self._spec_engine(params, cfg, spec_decode=None)
+        a = es.run(prompts, max_new_tokens=12, eos_token_id=eos)
+        b = en.run(prompts, max_new_tokens=12, eos_token_id=eos)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert es.cache.manager.blocks_in_use == 0
+
+    @pytest.mark.parametrize("kv_quant,kernel", [
+        (None, True), ("int8", False), ("int8", True)])
+    def test_spec_matrix_kernel_int8(self, setup, kv_quant, kernel):
+        """The verify's second kernel entry point and the int8 pool
+        compose: spec == non-spec bitwise per configuration."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(3)
+        prompts = self._cycled_prompts(params, cfg, rng, n=2)
+        es = self._spec_engine(params, cfg, kv_quant=kv_quant,
+                               paged_kernel=kernel)
+        en = self._spec_engine(params, cfg, spec_decode=None,
+                               kv_quant=kv_quant, paged_kernel=kernel)
+        a = es.run(prompts, max_new_tokens=10, eos_token_id=None)
+        b = en.run(prompts, max_new_tokens=10, eos_token_id=None)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert es.stats()["spec_accepted"] > 0
+        assert es.cache.manager.blocks_in_use == 0
+
+    def test_spec_under_preemption_pressure(self, setup):
+        """Spec + an undersized pool: drafts degrade, preemption fires,
+        rollback and recompute interleave — outputs stay bit-identical to
+        the calm non-spec engine and the pool partition survives."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(4)
+        prompts = self._cycled_prompts(params, cfg, rng)
+        calm = self._spec_engine(params, cfg, spec_decode=None,
+                                 prefix_cache=None)
+        tight = self._spec_engine(params, cfg, num_blocks=28,
+                                  prefix_cache=None)
+        want = calm.run(prompts, max_new_tokens=12, eos_token_id=None)
+        got = tight.run(prompts, max_new_tokens=12, eos_token_id=None)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        bm = tight.cache.manager
+        assert bm.blocks_in_use == 0
+        assert len(bm._free) + len(bm._evictable) == bm.num_blocks - 1
+
+    def test_rollback_frees_rejected_tail_blocks(self, setup):
+        """Step-by-step: after every engine step the free + evictable +
+        in-use partition holds exactly — a verify that allocates blocks
+        for its draft window and rejects the tail must hand the surplus
+        back through the ref-counted free path."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(5)
+        prompts = self._cycled_prompts(params, cfg, rng)
+        eng = self._spec_engine(params, cfg, spec_decode=6)
+        bm = eng.cache.manager
+        usable = bm.num_blocks - 1
+        rids = [eng.submit(p, max_new_tokens=12, eos_token_id=None)
+                for p in prompts]
+        steps = 0
+        while eng.pending:
+            eng.step()
+            steps += 1
+            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
+            assert total == usable, f"leak after step {steps}"
+        assert bm.blocks_in_use == 0
+        assert eng.stats()["spec_steps"] >= 1
+        for r in rids:
+            assert len(eng.request(r).tokens) == 12
+
+    def test_incoherent_prompts_fall_through_to_decode(self, setup):
+        """No n-gram match -> no draft -> the step runs the plain decode
+        loop (bounded drafting overhead): random prompts with a long
+        ngram requirement never spec-step, and outputs match the dense
+        oracle exactly."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg, spec_decode=4, spec_ngram=6)
+        got = eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
+        want = dense_rows(params, cfg, prompts, outs)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        st = eng.stats()
+        assert st["spec_steps"] == 0 and st["spec_drafted"] == 0
+        assert st["decode_traces"] == 1
+
+    def test_spec_compiles_once_and_rerun_adds_nothing(self, setup):
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(0)    # seed with a measured cycle
+        prompts = self._cycled_prompts(params, cfg, rng)
+        eng = self._spec_engine(params, cfg)
+        eng.run(prompts, max_new_tokens=10, eos_token_id=None)
+        st = eng.stats()
+        assert st["spec_traces"] == 1
+        # second run prefix-HITS, which may trace the chunk program once
+        # (the hit path's executable); from then on every counter freezes
+        eng.run(prompts, max_new_tokens=10, eos_token_id=None)
+        st = eng.stats()
+        assert st["spec_traces"] == 1
+        t0 = (st["spec_traces"], st["decode_traces"], st["prefill_traces"],
+              st["chunk_prefill_traces"])
+        eng.run(prompts, max_new_tokens=10, eos_token_id=None)
+        st = eng.stats()
+        assert (st["spec_traces"], st["decode_traces"],
+                st["prefill_traces"], st["chunk_prefill_traces"]) == t0
+
+    def test_per_request_spec_counters(self, setup):
+        """Request records carry spec_drafted/spec_accepted; stream()
+        finish events and stats() aggregate them."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(0)    # seed with a measured cycle
+        prompts = self._cycled_prompts(params, cfg, rng)
+        eng = self._spec_engine(params, cfg)
+        rids = [eng.submit(p, max_new_tokens=12, eos_token_id=None)
+                for p in prompts]
+        while eng.pending:
+            eng.step()
+        tot_d = sum(eng.request(r).spec_drafted for r in rids)
+        tot_a = sum(eng.request(r).spec_accepted for r in rids)
+        st = eng.stats()
+        assert (st["spec_drafted"], st["spec_accepted"]) == (tot_d, tot_a)
+        assert tot_a > 0
+
+    def test_spec_config_validation(self):
+        from paddle_tpu.inference.serving import ServingConfig
+        with pytest.raises(ValueError, match="spec_decode"):
+            ServingConfig(spec_decode=-1)
+        with pytest.raises(ValueError, match="spec_ngram"):
+            ServingConfig(spec_ngram=0)
+        assert ServingConfig().spec_decode == 0          # flag default off
+        assert ServingConfig(spec_decode=None).spec_decode == 0
+        assert ServingConfig(spec_decode=4).spec_decode == 4
