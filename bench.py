@@ -1232,6 +1232,88 @@ def bench_serve(backend):
     else:
         cap_eos_parity = None
 
+    # ---- tensor-parallel row: pool sharded across the tp mesh (ISSUE 12)
+    # per-chip concurrent capacity at a FIXED PER-DEVICE byte budget: a
+    # TP=2 replica's devices each hold half of every token's KV (the pool
+    # shards its kv-heads axis; block tables stay global), so the same
+    # per-device budget backs 2x the blocks -> 2x the concurrent
+    # sequences per chip at unchanged block-table logic. The row sizes a
+    # TP=1 and a TP=2 pool to ONE per-device budget, serves the same
+    # trace through both (greedy + a seeded-sampling wave), and asserts
+    # bit-parity across mesh shapes, one decode executable per engine,
+    # zero leaked blocks, and that the sharded pool actually fits the
+    # per-device budget. The static >= 2x ratio is the
+    # serving_tp_capacity_ratio anchor — the first row feeding the
+    # MULTICHIP trajectory from the serving stack.
+    tp_supported = len(jax.devices()) >= 2
+    if tp_supported:
+        if backend == "tpu":
+            tp_n, tp_plen, tp_out, tp_slots, tp_blocks1 = 16, 32, 16, 16, 17
+        else:
+            tp_n, tp_plen, tp_out, tp_slots, tp_blocks1 = 8, 16, 8, 8, 10
+        tp_budget = tp_blocks1 * paged_pool_block_bytes(cfg, blk)
+        tp2_blocks = tp_budget // paged_pool_block_bytes(cfg, blk, tp=2)
+        tp_seq_blocks = -(-(tp_plen + tp_out) // blk)          # ceil
+        tp_cap1 = (tp_blocks1 - 1) // tp_seq_blocks
+        tp_cap2 = min((tp2_blocks - 1) // tp_seq_blocks, tp_slots)
+        tp_prompts = [rng.integers(0, cfg.vocab_size,
+                                   (tp_plen,)).astype(np.int32)
+                      for _ in range(tp_n)]
+
+        def run_tp(tp, num_blocks):
+            eng = ServingEngine(params, cfg, ServingConfig(
+                block_size=blk, max_slots=tp_slots, max_model_len=mlen,
+                decode_chunk=chunk, queue_depth=tp_n, prefix_cache=None,
+                num_blocks=num_blocks, tp=tp))
+            eng.run(tp_prompts[:2], max_new_tokens=2,
+                    eos_token_id=None)                  # warm/compile
+            t0 = time.time()
+            rids = [eng.submit(p, max_new_tokens=tp_out,
+                               eos_token_id=None) for p in tp_prompts]
+            peak = 0
+            while eng.pending:
+                # single-iteration dispatches: live concurrency SAMPLED
+                # mid-trace, same methodology as the int8 capacity row
+                eng.step(max_iters=1)
+                peak = max(peak, eng.stats()["live_slots"])
+            outs = [eng.request(r).output() for r in rids]
+            elapsed = time.time() - t0
+            # seeded-sampling wave: identical seeds on both mesh shapes
+            srids = [eng.submit(p, max_new_tokens=tp_out,
+                                eos_token_id=None, temperature=0.9,
+                                top_k=20, top_p=0.95, seed=i + 1)
+                     for i, p in enumerate(tp_prompts[:4])]
+            while eng.pending:
+                eng.step()
+            souts = [eng.request(r).output() for r in srids]
+            return eng, outs, souts, peak, elapsed
+
+        eng_t1, tp_o1, tp_s1, tp_live1, _ = run_tp(1, tp_blocks1)
+        eng_t2, tp_o2, tp_s2, tp_live2, tp_t2 = run_tp(2, int(tp2_blocks))
+        tp_match = all(np.array_equal(np.asarray(a), np.asarray(b))
+                       for a, b in zip(tp_o1 + tp_s1, tp_o2 + tp_s2))
+        tp_leaked = eng_t1.cache.manager.blocks_in_use + \
+            eng_t2.cache.manager.blocks_in_use
+        tp_tok_s = tp_n * tp_out / tp_t2
+        tp_ratio = tp_cap2 / max(tp_cap1, 1)
+        assert tp_match, \
+            "TP=2 outputs diverged from the TP=1 engine"
+        assert eng_t1.stats()["decode_traces"] == 1 and \
+            eng_t2.stats()["decode_traces"] == 1, "TP row recompiled decode"
+        assert tp_leaked == 0, f"TP row leaked {tp_leaked} blocks"
+        assert eng_t2.cache.kv_bytes(per_shard=True) <= tp_budget, \
+            "TP=2 per-device pool bytes exceed the per-device budget"
+        assert tp_ratio >= 2.0, \
+            f"TP=2 pool backs only {tp_ratio}x concurrent sequences " \
+            f"(static block arithmetic)"
+        # the MEASURED half (same methodology as the int8 capacity row):
+        # the 2x must show up as actually-admitted live concurrency, not
+        # just block arithmetic — an admission bug keyed on the wrong
+        # budget would leave the peak flat while the ratio stays 2.0
+        assert tp_live2 >= 2 * tp_live1, \
+            f"TP=2 peaked at {tp_live2} live vs TP=1's {tp_live1} — " \
+            f"the capacity win did not materialize as admissions"
+
     # ---- spec-decode row: n-gram drafting + paged verify (ISSUE 11) -----
     # tok/s across an acceptance-rate sweep: a HIGH-acceptance trace
     # (self-continuation prompts — each prompt is seeded with the model's
@@ -1526,6 +1608,27 @@ def bench_serve(backend):
         "kv_token_agreement": round(cap_agree, 4),
         "kv_eos_parity": bool(cap_eos_parity),
         "kv_int8_pool_bytes": eng_c8.cache.kv_bytes(),
+        # tensor-parallel row (ISSUE 12): the paged pool sharded on its
+        # kv-heads axis over the tp mesh — per-chip concurrent capacity
+        # at one fixed per-device byte budget, bit-parity across mesh
+        # shapes asserted in-section (absent only on single-device
+        # platforms, where no mesh can be built)
+        "tp_supported": bool(tp_supported),
+        **({"tp_degree": 2,
+            "tp_per_device_budget_bytes": int(tp_budget),
+            "tp1_blocks": int(tp_blocks1 - 1),
+            "tp2_blocks": int(tp2_blocks - 1),
+            "tp1_concurrent": int(tp_cap1),
+            "tp2_concurrent": int(tp_cap2),
+            "tp_capacity_ratio": round(tp_ratio, 2),
+            "tp1_peak_live": int(tp_live1),
+            "tp2_peak_live": int(tp_live2),
+            "tp_outputs_match": bool(tp_match),
+            "tp_leaked_blocks": int(tp_leaked),
+            "tp_tok_s": round(tp_tok_s, 1),
+            "tp2_shard_bytes": int(eng_t2.cache.kv_bytes(per_shard=True)),
+            "tp_decode_traces": eng_t2.stats()["decode_traces"],
+            } if tp_supported else {}),
         # spec-decode row (ISSUE 11): n-gram drafting + multi-query verify
         # vs the same engine without speculation — output bit-parity on
         # BOTH traces, acceptance > 0, one verify executable and zero
@@ -1662,6 +1765,11 @@ _R2_ANCHORS = {
     # acceptance bound (>= 2x; arithmetic gives ~3.5x for fp32 pools and
     # the in-section assert enforces the 2x floor)
     "serving_kv_capacity_ratio": 2.0,
+    # TP capacity anchor IS the acceptance bound (r12): per-chip
+    # concurrent sequences at a fixed per-device byte budget, TP=2 vs
+    # TP=1 — the kv-heads split is exact, so the static ratio is 2.0 by
+    # construction and any regression is a sharding-layout bug
+    "serving_tp_capacity_ratio": 2.0,
     # spec-decode row (ISSUE 11): tok/s with n-gram drafting + multi-
     # query verify vs the same engine without speculation on the
     # high-acceptance (self-continuation) trace — the anchor IS the
@@ -1718,8 +1826,18 @@ def main():
     def want(s):
         return run_all or s in chosen
 
-    import jax
     import os
+    # the serve section's tensor-parallel row (ISSUE 12) shards over >= 2
+    # devices; on the CPU/host platform that means the virtual device
+    # count must be raised BEFORE jax initializes its backend (the flag
+    # only affects the host platform — inert on real TPU slices, where
+    # the device count is the hardware's)
+    if want("serve") and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+    import jax
     # Persistent compilation cache: recompiles are warm across sections AND
     # across runs (the driver's run reuses executables compiled during the
     # build session), which is what keeps the whole sweep inside the 420s
@@ -1766,12 +1884,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0, "health": 45.0, "serve": 160.0} if _warm else
+                  "input": 20.0, "health": 45.0, "serve": 190.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0, "health": 90.0, "serve": 280.0})
+                  "input": 30.0, "health": 90.0, "serve": 330.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -1985,6 +2103,22 @@ def main():
             assert s["kv_token_agreement"] >= 0.6, \
                 f"int8 KV token agreement {s['kv_token_agreement']} " \
                 f"below the 0.6 tolerance"
+            # tensor-parallel row (ISSUE 12): at one per-device byte
+            # budget a TP=2 replica must hold >= 2x the concurrent
+            # sequences of the TP=1 engine, serve bit-identically
+            # (greedy + seeded sampling), compile decode once per mesh
+            # shape and leak nothing (skipped only where no second
+            # device exists to build a mesh over)
+            if s["tp_supported"]:
+                assert s["tp_outputs_match"], \
+                    "TP=2 outputs diverged from the TP=1 engine"
+                assert s["tp_capacity_ratio"] >= 2.0, \
+                    f"TP=2 held only {s['tp_capacity_ratio']}x " \
+                    f"concurrent sequences at the per-device budget"
+                assert s["tp_decode_traces"] == 1, \
+                    "TP row recompiled decode mid-trace"
+                assert s["tp_leaked_blocks"] == 0, \
+                    f"TP row leaked {s['tp_leaked_blocks']} KV blocks"
             # overload row (ISSUE 6): every served request bit-matches the
             # oracle (timed-out partials prefix-match), load genuinely
             # shed, and the SLO-aware policy beats status-quo FIFO on p99
@@ -2052,6 +2186,10 @@ def main():
             _emit("serving_kv_capacity_ratio", s["kv_capacity_ratio"],
                   "x", s["kv_capacity_ratio"] /
                   _R2_ANCHORS["serving_kv_capacity_ratio"])
+            if s["tp_supported"]:
+                _emit("serving_tp_capacity_ratio", s["tp_capacity_ratio"],
+                      "x", s["tp_capacity_ratio"] /
+                      _R2_ANCHORS["serving_tp_capacity_ratio"])
         section("serve", _serve)
     if want("wide"):
         def _wide():

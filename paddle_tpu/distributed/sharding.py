@@ -21,11 +21,44 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.tensor import Tensor
 from .topology import HybridCommunicateGroup, get_hybrid_communicate_group
 
-__all__ = ["group_sharded_parallel", "shard_optimizer_states", "shard_params"]
+__all__ = ["group_sharded_parallel", "shard_optimizer_states", "shard_params",
+           "shard_dim_spec"]
 
 
-def _shard_spec(shape, mesh, axis: str) -> P:
-    """Shard along the first dim divisible by the axis size; replicate if none."""
+def shard_dim_spec(shape, mesh, axis: str, dim: int, name: str = "tensor") -> P:
+    """PartitionSpec splitting exactly ``dim`` of ``shape`` over mesh
+    ``axis`` — the spelling for layouts where the sharded dimension is part
+    of the CONTRACT (the serving engine's paged KV pool shards its kv-heads
+    axis; a silent fallback to replication would quietly erase the capacity
+    win). An indivisible dim raises a structured error naming the tensor
+    and the axis up front, instead of failing deep inside ``device_put``
+    with an unattributed XLA sharding error; so does an out-of-range
+    ``dim`` — the likeliest layout mistake (e.g. copying a K/V leaf's dim
+    onto a scale plane that dropped an axis) must not silently shard a
+    different axis."""
+    n = int(mesh.shape[axis])
+    if not -len(shape) <= dim < len(shape):
+        raise ValueError(
+            f"cannot shard {name}: dim {dim} is out of range for shape "
+            f"{tuple(shape)} (rank {len(shape)})")
+    d = dim % len(shape)
+    if shape[d] % n or shape[d] == 0:
+        raise ValueError(
+            f"cannot shard {name}: dim {d} (size {shape[d]} of shape "
+            f"{tuple(shape)}) is not divisible by mesh axis {axis!r} "
+            f"(size {n})")
+    return P(*([None] * d + [axis]))
+
+
+def _shard_spec(shape, mesh, axis: str, dim: Optional[int] = None,
+                name: str = "tensor") -> P:
+    """Shard along the first dim divisible by the axis size, SKIPPING
+    indivisible dims; replicate if none qualifies. With ``dim`` given the
+    choice is no longer heuristic — delegate to :func:`shard_dim_spec`,
+    which raises the structured divisibility error instead of letting an
+    unshardable layout reach ``device_put``."""
+    if dim is not None:
+        return shard_dim_spec(shape, mesh, axis, dim, name)
     n = int(mesh.shape[axis])
     for d, s in enumerate(shape):
         if s % n == 0 and s > 0:
@@ -33,10 +66,10 @@ def _shard_spec(shape, mesh, axis: str) -> P:
     return P()
 
 
-def _apply_sharding(t, mesh, axis: str):
+def _apply_sharding(t, mesh, axis: str, name: str = "tensor"):
     if t is None or not isinstance(t, Tensor) or t.ndim == 0:
         return
-    spec = _shard_spec(t.shape, mesh, axis)
+    spec = _shard_spec(t.shape, mesh, axis, name=name)
     t._raw = jax.device_put(t._raw, NamedSharding(mesh, spec))
 
 
@@ -47,11 +80,11 @@ def shard_optimizer_states(optimizer, hcg: Optional[HybridCommunicateGroup] = No
     hcg = hcg or get_hybrid_communicate_group()
     mesh, axis = hcg.mesh, "sharding"
 
-    for store in optimizer._accumulators.values():
-        for t in store.values():
-            _apply_sharding(t, mesh, axis)
-    for t in getattr(optimizer, "_master_weights", {}).values():
-        _apply_sharding(t, mesh, axis)
+    for acc_name, store in optimizer._accumulators.items():
+        for pname, t in store.items():
+            _apply_sharding(t, mesh, axis, name=f"{acc_name}[{pname}]")
+    for pname, t in getattr(optimizer, "_master_weights", {}).items():
+        _apply_sharding(t, mesh, axis, name=f"master_weights[{pname}]")
 
     orig = optimizer._add_accumulator
 
@@ -73,7 +106,8 @@ def shard_params(model, hcg: Optional[HybridCommunicateGroup] = None):
     """Stage 3: parameters themselves live sharded; XLA all-gathers on use."""
     hcg = hcg or get_hybrid_communicate_group()
     for p in model.parameters():
-        _apply_sharding(p, hcg.mesh, "sharding")
+        _apply_sharding(p, hcg.mesh, "sharding",
+                        name=getattr(p, "name", "param"))
     return model
 
 

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import Mesh
 
 __all__ = ["HybridCommunicateGroup", "ParallelAxis", "get_hybrid_communicate_group",
-           "build_mesh", "set_hybrid_communicate_group"]
+           "build_mesh", "set_hybrid_communicate_group", "tp_mesh"]
 
 # outermost -> innermost (mp innermost = nearest-neighbor ICI); ep sits
 # between sharding and sep: expert all_to_all is bulkier than mp collectives
@@ -39,6 +39,37 @@ def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> M
             f"got {len(devices)}")
     arr = np.array(devices, dtype=object).reshape(shape)
     return Mesh(arr, _AXIS_ORDER)
+
+
+def tp_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """Dedicated serving tensor-parallel mesh: one ``"tp"`` axis over the
+    first ``tp`` devices.
+
+    Unlike :func:`build_mesh` (which grids EVERY device into the hybrid
+    training topology), a serving replica's mesh covers only its own
+    slice. The serving engine (``inference.serving.ServingConfig.tp``)
+    always takes the FIRST ``tp`` devices: in production each replica is
+    its own process/host whose visible devices ARE its slice, so
+    ``devices[:tp]`` is the whole allotment; an in-process fleet
+    (``ServingRouter`` in one process — the test/bench topology) stacks
+    its TP replicas on the same slice, exactly as its single-device
+    replicas already stack on device 0 — pass ``devices=`` here for a
+    custom placement. The engine keys its compiled programs by this
+    mesh's shape, so replicas at the same degree share executables.
+    Raises a structured error when the platform has fewer devices than
+    ``tp`` asks for.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tensor-parallel degree must be >= 1, got {tp}")
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor-parallel degree tp={tp} needs {tp} devices but the "
+            f"platform has {len(devices)}; lower ServingConfig.tp / "
+            f"FLAGS_serving_tp or provision more devices "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=N on CPU)")
+    return Mesh(np.array(devices[:tp], dtype=object), ("tp",))
 
 
 class ParallelAxis:

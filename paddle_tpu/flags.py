@@ -342,6 +342,17 @@ define_flag("FLAGS_serving_tenant_cache_quota", 0,
             "flooding unique prompts cannot evict everyone's system "
             "prompt). 0 = unlimited.", int)
 
+define_flag("FLAGS_serving_tp", 1,
+            "Tensor-parallel degree for the serving engine "
+            "(ServingConfig.tp): the paged KV pool shards its kv-heads "
+            "axis over a 'tp' mesh of this many devices and the "
+            "prefill/decode/verify programs run under shard_map — per-"
+            "device KV bytes per token divide by tp, so per-chip "
+            "concurrent capacity multiplies by tp at unchanged block-"
+            "table logic. Requires num_kv_heads % tp == 0 and tp "
+            "visible devices. 1 (the default) is the single-device "
+            "engine, byte-for-byte today's code path.", int)
+
 # serving front line (ISSUE 7): asyncio server + engine supervisor
 define_flag("FLAGS_serving_max_restarts", 3,
             "EngineSupervisor restart budget: unexpected step-loop "

@@ -127,9 +127,19 @@ HEALTH_SNAPSHOT_FIELDS = {
     "usable_blocks": "pool size excluding the reserved null block — the "
                      "EFFECTIVE capacity: at a fixed byte budget an int8 "
                      "pool holds ~2-4x the blocks of an fp one",
-    "kv_pool_bytes": "device bytes the KV pool holds (K + V + the scale "
-                     "planes on quantized layouts) — the denominator of "
-                     "the int8 capacity win",
+    "kv_pool_bytes": "device bytes the KV pool holds GLOBALLY (K + V + "
+                     "the scale planes on quantized layouts, summed over "
+                     "every tp shard) — the denominator of the int8 "
+                     "capacity win",
+    "tp_degree": "tensor-parallel degree of this replica "
+                 "(ServingConfig.tp / FLAGS_serving_tp): the paged pool "
+                 "is sharded over this many devices on its kv-heads axis; "
+                 "1 = the single-device engine",
+    "kv_pool_shard_bytes": "KV-pool bytes ONE device holds "
+                           "(kv_pool_bytes / tp_degree — the kv-heads "
+                           "split is exact): what a per-chip HBM budget "
+                           "must cover, so the autoscaler and capacity "
+                           "planning see sharded replicas correctly",
     "kv_quant": "KV-pool quantization mode (null = fp at the model/cache "
                 "dtype; 'int8' = int8 blocks + per-token-per-head fp32 "
                 "scales, dequant fused into the kernel's loads)",
@@ -214,6 +224,16 @@ class ServingConfig:
     max_model_len: Optional[int] = None
     queue_depth: Optional[int] = None
     decode_chunk: Optional[int] = None
+    tp: Optional[int] = None         # tensor-parallel degree (ISSUE 12):
+    #                                  the paged pool shards its kv-heads
+    #                                  axis over a "tp" mesh of this many
+    #                                  devices and the compiled programs
+    #                                  run under shard_map; None ->
+    #                                  FLAGS_serving_tp (default 1 = the
+    #                                  single-device engine, byte-for-byte
+    #                                  today's code path). Requires
+    #                                  num_kv_heads % tp == 0 (validated
+    #                                  with a structured error).
     num_blocks: int = 0              # 0 = auto (max_slots full sequences)
     quantize: Optional[str] = None   # "int8" -> weight-only decode path
     cache_dtype: Any = None          # None -> model activation dtype
@@ -251,9 +271,14 @@ class ServingConfig:
                         ("max_slots", "FLAGS_serving_max_slots"),
                         ("max_model_len", "FLAGS_serving_max_model_len"),
                         ("queue_depth", "FLAGS_serving_queue_depth"),
-                        ("decode_chunk", "FLAGS_serving_decode_chunk")):
+                        ("decode_chunk", "FLAGS_serving_decode_chunk"),
+                        ("tp", "FLAGS_serving_tp")):
             if getattr(self, f) is None:
                 setattr(self, f, int(flag(name)))
+        self.tp = int(self.tp)
+        if self.tp < 1:
+            raise ValueError(f"tensor-parallel degree must be >= 1 (1 = "
+                             f"the single-device engine), got tp={self.tp}")
         if self.prefix_cache == _UNSET:
             self.prefix_cache = bool(flag("FLAGS_serving_prefix_cache"))
         else:
@@ -320,6 +345,23 @@ class ServingEngine:
         from ...models.llama import ensure_quantized
         self._params = ensure_quantized(params, self.config.quantize)
         self._cfg = model_config
+        # tensor parallelism (ISSUE 12): tp > 1 builds the "tp" mesh over
+        # the replica's device slice, lays the QKV projections out
+        # column-sharded (everything else replicated — the ONE
+        # shard_serving_params layout) and emits the paged pool sharded on
+        # its kv-heads axis. The scheduler / BlockManager / prefix cache
+        # below stay device-count-agnostic: block ids are global, tables
+        # and slot operands replicate, only pool bytes split — per-chip KV
+        # capacity multiplies by tp at unchanged block-table logic.
+        if self.config.tp > 1:
+            from ...distributed.topology import tp_mesh
+            from ...models.generation import validate_tp
+            from ...models.llama import shard_serving_params
+            validate_tp(model_config, self.config.tp)
+            self._mesh = tp_mesh(self.config.tp)
+            self._params = shard_serving_params(self._params, self._mesh)
+        else:
+            self._mesh = None
         self.cache = PagedKVCache(model_config, self.config.max_slots,
                                   self.config.max_model_len,
                                   self.config.block_size,
@@ -327,7 +369,8 @@ class ServingEngine:
                                   dtype=self.config.cache_dtype,
                                   prefix_cache=self.config.prefix_cache,
                                   tenant_quota=self.config.tenant_cache_quota,
-                                  kv_quant=self.config.kv_quant)
+                                  kv_quant=self.config.kv_quant,
+                                  mesh=self._mesh)
         self._policy = resolve_policy(
             self.config.policy,
             ttft_slo_s=float(flag("FLAGS_serving_ttft_slo_s")))
@@ -366,10 +409,15 @@ class ServingEngine:
         # never exceeds max_model_len KV entries, so neither can steps)
         self._out_width = int(self.config.max_model_len)
         self._jax = jax
+        # tp (the mesh shape) is part of the signature: engines at
+        # different mesh shapes never share programs; same shape shares —
+        # a supervisor rebuild or router spawn of a TP replica reuses the
+        # dead engine's executables without retracing (flat decode_traces)
         key = (model_config, self.config.block_size, self.config.max_slots,
                self.config.max_model_len, self.config.quantize,
                str(self.config.cache_dtype), self.config.kv_quant,
-               self.config.paged_kernel, self.config.spec_decode)
+               self.config.paged_kernel, self.config.spec_decode,
+               self.config.tp)
         if programs is not None:
             if programs.key != key:
                 raise ValueError(
@@ -404,6 +452,12 @@ class ServingEngine:
         from ...jit.train_step import donation_supported
         from ...models import generation as G
         cfg, stats, Cmax = self._cfg, self._stats, self._out_width
+        if self._mesh is not None:
+            # the LOCAL config the shard_map'd programs close over: head
+            # counts stay global (the paged entry points derive the local
+            # slice from the pool shard's shape); tp_axis names the mesh
+            # axis the attention-output merge all_gathers over
+            cfg = dataclasses.replace(cfg, tp_axis="tp")
 
         def prefill_fn(params, ids, prompt_lens, block_tables, pool, active):
             stats["prefill_traces"] += 1           # trace-time only
@@ -514,6 +568,35 @@ class ServingEngine:
             kt = jax.vmap(jax.random.fold_in)(keys, idx)
             return G.sample_tokens(logits, kt, temp, topk, topp)
 
+        if self._mesh is not None:
+            # tensor parallelism: every pool-touching program runs under
+            # shard_map on the replica's "tp" mesh — params enter at the
+            # serving_param_specs layout (QKV column-sharded, the rest
+            # replicated), the pool at its kv-heads split, and every
+            # scheduler operand (tokens / tables / slot state / sampling
+            # knobs / the iteration bound) REPLICATED, so the host-side
+            # dispatch code below this point is identical at every tp.
+            # The sampler (sample_fn) touches neither params nor pool and
+            # stays a plain jit on the replicated prefill logits.
+            from jax.sharding import PartitionSpec
+            from ...core.jax_compat import shard_map
+            from ...models.llama import serving_param_specs
+            ps = serving_param_specs(self._params, self._mesh)
+            zs = G.paged_pool_specs(self.cache.pool, self._mesh)
+            R = PartitionSpec()
+            prefill_fn = shard_map(prefill_fn, mesh=self._mesh,
+                                   in_specs=(ps, R, R, R, zs, R),
+                                   out_specs=(R, zs, R), check_vma=False)
+            chunk_fn = shard_map(chunk_fn, mesh=self._mesh,
+                                 in_specs=(ps, R, R, R, R, zs),
+                                 out_specs=(R, zs, R), check_vma=False)
+            decode_fn = shard_map(decode_fn, mesh=self._mesh,
+                                  in_specs=(ps, zs) + (R,) * 12,
+                                  out_specs=(zs, R, R, R, R, R),
+                                  check_vma=False)
+            spec_fn = shard_map(spec_fn, mesh=self._mesh,
+                                in_specs=(ps, zs) + (R,) * 11,
+                                out_specs=(zs, R, R), check_vma=False)
         donate = donation_supported()
         jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
         jchk = jax.jit(chunk_fn, donate_argnums=(5,) if donate else ())
@@ -1370,7 +1453,9 @@ class ServingEngine:
                 "spec_decode": self.config.spec_decode,
                 "spec_drafted": self._sched.spec_drafted,
                 "spec_accepted": self._sched.spec_accepted,
+                "tp_degree": self.config.tp,
                 "kv_pool_bytes": self.cache.kv_bytes(),
+                "kv_pool_shard_bytes": self.cache.kv_bytes(per_shard=True),
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}
 
     def health_snapshot(self) -> Dict[str, Any]:
@@ -1439,6 +1524,8 @@ class ServingEngine:
             "free_blocks": self.cache.free_blocks,
             "usable_blocks": self.cache.manager.num_blocks - 1,
             "kv_pool_bytes": self.cache.kv_bytes(),
+            "tp_degree": self.config.tp,
+            "kv_pool_shard_bytes": self.cache.kv_bytes(per_shard=True),
             "kv_quant": self.config.kv_quant,
             "paged_kernel": self.config.paged_kernel,
             "spec_decode": self.config.spec_decode,

@@ -254,12 +254,20 @@ class PagedKVCache:
     def __init__(self, model_config, max_slots: int, max_model_len: int,
                  block_size: int, num_blocks: int = 0, dtype=None,
                  prefix_cache: bool = True,
-                 tenant_quota: Optional[int] = None, kv_quant=None):
+                 tenant_quota: Optional[int] = None, kv_quant=None,
+                 mesh=None):
         from ...models.generation import init_paged_pool
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
         self.prefix_cache = bool(prefix_cache)
         self.kv_quant = kv_quant
+        # serving tensor parallelism (ISSUE 12): with a mesh, the pool
+        # leaves are emitted sharded on their kv-heads axis over the "tp"
+        # axis — every HOST structure here (block manager, tables, prefix
+        # keys over token ids) is device-count-AGNOSTIC: block ids are
+        # global, tables replicate, only pool bytes split across devices
+        self.mesh = mesh
+        self.tp = int(mesh.shape["tp"]) if mesh is not None else 1
         self.blocks_per_seq = max(1, math.ceil(max_model_len / block_size))
         if num_blocks <= 0:
             # auto-size: every slot can hold a full-length sequence, +1 null
@@ -271,7 +279,7 @@ class PagedKVCache:
         # fp blocks; only the device pool layout changes
         self.pool: Dict = init_paged_pool(model_config, num_blocks,
                                           block_size, dtype,
-                                          kv_quant=kv_quant)
+                                          kv_quant=kv_quant, mesh=mesh)
         self.manager = BlockManager(num_blocks, block_size,
                                     tenant_quota=tenant_quota)
         self.tables = np.zeros((max_slots, self.blocks_per_seq), np.int32)
@@ -379,8 +387,13 @@ class PagedKVCache:
         self.manager.free(blocks)
         self.tables[slot] = 0
 
-    def kv_bytes(self) -> int:
+    def kv_bytes(self, per_shard: bool = False) -> int:
         """Device bytes the pool holds — every leaf (K + V, plus the scale
         planes on quantized layouts), the number capacity planning and the
-        ``kv_pool_bytes`` ops field report."""
-        return sum(a.size * a.dtype.itemsize for a in self.pool.values())
+        ``kv_pool_bytes`` ops field report. ``per_shard=True`` returns the
+        bytes ONE device holds under tensor parallelism (the global total
+        divided by the TP degree — the kv-heads split is exact): the
+        number a per-chip HBM budget must cover, and the
+        ``kv_pool_shard_bytes`` ops field."""
+        total = sum(a.size * a.dtype.itemsize for a in self.pool.values())
+        return total // self.tp if per_shard else total

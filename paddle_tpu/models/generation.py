@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,9 +60,10 @@ from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
 
 __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
-           "init_paged_pool", "paged_pool_block_bytes", "paged_prefill",
-           "paged_prefill_chunk", "paged_decode_step", "paged_spec_step",
-           "sample_tokens", "seed_key", "validate_sampling"]
+           "init_paged_pool", "paged_pool_block_bytes", "paged_pool_specs",
+           "paged_prefill", "paged_prefill_chunk", "paged_decode_step",
+           "paged_spec_step", "sample_tokens", "seed_key",
+           "validate_sampling", "validate_tp"]
 
 
 # ---------------------------------------------------------------------------
@@ -581,8 +582,74 @@ class DecodeSession:
 # paged KV cache (block-table attention — the serving-engine entry points)
 # ---------------------------------------------------------------------------
 
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    """Structured validation of a serving tensor-parallel degree against a
+    model config (the same error convention as :func:`validate_sampling` /
+    ``llama.validate_quant_mode``): the paged pool shards its kv-heads
+    axis, so ``tp`` must divide ``num_kv_heads`` — checked HERE, up front,
+    instead of failing deep inside ``device_put`` on an indivisible
+    ``Hk``. Raised at ``ServingConfig``/engine construction."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tensor-parallel degree must be >= 1 (1 = the "
+                         f"single-device engine), got tp={tp}")
+    if tp == 1:
+        return
+    Hk = cfg.kv_heads
+    if Hk % tp:
+        divisors = [d for d in range(1, Hk + 1) if Hk % d == 0]
+        raise ValueError(
+            f"tensor-parallel degree tp={tp} does not divide the model's "
+            f"num_kv_heads={Hk} (the paged KV pool shards its kv-heads "
+            f"axis); supported degrees for this config: {divisors}")
+
+
+def _merge_heads(o, cfg: LlamaConfig):
+    """Flatten attention output ``[B, T, h, D] -> [B, T, h*D]`` for the
+    output projection. Under serving tensor parallelism (``cfg.tp_axis``
+    set — the engine's shard_map'd programs) ``h`` is the LOCAL head
+    slice: all_gather the shards into the full head set first. The gather
+    is a pure tiled concatenation — no floating-point addition — so the
+    merged tensor is BITWISE the single-device one and the replicated
+    wo/FFN/lm-head math downstream stays inside every greedy/seeded
+    parity oracle. (A Megatron row-parallel merge — psum of per-shard
+    ``wo`` partials — would change fp accumulation order and break
+    bit-parity vs TP=1; measured on XLA:CPU.)"""
+    if cfg.tp_axis is not None:
+        o = lax.all_gather(o, cfg.tp_axis, axis=2, tiled=True)
+    B, T = o.shape[:2]
+    return o.reshape(B, T, o.shape[2] * o.shape[3])
+
+
+def _local_heads(cfg: LlamaConfig, pool: Dict) -> Tuple[int, int]:
+    """(query heads, kv heads) of the pool VIEW a paged entry point was
+    handed. Under shard_map the pool leaf is this shard's ``Hk/tp`` head
+    slice, and the GQA group size ``G = H // Hk`` is shard-invariant — so
+    the local query-head count follows from the pool shape and the config
+    keeps its global head counts (``cfg.head_dim`` stays correct, being
+    derived from the UNCHANGED hidden_size / num_attention_heads)."""
+    Hk = pool["k"].shape[3]
+    return Hk * (cfg.num_attention_heads // cfg.kv_heads), Hk
+
+
+def paged_pool_specs(pool: Dict, mesh, axis: str = "tp") -> Dict:
+    """PartitionSpecs splitting every pool leaf's kv-heads axis over mesh
+    ``axis``: K/V ``[L, N, bs, Hk, D]`` and scale ``[L, N, bs, Hk]``
+    leaves both shard dim 3, so int8 pools shard k/v and their scale
+    planes identically and a shard's scales always describe its own
+    blocks. Block ids stay GLOBAL — tables and slot operands replicate,
+    only pool bytes split. Indivisible head counts raise the structured
+    :func:`~paddle_tpu.distributed.sharding.shard_dim_spec` error naming
+    the leaf."""
+    from ..distributed.sharding import shard_dim_spec
+    return {name: shard_dim_spec(leaf.shape, mesh, axis, dim=3,
+                                 name=f"paged_pool.{name}")
+            for name, leaf in pool.items()}
+
+
 def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
-                    dtype=None, kv_quant=None) -> Dict:
+                    dtype=None, kv_quant=None, mesh=None,
+                    tp_axis: str = "tp") -> Dict:
     """Physical KV block pool ``{"k","v": [L, num_blocks, block_size, Hk,
     D]}`` shared by every sequence the serving engine runs (PagedAttention
     layout): a sequence holds only the blocks its block table points at,
@@ -604,6 +671,14 @@ def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
     Dequantization happens inside the consumers (fused into the Pallas
     kernel's block loads; the XLA gather fallback dequantizes after its
     gather) — a dense fp copy of the pool never exists.
+
+    With ``mesh`` given (a ``tp_mesh`` — serving tensor parallelism,
+    ISSUE 12) every leaf is emitted with a ``NamedSharding`` splitting its
+    kv-heads axis over ``tp_axis`` (:func:`paged_pool_specs`): each device
+    holds ``Hk/tp`` heads of every block, so per-device KV bytes per token
+    divide by the TP degree while block ids, tables and the host-side
+    block manager stay device-count-agnostic. int8 pools shard k/v and
+    their scale planes identically.
     """
     from .llama import KV_QUANT_MODES, validate_quant_mode
     validate_quant_mode(kv_quant, KV_QUANT_MODES, "kv_quant")
@@ -611,22 +686,34 @@ def init_paged_pool(cfg: LlamaConfig, num_blocks: int, block_size: int,
     shape = (cfg.num_hidden_layers, num_blocks, block_size, cfg.kv_heads,
              cfg.head_dim)
     if kv_quant == "int8":
-        return {"k": jnp.zeros(shape, jnp.int8),
+        pool = {"k": jnp.zeros(shape, jnp.int8),
                 "v": jnp.zeros(shape, jnp.int8),
                 "k_scale": jnp.zeros(shape[:-1], jnp.float32),
                 "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    else:
+        pool = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        specs = paged_pool_specs(pool, mesh, tp_axis)
+        pool = {n: jax.device_put(a, NamedSharding(mesh, specs[n]))
+                for n, a in pool.items()}
+    return pool
 
 
 def paged_pool_block_bytes(cfg: LlamaConfig, block_size: int, dtype=None,
-                           kv_quant=None) -> int:
+                           kv_quant=None, tp: int = 1) -> int:
     """Bytes ONE physical block costs across all layers (K + V + scales) —
     the capacity-planning arithmetic behind sizing ``num_blocks`` to a
-    byte budget (``bench --serve``'s int8-vs-fp capacity row divides a
-    fixed budget by this per layout)."""
+    byte budget (``bench --serve``'s int8-vs-fp and TP capacity rows
+    divide a fixed budget by this per layout). ``tp > 1`` returns the
+    PER-DEVICE cost of the block under a tensor-parallel pool: each
+    device holds ``Hk/tp`` heads of every block, so a fixed per-device
+    byte budget backs ``tp`` times the blocks — the per-chip capacity
+    multiplier the TP bench row measures."""
     import numpy as _np
+    validate_tp(cfg, tp)
     L, bs = cfg.num_hidden_layers, int(block_size)
-    Hk, D = cfg.kv_heads, cfg.head_dim
+    Hk, D = cfg.kv_heads // int(tp), cfg.head_dim
     if kv_quant == "int8":
         return L * bs * Hk * (2 * D * 1 + 2 * 4)
     dt = dtype if dtype is not None else cfg.dtype
@@ -708,7 +795,8 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
     """
     from ..kernels.rope import rope_cos_sin
     B, Sb = ids.shape
-    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    H, Hk = _local_heads(cfg, pool)    # the shard's head slice under TP
+    D = cfg.head_dim
     bs = pool["k"].shape[2]
     W = block_tables.shape[1]
     dt = cfg.dtype
@@ -732,7 +820,7 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
         k = _rope(k, cos, sin, False)
         pz, ka, va = _kv_store(pz, phys, off, k, v)
         o = _masked_sdpa(q, ka, va, kv_mask)
-        h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
+        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
@@ -768,7 +856,8 @@ def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
     dropped_tokens).
     """
     B, Sb = ids.shape
-    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    H, Hk = _local_heads(cfg, pool)    # the shard's head slice under TP
+    D = cfg.head_dim
     bs = pool["k"].shape[2]
     W = block_tables.shape[1]
     C = W * bs
@@ -798,7 +887,7 @@ def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
         pz, _, _ = _kv_store(pz, phys, off, k, v)
         kk, vv = _kv_gather(pz, block_tables, B, C, Hk, D)
         o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(o.reshape(B, Sb, H * D).astype(dt), lp, "wo", dt)
+        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
@@ -835,7 +924,8 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     Returns (logits ``[M, V]``, pool, dropped_tokens).
     """
     M = tokens.shape[0]
-    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    H, Hk = _local_heads(cfg, pool)    # the shard's head slice under TP
+    D = cfg.head_dim
     bs = pool["k"].shape[2]
     W = block_tables.shape[1]
     C = W * bs
@@ -868,7 +958,7 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
         else:
             kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
             o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(o.reshape(M, 1, H * D).astype(dt), lp, "wo", dt)
+        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
@@ -927,7 +1017,8 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     DMA per kv head scored against all ``Q`` query rows. Returns
     (logits ``[M, Q, V]``, pool, dropped_tokens)."""
     M, Q = tokens.shape
-    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    H, Hk = _local_heads(cfg, pool)    # the shard's head slice under TP
+    D = cfg.head_dim
     bs = pool["k"].shape[2]
     W = block_tables.shape[1]
     C = W * bs
@@ -967,7 +1058,7 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
         else:
             kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
             o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(o.reshape(M, Q, H * D).astype(dt), lp, "wo", dt)
+        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 

@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "param_specs",
            "batch_spec", "make_train_step", "LlamaForCausalLM", "num_params",
            "make_pp_train_step", "to_pp_layout", "from_pp_layout",
-           "pp_param_specs"]
+           "pp_param_specs", "serving_param_specs", "shard_serving_params"]
 
 
 @dataclasses.dataclass
@@ -78,6 +78,14 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     ep_axis: Optional[str] = None    # expert-parallel mesh axis (e.g. "ep")
+    tp_axis: Optional[str] = None    # serving tensor-parallel mesh axis
+    # (inference.serving ISSUE 12). Set only on the LOCAL config the
+    # serving engine's shard_map'd programs close over: the paged decode/
+    # prefill/verify entry points then all_gather their attention-output
+    # head slices over this axis before the (replicated) output
+    # projection. Head counts stay GLOBAL here — the paged entry points
+    # derive the local head counts from the pool shard they are handed.
+    # User-facing configs leave it None.
     ce_chunks: int = 1               # >1: token-chunked cross-entropy — the
     # fp32 [T, V] logits (2.1GB at the bench config) never materialize;
     # each chunk's logits are recomputed in backward (jax.checkpoint), which
@@ -199,6 +207,61 @@ def batch_spec(dp_axes=("dp",), sep_axis: Optional[str] = None) -> P:
 def shard_params(params, mesh: Mesh, cfg: LlamaConfig, mp_axis="mp",
                  fsdp_axis=None):
     specs = param_specs(cfg, mp_axis, fsdp_axis)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+# QKV projections (and their weight-only-int8 scale leaves) are the only
+# params the SERVING tensor-parallel layout shards — on the head output dim,
+# so each shard computes exactly the q/k/v head slice whose KV pool shard it
+# owns. Everything else stays replicated: see serving_param_specs.
+_SERVING_TP_SHARDED = ("wq", "wk", "wv", "wq_s", "wk_s", "wv_s")
+
+
+def serving_param_specs(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
+    """PartitionSpecs for the serving engine's tensor-parallel layout
+    (inference.serving ISSUE 12): ``wq``/``wk``/``wv`` (and their int8
+    ``*_s`` scale leaves) COLUMN-sharded on their head output dim over
+    ``axis``; every other leaf — ``wo``, the FFN, norms, embed, lm_head —
+    REPLICATED.
+
+    This is deliberately NOT the Megatron training layout
+    (:func:`param_specs`): attention is head-sharded (each shard runs the
+    unmodified kernel on its kv-head slice of the paged pool) and the
+    per-shard outputs are merged by an exact all_gather concatenation, so
+    the replicated post-attention math is BITWISE the single-device
+    engine's — the parity oracle every serving test pins. Row-parallel
+    ``wo``/FFN partial sums merged by psum would change the fp
+    accumulation order and break bit-parity vs TP=1 (measured on XLA:CPU),
+    for an FFN-flops saving the decode hot path doesn't need; the capacity
+    win lives in the sharded KV pool. Divisibility failures raise the
+    structured :func:`~paddle_tpu.distributed.sharding.shard_dim_spec`
+    error naming the offending leaf.
+    """
+    from ..distributed.sharding import shard_dim_spec
+
+    def leaf_spec(name: str, leaf) -> P:
+        if name in _SERVING_TP_SHARDED:
+            return shard_dim_spec(leaf.shape, mesh, axis, dim=-1,
+                                  name=f"params.layers.{name}")
+        return P()
+
+    specs: Dict = {}
+    for key, val in params.items():
+        if key == "layers":
+            specs[key] = {n: leaf_spec(n, a) for n, a in val.items()}
+        else:
+            specs[key] = jax.tree_util.tree_map(lambda _: P(), val)
+    return specs
+
+
+def shard_serving_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
+    """Lay the (fp or weight-only-int8) param pytree out for serving
+    tensor parallelism — the ONE helper behind which dense weights are
+    replicated-or-sharded (:func:`serving_param_specs`); the engine, the
+    supervisor's rebuild path and every router replica place params
+    through here, so a recovered engine can never diverge in layout."""
+    specs = serving_param_specs(params, mesh, axis)
     return jax.tree_util.tree_map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
 

@@ -58,3 +58,24 @@ def _seeded():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+@pytest.fixture(scope="session")
+def tp_platform():
+    """The multi-device host platform the serving tensor-parallel tests
+    (@pytest.mark.tp) shard over. This conftest provisions (and asserts,
+    above) the 8-way virtual CPU mesh for the whole suite — XLA_FLAGS is
+    set before jax initializes, so it cannot be toggled per test. This
+    fixture is the TP tests' explicit CONTRACT with that mesh: it names
+    the dependency, returns the device count so tests size their meshes,
+    and — belt and braces for a harness that bootstraps the platform
+    differently (e.g. tests invoked without this conftest's env control)
+    — skips rather than erroring deep inside device_put when fewer than
+    2 devices resolved. Session-scoped so MODULE-scoped engine fixtures
+    can depend on it (a skip must fire before an engine fixture builds a
+    mesh, which would ERROR instead)."""
+    n = jax.device_count()
+    if n < 2:
+        pytest.skip("serving TP tests need >= 2 devices "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count)")
+    return n

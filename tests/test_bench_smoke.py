@@ -112,6 +112,27 @@ def test_bench_serve_entry_point():
     assert detail["kv_eos_parity"] is not False
     assert detail["kv_token_agreement"] >= 0.6
     assert detail["kv_int8_pool_bytes"] <= detail["kv_budget_bytes"]
+    # tensor-parallel row (ISSUE 12): at one fixed PER-DEVICE byte budget
+    # the TP=2 engine (pool sharded on its kv-heads axis over the tp
+    # mesh) must hold >= 2x the TP=1 engine's concurrent sequences,
+    # serve the trace bit-identically (greedy + seeded sampling), compile
+    # decode once per mesh shape, leak nothing, and its per-device pool
+    # bytes must actually fit the budget. The parity/compile-once asserts
+    # also live in-section; the smoke pins the detail record and the
+    # serving_tp_capacity_ratio metric. bench provisions the 8-way host
+    # platform itself (XLA_FLAGS before jax init), so tp_supported must
+    # be True here.
+    assert detail["tp_supported"] is True
+    assert detail["tp_outputs_match"] is True
+    assert detail["tp_capacity_ratio"] >= 2.0
+    assert detail["tp2_concurrent"] >= 2 * detail["tp1_concurrent"]
+    # measured, not just arithmetic: the live peak actually doubled
+    assert detail["tp2_peak_live"] >= 2 * detail["tp1_peak_live"]
+    assert detail["tp_decode_traces"] == 1
+    assert detail["tp_leaked_blocks"] == 0
+    assert detail["tp2_shard_bytes"] <= detail["tp_per_device_budget_bytes"]
+    assert detail["tp_tok_s"] > 0
+    assert "serving_tp_capacity_ratio" in metrics
     # spec-decode row (ISSUE 11): n-gram drafting + multi-query verify
     # across the acceptance sweep — bit-parity on BOTH traces, real
     # acceptance on the high trace, one verify executable, zero leaked
