@@ -374,6 +374,15 @@ define_flag("FLAGS_serving_client_queue", 64,
             "DISCONNECTED and its request cancelled through the normal "
             "lifecycle path (KV blocks freed immediately) — a stalled SSE "
             "reader cannot pin pool blocks or host memory.", int)
+define_flag("FLAGS_serving_audit", False,
+            "Run the serving InvariantAuditor's structural checks "
+            "(block-pool partition conservation, zero leaks at idle, "
+            "terminal-state consistency, per-tenant accounting closure, "
+            "monotonic counters — the AUDIT_CHECKS registry) inside "
+            "ServingRouter.health_snapshot(), surfacing the verdict on "
+            "/metrics. Off by default: the checks walk every block map, "
+            "a cost a hot serving loop should only pay when asked to "
+            "(docs/OPS.md Workload replay & capacity planning).", bool)
 define_flag("FLAGS_serving_retry_after_s", 1.0,
             "Conservative retry-after hint (s) returned to shed clients "
             "BEFORE the engine has observed two retirements (cold start: "

@@ -21,9 +21,20 @@ delivered tokens), per-replica :class:`CircuitBreaker`\\ s, hedged
 retries, autoscale actuation and rolling restarts (docs/OPS.md "Serving
 fleet"). Benchmarked by ``bench.py --serve`` against the static-batch
 ``generate()`` baseline and driven through hostile-traffic faults by
-``testing.chaos``'s serving injectors.
+``testing.chaos``'s serving injectors. The fleet-scale proof layer
+(ISSUE 13) sits across all of it: :class:`InvariantAuditor` — one
+registry of named invariants (``AUDIT_CHECKS``) replacing the asserts
+scattered through the test suite, surfaced in production via
+``FLAGS_serving_audit`` — and the deterministic workload replay
+(:class:`WorkloadSpec` / :func:`run_replay`): seeded traces with
+diurnal/bursty arrivals, Zipf tenants, shared-prefix families and
+client misbehavior, driven through a multi-replica router under a
+seeded chaos timeline with the autoscaler actuating, emitting a
+replay manifest (bit-exact reproduction) and a capacity-planning
+report (``capacity_report`` + the ``serving_replay_goodput`` metric).
 """
 
+from .audit import AUDIT_CHECKS, InvariantAuditor, InvariantViolation
 from .engine import (EnginePrograms, HEALTH_SNAPSHOT_FIELDS,
                      SUPERVISOR_SNAPSHOT_KEYS, ServingConfig, ServingEngine)
 from .paged_cache import BlockManager, PagedKVCache
@@ -39,6 +50,8 @@ from .router import (ROUTER_HEALTH_FIELDS, RouterConfig, RouterRequest,
 from .server import ClientStream, ServingServer, serve_requests, sse_encode
 from .supervisor import (EngineSupervisor, FAILED, ServingUnavailable,
                          TrackedRequest, autoscale_signal)
+from .workload import (ReplayManifest, TraceRequest, WorkloadSpec,
+                       capacity_report, generate_trace, run_replay)
 
 __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
            "Scheduler", "Request", "ServingQueueFull",
@@ -52,4 +65,7 @@ __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
            "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS",
            "ServingRouter", "RouterConfig", "RouterRequest",
            "ROUTER_HEALTH_FIELDS", "Replica", "CircuitBreaker",
-           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN"]
+           "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
+           "InvariantAuditor", "InvariantViolation", "AUDIT_CHECKS",
+           "WorkloadSpec", "TraceRequest", "generate_trace",
+           "ReplayManifest", "run_replay", "capacity_report"]

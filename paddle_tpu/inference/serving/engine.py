@@ -1472,6 +1472,18 @@ class ServingEngine:
         with self._lock:
             return self._health_snapshot_locked()
 
+    def block_partition(self) -> Dict[str, int]:
+        """A consistent view of the pool partition (free / evictable /
+        in-use / usable) under the engine lock — the conservation
+        invariant the InvariantAuditor (audit.py) checks every step:
+        free + evictable + in_use == usable."""
+        with self._lock:
+            bm = self.cache.manager
+            return {"free": len(bm._free),
+                    "evictable": len(bm._evictable),
+                    "in_use": bm.blocks_in_use,
+                    "usable": bm.num_blocks - 1}
+
     def _health_snapshot_locked(self) -> Dict[str, Any]:
         sched = self._sched
         wd = _watchdog.current()
@@ -1480,28 +1492,17 @@ class ServingEngine:
             return (round(float(np.percentile(np.asarray(xs), q)), 4)
                     if xs else None)
 
-        def tkey(name: str) -> str:
-            # tenants past MAX_TENANTS were folded into the overflow
-            # record at submit; fold their queued/live counts the same
-            # way or the overflow row would report 0 forever
-            return (name if name in sched.tenants
-                    else sched._OVERFLOW_TENANT)
-
-        live_by_tenant: Dict[str, int] = {}
-        for r in sched.live:
-            k = tkey(r.tenant)
-            live_by_tenant[k] = live_by_tenant.get(k, 0) + 1
-        queued_by_tenant: Dict[str, int] = {}
-        for r in sched.queue:
-            k = tkey(r.tenant)
-            queued_by_tenant[k] = queued_by_tenant.get(k, 0) + 1
+        # tenants past MAX_TENANTS were folded into the overflow record
+        # at submit; by_tenant() folds queued/live the same way (or the
+        # overflow row would report 0 forever)
+        occupancy = sched.by_tenant()
         tenants = {}
         for name, t in sched.tenants.items():
             ttfts = list(t["ttfts"])
             tpots = list(t["tpots"])
             tenants[name] = {
-                "queued": queued_by_tenant.get(name, 0),
-                "live": live_by_tenant.get(name, 0),
+                "queued": occupancy[name]["queued"],
+                "live": occupancy[name]["live"],
                 "submitted": t["submitted"], "admitted": t["admitted"],
                 "retired": t["retired"], "cancelled": t["cancelled"],
                 "timed_out": t["timed_out"], "shed": t["shed"],

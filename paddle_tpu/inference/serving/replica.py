@@ -170,6 +170,17 @@ class Replica:
         except Exception:              # noqa: BLE001 — wedged ops surface
             return False
 
+    def adoptable(self) -> bool:
+        """Whether work may still LAND here when the admission queue is
+        full: breaker closed, not retiring/draining, restart budget
+        intact. Weaker than :meth:`routable` (which also needs an open
+        queue) — failover resubmit bypasses the queue bound (the work
+        was accepted once, somewhere), and the submit path falls back to
+        this set so plain overload sheds with the engine's structured
+        429, not a misleading \"broken/circuit-broken\" 503."""
+        return (self.breaker.allow() and not self.retiring
+                and not self.draining and not self.sup.broken)
+
     def depth(self) -> int:
         """Queued + live work (the power-of-two-choices comparison key)."""
         return self.sup.depth()

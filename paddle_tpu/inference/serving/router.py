@@ -111,6 +111,13 @@ ROUTER_HEALTH_FIELDS = {
                  "reading it never consumes the shed delta)",
     "watchdog": "global hang-watchdog state (installed / fired / "
                 "timeout_s) — process-wide, shared by every replica",
+    "audit": "InvariantAuditor verdict (audit.py AUDIT_CHECKS: block-"
+             "pool partition conservation, zero leaks at idle, terminal-"
+             "state consistency, per-tenant accounting closure, "
+             "monotonic counters) run fleet-wide inside this snapshot "
+             "when FLAGS_serving_audit is on; {'enabled': false} "
+             "otherwise — the checks walk every block map, a cost a hot "
+             "loop only pays when asked to",
     "supervisor": "single-supervisor compatibility summary so /readyz "
                   "serves a router unchanged: draining / broken (ALL "
                   "replicas broken) / restarts (fleet total) / "
@@ -261,6 +268,7 @@ class ServingRouter:
         self.closed = False
         self._prev_sigterm = None
         self._roll: Optional[Dict[str, Any]] = None
+        self._auditor = None          # lazy InvariantAuditor (audit())
         self._shed_accum = 0       # monotonic fleet-lifetime shed total
         self._last_shed = 0        # baseline autoscale_signal() consumed
         # lifetime contributions of replicas since rebuilt/removed, so
@@ -468,8 +476,7 @@ class ServingRouter:
                 # a single supervisor gives), not a misleading
                 # "broken/circuit-broken" 503 for plain overload
                 cands = [rep for rep in self._replicas.values()
-                         if rep.breaker.allow() and not rep.retiring
-                         and not rep.draining and not rep.sup.broken]
+                         if rep.adoptable()]
             if replica is not None:
                 cands = [r for r in cands if r.rid == replica]
             if not cands:
@@ -655,7 +662,17 @@ class ServingRouter:
             self.completed += 1
             self._retire_record(req)
             return
-        for rep in self._candidates(exclude=exclude, now=now):
+        cands = self._candidates(exclude=exclude, now=now)
+        if not cands:
+            # a replica whose only problem is a FULL admission queue can
+            # still ADOPT: resubmit rides the recovery path, which
+            # bypasses the queue-depth shed (the work was accepted once,
+            # somewhere). Without this fallback, a replica killed at
+            # peak saturation (the fleet-replay regime) FAILs its
+            # in-flight requests even though healthy replicas remain.
+            cands = [rep for rep in self._replicas.values()
+                     if rep.rid not in exclude and rep.adoptable()]
+        for rep in cands:
             try:
                 srid = rep.sup.resubmit(
                     req.prompt, req.tokens,
@@ -1081,6 +1098,22 @@ class ServingRouter:
 
     # ---- telemetry -----------------------------------------------------------
 
+    def audit(self) -> Dict[str, Any]:
+        """Run the :class:`~.audit.InvariantAuditor`'s structural checks
+        against the whole fleet (production spelling: collects, never
+        raises). The auditor instance persists across calls so the
+        monotonic-counter baselines accumulate; ``health_snapshot()``
+        folds the verdict in behind ``FLAGS_serving_audit``."""
+        from .audit import InvariantAuditor
+        with self._lock:
+            if self._auditor is None:
+                # bounded history: a production auditor scraped at 1 Hz
+                # forever must not grow its trail/violation lists without
+                # bound (replay auditors stay unbounded — the
+                # determinism contract compares the full trail)
+                self._auditor = InvariantAuditor(history=256)
+            return self._auditor.audit(self)
+
     def health_snapshot(self) -> Dict[str, Any]:
         """The fleet ops payload — keys pinned to
         :data:`ROUTER_HEALTH_FIELDS` (docs/OPS.md "Serving fleet"). Shaped
@@ -1147,6 +1180,12 @@ class ServingRouter:
                     if wd is not None else False,
                     "timeout_s": wd.timeout if wd is not None else None,
                 },
+                # the production audit hook: FLAGS_serving_audit runs the
+                # InvariantAuditor fleet-wide inside this snapshot (the
+                # checks walk every block map — paid only when asked to)
+                "audit": ({"enabled": True, **self.audit()}
+                          if flag("FLAGS_serving_audit")
+                          else {"enabled": False}),
                 "supervisor": {
                     "draining": bool(self._drain_requested or self.draining),
                     "broken": bool(reps) and all(r["broken"]

@@ -314,6 +314,24 @@ class Scheduler:
             }
         return d
 
+    def by_tenant(self) -> Dict[str, Dict[str, int]]:
+        """Queued/live request counts per TENANT ROW — tenants past
+        ``MAX_TENANTS`` fold into the overflow row exactly as
+        :meth:`tenant` folded their counters at submit, so the rows
+        always close against the counter dict. The ONE folding used by
+        the engine's ``health_snapshot()`` per-tenant breakdown and the
+        InvariantAuditor's accounting-closure check."""
+        def tkey(name: str) -> str:
+            return name if name in self.tenants else self._OVERFLOW_TENANT
+
+        out = {name: {"queued": 0, "live": 0} for name in self.tenants}
+        for r in self.queue:
+            out[tkey(r.tenant)]["queued"] += 1
+        for r in self.slots:
+            if r is not None:
+                out[tkey(r.tenant)]["live"] += 1
+        return out
+
     def retry_after_s(self) -> float:
         """Suggested backoff when shedding: the mean interval between the
         most recent retirements (one retirement frees one slot, which is

@@ -653,13 +653,9 @@ class EngineSupervisor:
         return snap
 
     def block_partition(self) -> Dict[str, int]:
-        """A consistent view of the pool partition (free / evictable /
-        in-use / usable) under the engine lock — the accounting invariant
-        chaos and fuzz tests assert every step: free + evictable + in_use
-        == usable."""
-        with self._lock, self.engine._lock:
-            bm = self.engine.cache.manager
-            return {"free": len(bm._free),
-                    "evictable": len(bm._evictable),
-                    "in_use": bm.blocks_in_use,
-                    "usable": bm.num_blocks - 1}
+        """The engine's pool-partition view (free / evictable / in-use /
+        usable) taken under this supervisor's lock — the accounting
+        invariant the InvariantAuditor (audit.py) checks every step:
+        free + evictable + in_use == usable."""
+        with self._lock:
+            return self.engine.block_partition()

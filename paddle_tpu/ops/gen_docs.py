@@ -238,6 +238,91 @@ def generate(out_path: str = "docs/OPS.md") -> str:
     lines += flags_table(sorted(
         n for n in get_flags()
         if n.startswith("FLAGS_serving_router_")))
+    # fleet-scale replay + invariant audit (ISSUE 13): the auditor check
+    # table renders straight from the AUDIT_CHECKS registry and the
+    # replay runbook documents the manifest contract, so neither can
+    # drift from audit.py/workload.py
+    from paddle_tpu.inference.serving.audit import AUDIT_CHECKS
+    lines += [
+        "",
+        "## Workload replay & capacity planning "
+        "(`inference.serving.workload` / `.audit`)",
+        "",
+        "The fleet-scale proof layer: a DETERMINISTIC workload generator "
+        "(`WorkloadSpec`/`generate_trace` — diurnal/bursty arrivals, "
+        "Zipf tenants, shared-prefix prompt families, mixed greedy/"
+        "sampled knobs, priorities/deadlines, client cancels/disconnects/"
+        "abandons, and 429/503 retries that back off by the returned "
+        "`retry_after_s`), replayed through a multi-replica router by "
+        "`run_replay` under a seeded step-indexed chaos timeline "
+        "(`testing.chaos.chaos_timeline`) while the autoscaler actuates, "
+        "with the `InvariantAuditor` sampling throughout and running "
+        "exhaustively at quiesce.",
+        "",
+        "### Invariant auditor",
+        "",
+        "`InvariantAuditor` evaluates the registry below against a live "
+        "engine / supervisor / router; a failure raises a structured "
+        "`InvariantViolation` naming the CHECK, the REPLICA and the "
+        "replay MANIFEST that reproduces it. Three deployment modes: "
+        "per-step in tests (the one definition of each invariant the "
+        "test suite's fuzzes call), sampled in long replays "
+        "(`WorkloadSpec.audit_every`), and in production — "
+        "`router.audit()`, folded into `health_snapshot()` behind "
+        "`FLAGS_serving_audit` (off by default: the checks walk every "
+        "block map).",
+        "",
+        "| check | proves |",
+        "|---|---|"]
+    lines += [f"| `{k}` | {v} |" for k, v in AUDIT_CHECKS.items()]
+    lines += [
+        "",
+        "### Replay runbook",
+        "",
+        "1. Every `run_replay` emits a `ReplayManifest` (seed + spec + "
+        "chaos schedule + the resolved `ServingConfig` and "
+        "`RouterConfig` scalars + the starting replica count, plus the "
+        "`FLAGS_serving_*` values recorded for the operator's "
+        "reference — both configs resolve from them eagerly, so the "
+        "shape fields already carry the values that mattered; "
+        "`manifest_json` in the report) and stamps it into every "
+        "violation. To reproduce a fleet-scale failure bit-exactly: "
+        "`run_replay(params, cfg, "
+        "manifest=ReplayManifest.from_json(s))` — the captured engine "
+        "+ fleet shape is re-applied (pass `serving_config=` / "
+        "`router_config=` / `replicas=` to override), same per-request "
+        "token streams, same chaos firing order, same audit trail "
+        "(`retry_policy=\"fixed\"`; the `\"hint\"` policy honors the "
+        "measured wall-clock `retry_after_s`, so shed counts then track "
+        "host load).",
+        "2. Chaos timelines are STEP-indexed, never wall-clock: an event "
+        "fires at the identical point in the request stream on every "
+        "replay. `replica_kill` is skipped (and logged) when fewer than "
+        "two adoption-capable replicas remain — killing the sole "
+        "survivor proves nothing about failover.",
+        "3. The driver's clients are part of the workload: a shed submit "
+        "retries after the backoff its policy dictates, misbehaving "
+        "clients cancel/disconnect/abandon at scripted token counts, "
+        "and client-side step deadlines cancel overdue work.",
+        "4. The report's acceptance surface: `violations == []`, "
+        "`failed == 0` (no request stranded without a replica), "
+        "`leaked_blocks == 0` on every replica at quiesce, autoscale "
+        "`spawns`/`drains` >= 1 each with the measured arrival-TTFT "
+        "p99 effect vs the fixed-fleet counterfactual "
+        "(`bench --serve`'s replay row asserts all of it).",
+        "",
+        "### Capacity report",
+        "",
+        "`capacity_report` (emitted with every replay, standalone "
+        "callable) combines the `paged_pool_block_bytes` arithmetic — "
+        "per-chip block cost and concurrent sequences across fp/int8 x "
+        "TP degree at an HBM budget — with the replay's measured "
+        "curves: req/s, TTFT/TPOT p50/p99, `goodput_tok_s_per_chip` "
+        "(SLO-met tokens per second per chip — the "
+        "`serving_replay_goodput` bench metric), and the sizing line "
+        "(\"X replicas of config Y serve Z req/s within SLO\") plus "
+        "`replicas_for_<N>_req_s` projections.",
+    ]
     lines += ["",
               "## Op table",
               "",

@@ -74,7 +74,8 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "dead_worker", "stalled_consumer", "poison_prompt",
            "flood_tenant", "engine_crash", "disconnect_mid_stream",
            "slow_client", "replica_kill", "slow_replica", "flaky_probe",
-           "INJECTORS"]
+           "ChaosEvent", "ChaosTimeline", "chaos_timeline",
+           "TIMELINE_INJECTORS", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -530,6 +531,110 @@ def flaky_probe(target, rid=None, fails: int = 3,
 
     sup.health_snapshot = shim
     return state
+
+
+# ---------------------------------------------------------------------------
+# chaos timeline (fleet-scale replay; ISSUE 13)
+# ---------------------------------------------------------------------------
+
+class ChaosEvent:
+    """One scheduled injector firing: ``step`` (the replay driver's
+    engine-step index — NOT wall-clock, so two replays of one seed fire
+    in the identical order), the injector ``name`` (a serving entry of
+    :data:`INJECTORS`, or ``"disconnect_mid_stream"`` which the replay
+    driver applies at the client layer), and its ``kwargs``."""
+
+    __slots__ = ("step", "name", "kwargs")
+
+    def __init__(self, step: int, name: str, **kwargs):
+        self.step = int(step)
+        self.name = str(name)
+        self.kwargs = kwargs
+
+    def __repr__(self):
+        kw = "".join(f", {k}={v!r}" for k, v in sorted(self.kwargs.items()))
+        return f"ChaosEvent({self.step}, {self.name!r}{kw})"
+
+
+class ChaosTimeline:
+    """A seeded, step-indexed schedule of serving-injector firings — the
+    chaos half of a replay manifest (docs/FAULT_TOLERANCE.md "Chaos
+    timelines"). Events are plain ``(step, injector, kwargs)`` triples
+    sorted by step; :meth:`due` pops the ones whose step has arrived and
+    the DRIVER (:func:`inference.serving.workload.run_replay`) interprets
+    them against the live fleet, logging each firing into the replay's
+    deterministic chaos log. Because steps (not timestamps) key the
+    schedule, two replays of one manifest fire every event at the
+    identical point in the request stream."""
+
+    def __init__(self, events):
+        self.events = sorted(events, key=lambda e: (e.step, e.name))
+        self._cursor = 0
+        self.fired: list = []     # (step, name, detail) — the chaos log
+
+    def due(self, step: int) -> list:
+        """Events scheduled at or before ``step`` that have not fired."""
+        out = []
+        while self._cursor < len(self.events) and \
+                self.events[self._cursor].step <= step:
+            out.append(self.events[self._cursor])
+            self._cursor += 1
+        return out
+
+    @property
+    def remaining(self) -> int:
+        return len(self.events) - self._cursor
+
+    def log(self, step: int, name: str, detail) -> None:
+        self.fired.append((int(step), str(name), detail))
+
+    def spec(self) -> list:
+        """JSON-serializable schedule (the manifest's ``chaos`` field):
+        ``[[step, name, kwargs], ...]``."""
+        return [[e.step, e.name, dict(e.kwargs)] for e in self.events]
+
+    @classmethod
+    def from_spec(cls, spec) -> "ChaosTimeline":
+        return cls([ChaosEvent(s, n, **kw) for s, n, kw in spec])
+
+
+# serving injectors a timeline may schedule (the replay driver knows how
+# to aim each one at a live router/fleet; disconnect_mid_stream is applied
+# at the client layer — cancel a live stream mid-flight)
+TIMELINE_INJECTORS = ("replica_kill", "slow_replica", "flood_tenant",
+                      "poison_prompt", "disconnect_mid_stream",
+                      "flaky_probe")
+
+
+def chaos_timeline(seed: int, horizon_steps: int,
+                   kinds=TIMELINE_INJECTORS, events: int = 6,
+                   start_frac: float = 0.1,
+                   end_frac: float = 0.75) -> ChaosTimeline:
+    """Build a seeded chaos schedule for a replay: ``events`` firings
+    drawn round-robin over ``kinds`` (every kind fires at least once when
+    ``events >= len(kinds)``), at seeded steps inside ``[start_frac,
+    end_frac)`` of the horizon — early enough that recovery happens under
+    traffic, late enough that the fleet has work in flight. Pure function
+    of its arguments: the schedule IS replayable."""
+    rng = random.Random(int(seed))
+    lo = max(1, int(horizon_steps * start_frac))
+    hi = max(lo + 1, int(horizon_steps * end_frac))
+    out = []
+    for i in range(int(events)):
+        name = kinds[i % len(kinds)]
+        step = rng.randrange(lo, hi)
+        kw = {}
+        if name == "slow_replica":
+            kw = {"stall_steps": rng.randrange(2, 5), "delay_s": 0.001}
+        elif name == "flood_tenant":
+            kw = {"n": rng.randrange(8, 17), "seed": rng.randrange(1000)}
+        elif name == "poison_prompt":
+            kw = {"mode": rng.choice(["oov", "neg"]),
+                  "seed": rng.randrange(1000)}
+        elif name == "flaky_probe":
+            kw = {"fails": rng.randrange(2, 5)}
+        out.append(ChaosEvent(step, name, **kw))
+    return ChaosTimeline(out)
 
 
 # name -> injector; docs/FAULT_TOLERANCE.md's generated injector count

@@ -71,16 +71,18 @@ def mk_router(setup, replicas=2, router_config=None, **sc_kw):
         programs=programs if share else None)
 
 
-def assert_partitions(router):
-    for rid, part in router.block_partitions().items():
-        assert part["free"] + part["evictable"] + part["in_use"] == \
-            part["usable"], (rid, part)
+def assert_partitions(router, auditor=None):
+    """ONE definition of the fleet invariants (ISSUE 13 satellite): the
+    shared InvariantAuditor replaces the hand-rolled partition sum —
+    a violation raises a named InvariantViolation."""
+    from paddle_tpu.inference.serving import InvariantAuditor
+    (auditor if auditor is not None else InvariantAuditor()).check(router)
 
 
-def assert_balanced(router):
+def assert_balanced(router, auditor=None):
+    assert_partitions(router, auditor)
     for rid, part in router.block_partitions().items():
         assert part["in_use"] == 0, (rid, part)
-    assert_partitions(router)
 
 
 # ---------------------------------------------------------------------------
@@ -614,12 +616,17 @@ class TestFailoverFuzz:
         surviving replica after EVERY step, no duplicate delivered
         tokens, and survivor outputs bit-exact vs the single-replica
         oracle."""
+        from paddle_tpu.inference.serving import InvariantAuditor
         cfg, params, prompts, _ = setup
         rng = np.random.default_rng(100 + trial)
         # undersized pool + chunked prefill: preemption and mid-prefill
         # states occur naturally; long prompts exercise the chunk path
         r = mk_router(setup, replicas=2, num_blocks=10, prefill_chunk=4,
                       queue_depth=16)
+        # ONE auditor across the whole trial: its exactly-once ledger
+        # (observe) catches a duplicate/gap the moment it is delivered,
+        # and its counter baselines span the fault
+        auditor = InvariantAuditor()
         long_prompt = rng.integers(0, 97, (14,)).astype(np.int32)
         reqs = {}
         for i in range(6):
@@ -629,9 +636,11 @@ class TestFailoverFuzz:
             reqs[frid] = (p, n, [])
         # walk to a random lifecycle point, then inject a random fault
         for _ in range(int(rng.integers(0, 6))):
-            for f, toks in r.step(1).items():
+            out = r.step(1)
+            auditor.observe(out, lookup=r._reqs.get)
+            for f, toks in out.items():
                 reqs[f][2].extend(toks)
-            assert_partitions(r)
+            assert_partitions(r, auditor)
         fault = ["kill", "slow", "flaky", "roll"][int(rng.integers(0, 4))]
         victim = r.replicas[int(rng.integers(0, 2))]
         if fault == "kill":
@@ -649,9 +658,11 @@ class TestFailoverFuzz:
         reqs[frid] = (prompts[0], 3, [])
         steps = 0
         while (r.pending or r.rolling) and steps < 600:
-            for f, toks in r.step(1).items():
+            out = r.step(1)
+            auditor.observe(out, lookup=r._reqs.get)
+            for f, toks in out.items():
                 reqs[f][2].extend(toks)
-            assert_partitions(r)
+            assert_partitions(r, auditor)
             steps += 1
         assert steps < 600
         snap = r.health_snapshot()
@@ -664,7 +675,8 @@ class TestFailoverFuzz:
                 np.asarray(delivered, np.int32), oracle,
                 err_msg=f"frid {f} fault {fault} (dup or gap)")
             np.testing.assert_array_equal(r.result(f), oracle)
-        assert_balanced(r)
+        auditor.quiesce(r)
+        assert_balanced(r, auditor)
         # the trace genuinely exercised paging machinery at least once
         # across trials; per-trial we only require accounting to balance
         del preempted

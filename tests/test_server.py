@@ -77,9 +77,13 @@ def mk_sup(setup, programs="donor", **kw):
 
 
 def balanced(eng) -> bool:
-    bm = eng.cache.manager
-    return (bm.blocks_in_use == 0
-            and len(bm._free) + len(bm._evictable) == bm.num_blocks - 1)
+    """Auditor-backed spelling of the old hand-rolled partition sum
+    (ISSUE 13 satellite): every structural invariant holds — the shared
+    InvariantAuditor raises a named InvariantViolation otherwise — and
+    zero blocks are held."""
+    from paddle_tpu.inference.serving import InvariantAuditor
+    InvariantAuditor().check(eng)
+    return eng.block_partition()["in_use"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -709,10 +713,12 @@ class TestDisconnectFuzz:
         sup = mk_sup(setup, programs=None, max_slots=2, num_blocks=10,
                      prefill_chunk=4, queue_depth=16)
 
+        from paddle_tpu.inference.serving import InvariantAuditor
+        auditor = InvariantAuditor()
+
         async def main():
             srv = ServingServer(sup, client_queue=16)
             completed = {}
-            partitions = []
 
             async def client(i):
                 p = prompts[i % 4]
@@ -738,7 +744,11 @@ class TestDisconnectFuzz:
                 tasks = [asyncio.ensure_future(client(i))
                          for i in range(12)]
                 while not all(t.done() for t in tasks):
-                    partitions.append(sup.block_partition())
+                    # the shared auditor IS the continuous partition
+                    # check (it raises a named InvariantViolation) —
+                    # polled from the event loop while the engine
+                    # thread serves, so thread-safety rides along
+                    auditor.check(sup)
                     await asyncio.sleep(0.005)
                 await asyncio.gather(*tasks)
                 # the drain lifecycle point: open streams, then close the
@@ -749,16 +759,14 @@ class TestDisconnectFuzz:
                               for i in range(3)]
                 for s in stragglers:
                     await s.__anext__()        # start event: submitted
-                partitions.append(sup.block_partition())
+                auditor.check(sup)
                 for s in stragglers:
                     await s.aclose()           # disconnect while draining
-            partitions.append(sup.block_partition())
-            return completed, partitions
+            auditor.check(sup)
+            return completed
 
-        completed, partitions = run_async(main(), timeout=300.0)
-        for part in partitions:
-            assert part["free"] + part["evictable"] + part["in_use"] == \
-                part["usable"], part
+        completed = run_async(main(), timeout=300.0)
+        auditor.quiesce(sup)
         assert completed                   # some clients survived
         for (i, n), toks in completed.items():
             np.testing.assert_array_equal(

@@ -569,6 +569,7 @@ class TestBlockManagerAdversarial:
         """Randomized alloc/free/register/share loop: free + evictable +
         in-use must equal the usable pool at EVERY step, and releasing
         everything at the end restores full capacity."""
+        from paddle_tpu.inference.serving import InvariantAuditor
         rng = np.random.default_rng(0)
         bm = self._bm(num_blocks=17, block_size=4)   # 16 usable
         owned, next_key, keys = [], 1000, []
@@ -589,11 +590,12 @@ class TestBlockManagerAdversarial:
                 b = bm.lookup(keys[int(rng.integers(0, len(keys)))])
                 if b is not None:
                     owned.append([bm.share(b)])
-            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
-            assert total == 16, f"pool accounting leaked: {total}"
-            assert bm.free_blocks == 16 - bm.blocks_in_use
+            # the shared auditor's bare-manager checks (partition
+            # conservation + structural consistency), every step
+            InvariantAuditor.check_manager(bm)
         for grp in owned:
             bm.free(grp)
+        InvariantAuditor.check_manager(bm)
         assert bm.free_blocks == 16 and bm.blocks_in_use == 0
 
 
@@ -892,7 +894,11 @@ class TestRequestLifecycle:
     accounting and the dense oracle for the surviving requests."""
 
     def _balanced(self, eng):
-        assert eng.stats()["free_blocks"] == eng.cache.manager.num_blocks - 1
+        # the shared InvariantAuditor is the one definition of the pool
+        # invariants (ISSUE 13 satellite); a violation raises named
+        from paddle_tpu.inference.serving import InvariantAuditor
+        InvariantAuditor().check(eng)
+        assert eng.block_partition()["in_use"] == 0
 
     def test_cancel_queued_and_running(self, setup):
         cfg, params, prompts, _ = setup
@@ -1088,12 +1094,12 @@ class TestRequestLifecycle:
         of the BlockManager fuzz): after every step the pool's free +
         evictable + in-use partition must hold, and after the storm the
         engine still serves a fresh request bit-identically."""
+        from paddle_tpu.inference.serving import InvariantAuditor
         cfg, params, prompts, _ = setup
         rng = np.random.default_rng(7)
         eng = make_engine(params, cfg, max_slots=3, num_blocks=12,
                           prefill_chunk=4, queue_depth=16)
-        bm = eng.cache.manager
-        usable = bm.num_blocks - 1
+        auditor = InvariantAuditor()       # one ledger across the storm
         live_rids = []
         for i in range(60):
             op = rng.integers(0, 4)
@@ -1112,13 +1118,12 @@ class TestRequestLifecycle:
             elif op == 1 and live_rids:
                 eng.cancel(int(rng.choice(live_rids)))
             elif eng.pending:
-                eng.step()
-            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
-            assert total == usable, f"leak at iter {i}: {total}"
+                auditor.observe(eng.step(), lookup=eng._sched.find)
+            auditor.check(eng)             # partition + lifecycle +
+            #                                tenant closure, every step
         while eng.pending:
-            eng.step()
-        assert bm.blocks_in_use == 0
-        assert eng.stats()["free_blocks"] == usable
+            auditor.observe(eng.step(), lookup=eng._sched.find)
+        auditor.quiesce(eng)
         out = eng.run([prompts[0]], max_new_tokens=5, eos_token_id=None)[0]
         np.testing.assert_array_equal(
             np.asarray(out), dense_rows(params, cfg, [prompts[0]], [5])[0])
@@ -1331,8 +1336,8 @@ class TestTenantCacheQuota:
         assert bm.tenant_cached("sys") == 2        # untouched by the flood
         for i in range(2):
             assert bm.lookup(100 + i, (i,)) is not None
-        total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
-        assert total == 11                         # accounting balanced
+        from paddle_tpu.inference.serving import InvariantAuditor
+        InvariantAuditor.check_manager(bm)         # accounting balanced
 
     def test_quota_skips_when_all_entries_pinned(self):
         """At quota with every entry still referenced there is nothing of
@@ -1982,12 +1987,12 @@ class TestOnDeviceSampling:
         must hold every step with sampled and greedy requests churning
         through cancel/timeout/preemption together, and afterwards the
         engine still reproduces a seeded sampled stream exactly."""
+        from paddle_tpu.inference.serving import InvariantAuditor
         cfg, params, prompts, _ = setup
         rng = np.random.default_rng(11)
         eng = make_engine(params, cfg, max_slots=3, num_blocks=12,
                           prefill_chunk=4, queue_depth=16)
-        bm = eng.cache.manager
-        usable = bm.num_blocks - 1
+        auditor = InvariantAuditor()
         live_rids = []
         for i in range(60):
             op = rng.integers(0, 4)
@@ -2011,12 +2016,11 @@ class TestOnDeviceSampling:
             elif op == 1 and live_rids:
                 eng.cancel(int(rng.choice(live_rids)))
             elif eng.pending:
-                eng.step()
-            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
-            assert total == usable, f"leak at iter {i}: {total}"
+                auditor.observe(eng.step(), lookup=eng._sched.find)
+            auditor.check(eng)
         while eng.pending:
-            eng.step()
-        assert bm.blocks_in_use == 0
+            auditor.observe(eng.step(), lookup=eng._sched.find)
+        auditor.quiesce(eng)
         # a seeded sampled stream still reproduces after the storm
         ref = make_engine(params, cfg)
         kw = dict(max_new_tokens=6, eos_token_id=None, temperature=0.7,
@@ -2252,30 +2256,28 @@ class TestSpeculativeDecoding:
         got = tight.run(prompts, max_new_tokens=12, eos_token_id=None)
         for g, w in zip(got, want):
             np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
-        bm = tight.cache.manager
-        assert bm.blocks_in_use == 0
-        assert len(bm._free) + len(bm._evictable) == bm.num_blocks - 1
+        from paddle_tpu.inference.serving import InvariantAuditor
+        InvariantAuditor().quiesce(tight)
 
     def test_rollback_frees_rejected_tail_blocks(self, setup):
         """Step-by-step: after every engine step the free + evictable +
         in-use partition holds exactly — a verify that allocates blocks
         for its draft window and rejects the tail must hand the surplus
         back through the ref-counted free path."""
+        from paddle_tpu.inference.serving import InvariantAuditor
         cfg, params, _, _ = setup
         rng = np.random.default_rng(5)
         prompts = self._cycled_prompts(params, cfg, rng)
         eng = self._spec_engine(params, cfg, spec_decode=6)
-        bm = eng.cache.manager
-        usable = bm.num_blocks - 1
+        auditor = InvariantAuditor()
         rids = [eng.submit(p, max_new_tokens=12, eos_token_id=None)
                 for p in prompts]
         steps = 0
         while eng.pending:
-            eng.step()
+            auditor.observe(eng.step(), lookup=eng._sched.find)
             steps += 1
-            total = len(bm._free) + len(bm._evictable) + bm.blocks_in_use
-            assert total == usable, f"leak after step {steps}"
-        assert bm.blocks_in_use == 0
+            auditor.check(eng)
+        auditor.quiesce(eng)
         assert eng.stats()["spec_steps"] >= 1
         for r in rids:
             assert len(eng.request(r).tokens) == 12
