@@ -959,7 +959,22 @@ def bench_serve(backend):
     step-indexed arrival->first-token p99 and makespan (the measured
     autoscale effect; deterministic, so assertable). Emits
     serving_replay_goodput (SLO-met tokens/s per chip) plus the
-    capacity-planning sizing line."""
+    capacity-planning sizing line.
+
+    Two ISSUE 16 rows: a KV TIERING row (a prefix-family re-visit trace
+    through a device pool sized well below the families' combined
+    working set — with the host-RAM offload tier ON, churn-evicted
+    prefix chains swap to bounded host memory and the re-visit wave
+    readmits them H2D as prefix hits with zero recompute; with the tier
+    OFF the same wave re-prefills from scratch; bit parity both ways is
+    asserted and the re-visit TTFT ratio off/on is the
+    serving_tier_hit_ttft_ratio metric) and a MIGRATION row (a scale-in
+    drain through a 2-replica router with live KV migration ON: every
+    in-flight request on the drained replica must move — block chains +
+    resolved decode state — to the survivor and finish bit-identically
+    with recomputed_tokens == 0 and zero leaks; the prefill+decode
+    tokens that did NOT have to be recomputed are the
+    serving_migration_recompute_saved metric)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.inference.serving import ServingConfig, ServingEngine
@@ -1619,6 +1634,130 @@ def bench_serve(backend):
         (rp["steps"], rp_fixed["steps"])
     assert rp["capacity"]["sizing"], "capacity report missing"
 
+    # ---- KV tiering row: host-RAM offload tier (ISSUE 16) ---------------
+    # prefix-family re-visit trace through an UNDERSIZED device pool: the
+    # families' combined working set overflows HBM, so serving them in
+    # sequence churns the early families' chains out. Tier ON: refcount-0
+    # evictions swap to bounded host RAM, and the re-visit wave readmits
+    # the evicted chains H2D (prefix hits — checksummed, so a corrupt
+    # host block degrades to a MISS, never wrong KV). Tier OFF: the same
+    # re-visit re-prefills from scratch. Both engines use chunked prefill
+    # so the restore path and the recompute path share one executable —
+    # the TTFT ratio measures data movement vs prefill FLOPs, not a
+    # compile. Parity + the swap counters are the row's proof; the
+    # wall-clock ratio is the emitted metric.
+    if backend == "tpu":
+        tr_fam, tr_per, tr_pre, tr_tail, tr_out = 4, 3, 64, 16, 8
+    else:
+        tr_fam, tr_per, tr_pre, tr_tail, tr_out = 4, 2, 48, 8, 4
+    tr_slots, tr_blocks, tr_host = 2, 24, 64
+    tr_prefixes = [rng.integers(0, cfg.vocab_size,
+                                (tr_pre,)).astype(np.int32)
+                   for _ in range(tr_fam)]
+    tr_prompts = [np.concatenate(
+        [pre, rng.integers(0, cfg.vocab_size, (tr_tail,)).astype(np.int32)])
+        for pre in tr_prefixes for _ in range(tr_per)]
+    # re-visit the FIRST two families — by the end of the churn wave the
+    # LRU eviction order guarantees their chains have left the device
+    tr_wave2 = tr_prompts[:2 * tr_per]
+    tr_oracle = np.asarray(G.generate(params, jnp.asarray(
+        np.stack(tr_wave2)), cfg, max_new_tokens=tr_out))
+
+    def run_tier(on):
+        eng = ServingEngine(params, cfg, ServingConfig(
+            block_size=blk, max_slots=tr_slots, max_model_len=pre_mlen,
+            decode_chunk=chunk, queue_depth=len(tr_prompts),
+            prefix_cache=True, num_blocks=tr_blocks,
+            offload=on, offload_blocks=tr_host))
+        eng.run(tr_prompts, max_new_tokens=tr_out,
+                eos_token_id=None)                  # churn wave (+ compile)
+        # warm the HIT path too: a prefix hit leaves a short residual
+        # prefill that takes the chunked-prefill program — untimed here so
+        # wave-2 TTFT measures steady-state restore, not a one-off compile
+        eng.run([np.concatenate([tr_prefixes[-1], rng.integers(
+            0, cfg.vocab_size, (tr_tail,)).astype(np.int32)])],
+            max_new_tokens=tr_out, eos_token_id=None)
+        st1 = eng.stats()
+        t0 = time.time()
+        rids = [eng.submit(p, max_new_tokens=tr_out, eos_token_id=None)
+                for p in tr_wave2]
+        while eng.pending:
+            eng.step()
+        elapsed = time.time() - t0
+        reqs = [eng.request(r) for r in rids]
+        st2 = eng.stats()
+        hit_delta = st2["prefix_hit_tokens"] - st1["prefix_hit_tokens"]
+        ttft = float(np.mean([r.ttft_s for r in reqs]))
+        return eng, reqs, hit_delta, ttft, elapsed, st2
+
+    eng_tr, tr_reqs, tr_hits_on, tr_ttft_on, tr_s_on, tr_st = run_tier(True)
+    _, tr_reqs_off, tr_hits_off, tr_ttft_off, _, tr_st_off = run_tier(False)
+    tr_match = all(np.array_equal(np.asarray(r.output()), tr_oracle[i])
+                   for i, r in enumerate(tr_reqs)) and \
+        all(np.array_equal(np.asarray(r.output()), tr_oracle[i])
+            for i, r in enumerate(tr_reqs_off))
+    tr_off = tr_st["offload"]
+    assert tr_match, "tiering-row outputs diverged from the dense oracle"
+    assert tr_off["swap_outs"] > 0, \
+        "tiering row evicted nothing to the host tier"
+    assert tr_off["swap_ins"] > 0 and tr_off["tier_hits"] > 0, \
+        "re-visit wave never readmitted a host block"
+    assert tr_off["corrupt_drops"] == 0, tr_off
+    assert tr_st["recomputed_tokens"] == 0, \
+        "tiering row preempted — pool too small for the slot count"
+    assert tr_hits_on > tr_hits_off, \
+        f"tier restored no extra prefix hits ({tr_hits_on} vs " \
+        f"{tr_hits_off} without the tier)"
+
+    # ---- migration row: scale-in drain with live KV migration (ISSUE 16)
+    # the same shape signature as the overload engines -> shared compiled
+    # programs, zero new compiles. One replica of a loaded 2-replica
+    # fleet is drained for scale-in with migration ON: its in-flight
+    # requests move (block chains + resolved decode state) to the
+    # survivor and finish there bit-identically, with zero recompute,
+    # zero failures and zero leaked blocks on every replica. The
+    # prefill+decode tokens the survivor did NOT re-run — prompt plus
+    # generated prefix per migrated request — are the recompute-saved
+    # metric (under the PR 9 resubmit fallback all of it would re-run).
+    from paddle_tpu.inference.serving import RouterConfig
+    if backend == "tpu":
+        mg_n, mg_out = 8, 24
+    else:
+        mg_n, mg_out = 4, 16
+    mg_prompts = [rng.integers(0, cfg.vocab_size,
+                               (ov_plen,)).astype(np.int32)
+                  for _ in range(mg_n)]
+    mg_oracle = np.asarray(G.generate(params, jnp.asarray(
+        np.stack(mg_prompts)), cfg, max_new_tokens=mg_out))
+    mg_router = ServingRouter(params, cfg, ServingConfig(
+        block_size=blk, max_slots=ov_slots, max_model_len=mlen,
+        decode_chunk=chunk, queue_depth=mg_n, prefix_cache=None),
+        router_config=RouterConfig(replicas=2, migrate=True),
+        programs=eng_ov.programs)
+    mg_frids = [mg_router.submit(p, max_new_tokens=mg_out,
+                                 eos_token_id=None) for p in mg_prompts]
+    mg_router.step(1)                     # requests genuinely mid-flight
+    mg_router.drain_replica(mg_router.replicas[0])
+    while mg_router.pending:
+        mg_router.step(1)
+    mg_match = all(np.array_equal(mg_router.result(f), mg_oracle[i])
+                   for i, f in enumerate(mg_frids))
+    mg_snap = mg_router.health_snapshot()
+    mg_recomputed = sum(rep.sup.engine.stats()["recomputed_tokens"]
+                        for rep in mg_router._replicas.values())
+    mg_leaked = sum(p["in_use"]
+                    for p in mg_router.block_partitions().values())
+    # every migrated request carries its prompt prefill + generated
+    # prefix with it; the resubmit fallback recomputes all of it
+    mg_saved = mg_router.migration_tokens + mg_router.migrations * ov_plen
+    assert mg_match, "migrated streams diverged from the dense oracle"
+    assert mg_router.migrations >= 1, \
+        "scale-in drain finished without migrating anything"
+    assert mg_snap["counters"]["failed"] == 0, mg_snap["counters"]
+    assert mg_recomputed == 0, \
+        f"migration recomputed {mg_recomputed} tokens"
+    assert mg_leaked == 0, f"migration row leaked {mg_leaked} blocks"
+
     return {
         "serving_tok_s": round(serving_tok_s, 1),
         "static_tok_s": round(static_tok_s, 1),
@@ -1781,6 +1920,37 @@ def bench_serve(backend):
         "replay_goodput_tok_s_per_chip": rp["goodput_tok_s_per_chip"],
         "replay_capacity_sizing": rp["capacity"]["sizing"],
         "replay_manifest_crc": rp["manifest"].tag.split("crc=")[-1],
+        # KV tiering row (ISSUE 16): host-RAM offload tier under an
+        # undersized device pool — parity, swap counters, zero recompute
+        # and the extra prefix hits are asserted in-section; the re-visit
+        # TTFT ratio (off/on) is the serving_tier_hit_ttft_ratio metric
+        "tier_outputs_match": bool(tr_match),
+        "tier_hit_ttft_ratio": round(tr_ttft_off / max(tr_ttft_on, 1e-9),
+                                     3),
+        "tier_ttft_on_ms": round(tr_ttft_on * 1e3, 2),
+        "tier_ttft_off_ms": round(tr_ttft_off * 1e3, 2),
+        "tier_revisit_s": round(tr_s_on, 3),
+        "tier_swap_outs": tr_off["swap_outs"],
+        "tier_swap_ins": tr_off["swap_ins"],
+        "tier_hits": tr_off["tier_hits"],
+        "tier_misses": tr_off["tier_misses"],
+        "tier_corrupt_drops": tr_off["corrupt_drops"],
+        "tier_host_blocks": tr_off["blocks"],
+        "tier_host_capacity": tr_off["capacity"],
+        "tier_prefix_hit_tokens": int(tr_hits_on),
+        "tier_off_prefix_hit_tokens": int(tr_hits_off),
+        "tier_recomputed_tokens": tr_st["recomputed_tokens"],
+        # migration row (ISSUE 16): scale-in drain with live KV migration
+        # — parity, migrations >= 1, zero failed/recompute/leaks asserted
+        # in-section; recompute-saved is the tracked metric
+        "migration_outputs_match": bool(mg_match),
+        "migrations": int(mg_router.migrations),
+        "migration_tokens": int(mg_router.migration_tokens),
+        "migration_fallbacks": int(mg_router.migration_fallbacks),
+        "migration_recompute_saved": int(mg_saved),
+        "migration_failed": mg_snap["counters"]["failed"],
+        "migration_recomputed_tokens": int(mg_recomputed),
+        "migration_leaked_blocks": int(mg_leaked),
     }
 
 
@@ -1878,6 +2048,22 @@ _R2_ANCHORS = {
     # measured p99 effect vs the fixed-fleet counterfactual — are
     # asserted in-section). Anchored at the CPU measurement.
     "serving_replay_goodput": 19.0,    # tok/s/chip observed on CPU
+    # KV tiering row (ISSUE 16): re-visit TTFT with the host offload
+    # tier OFF over ON — re-visit TTFT with the tier off (full re-prefill)
+    # over tier on (H2D restore + residual prefill). On CPU the bench
+    # model is so small that ONE fused re-prefill dispatch beats ~12
+    # per-block restore dispatches, so the steady-state CPU ratio sits
+    # well below 1; it is tracked because dispatch-path regressions (e.g.
+    # per-block-index recompiles) tank it by an order of magnitude. The
+    # >= 1.0 payoff claim belongs to real accelerators + real model
+    # sizes, where re-prefill costs FLOPs the restore doesn't. The row's
+    # hard proofs — parity, swap counters, zero recompute, extra prefix
+    # hits — are asserted in-section.
+    "serving_tier_hit_ttft_ratio": 0.2,  # observed CPU steady state
+    # migration row (ISSUE 16): prefill+decode tokens a scale-in drain
+    # did NOT recompute because live KV migration moved the chains
+    # instead of resubmitting — anchored at the CPU measurement
+    "serving_migration_recompute_saved": 28.0,  # tok observed on CPU
 }
 
 
@@ -1986,12 +2172,12 @@ def main():
                   "wide": 40.0, "attn": 30.0,
                   "sdxl": 25.0, "decode": 45.0, "tuned": 35.0, "int8": 45.0,
                   "detect": 150.0, "checkpoint": 30.0,
-                  "input": 20.0, "health": 45.0, "serve": 230.0} if _warm else
+                  "input": 20.0, "health": 45.0, "serve": 260.0} if _warm else
                  {"bert": 280.0, "resnet": 260.0, "resnet_nhwc": 260.0,
                   "wide": 90.0, "attn": 60.0,
                   "sdxl": 45.0, "decode": 90.0, "tuned": 60.0,
                   "int8": 90.0, "detect": 240.0, "checkpoint": 50.0,
-                  "input": 30.0, "health": 90.0, "serve": 370.0})
+                  "input": 30.0, "health": 90.0, "serve": 410.0})
     print(json.dumps({"compile_cache": "warm" if _warm else "cold"}),
           file=sys.stderr)
 
@@ -2303,6 +2489,18 @@ def main():
                   s["replay_goodput_tok_s_per_chip"], "tok/s/chip",
                   s["replay_goodput_tok_s_per_chip"] /
                   _R2_ANCHORS["serving_replay_goodput"])
+            # tiering + migration rows (ISSUE 16): the hard proofs —
+            # parity, swap counters, zero recompute, migrations >= 1,
+            # zero failed/leaked — are asserted inside bench_serve; the
+            # two metrics are the tracked numbers
+            _emit("serving_tier_hit_ttft_ratio",
+                  s["tier_hit_ttft_ratio"], "x",
+                  s["tier_hit_ttft_ratio"] /
+                  _R2_ANCHORS["serving_tier_hit_ttft_ratio"])
+            _emit("serving_migration_recompute_saved",
+                  s["migration_recompute_saved"], "tok",
+                  s["migration_recompute_saved"] /
+                  _R2_ANCHORS["serving_migration_recompute_saved"])
             if s["tp_supported"]:
                 _emit("serving_tp_capacity_ratio", s["tp_capacity_ratio"],
                       "x", s["tp_capacity_ratio"] /

@@ -353,6 +353,36 @@ define_flag("FLAGS_serving_tp", 1,
             "visible devices. 1 (the default) is the single-device "
             "engine, byte-for-byte today's code path.", int)
 
+# KV tiering & migration (ISSUE 16): host-RAM offload tier + live
+# cross-replica block migration — docs/SERVING.md "KV tiering & migration"
+define_flag("FLAGS_serving_offload", False,
+            "Host-RAM KV offload tier (ServingConfig.offload): refcount-0 "
+            "evictable blocks (including a preemption victim's registered "
+            "blocks) swap to a bounded host-side pool instead of dying "
+            "when device pressure evicts them — a later prefix hit or "
+            "victim readmission H2D-restores the chain with zero "
+            "recompute. Write-time checksums make a corrupt host block "
+            "degrade to a cache MISS (recompute), never to wrong KV; the "
+            "lookup() verification contract extends to the tier. Off by "
+            "default: the tier costs host RAM and D2H bandwidth.", bool)
+define_flag("FLAGS_serving_offload_blocks", 256,
+            "Host-tier capacity bound in KV blocks "
+            "(ServingConfig.offload_blocks): the offload pool holds at "
+            "most this many swapped-out blocks, LRU-evicting beyond it "
+            "(an evicted host block falls back to the recompute path "
+            "bit-exactly). int8-quantized blocks are ~3.5x cheaper per "
+            "block, so the same bound holds ~3.5x the cached tokens.", int)
+define_flag("FLAGS_serving_migrate", False,
+            "Live KV migration (RouterConfig.migrate): graceful drain, "
+            "rolling restart, and scale-in transfer each in-flight "
+            "request's KV block chain + resolved record to an adoptive "
+            "replica (same shared-programs fleet, shapes always agree) "
+            "instead of resubmitting for recompute — recomputed_tokens "
+            "== 0 across a clean roll, token streams bit-identical. "
+            "Falls back automatically to the resubmit path when the "
+            "target can't take the blocks (pool-full, mid-crash, "
+            "TP-shape mismatch). Off by default.", bool)
+
 # serving front line (ISSUE 7): asyncio server + engine supervisor
 define_flag("FLAGS_serving_max_restarts", 3,
             "EngineSupervisor restart budget: unexpected step-loop "

@@ -60,6 +60,14 @@ AUDIT_CHECKS = {
                          "bijection, the null block is never owned, and "
                          "every live slot's block table points only at "
                          "blocks its request actually holds",
+    "tier_partition": "host-tier conservation (ISSUE 16): a cached block "
+                      "key is device-resident XOR host-resident (the "
+                      "offload tier never shadows a registered key), the "
+                      "tier never holds more blocks than its capacity "
+                      "bound, every host entry carries exactly one "
+                      "block's tokens with a checksum per pool leaf, and "
+                      "the tier's swap/hit/drop counters never go "
+                      "backwards (vacuously true with the tier off)",
     "quiesce_leaks": "zero leaked blocks at quiesce: a replica with no "
                      "queued or live work holds zero pool blocks "
                      "(vacuous mid-trace, enforced whenever a replica "
@@ -85,6 +93,14 @@ AUDIT_CHECKS = {
                     "or past max_new_tokens, and the delivered ledger "
                     "matches the authoritative record — across "
                     "preemption, crash resubmit, failover and hedges",
+    "migration_exactly_once": "live KV migration exactly-once (ISSUE "
+                              "16): for every primary route, the "
+                              "router's delivered-token mirror is a "
+                              "PREFIX of the serving replica's "
+                              "authoritative record — an adopted "
+                              "request resumed exactly where the origin "
+                              "paused it, repeating no delivered token "
+                              "and skipping none",
     "router_routes": "router bookkeeping: every (replica, srid) route "
                      "points at a live replica and a known request, and "
                      "the active set holds exactly the non-terminal "
@@ -306,6 +322,7 @@ class InvariantAuditor:
                         self._counter_floor(
                             f"replica {rid}", rep.sup,
                             ("restarts", "resubmitted", "adopted",
+                             "migrated_in", "migrated_out",
                              "completed"), fail)
                         self._counter_floor(
                             f"replica {rid}", rep.breaker,
@@ -314,8 +331,9 @@ class InvariantAuditor:
             elif hasattr(target, "engine") \
                     and "counters_monotonic" in self.checks:
                 self._counter_floor("replica", target,
-                                    ("restarts", "resubmitted",
-                                     "adopted", "completed"), fail)
+                                    ("restarts", "resubmitted", "adopted",
+                                     "migrated_in", "migrated_out",
+                                     "completed"), fail)
         # prune baselines whose owner is gone (a drained/rebuilt
         # replica's supervisor, breaker, scheduler): a persistent
         # production auditor over an autoscaling fleet must not
@@ -401,6 +419,9 @@ class InvariantAuditor:
                              f"slot {req.slot} table maps foreign "
                              f"blocks {sorted(extra)} (request "
                              f"{req.rid} owns {req.blocks})", label)
+        tier = getattr(eng.cache, "offload", None)
+        if on("tier_partition") and tier is not None:
+            self._check_tier(label, bm, tier, fail)
         if on("quiesce_leaks") and not sched.pending \
                 and bm.blocks_in_use != 0:
             fail("quiesce_leaks",
@@ -417,6 +438,45 @@ class InvariantAuditor:
                  "preemptions", "oom_truncated", "prefix_hit_tokens",
                  "recomputed_tokens", "spec_drafted", "spec_accepted"),
                 fail)
+            if tier is not None:
+                self._counter_floor(
+                    label, tier,
+                    ("swap_outs", "swap_ins", "tier_hits", "tier_misses",
+                     "corrupt_drops", "tier_evictions"), fail)
+
+    @staticmethod
+    def _check_tier(label: str, bm, tier, fail) -> None:
+        """The host-tier half of the conservation story (ISSUE 16): the
+        tier stays inside its bound, holds only well-formed single-block
+        entries, and never shadows a device-registered key — residency is
+        device XOR host, so a prefix hit has exactly one authoritative
+        source."""
+        if tier.blocks > tier.capacity:
+            fail("tier_partition",
+                 f"host tier holds {tier.blocks} block(s) past its "
+                 f"capacity bound {tier.capacity}", label)
+        shadowed = set(bm._hash2block) & set(tier.keys())
+        if shadowed:
+            fail("tier_partition",
+                 f"key(s) {sorted(shadowed)[:4]} resident on device AND "
+                 f"in the host tier (residency must be XOR)", label)
+        for key, e in tier._entries.items():
+            if len(e["tokens"]) != tier.block_size:
+                fail("tier_partition",
+                     f"host entry {key} holds {len(e['tokens'])} tokens "
+                     f"(exactly block_size={tier.block_size} expected)",
+                     label)
+            if set(e["crc"]) != set(e["data"]):
+                fail("tier_partition",
+                     f"host entry {key} checksum leaves "
+                     f"{sorted(e['crc'])} != data leaves "
+                     f"{sorted(e['data'])}", label)
+        for key, (toks, _) in tier._pending.items():
+            if len(toks) != tier.block_size:
+                fail("tier_partition",
+                     f"pending host entry {key} holds {len(toks)} tokens "
+                     f"(exactly block_size={tier.block_size} expected)",
+                     label)
 
     @staticmethod
     def _check_manager(bm, fail, parts: bool = True,
@@ -594,12 +654,38 @@ class InvariantAuditor:
                          f"router request {frid} holds "
                          f"{len(req.tokens)} tokens past its "
                          f"{req.max_new_tokens} budget")
+        if on("migration_exactly_once"):
+            for rid, routes in router._routes.items():
+                rep = router._replicas.get(rid)
+                if rep is None:
+                    continue
+                for srid, frid in routes.items():
+                    req = router._reqs.get(frid)
+                    if req is None or req.terminal:
+                        continue
+                    if (req.replica, req.srid) != (rid, srid):
+                        continue       # hedge copy: mirrors the primary
+                    rec = rep.sup._reqs.get(srid)
+                    if rec is None:
+                        continue
+                    have = [int(t) for t in rec.tokens]
+                    mirror = [int(t) for t in req.tokens]
+                    if have[:len(mirror)] != mirror:
+                        fail("migration_exactly_once",
+                             f"request {frid} on replica {rid}: the "
+                             f"router's delivered mirror ({len(mirror)} "
+                             f"tokens, crc {_crc(mirror)}) is not a "
+                             f"prefix of the replica record "
+                             f"({len(have)} tokens, crc {_crc(have)}) — "
+                             f"a migration/failover repeated or skipped "
+                             f"a delivered token")
         if on("counters_monotonic"):
             self._counter_floor(
                 "router", router,
                 ("routed", "sticky_hits", "failovers", "failover_tokens",
                  "hedges", "hedge_wins", "hedges_cancelled",
                  "probe_failures", "replica_restarts", "rolls_completed",
+                 "migrations", "migration_tokens", "migration_fallbacks",
                  "completed", "failed", "_shed_accum", "_opens_retired",
                  "_restarts_retired"), fail)
 

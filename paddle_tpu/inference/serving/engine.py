@@ -100,7 +100,7 @@ from .policies import resolve_policy
 from .scheduler import (CANCELLED, DEFAULT_TENANT, SHED,  # noqa: F401
                         TIMED_OUT, Request, Scheduler, ServingQueueFull)
 
-__all__ = ["ServingConfig", "ServingEngine", "EnginePrograms",
+__all__ = ["AdoptError", "ServingConfig", "ServingEngine", "EnginePrograms",
            "HEALTH_SNAPSHOT_FIELDS", "SUPERVISOR_SNAPSHOT_KEYS"]
 
 _UNSET = "unset"
@@ -160,6 +160,12 @@ HEALTH_SNAPSHOT_FIELDS = {
     "counters": "lifetime totals: admitted / retired / cancelled / "
                 "timed_out / shed / preemptions / oom_truncated / "
                 "prefix_hit_tokens / evictions",
+    "offload": "host-RAM KV offload tier (FLAGS_serving_offload; ISSUE "
+               "16): enabled + the tier's capacity / blocks (host-"
+               "resident now) / swap_outs / swap_ins / tier_hits / "
+               "tier_misses / corrupt_drops (checksum or token-mismatch "
+               "entries dropped — degraded to a MISS, never attended) / "
+               "tier_evictions; all zeros with the tier off",
     "watchdog": "global hang-watchdog state: installed / fired / "
                 "timeout_s",
     "tenants": "per-tenant breakdown: queued / live / submitted / "
@@ -170,8 +176,10 @@ HEALTH_SNAPSHOT_FIELDS = {
     "supervisor": "EngineSupervisor layer (supervisor snapshots only): "
                   "restarts / restart_budget / broken / draining / "
                   "accepting / resubmitted / recovered_tokens / adopted "
-                  "(requests failed over FROM another replica) / completed "
-                  "/ crashes (most recent restart reasons)",
+                  "(requests failed over FROM another replica) / "
+                  "migrated_in + migrated_out (live KV migrations adopted "
+                  "here / released here; ISSUE 16) / completed / crashes "
+                  "(most recent restart reasons)",
     "autoscale": "autoscale_signal() record (supervisor snapshots only): "
                  "action (scale_up/scale_in/hold) + reason + "
                  "queue_pressure / utilization / shed_delta — the "
@@ -182,6 +190,13 @@ HEALTH_SNAPSHOT_FIELDS = {
 # snapshot fields only the EngineSupervisor adds; the engine-level payload
 # is HEALTH_SNAPSHOT_FIELDS minus these (the shape test pins both layers)
 SUPERVISOR_SNAPSHOT_KEYS = ("supervisor", "autoscale")
+
+
+class AdoptError(RuntimeError):
+    """A migration target refused a serialized request (pool full, no free
+    slot, KV-layout/TP-shape mismatch, over-long chain). The caller falls
+    back to the resubmit path — recompute instead of transfer, outputs
+    still bit-identical."""
 
 
 @dataclasses.dataclass
@@ -265,6 +280,12 @@ class ServingConfig:
     #                                  policy (default fifo)
     tenant_cache_quota: Any = _UNSET  # max prefix-cache blocks one tenant
     #                                   may keep registered; None/0 = off
+    # host-RAM KV offload tier (ISSUE 16)
+    offload: Any = _UNSET            # bool; evicted registered blocks swap
+    #                                  to a bounded host pool instead of
+    #                                  dying; unset -> FLAGS_serving_offload
+    offload_blocks: Any = _UNSET     # host-tier capacity bound in blocks;
+    #                                  unset -> FLAGS_serving_offload_blocks
 
     def __post_init__(self):
         for f, name in (("block_size", "FLAGS_serving_block_size"),
@@ -311,6 +332,14 @@ class ServingConfig:
                 flag("FLAGS_serving_tenant_cache_quota"))
         self.tenant_cache_quota = (int(self.tenant_cache_quota)
                                    if self.tenant_cache_quota else None)
+        if self.offload == _UNSET:
+            self.offload = bool(flag("FLAGS_serving_offload"))
+        else:
+            self.offload = bool(self.offload)
+        if self.offload_blocks == _UNSET:
+            self.offload_blocks = int(flag("FLAGS_serving_offload_blocks"))
+        self.offload_blocks = (int(self.offload_blocks)
+                               if self.offload_blocks else 0)
         if self.policy is None:
             self.policy = str(flag("FLAGS_serving_policy"))
         from ...models.llama import (KV_QUANT_MODES, QUANTIZE_MODES,
@@ -370,7 +399,9 @@ class ServingEngine:
                                   prefix_cache=self.config.prefix_cache,
                                   tenant_quota=self.config.tenant_cache_quota,
                                   kv_quant=self.config.kv_quant,
-                                  mesh=self._mesh)
+                                  mesh=self._mesh,
+                                  offload=self.config.offload,
+                                  offload_blocks=self.config.offload_blocks)
         self._policy = resolve_policy(
             self.config.policy,
             ttft_slo_s=float(flag("FLAGS_serving_ttft_slo_s")))
@@ -726,6 +757,143 @@ class ServingEngine:
                 f"{req.max_new_tokens}); record it, don't resubmit it")
         with self._lock:
             return self._sched.submit(req, enforce_bound=False)
+
+    # ---- live KV migration (ISSUE 16) -------------------------------------
+
+    def kv_shape_key(self) -> tuple:
+        """The KV-layout signature two engines must share for a block
+        chain to transfer byte-for-byte: block size, quantization mode,
+        TP degree and every pool leaf's per-block shape/dtype (the block
+        axis itself excluded — pools of different sizes interoperate).
+        In a shared-programs fleet these always agree; :meth:`adopt`
+        refuses a mismatched payload so a heterogeneous fleet falls back
+        to resubmit instead of writing garbage KV."""
+        return (int(self.config.block_size), str(self.config.kv_quant),
+                int(self.config.tp),
+                tuple(sorted((name, str(a.dtype),
+                              tuple(int(s) for i, s in enumerate(a.shape)
+                                    if i != 1))
+                             for name, a in self.cache.pool.items())))
+
+    def serialize_request(self, rid: int) -> Optional[Dict[str, Any]]:
+        """Snapshot one live request for adoption by another replica: the
+        resolved record (prompt, delivered tokens, sampling knobs, tenant
+        / priority / deadline) plus — for a request holding a slot — its
+        KV block chain's device bytes (one gather per pool leaf over the
+        blocks with committed entries, materialized D2H). Returns None
+        for unknown/terminal requests and for finished ones awaiting the
+        retire sweep (their work is done; migrating it would re-deliver).
+        Queued and preempted-requeued requests serialize with ``kv:
+        None`` — they hold no KV, so adoption degrades to a plain
+        resubmit of the record."""
+        with self._lock:
+            req = self._sched.find(rid)
+            if req is None or req.terminal or req.finished:
+                return None
+            payload: Dict[str, Any] = {
+                "prompt": np.array(req.prompt, np.int32),
+                "tokens": list(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_token_id": req.eos_token_id,
+                "temperature": req.temperature,
+                "top_k": req.top_k, "top_p": req.top_p, "seed": req.seed,
+                "tenant": req.tenant, "priority": req.priority,
+                "deadline": req.deadline,
+                "kv": None,
+            }
+            if req.slot is None or not req.blocks:
+                return payload
+            if req.prefilling:
+                entries = int(req.num_computed)
+            else:
+                entries = int(self._seq_lens[req.slot])
+            bs = self.config.block_size
+            nd = min(-(-entries // bs), len(req.blocks)) if entries else 0
+            data = None
+            if nd:
+                idx = np.asarray(req.blocks[:nd], np.int32)
+                data = {name: np.asarray(arr[:, idx])
+                        for name, arr in self.cache.pool.items()}
+            payload["kv"] = {
+                "entries": entries,
+                "prefilling": bool(req.prefilling),
+                "data_blocks": nd,
+                "total_blocks": len(req.blocks),
+                "data": data,
+                "shape_key": self.kv_shape_key(),
+            }
+            return payload
+
+    def adopt(self, payload: Dict[str, Any]) -> int:
+        """Adopt a request serialized on another replica, KV included:
+        allocate the chain, H2D-write the committed blocks, seat the
+        request directly in a RUNNING slot (mid-chunked-prefill resumes
+        at its chunk offset; decoding resumes from its last token with
+        the sampling cursor continuing at the same PRNG index, so the
+        stream stays bit-identical) and re-register the chain's prefix
+        keys. Raises :class:`AdoptError` when the blocks can't land here
+        — no free slot, pool full, KV-layout/TP-shape mismatch — and the
+        caller falls back to the resubmit/recompute path. A ``kv: None``
+        payload (queued/preempted origin) is queued via the resubmit
+        path directly."""
+        with self._lock:
+            req = self._make_request(
+                payload["prompt"], payload["max_new_tokens"],
+                payload["eos_token_id"], payload["tenant"],
+                payload["priority"], payload["deadline"],
+                tokens=payload["tokens"],
+                temperature=payload["temperature"],
+                top_k=payload["top_k"], top_p=payload["top_p"],
+                seed=payload["seed"])
+            if req.finished:
+                raise AdoptError("request already finished; record it, "
+                                 "don't migrate it")
+            kv = payload.get("kv")
+            if kv is None:
+                return self._sched.submit(req, enforce_bound=False)
+            if tuple(kv["shape_key"]) != self.kv_shape_key():
+                raise AdoptError("KV layout mismatch (block size / "
+                                 "kv_quant / TP shape differ); falling "
+                                 "back to resubmit")
+            if req.kv_tokens > self.cache.max_model_len:
+                raise AdoptError("chain exceeds this engine's "
+                                 "max_model_len")
+            free = [m for m, r in enumerate(self._sched.slots) if r is None]
+            if not free:
+                raise AdoptError("no free decode slot")
+            total = int(kv["total_blocks"])
+            if total > self.cache.blocks_per_seq:
+                raise AdoptError("chain longer than the block table")
+            if not self.cache.manager.can_alloc(total):
+                raise AdoptError("pool full")
+            blocks = self.cache.manager.alloc(total)
+            nd = int(kv["data_blocks"])
+            try:
+                if nd:
+                    self.cache.write_blocks(blocks[:nd], kv["data"])
+            except Exception as e:
+                self.cache.manager.free(blocks)
+                raise AdoptError(f"KV restore failed: {e}")
+            slot = free[0]
+            self._clear_slot(slot)
+            self._sched.adopt_running(req, slot, blocks)
+            self.cache.assign(slot, blocks)
+            entries = int(kv["entries"])
+            if kv["prefilling"]:
+                # resume the chunked prefill exactly at its chunk offset:
+                # _advance_prefills picks the slot up next step
+                req.prefill_ids = req.build_prefill_ids()
+                req.num_computed = entries
+            else:
+                req.prefill_ids = None
+                self._start_decode(req)
+            # re-derive the prefix-cache registration chain (the chained
+            # content keys are a pure function of the token ids, so the
+            # adopted blocks register under exactly the origin's keys)
+            req.reg_state = self.cache.register_prefix(
+                req.build_prefill_ids(), blocks, entries,
+                tenant=req.tenant)
+            return req.rid
 
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request: its remaining work is
@@ -1456,7 +1624,9 @@ class ServingEngine:
                 "tp_degree": self.config.tp,
                 "kv_pool_bytes": self.cache.kv_bytes(),
                 "kv_pool_shard_bytes": self.cache.kv_bytes(per_shard=True),
-                "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2)}
+                "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2),
+                "offload": (self.cache.offload.stats()
+                            if self.cache.offload is not None else None)}
 
     def health_snapshot(self) -> Dict[str, Any]:
         """One JSON-serializable health/ops record (docs/OPS.md): overall
@@ -1476,13 +1646,20 @@ class ServingEngine:
         """A consistent view of the pool partition (free / evictable /
         in-use / usable) under the engine lock — the conservation
         invariant the InvariantAuditor (audit.py) checks every step:
-        free + evictable + in_use == usable."""
+        free + evictable + in_use == usable. With the host offload tier
+        attached, ``host``/``host_capacity`` report the host-resident
+        side of the two-tier partition (the auditor's ``tier_partition``
+        check: a key is device-resident XOR host-resident)."""
         with self._lock:
             bm = self.cache.manager
+            tier = self.cache.offload
             return {"free": len(bm._free),
                     "evictable": len(bm._evictable),
                     "in_use": bm.blocks_in_use,
-                    "usable": bm.num_blocks - 1}
+                    "usable": bm.num_blocks - 1,
+                    "host": tier.blocks if tier is not None else 0,
+                    "host_capacity": tier.capacity
+                    if tier is not None else 0}
 
     def _health_snapshot_locked(self) -> Dict[str, Any]:
         sched = self._sched
@@ -1538,6 +1715,14 @@ class ServingEngine:
                 "oom_truncated": sched.oom_truncated,
                 "prefix_hit_tokens": sched.prefix_hit_tokens,
                 "evictions": self.cache.manager.evictions,
+            },
+            "offload": {
+                "enabled": self.cache.offload is not None,
+                **(self.cache.offload.stats()
+                   if self.cache.offload is not None else
+                   {"capacity": 0, "blocks": 0, "swap_outs": 0,
+                    "swap_ins": 0, "tier_hits": 0, "tier_misses": 0,
+                    "corrupt_drops": 0, "tier_evictions": 0}),
             },
             "watchdog": {
                 "installed": wd is not None,

@@ -1,0 +1,200 @@
+"""Host-RAM KV offload tier (ISSUE 16 tentpole a): survivable cached
+blocks.
+
+The paged serving engine's prefix cache keeps refcount-0 blocks device-
+resident until allocation pressure LRU-evicts them — and an evicted block
+is recomputed from scratch on the next prefix hit. This module gives
+evicted blocks a second life: :class:`HostOffloadTier` is a bounded
+host-side pool that registered blocks swap into *instead of dying* when
+the :class:`~paddle_tpu.inference.serving.paged_cache.BlockManager`
+evicts them (the ``alloc()`` LRU branch and the tenant-quota recycle in
+``register()`` — which also covers a preemption victim's registered
+blocks, released to the evictable list and squeezed out later). A
+subsequent prefix hit or victim readmission H2D-restores the chain
+through ``PagedKVCache.admit()`` with zero recompute; if the bounded
+tier itself evicted the entry, admission falls through to the existing
+recompute path bit-exactly.
+
+Design points:
+
+* **Asynchronous swap-out.** ``put()`` captures per-leaf DEVICE slices
+  of the dying block (``pool[leaf][:, b]`` — a copy is dispatched, the
+  host does not block) into a small pending window, riding the same
+  double-buffer idea as ``io.dataloader.prefetch_to_device``: the D2H
+  materialization (``np.asarray``) of the oldest pending entry happens
+  only when a newer eviction pushes it out of the window, on lookup, or
+  at ``flush()`` — device work and the copy overlap instead of
+  serializing the allocator on a transfer.
+* **Write-time checksums.** Every leaf materializes with a CRC32 stamped
+  at write time; ``take()`` re-verifies tokens AND checksums, so a
+  corrupt host block (bit-rot, a chaos ``corrupt_offload_block``)
+  degrades to a cache MISS — recompute, never wrong KV. This extends
+  the PR 5 ``BlockManager.lookup()`` verification contract to the tier.
+* **Move semantics.** A successful ``take()`` removes the entry: a block
+  key is device-resident XOR host-resident (the auditor's
+  ``tier_partition`` check), and ``BlockManager.register()`` discards
+  any stale host copy when a key re-registers on device.
+* **Bounded.** At ``capacity`` blocks the least-recently-written entry
+  is dropped (``tier_evictions``); ``resize()`` shrinks the bound live
+  (the ``host_pressure`` chaos injector). int8-quantized blocks are
+  ~3.5x cheaper per block, so one bound holds ~3.5x the cached tokens.
+
+No jax import here — like ``paged_cache`` this module only calls
+methods on the array objects it is handed; device math stays in
+``models/generation.py``.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["HostOffloadTier"]
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+class HostOffloadTier:
+    """Bounded host-RAM pool of swapped-out KV blocks, keyed by the same
+    chained content hash the device prefix cache uses."""
+
+    def __init__(self, capacity_blocks: int, block_size: int,
+                 pending_depth: int = 2):
+        self.capacity = max(0, int(capacity_blocks))
+        self.block_size = int(block_size)
+        self.pending_depth = max(0, int(pending_depth))
+        # key -> {"tokens": tuple, "data": {leaf: np.ndarray}, "crc": {...}}
+        self._entries: "OrderedDict[int, Dict]" = OrderedDict()
+        # key -> (tokens, {leaf: device-array slice}) — swap-outs whose D2H
+        # has been dispatched but not yet materialized (the double buffer)
+        self._pending: "OrderedDict[int, Tuple[tuple, Dict]]" = OrderedDict()
+        self.swap_outs = 0        # blocks accepted into the tier
+        self.swap_ins = 0         # blocks restored to device by admit()
+        self.tier_hits = 0        # verified take() hits
+        self.tier_misses = 0      # take() for an absent key
+        self.corrupt_drops = 0    # entries dropped on checksum/token mismatch
+        self.tier_evictions = 0   # entries dropped by the capacity bound
+
+    # -- capacity -----------------------------------------------------------
+
+    @property
+    def blocks(self) -> int:
+        """Blocks currently host-resident (materialized + pending)."""
+        return len(self._entries) + len(self._pending)
+
+    def keys(self):
+        """Every key the tier currently holds (materialized + pending)."""
+        yield from self._entries
+        yield from self._pending
+
+    def _evict_to(self, bound: int) -> None:
+        while self.blocks > bound:
+            if self._pending:   # oldest swap-out first (it is the LRU-est)
+                self._pending.popitem(last=False)
+            else:
+                self._entries.popitem(last=False)
+            self.tier_evictions += 1
+
+    def resize(self, capacity_blocks: int) -> None:
+        """Shrink/grow the bound live; excess entries fall back to the
+        recompute path (the ``host_pressure`` chaos injector)."""
+        self.capacity = max(0, int(capacity_blocks))
+        self._evict_to(self.capacity)
+
+    # -- swap-out -----------------------------------------------------------
+
+    def put(self, key: int, tokens: tuple, slices: Dict) -> None:
+        """Accept a dying block: ``slices`` maps pool leaf name to a
+        device-array slice of the block (copy already dispatched). The
+        host-side materialization is deferred (see module docstring)."""
+        if self.capacity <= 0:
+            return
+        self._entries.pop(key, None)      # re-offload supersedes
+        self._pending.pop(key, None)
+        self._pending[key] = (tuple(tokens), dict(slices))
+        self.swap_outs += 1
+        while len(self._pending) > self.pending_depth:
+            k, (toks, sl) = self._pending.popitem(last=False)
+            self._materialize(k, toks, sl)
+        self._evict_to(self.capacity)
+
+    def _materialize(self, key: int, tokens: tuple, slices: Dict) -> None:
+        data = {name: np.asarray(arr) for name, arr in slices.items()}
+        self._entries[key] = {"tokens": tokens, "data": data,
+                              "crc": {n: _crc(a) for n, a in data.items()}}
+
+    def flush(self) -> None:
+        """Materialize every pending swap-out (quiesce / audit barrier)."""
+        while self._pending:
+            k, (toks, sl) = self._pending.popitem(last=False)
+            self._materialize(k, toks, sl)
+
+    def discard(self, key: int) -> None:
+        """Drop any host copy of ``key`` — called when the key registers
+        on device again (device copy becomes the authoritative one)."""
+        self._entries.pop(key, None)
+        self._pending.pop(key, None)
+
+    # -- swap-in ------------------------------------------------------------
+
+    def take(self, key: int, tokens) -> Optional[Dict]:
+        """Verified move-out: return the block's host arrays iff the key
+        is present, the stored token ids match ``tokens`` exactly, and
+        every leaf's write-time checksum still verifies; the entry is
+        removed on success (device becomes the resident tier). Any
+        mismatch drops the entry and returns None — a MISS, so the
+        caller recomputes; corruption is never attended."""
+        if key in self._pending:
+            toks, sl = self._pending.pop(key)
+            self._materialize(key, toks, sl)
+        e = self._entries.get(key)
+        if e is None:
+            self.tier_misses += 1
+            return None
+        if e["tokens"] != tuple(int(t) for t in tokens):
+            del self._entries[key]
+            self.corrupt_drops += 1
+            self.tier_misses += 1
+            return None
+        for name, arr in e["data"].items():
+            if _crc(arr) != e["crc"][name]:
+                del self._entries[key]
+                self.corrupt_drops += 1
+                self.tier_misses += 1
+                return None
+        del self._entries[key]
+        self.tier_hits += 1
+        return e["data"]
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        return {"capacity": self.capacity, "blocks": self.blocks,
+                "swap_outs": self.swap_outs, "swap_ins": self.swap_ins,
+                "tier_hits": self.tier_hits, "tier_misses": self.tier_misses,
+                "corrupt_drops": self.corrupt_drops,
+                "tier_evictions": self.tier_evictions}
+
+    def corrupt_one(self, seed: int = 0) -> Optional[int]:
+        """Chaos hook (``corrupt_offload_block``): flip one byte in one
+        stored leaf of a deterministic entry WITHOUT updating its
+        checksum, so the next ``take()`` must detect it and degrade to a
+        miss. Returns the corrupted key, or None when the tier is
+        empty."""
+        self.flush()
+        if not self._entries:
+            return None
+        keys = list(self._entries)
+        key = keys[seed % len(keys)]
+        e = self._entries[key]
+        name = sorted(e["data"])[seed % len(e["data"])]
+        arr = np.array(e["data"][name], copy=True)
+        flat = arr.reshape(-1).view(np.uint8)
+        flat[seed % flat.size] ^= 0xFF
+        e["data"][name] = arr
+        return key

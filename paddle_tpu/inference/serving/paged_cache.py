@@ -8,7 +8,9 @@ block-table matrix, and the compiled decode step gathers exactly those
 blocks. This module is the HOST half: a ref-counted block manager with a
 content-hash prefix cache plus the ``[max_slots, W]`` block-table matrix
 the engine ships with every dispatch. No jax import here — device math
-lives in ``models/generation.py``.
+lives in ``models/generation.py`` (the block copy helpers lazily import
+jax only to pass block indices as DEVICE scalars, keeping one compiled
+slice/update program across all block indices).
 
 Allocation policy (ISSUE 5): **on-demand** — a sequence holds only the
 blocks covering KV entries it has actually filled (admission maps/allocates
@@ -28,6 +30,16 @@ collide), so admissions sharing a system-prompt/few-shot prefix map the
 cached blocks by refcount instead of re-running prefill over them. Blocks
 whose refcount drops to 0 stay cached on an LRU list and are evicted only
 when the free list runs dry.
+
+Host offload tier (ISSUE 16): with a :class:`~paddle_tpu.inference.
+serving.offload.HostOffloadTier` attached, an LRU-evicted registered
+block swaps OUT to the bounded host pool instead of dying (both eviction
+sites — ``alloc``'s LRU branch and ``register``'s tenant-quota recycle),
+and ``admit``'s chain walk consults the tier on a device miss: a
+verified host hit allocates a device block, H2D-restores the bytes, and
+re-registers the key — zero recompute. A key is device-resident XOR
+host-resident: registering a key on device discards any stale host copy,
+and a successful host take moves the entry back to device.
 """
 
 from __future__ import annotations
@@ -111,6 +123,12 @@ class BlockManager:
         self._block_tenant: Dict[int, str] = {}
         self._tenant_cached: Dict[str, int] = {}
         self.evictions = 0
+        # host offload tier (ISSUE 16): installed by PagedKVCache when
+        # FLAGS_serving_offload is on. `offload_capture(b)` returns the
+        # per-leaf device slices of block b (the cache owns device I/O —
+        # this module stays jax-free); `offload.put` accepts them.
+        self.offload = None
+        self.offload_capture = None
 
     @property
     def free_blocks(self) -> int:
@@ -143,11 +161,25 @@ class BlockManager:
                 b = self._free.pop()
             else:                                # LRU-evict a cached block
                 b, _ = self._evictable.popitem(last=False)
+                self._offload(b)
                 self._unregister(b)
                 self.evictions += 1
             self._ref[b] = 1
             blocks.append(b)
         return blocks
+
+    def _offload(self, b: int) -> None:
+        """Swap a dying registered block into the host tier (when one is
+        attached) — called at both eviction sites, BEFORE the block's
+        registration (key + verified tokens) is dropped. Blocks without
+        stored tokens are skipped: the tier's verified-hit contract needs
+        them."""
+        if self.offload is None or self.offload_capture is None:
+            return
+        key = self._block2hash.get(b)
+        toks = self._block_tokens.get(b)
+        if key is not None and toks is not None:
+            self.offload.put(key, toks, self.offload_capture(b))
 
     def _unregister(self, b: int) -> None:
         """Drop block ``b``'s prefix-cache registration (hash maps, stored
@@ -229,9 +261,14 @@ class BlockManager:
             if mine is None:
                 return                   # quota full of pinned entries
             del self._evictable[mine]
+            self._offload(mine)
             self._unregister(mine)
             self._free.append(mine)
             self.evictions += 1
+        if self.offload is not None:
+            # the device copy becomes the resident tier for this key — a
+            # stale host copy must not survive (device XOR host residency)
+            self.offload.discard(key)
         self._hash2block[key] = block
         self._block2hash[block] = key
         if tokens is not None:
@@ -255,7 +292,8 @@ class PagedKVCache:
                  block_size: int, num_blocks: int = 0, dtype=None,
                  prefix_cache: bool = True,
                  tenant_quota: Optional[int] = None, kv_quant=None,
-                 mesh=None):
+                 mesh=None, offload: bool = False,
+                 offload_blocks: int = 0):
         from ...models.generation import init_paged_pool
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
@@ -283,10 +321,59 @@ class PagedKVCache:
         self.manager = BlockManager(num_blocks, block_size,
                                     tenant_quota=tenant_quota)
         self.tables = np.zeros((max_slots, self.blocks_per_seq), np.int32)
+        # host offload tier (ISSUE 16): evicted registered blocks swap to
+        # a bounded host pool instead of dying; admit() restores them
+        self.offload = None
+        if offload and prefix_cache and offload_blocks > 0:
+            from .offload import HostOffloadTier
+            self.offload = HostOffloadTier(offload_blocks, block_size)
+            self.manager.offload = self.offload
+            self.manager.offload_capture = self.read_block
 
     @property
     def free_blocks(self) -> int:
         return self.manager.free_blocks
+
+    # ---- device block I/O --------------------------------------------------
+
+    def read_block(self, block: int) -> Dict:
+        """Per-leaf device slices of one physical block (``pool[leaf][:,
+        b]`` — the copy is DISPATCHED here, not materialized: np.asarray
+        on a returned slice blocks for the D2H). Shared by the offload
+        tier's swap-out capture and migration's chain serialization.
+
+        The block index crosses as a DEVICE scalar: a python int bakes
+        into the sliced executable as a constant, so a churning tier
+        would compile one slice program per distinct block index
+        (measured ~50ms each on XLA:CPU — dwarfing the copy itself)."""
+        import jax
+        import jax.numpy as jnp  # local: module stays jax-free at import
+
+        b = jnp.asarray(block, jnp.int32)
+        return {name: jax.lax.dynamic_index_in_dim(arr, b, axis=1,
+                                                   keepdims=False)
+                for name, arr in self.pool.items()}
+
+    def write_block(self, block: int, data: Dict) -> None:
+        """H2D-write one physical block's per-leaf host arrays back into
+        the pool — the offload tier's swap-in restore. Same device-scalar
+        index discipline as ``read_block`` (one compiled update program
+        for every block index, not one per index)."""
+        import jax
+        import jax.numpy as jnp  # local: module stays jax-free at import
+
+        b = jnp.asarray(block, jnp.int32)
+        for name, arr in self.pool.items():
+            self.pool[name] = jax.lax.dynamic_update_index_in_dim(
+                arr, jnp.asarray(data[name], arr.dtype), b, axis=1)
+
+    def write_blocks(self, blocks: List[int], data: Dict) -> None:
+        """H2D-write a gathered run of blocks (``data[leaf]`` carries the
+        block axis at position 1: ``[L, len(blocks), ...]``) — the
+        migration adopt path's bulk restore."""
+        idx = np.asarray(blocks, np.int32)
+        for name, arr in self.pool.items():
+            self.pool[name] = arr.at[:, idx].set(data[name])
 
     # ---- admission ---------------------------------------------------------
 
@@ -320,17 +407,33 @@ class PagedKVCache:
         hits: List[int] = []
         last_key: Optional[int] = None
         if self.prefix_cache:
+            # pin-as-we-go: each hit is share()d the moment it verifies, so
+            # a host-tier restore's alloc (which may itself LRU-evict) can
+            # never evict a block we are about to map
             for key, toks in prefix_block_chain(ids, self.block_size,
                                                 len(ids) - 1):
                 b = self.manager.lookup(key, toks)
-                if b is None:
-                    break
-                hits.append(b)
-                last_key = key
-        # pin the hit blocks FIRST — allocating the remainder may otherwise
-        # LRU-evict the very blocks we are about to map
-        for b in hits:
-            self.manager.share(b)
+                if b is not None:
+                    self.manager.share(b)
+                    hits.append(b)
+                    last_key = key
+                    continue
+                if self.offload is not None and self.manager.can_alloc(1):
+                    # device miss — consult the host tier. A verified take
+                    # H2D-restores the block and re-registers the key: the
+                    # chain continues with zero recompute. A miss (absent,
+                    # evicted, or checksum-failed) breaks to the recompute
+                    # path exactly as before the tier existed.
+                    data = self.offload.take(key, toks)
+                    if data is not None:
+                        [b] = self.manager.alloc(1)
+                        self.write_block(b, data)
+                        self.manager.register(key, b, toks)
+                        self.offload.swap_ins += 1
+                        hits.append(b)
+                        last_key = key
+                        continue
+                break
         n_new = n_total - len(hits)
         if not self.manager.can_alloc(n_new):
             if hits:

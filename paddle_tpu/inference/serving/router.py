@@ -97,7 +97,12 @@ ROUTER_HEALTH_FIELDS = {
     "counters": "router lifetime totals: routed / sticky_hits / "
                 "failovers / failover_tokens / hedges / hedge_wins / "
                 "hedges_cancelled / probe_failures / breaker_opens / "
-                "replica_restarts / rolls_completed / completed / failed "
+                "replica_restarts / rolls_completed / migrations + "
+                "migration_tokens (requests moved LIVE with their KV "
+                "blocks during a drain/roll/scale-in — the tokens never "
+                "recompute; ISSUE 16) / migration_fallbacks (exports "
+                "that no replica could adopt; they ride the resubmit/"
+                "recompute path instead) / completed / failed "
                 "(failed MUST stay 0 across a rolling restart)",
     "replicas": "per-replica rows: accepting / broken / draining / "
                 "retiring / generation / restarts / depth / breaker "
@@ -137,6 +142,10 @@ class RouterConfig:
     hedge_ttft_mult: Optional[float] = None   # 0 = hedging off
     ttft_slo_s: Optional[float] = None        # base for the hedge delay
     affinity: bool = True                     # prefix/tenant stickiness
+    # live KV migration (ISSUE 16): drain/roll/scale-in moves in-flight
+    # requests to an adoptive replica WITH their computed blocks instead
+    # of recomputing; None resolves FLAGS_serving_migrate
+    migrate: Optional[bool] = None
     seed: int = 0                             # P2C sampling RNG
     # successful health probes are cached this long: 0 (default) probes
     # every candidate on every submit — the spec'd behavior, and what a
@@ -162,6 +171,8 @@ class RouterConfig:
                 flag("FLAGS_serving_router_hedge_ttft_mult"))
         if self.ttft_slo_s is None:
             self.ttft_slo_s = float(flag("FLAGS_serving_ttft_slo_s"))
+        if self.migrate is None:
+            self.migrate = bool(flag("FLAGS_serving_migrate"))
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1 (got {self.replicas})")
         self.max_replicas = max(self.max_replicas, self.replicas)
@@ -287,6 +298,9 @@ class ServingRouter:
         self.probe_failures = 0
         self.replica_restarts = 0      # rolling-restart rebuilds
         self.rolls_completed = 0
+        self.migrations = 0            # live KV migrations completed
+        self.migration_tokens = 0      # tokens that skipped recompute
+        self.migration_fallbacks = 0   # exports no replica could adopt
         self.completed = 0
         self.failed = 0                # router-terminal FAILED (no replica)
         for _ in range(self.config.replicas):
@@ -319,12 +333,15 @@ class ServingRouter:
             return rid
 
     def drain_replica(self, rid: int) -> None:
-        """Scale-in: stop routing to the replica, let its in-flight work
-        finish (step() keeps pumping it), remove it once empty."""
+        """Scale-in: stop routing to the replica, migrate its in-flight
+        work out live (KV blocks and all, when ``RouterConfig.migrate``)
+        and let whatever stays finish in place (step() keeps pumping it);
+        remove it once empty."""
         with self._lock:
             rep = self._replicas[rid]
             rep.retiring = True
             rep.sup.request_drain()
+            self._migrate(rep, time.time())
 
     def _finalize_retiring(self) -> None:
         for rid in [r for r, rep in self._replicas.items() if rep.retiring]:
@@ -638,6 +655,58 @@ class ServingRouter:
             else:
                 req.hedge = None       # the hedge copy died with its host
 
+    def _migrate(self, rep: Replica, now: float) -> None:
+        """Live KV migration (ISSUE 16): move every in-flight PRIMARY
+        request off a draining/retiring replica WITH its computed blocks
+        — the adoptive replica resumes it mid-stream with
+        ``recomputed_tokens == 0`` (the :meth:`EngineSupervisor.adopt`
+        contract), bit-identical to staying put. A request no replica
+        can adopt (pool full, TP/layout mismatch, mid-crash) stays on
+        the origin: the drain window may still finish it, and the
+        deadline evacuation falls back to the resubmit/recompute path —
+        migration only ever SAVES work, never risks it."""
+        if not self.config.migrate:
+            return
+        from .engine import AdoptError
+        for srid, frid in list(self._routes.get(rep.rid, {}).items()):
+            req = self._reqs.get(frid)
+            if req is None or req.terminal:
+                continue
+            if (req.replica, req.srid) != (rep.rid, srid):
+                continue           # hedge copy: its primary keeps serving
+            try:
+                payload = rep.sup.export_request(srid)
+            except Exception:      # noqa: BLE001 — sick origin
+                payload = None
+            if payload is None:
+                continue           # already finishing inside the drain
+            moved = False
+            for cand in self._candidates(exclude={rep.rid}, now=now):
+                try:
+                    new_srid = cand.sup.adopt(payload)
+                except (AdoptError, ServingUnavailable):
+                    continue       # this target can't take the blocks
+                except Exception:  # noqa: BLE001 — raced a crash
+                    continue
+                # pop the route BEFORE cancelling the origin copy so the
+                # drain-cancel sweep can never double-failover this frid
+                self._routes[rep.rid].pop(srid, None)
+                try:
+                    rep.sup.release_migrated(srid)
+                except Exception:  # noqa: BLE001 — drain will reap it
+                    pass
+                self._routes[cand.rid][new_srid] = frid
+                req.replica, req.srid = cand.rid, new_srid
+                if req.affinity_key is not None:
+                    # shared-prefix traffic follows the blocks
+                    self._affinity[req.affinity_key] = cand.rid
+                self.migrations += 1
+                self.migration_tokens += len(req.tokens)
+                moved = True
+                break
+            if not moved:
+                self.migration_fallbacks += 1
+
     def _failover(self, req: RouterRequest, exclude: Set[int],
                   now: float) -> None:
         """Resume one request on a healthy replica from the tokens the
@@ -876,6 +945,10 @@ class ServingRouter:
             roll["target"] = rid
             roll["t0"] = now
             rep.sup.request_drain()
+            # live migration empties the target immediately — its KV
+            # moves with the requests, so the roll's zero-recompute
+            # contract holds even at a 0s drain deadline
+            self._migrate(rep, now)
             return
         rid = roll["target"]
         rep = self._replicas.get(rid)
@@ -885,11 +958,14 @@ class ServingRouter:
         if rep.sup.pending and now - roll["t0"] < roll["deadline_s"]:
             return                            # still draining; step() pumps
         if rep.sup.pending:
-            # deadline: move the stragglers — the same evacuation the
-            # breaker path uses (fails primaries over, clears hedge
-            # copies so a later failover can't promote a stale srid of
-            # the rebuilt supervisor); the close-out drain below then
-            # cancels what's left
+            # deadline: retry live migration first (an earlier fallback
+            # may find room now that the fleet drained), then move the
+            # stragglers — the same evacuation the breaker path uses
+            # (fails primaries over, clears hedge copies so a later
+            # failover can't promote a stale srid of the rebuilt
+            # supervisor); the close-out drain below then cancels
+            # what's left
+            self._migrate(rep, now)
             self._evacuate(rep, now)
         report = rep.sup.drain(0)             # close-out + leak check
         fresh = self._build_supervisor()
@@ -1151,6 +1227,9 @@ class ServingRouter:
                     + sum(r["breaker"]["opens"] for r in reps.values()),
                     "replica_restarts": self.replica_restarts,
                     "rolls_completed": self.rolls_completed,
+                    "migrations": self.migrations,
+                    "migration_tokens": self.migration_tokens,
+                    "migration_fallbacks": self.migration_fallbacks,
                     "completed": self.completed,
                     "failed": self.failed,
                 },

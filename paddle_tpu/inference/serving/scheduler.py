@@ -294,6 +294,12 @@ class Scheduler:
         self._finish_times: Deque[float] = deque(maxlen=16)
         self.default_retry_after_s = float(
             flag("FLAGS_serving_retry_after_s", 1.0))
+        # absolute time the active drain completes (stamped by the
+        # supervisor's request_drain/drain): while set and in the future,
+        # retry_after_s() reports the drain-deadline REMAINDER — a client
+        # shed by a leaving replica must not be told to retry into it on
+        # the retirement-interval estimate (ISSUE 16 satellite)
+        self.drain_deadline: Optional[float] = None
         self.tenants: Dict[str, Dict] = {}
 
     # ---- per-tenant accounting ---------------------------------------------
@@ -338,7 +344,18 @@ class Scheduler:
         what drains one queued request). Before two retirements have been
         observed there is no interval to estimate, so the conservative
         ``FLAGS_serving_retry_after_s`` default is returned instead of a
-        degenerate None/0 a client would turn into a hot retry loop."""
+        degenerate None/0 a client would turn into a hot retry loop.
+
+        During an ACTIVE drain the retirement-interval estimate is the
+        wrong signal entirely — this replica is leaving, and a client
+        retrying into it on a sub-second interval estimate just gets
+        shed again. The hint becomes the drain deadline REMAINDER: after
+        that long, this replica is gone and the retry belongs to
+        whatever replaced it."""
+        if self.drain_deadline is not None:
+            remaining = self.drain_deadline - time.time()
+            if remaining > 0:
+                return round(remaining, 3)
         if len(self._finish_times) < 2:
             return self.default_retry_after_s
         span = self._finish_times[-1] - self._finish_times[0]
@@ -454,6 +471,36 @@ class Scheduler:
         t["admitted"] += 1
         t["service_tokens"] += req.prompt_len     # prefill work charged now
         return req
+
+    def adopt_running(self, req: Request, slot: int,
+                      blocks: List[int]) -> int:
+        """Seat a MIGRATED request (ISSUE 16) directly into a slot,
+        bypassing the queue: its KV chain arrived with it, so there is
+        no prefill to schedule and no admission to wait for. The engine
+        has already allocated ``blocks`` and written the chain; this
+        stamps the full submit+admit bookkeeping (rid, timestamps,
+        counters, tenant accounting) in one step so every closure
+        invariant the auditor checks (submitted >= admitted >= ...,
+        tenant rows, deadline_requests) holds exactly as if the request
+        had been submitted and admitted here."""
+        if self.slots[slot] is not None:
+            raise RuntimeError(f"adopt into occupied slot {slot}")
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.submit_t = time.time()
+        if req.deadline is not None:
+            self.deadline_requests += 1
+        t = self.tenant(req.tenant)
+        t["submitted"] += 1
+        req.blocks, req.slot = blocks, slot
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        req.state = RUNNING
+        self.slots[slot] = req
+        self.admitted += 1
+        t["admitted"] += 1
+        t["service_tokens"] += req.prompt_len
+        return req.rid
 
     def preempt(self, req: Request) -> None:
         """Free a RUNNING request's blocks and re-queue it at the FRONT for

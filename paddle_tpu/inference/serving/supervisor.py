@@ -212,6 +212,8 @@ class EngineSupervisor:
         self.resubmitted = 0
         self.recovered_tokens = 0
         self.adopted = 0          # requests failed over FROM another replica
+        self.migrated_in = 0      # adopted WITH their KV blocks (ISSUE 16)
+        self.migrated_out = 0     # released here after a live migration
         self.completed = 0
         self._drain_requested = False
         self._prev_sigterm = None
@@ -353,6 +355,59 @@ class EngineSupervisor:
             self.recovered_tokens += len(rec.tokens)
             return rec.srid
 
+    # ---- live KV migration (ISSUE 16) --------------------------------------
+
+    def export_request(self, srid: int):
+        """Serialize one in-flight request — resolved record + computed
+        KV blocks — for live migration to another replica (the router's
+        drain/roll/scale-in path). Returns the portable payload, or None
+        when the request is terminal or already finished (the origin's
+        own drain will deliver it; migrating would re-run it). The
+        origin keeps serving the request until :meth:`release_migrated`
+        confirms the adoption."""
+        with self._lock:
+            rec = self._reqs.get(srid)
+            if rec is None or rec.terminal:
+                return None
+            return self.engine.serialize_request(rec.erid)
+
+    def adopt(self, payload) -> int:
+        """ADOPT a live-migrated request: restore its KV blocks into this
+        replica's pool and resume it exactly where the origin paused it —
+        ``recomputed_tokens == 0``, bit-identical stream (the
+        :meth:`ServingEngine.adopt` contract). Raises
+        :class:`~.engine.AdoptError` when this replica cannot take the
+        blocks (pool full, slot shortage, TP/layout mismatch) — the
+        router falls back to the resubmit/recompute path — and
+        :class:`ServingUnavailable` while draining or broken. Returns
+        the new supervisor rid."""
+        with self._lock:
+            self._check_admitting()
+            erid = self.engine.adopt(payload)
+            rec = self._track(erid, resubmits=1)    # born from a migration
+            self.adopted += 1
+            self.migrated_in += 1
+            self.recovered_tokens += len(rec.tokens)
+            return rec.srid
+
+    def release_migrated(self, srid: int) -> bool:
+        """Confirm a migration: the adoptive replica owns the request
+        now, so cancel the origin's copy (frees its blocks — possibly
+        into the offload tier) and mark the record migrated so no sweep
+        treats it as lost work. Idempotent."""
+        with self._lock:
+            rec = self._reqs.get(srid)
+            if rec is None:
+                return False
+            already = rec.terminal
+            if not already:
+                self.engine.cancel(rec.erid)
+                self._sweep()
+                self.migrated_out += 1
+            if rec.finish is not None:
+                rec.finish["migrated"] = True
+            return not already
+
     def depth(self) -> int:
         """Queued + live requests on this replica — the router's
         power-of-two-choices load signal. A broken replica reports a
@@ -467,6 +522,9 @@ class EngineSupervisor:
         self.crashes.append(reason)
         survivors = sorted(self._by_erid.values(), key=lambda r: r.srid)
         self._by_erid = {}
+        # carry the drain deadline across the rebuild so a crash mid-
+        # drain keeps reporting the true remaining window
+        drain_deadline = self.engine._sched.drain_deadline
         if self.restarts >= self.max_restarts:
             # budget exhausted: flip to not-accepting instead of crash-
             # looping. In-flight requests FAIL (partial output readable);
@@ -479,9 +537,11 @@ class EngineSupervisor:
                               "reason": reason,
                               "resubmits": rec.resubmits}
             self.engine = self._build_engine()
+            self.engine._sched.drain_deadline = drain_deadline
             return
         self.restarts += 1
         self.engine = self._build_engine()
+        self.engine._sched.drain_deadline = drain_deadline
         for rec in survivors:
             if rec.finished_by_tokens:
                 # crashed after its last token but before the retire
@@ -536,8 +596,15 @@ class EngineSupervisor:
         """Thread/signal-safe drain trigger: admissions stop immediately
         (submit raises the structured 503); whoever owns the step loop —
         :meth:`drain` here, or the server's pump thread — finishes the
-        in-flight work within the deadline."""
+        in-flight work within the deadline. Stamps the scheduler's
+        ``drain_deadline`` so the structured 503's ``retry_after_s``
+        reports the REMAINING drain window, not a cold-start estimate
+        (whoever runs the actual :meth:`drain` re-stamps the final
+        deadline)."""
         self._drain_requested = True
+        # single attribute store — safe from a signal handler, no lock
+        self.engine._sched.drain_deadline = (time.time()
+                                             + self.drain_deadline_s)
 
     @property
     def drain_requested(self) -> bool:
@@ -578,6 +645,8 @@ class EngineSupervisor:
             done_before = self.completed
         deadline = t0 + (deadline_s if deadline_s is not None
                          else self.drain_deadline_s)
+        with self._lock:
+            self.engine._sched.drain_deadline = deadline
         while time.time() < deadline and self.pending:
             self.step()
         cancelled = 0
@@ -641,6 +710,8 @@ class EngineSupervisor:
                 "resubmitted": self.resubmitted,
                 "recovered_tokens": self.recovered_tokens,
                 "adopted": self.adopted,
+                "migrated_in": self.migrated_in,
+                "migrated_out": self.migrated_out,
                 "completed": self.completed,
                 "crashes": list(self.crashes[-4:]),
             }

@@ -349,6 +349,7 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                chaos: Any = "auto", chaos_events: int = 6,
                programs=None, router=None, collect_violations: bool = False,
                record_streams: bool = False, hbm_gb: float = 16.0,
+               host_gb: float = 0.0,
                max_steps: Optional[int] = None) -> Dict[str, Any]:
     """Drive one generated trace through a multi-replica router under a
     seeded chaos timeline, auditing throughout. Returns the replay
@@ -530,6 +531,28 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
             except (ServingQueueFull, ServingUnavailable):
                 # the poisoned prompt never entered an engine: skipped
                 timeline.log(step, ev.name, "skipped: shed")
+        elif ev.name == "host_pressure":
+            if not adoptable:
+                timeline.log(step, ev.name, "skipped: none healthy")
+                return
+            rid = min(adoptable)
+            res = _chaos.host_pressure(router, rid=rid, **ev.kwargs)
+            if res["enabled"]:
+                timeline.log(step, ev.name, res)
+            else:
+                # the tier is off: the fault had nothing to squeeze
+                timeline.log(step, ev.name, "skipped: offload tier off")
+        elif ev.name == "corrupt_offload_block":
+            # aim at a replica whose tier actually holds a block — a
+            # corruption that touched nothing did not exercise the
+            # checksum path and must not count as fired
+            for rid in adoptable:
+                res = _chaos.corrupt_offload_block(router, rid=rid,
+                                                   **ev.kwargs)
+                if res["enabled"] and res["key"] is not None:
+                    timeline.log(step, ev.name, res)
+                    return
+            timeline.log(step, ev.name, "skipped: tier off or empty")
         elif ev.name == "disconnect_mid_stream":
             # logged when a live stream is ACTUALLY cut (or as skipped
             # at quiesce if none ever was) — an armed-but-never-fired
@@ -790,7 +813,7 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
         report["streams"] = {c.tr.tid: list(c.delivered) for c in clients}
     report["capacity"] = capacity_report(
         model_config, router.decode_config, measured=report,
-        mean_seq_tokens=mean_seq, hbm_gb=hbm_gb)
+        mean_seq_tokens=mean_seq, hbm_gb=hbm_gb, host_gb=host_gb)
     if own_router:
         drain = router.close(0)
         report["drain_report"] = drain
@@ -799,19 +822,28 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
 
 def capacity_report(model_config, serving_config, measured: Optional[Dict]
                     = None, mean_seq_tokens: Optional[float] = None,
-                    hbm_gb: float = 16.0,
+                    hbm_gb: float = 16.0, host_gb: float = 0.0,
                     tp_degrees: Sequence[int] = (1, 2, 4, 8)
                     ) -> Dict[str, Any]:
     """The capacity-planning arithmetic + the measured curves in one
     record: per-block bytes across fp/int8 x TP degree
     (:func:`~paddle_tpu.models.generation.paged_pool_block_bytes`), the
     concurrent sequences one chip's HBM budget backs at the trace's mean
-    sequence length, and — when a replay's ``measured`` record is given —
-    the 'X replicas of config Y serve Z req/s within SLO' sizing line the
+    sequence length, the EFFECTIVE cached tokens once the host-RAM
+    offload tier extends the prefix cache past HBM (ISSUE 16 —
+    ``host_gb`` sizes the tier; 0 falls back to the configured
+    ``offload_blocks`` bound when the tier is on, since an int8 host
+    block is ~3.5x cheaper the same host budget holds ~3.5x the cached
+    tokens), and — when a replay's ``measured`` record is given — the 'X
+    replicas of config Y serve Z req/s within SLO' sizing line the
     report exists for."""
     from ...models.generation import paged_pool_block_bytes, validate_tp
     bs = int(serving_config.block_size)
     hbm = int(hbm_gb * (1 << 30))
+    host = int(host_gb * (1 << 30))
+    tier_on = bool(getattr(serving_config, "offload", False))
+    tier_blocks = int(getattr(serving_config, "offload_blocks", 0) or 0) \
+        if tier_on else 0
     seq = float(mean_seq_tokens
                 if mean_seq_tokens is not None
                 else serving_config.max_model_len)
@@ -826,10 +858,17 @@ def capacity_report(model_config, serving_config, measured: Optional[Dict]
             bb = paged_pool_block_bytes(model_config, bs, kv_quant=kv,
                                         tp=tp)
             blocks = hbm // bb
+            # host-tier column: an explicit host budget wins; otherwise
+            # the configured tier bound (0 rows when the tier is off)
+            host_blocks = (host // bb) if host else tier_blocks
             layouts[f"{kv or 'fp'}_tp{tp}"] = {
                 "block_bytes_per_chip": int(bb),
                 "blocks_per_chip": int(blocks),
                 "concurrent_seqs_per_chip": int(blocks // blocks_per_seq),
+                "host_blocks_per_chip": int(host_blocks),
+                "cached_tokens_hbm": int(blocks * bs),
+                "cached_tokens_hbm_plus_host": int(
+                    (blocks + host_blocks) * bs),
             }
     report: Dict[str, Any] = {
         "config": {
@@ -840,8 +879,11 @@ def capacity_report(model_config, serving_config, measured: Optional[Dict]
             "kv_quant": serving_config.kv_quant,
             "tp": serving_config.tp,
             "max_slots": serving_config.max_slots,
+            "offload": tier_on,
+            "offload_blocks": tier_blocks,
         },
         "hbm_budget_bytes_per_chip": hbm,
+        "host_budget_bytes_per_chip": host,
         "mean_seq_tokens": round(seq, 1),
         "blocks_per_seq": blocks_per_seq,
         "layouts": layouts,

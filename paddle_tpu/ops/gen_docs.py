@@ -212,6 +212,35 @@ def generate(out_path: str = "docs/OPS.md") -> str:
         "invariant. A `broken` replica is healed by the roll: its "
         "rebuild gets a fresh restart budget.",
         "",
+        "### Drain-with-migration runbook (live KV migration)",
+        "",
+        "With `FLAGS_serving_migrate` on (or `RouterConfig(migrate="
+        "True)`), every router-initiated drain — `drain_replica()` for "
+        "scale-in, each per-replica drain of a rolling restart, and the "
+        "deadline sweep before evacuation — first LIVE-MIGRATES the "
+        "draining replica's in-flight requests instead of waiting them "
+        "out: `EngineSupervisor.export_request` serializes the request's "
+        "resolved decode state plus its KV block chain "
+        "(`ServingEngine.serialize_request`), a healthy candidate "
+        "adopts it (`adopt` — shape-key-checked, all-or-nothing: any "
+        "refusal frees everything it touched and raises `AdoptError`), "
+        "and only after the adoptive route is installed is the origin "
+        "copy released (`release_migrated` — exactly-once by "
+        "construction: the route moves before the origin cancel, so the "
+        "drain-cancel sweep can never double-failover the request). "
+        "Decoding continues on the survivor with ZERO recomputed "
+        "tokens and a bit-identical stream; PRNG continuity for sampled "
+        "requests rides the serialized state. When NO candidate can "
+        "take the blocks (pool full, no slot, mismatched shape key) the "
+        "request falls back to the PR 9 resubmit path at the drain "
+        "deadline — `counters.migration_fallbacks` counts these; "
+        "correctness is unchanged, only the recompute cost returns. "
+        "Watch: `counters.migrations` / `migration_tokens` (work "
+        "preserved), `migration_fallbacks` climbing (targets too full "
+        "to adopt — add capacity before rolling), and the auditor's "
+        "`migration_exactly_once` check, which fails the fleet if a "
+        "migrated stream ever diverges from its router-side mirror.",
+        "",
         "### Autoscale actuation",
         "",
         "`router.autoscale()` acts on the fleet-aggregated "

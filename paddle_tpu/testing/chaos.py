@@ -50,6 +50,14 @@ injector               fault it models
 ``flaky_probe``        a replica whose health/ops surface raises while
                        the engine may be fine — the router's probe path
                        must route around it and charge its breaker
+``host_pressure``      host RAM pressure shrinking the KV offload tier
+                       live (OOM-killer headroom, a co-tenant ballooning)
+                       — displaced blocks must fall back to recompute,
+                       never crash or corrupt
+``corrupt_offload_block``  a bit-flip inside a host-offloaded KV block
+                       (ECC miss, bit rot): the write-time checksum must
+                       degrade the entry to a cache MISS so the request
+                       recomputes bit-exactly — wrong KV is never served
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -74,8 +82,9 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "dead_worker", "stalled_consumer", "poison_prompt",
            "flood_tenant", "engine_crash", "disconnect_mid_stream",
            "slow_client", "replica_kill", "slow_replica", "flaky_probe",
+           "host_pressure", "corrupt_offload_block",
            "ChaosEvent", "ChaosTimeline", "chaos_timeline",
-           "TIMELINE_INJECTORS", "INJECTORS"]
+           "TIMELINE_INJECTORS", "TIER_INJECTORS", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -534,6 +543,53 @@ def flaky_probe(target, rid=None, fails: int = 3,
 
 
 # ---------------------------------------------------------------------------
+# KV-tier injectors (inference.serving.offload; ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _tier(target, rid=None):
+    """Resolve (HostOffloadTier or None, rid) from a router / replica /
+    supervisor / bare engine."""
+    sup, rid = _fleet_sup(target, rid)
+    eng = getattr(sup, "engine", sup)
+    return getattr(eng.cache, "offload", None), rid
+
+
+def host_pressure(target, rid=None, blocks: int = 0) -> dict:
+    """Host RAM pressure: shrink one replica's KV offload tier to
+    ``blocks`` live (default 0 — the tier drops everything it holds).
+    Models the OOM killer reclaiming headroom or a co-tenant ballooning.
+    Displaced entries silently fall back to the recompute path — the
+    recovery proof is bit-identical outputs with pool accounting and the
+    ``tier_partition`` invariant intact. Returns ``{"rid", "enabled",
+    "before", "after", "capacity"}`` (``enabled=False`` with the tier
+    off — the fault is then vacuous, like killing a replica that holds
+    nothing)."""
+    tier, rid = _tier(target, rid)
+    if tier is None:
+        return {"rid": rid, "enabled": False, "before": 0, "after": 0,
+                "capacity": 0}
+    before = tier.blocks
+    tier.resize(blocks)
+    return {"rid": rid, "enabled": True, "before": before,
+            "after": tier.blocks, "capacity": tier.capacity}
+
+
+def corrupt_offload_block(target, rid=None, seed: int = 0) -> dict:
+    """Flip one byte inside one host-offloaded KV block WITHOUT updating
+    its write-time checksum — silent host-memory corruption (ECC miss,
+    bit rot). The next swap-in attempt must detect the mismatch and
+    degrade to a cache MISS (``corrupt_drops`` increments, the chain
+    recomputes bit-exactly); wrong KV must never reach a request.
+    Returns ``{"rid", "enabled", "key"}`` — ``key=None`` when the tier
+    holds nothing to corrupt (the fault is vacuous)."""
+    tier, rid = _tier(target, rid)
+    if tier is None:
+        return {"rid": rid, "enabled": False, "key": None}
+    return {"rid": rid, "enabled": True,
+            "key": tier.corrupt_one(int(seed))}
+
+
+# ---------------------------------------------------------------------------
 # chaos timeline (fleet-scale replay; ISSUE 13)
 # ---------------------------------------------------------------------------
 
@@ -605,6 +661,12 @@ TIMELINE_INJECTORS = ("replica_kill", "slow_replica", "flood_tenant",
                       "poison_prompt", "disconnect_mid_stream",
                       "flaky_probe")
 
+# the KV-tier faults (ISSUE 16) — NOT in the default timeline mix, which
+# would silently reshuffle every previously generated seed's schedule;
+# tier-exercising replays pass ``kinds=TIMELINE_INJECTORS +
+# TIER_INJECTORS`` (or any mix) explicitly
+TIER_INJECTORS = ("host_pressure", "corrupt_offload_block")
+
 
 def chaos_timeline(seed: int, horizon_steps: int,
                    kinds=TIMELINE_INJECTORS, events: int = 6,
@@ -633,6 +695,10 @@ def chaos_timeline(seed: int, horizon_steps: int,
                   "seed": rng.randrange(1000)}
         elif name == "flaky_probe":
             kw = {"fails": rng.randrange(2, 5)}
+        elif name == "host_pressure":
+            kw = {"blocks": rng.randrange(0, 4)}
+        elif name == "corrupt_offload_block":
+            kw = {"seed": rng.randrange(1000)}
         out.append(ChaosEvent(step, name, **kw))
     return ChaosTimeline(out)
 
@@ -658,4 +724,6 @@ INJECTORS = {
     "replica_kill": replica_kill,
     "slow_replica": slow_replica,
     "flaky_probe": flaky_probe,
+    "host_pressure": host_pressure,
+    "corrupt_offload_block": corrupt_offload_block,
 }

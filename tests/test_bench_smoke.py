@@ -179,6 +179,33 @@ def test_bench_serve_entry_point():
     assert detail["router_roll_restarts"] >= detail["router_replicas"]
     assert detail["router_recompiles_constant"] is True
     assert detail["router_tok_s"] > 0
+    # KV tiering row (ISSUE 16): device-pool churn with the host offload
+    # tier on vs off — re-visit parity, real swap traffic, verified (zero
+    # corrupt-drop) restores, zero recompute, and strictly more prefix
+    # hits than the tier-off run whose chains died with the device pool.
+    # The asserts also live in-section; the smoke pins the record + the
+    # emitted metric.
+    assert detail["tier_outputs_match"] is True
+    assert detail["tier_swap_outs"] > 0
+    assert detail["tier_swap_ins"] > 0
+    assert detail["tier_hits"] > 0
+    assert detail["tier_corrupt_drops"] == 0
+    assert detail["tier_recomputed_tokens"] == 0
+    assert detail["tier_prefix_hit_tokens"] > \
+        detail["tier_off_prefix_hit_tokens"]
+    assert detail["tier_hit_ttft_ratio"] > 0
+    assert "serving_tier_hit_ttft_ratio" in metrics
+    # migration row (ISSUE 16): scale-in drain with live KV migration —
+    # every in-flight request moved (block chains + resolved state) to
+    # the survivor and finished bit-identically with zero recompute,
+    # zero failures and zero leaked blocks anywhere in the fleet
+    assert detail["migration_outputs_match"] is True
+    assert detail["migrations"] >= 1
+    assert detail["migration_failed"] == 0
+    assert detail["migration_recomputed_tokens"] == 0
+    assert detail["migration_leaked_blocks"] == 0
+    assert detail["migration_recompute_saved"] > 0
+    assert "serving_migration_recompute_saved" in metrics
 
 
 def test_bench_health_entry_point():

@@ -976,7 +976,10 @@ class TestFleetChaos:
     def test_injector_registry_has_fleet_trio(self):
         for name in ("replica_kill", "slow_replica", "flaky_probe"):
             assert name in chaos.INJECTORS
-        assert len(chaos.INJECTORS) == 18
+        # + the ISSUE 16 KV-tier pair (host_pressure, corrupt_offload_block)
+        for name in chaos.TIER_INJECTORS:
+            assert name in chaos.INJECTORS
+        assert len(chaos.INJECTORS) == 20
 
     def _router(self, params, cfg, **kw):
         from paddle_tpu.inference.serving import ServingConfig, ServingRouter

@@ -528,3 +528,52 @@ class TestFleetReplay10k:
         assert two["chaos_fired"] == one["chaos_fired"]
         assert two["audit_trail"] == one["audit_trail"]
         assert two["audit"] == one["audit"]
+
+
+# ---------------------------------------------------------------------------
+# survivable-KV replay (ISSUE 16): tier + migration under chaos, audited
+# ---------------------------------------------------------------------------
+
+class TestSurvivableKVReplay:
+    def test_tier_and_migration_replay_clean(self, setup):
+        """A fleet with the host offload tier AND live migration on,
+        chaos drawn from the full mix INCLUDING the tier pair
+        (host_pressure, corrupt_offload_block) — the audit (now carrying
+        tier_partition + migration_exactly_once) stays clean, nothing
+        fails or leaks, and the capacity report grows the host-tier
+        columns."""
+        from paddle_tpu.inference.serving import RouterConfig, run_replay
+        from paddle_tpu.testing.chaos import (TIER_INJECTORS,
+                                              TIMELINE_INJECTORS,
+                                              chaos_timeline)
+        cfg, params, programs = setup
+        spec = small_spec()
+        timeline = chaos_timeline(
+            spec.seed + 1, spec.horizon,
+            kinds=TIMELINE_INJECTORS + TIER_INJECTORS, events=8)
+        rep = run_replay(
+            params, cfg, spec=spec,
+            serving_config=serving_config(offload=True, offload_blocks=32),
+            router_config=RouterConfig(replicas=3, migrate=True,
+                                       breaker_cooldown_s=0.0,
+                                       hedge_ttft_mult=0.0),
+            chaos=timeline, programs=programs, host_gb=1.0)
+        assert rep["violations"] == []
+        assert rep["failed"] == 0 and rep["router_failed"] == 0
+        assert rep["leaked_blocks"] == 0
+        assert rep["drain_report"]["leaked_blocks"] == 0
+        # the tier pair actually fired (scheduled kinds include them)
+        fired = {name for _, name, _ in rep["chaos_fired"]} \
+            if "chaos_fired" in rep else set(rep["chaos_kinds"])
+        assert fired & set(TIER_INJECTORS)
+        # host-tier capacity columns: an explicit host budget sizes the
+        # tier, and host-extended cached tokens strictly beat HBM-only
+        cap = rep["capacity"]
+        assert cap["host_budget_bytes_per_chip"] == 1 << 30
+        fp1 = cap["layouts"]["fp_tp1"]
+        assert fp1["host_blocks_per_chip"] > 0
+        assert fp1["cached_tokens_hbm_plus_host"] > \
+            fp1["cached_tokens_hbm"]
+        # int8 host blocks are cheaper: same budget, more cached tokens
+        assert cap["layouts"]["int8_tp1"]["host_blocks_per_chip"] > \
+            fp1["host_blocks_per_chip"]

@@ -915,3 +915,180 @@ class TestSampledFailover:
         assert snap["counters"]["failed"] == 0
         assert_balanced(r)
         r.close()
+
+
+# ---------------------------------------------------------------------------
+# live KV migration (ISSUE 16): drain/roll/scale-in move in-flight state
+# ---------------------------------------------------------------------------
+
+class TestMigration:
+    """RouterConfig(migrate=True): a drained replica's in-flight requests
+    transfer their KV block chains + resolved records to an adoptive
+    replica — zero recompute, bit-identical streams, automatic fallback
+    to the PR 9 resubmit path when nobody can adopt."""
+
+    # BASE slots (2) would leave the survivor no adoption headroom with
+    # work of its own; migration traces run 4 slots
+    BASE4 = dict(block_size=4, max_slots=4, max_model_len=32,
+                 decode_chunk=2, queue_depth=8)
+
+    @pytest.fixture(scope="class")
+    def mig_programs(self, setup):
+        from paddle_tpu.inference.serving import ServingConfig, ServingRouter
+        cfg, params, prompts, _ = setup
+        donor = ServingRouter(params, cfg, ServingConfig(**self.BASE4),
+                              replicas=1)
+        donor.run(prompts[:2], max_new_tokens=[2] * 2, eos_token_id=None)
+        return donor._programs
+
+    def mk(self, setup, programs, migrate=True, **kw):
+        from paddle_tpu.inference.serving import (RouterConfig,
+                                                  ServingConfig,
+                                                  ServingRouter)
+        cfg, params, _, _ = setup
+        sc = dict(self.BASE4)
+        sc.update(kw)
+        return ServingRouter(
+            params, cfg, ServingConfig(**sc),
+            router_config=RouterConfig(replicas=2, migrate=migrate),
+            programs=programs if sc == self.BASE4 else None)
+
+    @staticmethod
+    def _recomputed(router):
+        return sum(rep.sup.engine.stats()["recomputed_tokens"]
+                   for rep in router._replicas.values())
+
+    def _drain_all(self, router):
+        while router.pending:
+            router.step(1)
+
+    def test_scale_in_drain_migrates_bit_exact(self, setup, mig_programs):
+        """drain_replica() one step after submit: every in-flight request
+        on the drained replica moves live and finishes bit-identical to
+        dense with recomputed_tokens == 0 fleet-wide."""
+        cfg, params, prompts, _ = setup
+        r = self.mk(setup, mig_programs)
+        frids = [r.submit(p, max_new_tokens=6, eos_token_id=None)
+                 for p in prompts]
+        r.step(1)
+        r.drain_replica(r.replicas[0])
+        self._drain_all(r)
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 6))
+        snap = r.health_snapshot()
+        assert r.migrations >= 1
+        assert snap["counters"]["failed"] == 0
+        assert self._recomputed(r) == 0
+        assert_balanced(r)
+        from paddle_tpu.inference.serving import InvariantAuditor
+        assert InvariantAuditor().check(r, collect=True) == []
+
+    def test_rolling_restart_migrates(self, setup, mig_programs):
+        """A PACED rolling restart (step-pumped while requests are live)
+        migrates instead of resubmitting: zero failed, zero recompute,
+        every stream bit-exact, every replica rebuilt."""
+        cfg, params, prompts, _ = setup
+        r = self.mk(setup, mig_programs)
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        r.step(1)
+        r.start_rolling_restart(drain_deadline_s=5.0)
+        steps = 0
+        while r.rolling and steps < 500:
+            r.step(1)
+            steps += 1
+        assert not r.rolling
+        self._drain_all(r)
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 8))
+        assert r.migrations >= 1
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        assert self._recomputed(r) == 0
+        assert r.replica_restarts >= 2
+        assert_balanced(r)
+
+    def test_fallback_to_resubmit_when_slots_full(self, setup):
+        """No adoption headroom (2 slots, every slot busy fleet-wide):
+        the drain falls back to the PR 9 resubmit path — counted, zero
+        failed, outputs still bit-exact (recompute pays the cost)."""
+        from paddle_tpu.inference.serving import RouterConfig
+        cfg, params, prompts, _ = setup
+        r = mk_router(setup,
+                      router_config=RouterConfig(replicas=2, migrate=True))
+        frids = [r.submit(p, max_new_tokens=6, eos_token_id=None)
+                 for p in prompts]
+        r.step(1)
+        r.drain_replica(r.replicas[0])
+        while r.pending:
+            r.step(1)
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 6))
+        assert r.migration_fallbacks >= 1
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        assert_balanced(r)
+
+    def test_migrate_off_uses_resubmit(self, setup, mig_programs):
+        """Control: migrate=False drains through the PR 9 path — same
+        bits, but the migration counters stay zero."""
+        cfg, params, prompts, _ = setup
+        r = self.mk(setup, mig_programs, migrate=False)
+        frids = [r.submit(p, max_new_tokens=6, eos_token_id=None)
+                 for p in prompts]
+        r.step(1)
+        r.drain_replica(r.replicas[0])
+        self._drain_all(r)
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 6))
+        assert r.migrations == 0 and r.migration_tokens == 0
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        assert_balanced(r)
+
+    def test_mid_chunked_prefill_migrates(self, setup):
+        """A request drained MID-chunked-prefill (long prompt, small
+        chunk) migrates with its partial chain and finishes bit-exact
+        with zero recompute."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(23)
+        long_prompts = [rng.integers(0, 97, (24,)).astype(np.int32)
+                        for _ in range(2)]
+        r = self.mk(setup, None, prefill_chunk=8)
+        frids = [r.submit(p, max_new_tokens=6, eos_token_id=None)
+                 for p in long_prompts]
+        r.step(1)                       # at most one 8-token chunk done
+        r.drain_replica(r.replicas[0])
+        self._drain_all(r)
+        for f, p in zip(frids, long_prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 6))
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        assert self._recomputed(r) == 0
+        assert_balanced(r)
+
+    def test_preempted_requeued_request_survives_drain(self, setup):
+        """A request preempted back to the queue (pool pressure) before
+        its replica drains still finishes bit-exact with zero failures —
+        queued work re-routes, running work migrates."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(29)
+        prompts = [rng.integers(0, 97, (10,)).astype(np.int32)
+                   for _ in range(4)]
+        # pool sized to force preemption under 4 slots of live work
+        r = self.mk(setup, None, num_blocks=14)
+        frids = [r.submit(p, max_new_tokens=8, eos_token_id=None)
+                 for p in prompts]
+        for _ in range(3):
+            r.step(1)
+        r.drain_replica(r.replicas[0])
+        self._drain_all(r)
+        for f, p in zip(frids, prompts):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 8))
+        assert r.health_snapshot()["counters"]["failed"] == 0
+        stats = [rep.sup.engine.stats() for rep in r._replicas.values()]
+        assert sum(s["oom_truncated"] for s in stats) == 0
+        assert sum(s["preemptions"] for s in stats) >= 1
+        assert_balanced(r)

@@ -2343,3 +2343,229 @@ class TestSpeculativeDecoding:
         assert ServingConfig().spec_decode == 0          # flag default off
         assert ServingConfig(spec_decode=None).spec_decode == 0
         assert ServingConfig(spec_decode=4).spec_decode == 4
+
+
+class TestHostOffloadTier:
+    """ISSUE 16 tentpole (a): evicted prefix chains swap to the bounded
+    host-RAM tier and come back bit-exactly — fp and int8 pools, gather
+    and kernel decode paths — and a corrupt host block degrades to a
+    recompute MISS, never wrong KV."""
+
+    PRE, TAIL, OUT = 12, 3, 4      # 3 full blocks of prefix at bs=4
+
+    def _trace(self, rng, fams=3, per=2):
+        prefixes = [rng.integers(0, 97, (self.PRE,)).astype(np.int32)
+                    for _ in range(fams)]
+        prompts = [np.concatenate([pre, rng.integers(0, 97, (self.TAIL,))
+                                   .astype(np.int32)])
+                   for pre in prefixes for _ in range(per)]
+        return prefixes, prompts
+
+    def _tier_engine(self, params, cfg, on=True, **kw):
+        # device pool sized so the churn wave LRU-evicts every family's
+        # chain (2 slots x 5 blocks live + a little headroom)
+        base = dict(max_slots=2, num_blocks=12, prefix_cache=True,
+                    offload=on, offload_blocks=32)
+        base.update(kw)
+        return make_engine(params, cfg, **base)
+
+    def _churn_and_revisit(self, eng, rng, prompts, revisit):
+        eng.run(prompts, max_new_tokens=self.OUT, eos_token_id=None)
+        st1 = eng.stats()
+        outs = eng.run(revisit, max_new_tokens=self.OUT, eos_token_id=None)
+        return outs, st1, eng.stats()
+
+    def test_roundtrip_bit_parity_fp(self, setup):
+        """Churn wave evicts the families' chains into the host tier; the
+        re-visit restores them H2D as prefix hits with ZERO recompute and
+        dense-oracle bit parity."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(7)
+        _, prompts = self._trace(rng)
+        eng = self._tier_engine(params, cfg)
+        revisit = prompts[:2]
+        outs, st1, st2 = self._churn_and_revisit(eng, rng, prompts, revisit)
+        oracle = dense_rows(params, cfg, revisit, [self.OUT] * 2)
+        for o, d in zip(outs, oracle):
+            np.testing.assert_array_equal(o, d)
+        off = st2["offload"]
+        assert off["swap_outs"] > 0 and off["swap_ins"] > 0
+        assert off["tier_hits"] > 0 and off["corrupt_drops"] == 0
+        assert st2["recomputed_tokens"] == 0
+        assert st2["prefix_hit_tokens"] > st1["prefix_hit_tokens"]
+        # residency is device XOR host + the tier respects its bound
+        from paddle_tpu.inference.serving import InvariantAuditor
+        assert InvariantAuditor().check(eng, collect=True) == []
+
+    def test_tier_off_same_trace_recomputes(self, setup):
+        """Control: the identical trace with the tier OFF serves the same
+        bits (the tier is a pure cache) but re-prefills the re-visit —
+        no swap counters, no stats surface."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(7)
+        _, prompts = self._trace(rng)
+        eng = self._tier_engine(params, cfg, on=False)
+        revisit = prompts[:2]
+        outs, st1, st2 = self._churn_and_revisit(eng, rng, prompts, revisit)
+        oracle = dense_rows(params, cfg, revisit, [self.OUT] * 2)
+        for o, d in zip(outs, oracle):
+            np.testing.assert_array_equal(o, d)
+        assert st2["offload"] is None
+
+    def test_roundtrip_int8_pool(self, setup):
+        """The tier is layout-agnostic: int8 blocks (values + scales
+        leaves) swap out/in byte-exactly — tier-on output bit-equal to
+        the tier-off int8 engine (the int8 path's own oracle), with real
+        swap traffic."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(11)
+        _, prompts = self._trace(rng)
+        revisit = prompts[:2]
+        outs = {}
+        for on in (True, False):
+            eng = self._tier_engine(params, cfg, on=on, kv_quant="int8")
+            o, _, st2 = self._churn_and_revisit(
+                eng, np.random.default_rng(11), prompts, revisit)
+            outs[on] = [np.asarray(x) for x in o]
+            if on:
+                off = st2["offload"]
+                assert off["swap_ins"] > 0 and off["tier_hits"] > 0
+                assert off["corrupt_drops"] == 0
+                assert st2["recomputed_tokens"] == 0
+        for a, b in zip(outs[True], outs[False]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_roundtrip_kernel_path(self, setup):
+        """Restored host blocks feed the Pallas paged-attention kernel
+        (interpret mode on CPU — the real kernel path) bit-identically
+        to the dense oracle."""
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(13)
+        _, prompts = self._trace(rng, fams=2)
+        eng = self._tier_engine(params, cfg, paged_kernel="on")
+        revisit = prompts[:1]
+        outs, _, st2 = self._churn_and_revisit(eng, rng, prompts, revisit)
+        oracle = dense_rows(params, cfg, revisit, [self.OUT])
+        np.testing.assert_array_equal(outs[0], oracle[0])
+        assert st2["offload"]["tier_hits"] > 0
+        assert st2["recomputed_tokens"] == 0
+
+    def test_corrupt_block_degrades_to_recompute(self, setup):
+        """A bit-flipped host block (checksum NOT updated) must be caught
+        at take: dropped + counted, the lookup degrades to a MISS, and
+        the re-visit re-prefills BIT-EXACTLY. Corruption may cost
+        recompute; it may never serve wrong KV."""
+        from paddle_tpu.testing import chaos
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(17)
+        _, prompts = self._trace(rng)
+        eng = self._tier_engine(params, cfg)
+        eng.run(prompts, max_new_tokens=self.OUT, eos_token_id=None)
+        r = chaos.corrupt_offload_block(eng, seed=1)
+        assert r["enabled"] is True and r["key"] is not None
+        revisit = prompts[:2]
+        outs = eng.run(revisit, max_new_tokens=self.OUT, eos_token_id=None)
+        oracle = dense_rows(params, cfg, revisit, [self.OUT] * 2)
+        for o, d in zip(outs, oracle):
+            np.testing.assert_array_equal(o, d)
+        off = eng.stats()["offload"]
+        assert off["corrupt_drops"] >= 1
+
+    def test_host_pressure_shrinks_then_recovers(self, setup):
+        """The host_pressure injector resizes the tier live: dropped
+        entries silently fall back to recompute (bit parity holds), and
+        after the pressure lifts the tier accepts swap-outs again."""
+        from paddle_tpu.testing import chaos
+        cfg, params, _, _ = setup
+        rng = np.random.default_rng(19)
+        _, prompts = self._trace(rng)
+        eng = self._tier_engine(params, cfg)
+        eng.run(prompts, max_new_tokens=self.OUT, eos_token_id=None)
+        r = chaos.host_pressure(eng, blocks=0)
+        assert r["enabled"] is True and r["before"] > 0 and r["after"] == 0
+        revisit = prompts[:2]
+        outs = eng.run(revisit, max_new_tokens=self.OUT, eos_token_id=None)
+        oracle = dense_rows(params, cfg, revisit, [self.OUT] * 2)
+        for o, d in zip(outs, oracle):
+            np.testing.assert_array_equal(o, d)
+        tier = eng.cache.offload
+        tier.resize(32)
+        swaps0 = tier.swap_outs
+        eng.run(prompts[2:], max_new_tokens=self.OUT, eos_token_id=None)
+        assert tier.swap_outs > swaps0
+
+    def test_tier_unit_move_semantics_and_bound(self):
+        """HostOffloadTier unit contract: verified take() is a MOVE,
+        token/checksum mismatches drop as counted corrupt MISSes, the
+        capacity bound evicts oldest-first, discard() drops a stale host
+        copy."""
+        from paddle_tpu.inference.serving.offload import HostOffloadTier
+        t = HostOffloadTier(capacity_blocks=2, block_size=4)
+        mk = lambda v: {"k": np.full((2, 4), v, np.float32)}
+        t.put(1, (1, 2, 3, 4), mk(1.0))
+        t.put(2, (5, 6, 7, 8), mk(2.0))
+        assert t.blocks == 2
+        got = t.take(1, (1, 2, 3, 4))
+        np.testing.assert_array_equal(got["k"], mk(1.0)["k"])
+        assert t.take(1, (1, 2, 3, 4)) is None          # moved out
+        assert t.tier_hits == 1 and t.tier_misses == 1
+        # token mismatch -> counted corrupt drop
+        assert t.take(2, (9, 9, 9, 9)) is None
+        assert t.corrupt_drops == 1 and t.blocks == 0
+        # capacity bound: third put evicts the oldest (pending_depth=0
+        # materializes immediately, so eviction order is strict FIFO; at
+        # the default depth the bound drops the LRU-est PENDING entry)
+        t = HostOffloadTier(capacity_blocks=2, block_size=4,
+                            pending_depth=0)
+        t.put(3, (0,) * 4, mk(3.0))
+        t.put(4, (0,) * 4, mk(4.0))
+        t.put(5, (0,) * 4, mk(5.0))
+        assert t.blocks == 2 and t.tier_evictions == 1
+        assert t.take(3, (0,) * 4) is None              # it was evicted
+        # discard: device re-registration drops the host copy
+        t.discard(4)
+        assert t.take(4, (0,) * 4) is None
+        assert t.stats()["capacity"] == 2
+
+
+class TestDrainRetryAfter:
+    """ISSUE 16 satellite: during an ACTIVE drain the shed hint is the
+    drain-deadline REMAINDER, not the retirement-interval estimate — a
+    client must not be told to retry into a replica that is leaving."""
+
+    def _sched(self, setup):
+        from paddle_tpu.inference.serving import PagedKVCache, Scheduler
+        cfg, _, _, _ = setup
+        cache = PagedKVCache(cfg, max_slots=2, max_model_len=16,
+                             block_size=4)
+        return Scheduler(cache, max_slots=2, queue_depth=4)
+
+    def test_drain_deadline_remainder(self, setup):
+        import time as _t
+        sched = self._sched(setup)
+        sched.drain_deadline = _t.time() + 7.5
+        hint = sched.retry_after_s()
+        assert 6.5 < hint <= 7.5
+
+    def test_expired_deadline_falls_back(self, setup):
+        import time as _t
+        sched = self._sched(setup)
+        sched.drain_deadline = _t.time() - 1.0
+        # no retirements observed -> the conservative flag default
+        assert sched.retry_after_s() == sched.default_retry_after_s
+
+    def test_supervisor_drain_stamps_deadline(self, setup):
+        """request_drain() stamps the scheduler so the structured 503s a
+        draining replica sheds carry the remainder."""
+        from paddle_tpu.inference.serving import (EngineSupervisor,
+                                                  ServingConfig)
+        cfg, params, prompts, _ = setup
+        sup = EngineSupervisor(params, cfg, ServingConfig(
+            block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+            queue_depth=4), drain_deadline_s=9.0)
+        try:
+            sup.request_drain()
+            hint = sup.engine._sched.retry_after_s()
+            assert 8.0 < hint <= 9.0
+        finally:
+            sup.close()
