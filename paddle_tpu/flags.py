@@ -450,6 +450,35 @@ define_flag("FLAGS_serving_router_hedge_ttft_mult", 0.0,
             "the winner's stream is THE stream). 0 disables hedging; it "
             "also stays off while FLAGS_serving_ttft_slo_s is 0.", float)
 
+# disaggregated prefill + fleet-wide cache directory (ISSUE 17):
+# docs/SERVING.md "Disaggregated prefill & fleet cache"
+define_flag("FLAGS_serving_router_prefill_replicas", 0,
+            "Prefill-only replicas the ServingRouter spawns in addition "
+            "to its decode replicas (Splitwise/DistServe-style compute "
+            "disaggregation): long prompts (see "
+            "FLAGS_serving_prefill_len_threshold) run chunked prefill "
+            "there, then hand the finished KV chain + resolved record to "
+            "a decode replica via the live-migration adopt path with "
+            "recomputed_tokens == 0. 0 disables the split — every prompt "
+            "takes the unified path. The router also collapses to the "
+            "unified path automatically when the pool is empty, draining "
+            "or the transfer fails.", int)
+define_flag("FLAGS_serving_prefill_len_threshold", 64,
+            "Prompt length (tokens) at which the router classifies a "
+            "request as LONG and routes its prefill to the prefill-only "
+            "pool (when FLAGS_serving_router_prefill_replicas > 0). "
+            "Shorter prompts always take the unified path — their "
+            "prefill is too cheap to be worth a handoff.", int)
+define_flag("FLAGS_serving_fleet_cache", True,
+            "Fleet-wide KV cache directory: the router tracks which "
+            "replica (device pool or host tier) holds each prefix-chain "
+            "key, routes submits to the replica holding the LONGEST "
+            "cached chain, and otherwise PULLS the cached blocks "
+            "cross-replica (checksummed like offload puts — a mismatch "
+            "degrades to recompute, never wrong KV). Off: each replica's "
+            "prefix cache is an island and stickiness falls back to the "
+            "first-block affinity map.", bool)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

@@ -105,6 +105,20 @@ AUDIT_CHECKS = {
                      "points at a live replica and a known request, and "
                      "the active set holds exactly the non-terminal "
                      "requests",
+    "directory_coherence": "fleet cache directory (ISSUE 17): the "
+                           "forward and reverse holder maps agree, no "
+                           "entry has an empty holder set, the entry "
+                           "bound holds, every holder rid names a "
+                           "replica in the fleet, and NO entry is "
+                           "stale-authoritative — each (key, replica) "
+                           "claim is backed by that replica's device "
+                           "prefix cache or host offload tier right now "
+                           "(stale-missing is allowed by design: a pull "
+                           "of a just-evicted chain degrades to "
+                           "recompute; a stale-authoritative entry "
+                           "would mean the invalidation callbacks "
+                           "leaked) — vacuously true with the "
+                           "directory off",
 }
 
 
@@ -679,6 +693,36 @@ class InvariantAuditor:
                              f"({len(have)} tokens, crc {_crc(have)}) — "
                              f"a migration/failover repeated or skipped "
                              f"a delivered token")
+        if on("directory_coherence"):
+            d = getattr(router, "_directory", None)
+            if d is not None:
+                for msg in d.check_consistency():
+                    fail("directory_coherence", msg)
+                for key, holders in d.items():
+                    for rid in holders:
+                        rep = router._replicas.get(rid)
+                        if rep is None:
+                            fail("directory_coherence",
+                                 f"key {key} names replica {rid}, which "
+                                 f"is not in the fleet")
+                            continue
+                        try:
+                            cache = rep.sup.engine.cache
+                        except Exception:  # noqa: BLE001 — mid-rebuild;
+                            continue       # _observe drops the rid next
+                        dev = key in cache.manager._hash2block
+                        host = (cache.offload is not None
+                                and cache.offload.holds(key))
+                        if not (dev or host):
+                            fail("directory_coherence",
+                                 f"stale-authoritative entry: key {key} "
+                                 f"names replica {rid} but neither its "
+                                 f"device pool nor its host tier holds "
+                                 f"it", str(rid))
+                if "counters_monotonic" in self.checks:
+                    self._counter_floor("directory", d,
+                                        ("adds", "drops", "evicted"),
+                                        fail)
         if on("counters_monotonic"):
             self._counter_floor(
                 "router", router,
@@ -686,6 +730,9 @@ class InvariantAuditor:
                  "hedges", "hedge_wins", "hedges_cancelled",
                  "probe_failures", "replica_restarts", "rolls_completed",
                  "migrations", "migration_tokens", "migration_fallbacks",
+                 "directory_hits", "cache_pulls", "pulled_blocks",
+                 "pull_fallbacks", "prefill_routed", "prefill_handoffs",
+                 "handoff_fallbacks",
                  "completed", "failed", "_shed_accum", "_opens_retired",
                  "_restarts_retired"), fail)
 

@@ -95,6 +95,7 @@ import numpy as np
 
 from ...flags import flag
 from ...health import watchdog as _watchdog
+from .offload import block_crc as _block_crc
 from .paged_cache import PagedKVCache
 from .policies import resolve_policy
 from .scheduler import (CANCELLED, DEFAULT_TENANT, SHED,  # noqa: F401
@@ -359,6 +360,14 @@ class ServingConfig:
 
 class ServingEngine:
     """Continuous-batching greedy decode service over a causal-LM pytree."""
+
+    # chaos hook (testing/chaos.py ``stale_directory``): when set, the
+    # NEXT export_chain() flips one byte in its payload AFTER stamping
+    # the checksums, so the receiving graft_chain() must detect the
+    # mismatch and degrade to recompute — the fleet-cache pull's
+    # corruption drill. Class-level default; injectors set it per
+    # instance and the export consumes it.
+    _corrupt_next_export = False
 
     def __init__(self, params, model_config, serving_config:
                  Optional[ServingConfig] = None, gen_config=None,
@@ -894,6 +903,94 @@ class ServingEngine:
                 req.build_prefill_ids(), blocks, entries,
                 tenant=req.tenant)
             return req.rid
+
+    # ---- fleet-wide cache pulls (ISSUE 17) --------------------------------
+
+    def export_chain(self, chain) -> Optional[Dict[str, Any]]:
+        """Serialize the longest CONTIGUOUS prefix of ``chain`` — a list
+        of ``(key, tokens)`` pairs in :func:`~.paged_cache.
+        prefix_block_chain` order — that this replica holds: device
+        blocks gather D2H through :meth:`PagedKVCache.read_block` (the
+        device-scalar index discipline, one compiled slice program),
+        host-tier blocks come from a verified non-destructive
+        :meth:`HostOffloadTier.peek`. Every block's leaves are stamped
+        with a write-time CRC32 (the ``offload.py`` checksum), so the
+        receiving :meth:`graft_chain` can detect any corruption in
+        flight and degrade to recompute — never wrong KV. The export is
+        a COPY: refcounts, registrations and tier entries on this
+        replica are untouched. Returns None when not even the first key
+        resolves (a stale directory entry — the benign miss)."""
+        with self._lock:
+            blocks: List[Dict[str, Any]] = []
+            for key, toks in chain:
+                toks = tuple(int(t) for t in toks)
+                data = None
+                b = self.cache.manager.lookup(key, toks)
+                if b is not None:
+                    data = {name: np.asarray(arr)
+                            for name, arr in
+                            self.cache.read_block(b).items()}
+                elif self.cache.offload is not None:
+                    hit = self.cache.offload.peek(key, toks)
+                    if hit is not None:
+                        data = {name: np.array(arr) for name, arr
+                                in hit.items()}
+                if data is None:
+                    break                 # contiguity ends at first miss
+                blocks.append({"key": int(key), "tokens": toks,
+                               "data": data,
+                               "crc": {n: _block_crc(a)
+                                       for n, a in data.items()}})
+            if not blocks:
+                return None
+            if self._corrupt_next_export:
+                # chaos drill: flip one byte AFTER the checksums stamped
+                self._corrupt_next_export = False
+                leaf = sorted(blocks[0]["data"])[0]
+                arr = np.array(blocks[0]["data"][leaf], copy=True)
+                arr.reshape(-1).view(np.uint8)[0] ^= 0xFF
+                blocks[0]["data"][leaf] = arr
+            return {"blocks": blocks, "shape_key": self.kv_shape_key()}
+
+    def graft_chain(self, payload: Dict[str, Any]) -> Dict[str, int]:
+        """Graft an exported chain into this replica's prefix cache:
+        verify each block's checksums, allocate a device block,
+        H2D-write the bytes and register the chain key — the block then
+        parks refcount-0 on the evictable list exactly like a locally
+        computed cached block, where the next ``admit()`` hits it. Walks
+        in chain order and STOPS at the first checksum mismatch (the
+        rest of the chain is downstream of corrupt KV), already-present
+        key, or dry pool. Returns ``{"grafted", "present", "corrupt"}``
+        — the caller's submit degrades to recompute for whatever did
+        not land, so a failed pull can only cost time."""
+        counts = {"grafted": 0, "present": 0, "corrupt": 0}
+        if payload is None:
+            return counts
+        with self._lock:
+            if tuple(payload["shape_key"]) != self.kv_shape_key():
+                raise AdoptError("KV layout mismatch (block size / "
+                                 "kv_quant / TP shape differ); pull "
+                                 "falls back to recompute")
+            for ent in payload["blocks"]:
+                key, toks = int(ent["key"]), tuple(ent["tokens"])
+                if self.cache.manager._hash2block.get(key) is not None:
+                    counts["present"] += 1
+                    continue              # first writer won locally
+                bad = any(_block_crc(np.asarray(a)) != ent["crc"][n]
+                          for n, a in ent["data"].items())
+                if bad:
+                    counts["corrupt"] += 1
+                    break
+                if not self.cache.manager.can_alloc(1):
+                    break                 # pool pressure: partial graft
+                [b] = self.cache.manager.alloc(1)
+                self.cache.write_block(b, ent["data"])
+                self.cache.manager.register(key, b, toks)
+                # release to the evictable list: cached, shareable, and
+                # reclaimable under pressure — never a leak at quiesce
+                self.cache.manager.free([b])
+                counts["grafted"] += 1
+            return counts
 
     def cancel(self, rid: int) -> bool:
         """Cancel a queued or running request: its remaining work is

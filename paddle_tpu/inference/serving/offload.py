@@ -52,11 +52,17 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["HostOffloadTier"]
+__all__ = ["HostOffloadTier", "block_crc"]
 
 
 def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+# the one checksum the whole KV-movement surface shares: tier puts/takes,
+# cross-replica chain pulls (engine.export_chain/graft_chain) and their
+# chaos injectors all stamp and verify with this
+block_crc = _crc
 
 
 class HostOffloadTier:
@@ -79,6 +85,16 @@ class HostOffloadTier:
         self.tier_misses = 0      # take() for an absent key
         self.corrupt_drops = 0    # entries dropped on checksum/token mismatch
         self.tier_evictions = 0   # entries dropped by the capacity bound
+        # fleet cache directory invalidation (ISSUE 17): called with the
+        # key of EVERY entry that leaves the tier without re-registering
+        # on device in the same operation (capacity eviction, discard,
+        # verified take — the take's device re-registration re-adds the
+        # key immediately after). None = no listener.
+        self.on_drop = None
+
+    def _dropped(self, key: int) -> None:
+        if self.on_drop is not None:
+            self.on_drop(key)
 
     # -- capacity -----------------------------------------------------------
 
@@ -95,10 +111,11 @@ class HostOffloadTier:
     def _evict_to(self, bound: int) -> None:
         while self.blocks > bound:
             if self._pending:   # oldest swap-out first (it is the LRU-est)
-                self._pending.popitem(last=False)
+                k, _ = self._pending.popitem(last=False)
             else:
-                self._entries.popitem(last=False)
+                k, _ = self._entries.popitem(last=False)
             self.tier_evictions += 1
+            self._dropped(k)
 
     def resize(self, capacity_blocks: int) -> None:
         """Shrink/grow the bound live; excess entries fall back to the
@@ -134,11 +151,19 @@ class HostOffloadTier:
             k, (toks, sl) = self._pending.popitem(last=False)
             self._materialize(k, toks, sl)
 
+    def holds(self, key: int) -> bool:
+        """Whether the tier currently holds ``key`` (materialized or
+        pending) — the residency test the BlockManager's directory
+        invalidation consults when a device registration dies."""
+        return key in self._entries or key in self._pending
+
     def discard(self, key: int) -> None:
         """Drop any host copy of ``key`` — called when the key registers
         on device again (device copy becomes the authoritative one)."""
-        self._entries.pop(key, None)
-        self._pending.pop(key, None)
+        had = self._entries.pop(key, None) is not None
+        had = self._pending.pop(key, None) is not None or had
+        if had:
+            self._dropped(key)
 
     # -- swap-in ------------------------------------------------------------
 
@@ -160,15 +185,37 @@ class HostOffloadTier:
             del self._entries[key]
             self.corrupt_drops += 1
             self.tier_misses += 1
+            self._dropped(key)
             return None
         for name, arr in e["data"].items():
             if _crc(arr) != e["crc"][name]:
                 del self._entries[key]
                 self.corrupt_drops += 1
                 self.tier_misses += 1
+                self._dropped(key)
                 return None
         del self._entries[key]
         self.tier_hits += 1
+        self._dropped(key)   # the caller registers it on device right away
+        return e["data"]
+
+    def peek(self, key: int, tokens) -> Optional[Dict]:
+        """Verified NON-destructive read: the block's host arrays iff the
+        key is present and tokens + every checksum verify, else None —
+        the entry stays put either way (a cross-replica chain export
+        COPIES the holder's cache, it must not steal it). Unlike
+        :meth:`take`, a mismatch here does not drop the entry or charge
+        ``corrupt_drops``: the holder's own next ``take`` will, through
+        the accounting path its stats tests pin."""
+        if key in self._pending:
+            toks, sl = self._pending.pop(key)
+            self._materialize(key, toks, sl)
+        e = self._entries.get(key)
+        if e is None or e["tokens"] != tuple(int(t) for t in tokens):
+            return None
+        for name, arr in e["data"].items():
+            if _crc(arr) != e["crc"][name]:
+                return None
         return e["data"]
 
     # -- introspection ------------------------------------------------------

@@ -129,6 +129,16 @@ class BlockManager:
         # this module stays jax-free); `offload.put` accepts them.
         self.offload = None
         self.offload_capture = None
+        # fleet cache directory (ISSUE 17): the router subscribes these
+        # so its CacheDirectory learns which replica holds which chain
+        # key. `notify_register(key)` fires when a key becomes device-
+        # resident; `notify_unregister(key)` when it leaves the device
+        # WITHOUT surviving in the host tier (the tier's own on_drop
+        # covers the host side) — an entry can then never be
+        # stale-authoritative, only stale-missing, which pulls degrade
+        # from safely. None = no listener.
+        self.notify_register = None
+        self.notify_unregister = None
 
     @property
     def free_blocks(self) -> int:
@@ -185,7 +195,14 @@ class BlockManager:
         """Drop block ``b``'s prefix-cache registration (hash maps, stored
         tokens, tenant accounting). The caller owns what happens to the
         block itself."""
-        del self._hash2block[self._block2hash.pop(b)]
+        key = self._block2hash.pop(b)
+        del self._hash2block[key]
+        if self.notify_unregister is not None and \
+                not (self.offload is not None and self.offload.holds(key)):
+            # both eviction sites _offload() BEFORE _unregister(), so a
+            # key the tier accepted is still replica-resident — the
+            # directory entry survives the swap-out
+            self.notify_unregister(key)
         self._block_tokens.pop(b, None)
         t = self._block_tenant.pop(b, None)
         if t is not None:
@@ -273,6 +290,8 @@ class BlockManager:
         self._block2hash[block] = key
         if tokens is not None:
             self._block_tokens[block] = tokens
+        if self.notify_register is not None:
+            self.notify_register(key)
         if tenant is not None:
             self._block_tenant[block] = tenant
             self._tenant_cached[tenant] = \

@@ -130,10 +130,16 @@ class Replica:
     history, restart counters — survives the swap."""
 
     def __init__(self, rid: int, supervisor: EngineSupervisor,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 role: str = "decode"):
         self.rid = rid
         self.sup = supervisor
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # "decode" serves the full lifecycle; "prefill" (disaggregated
+        # prefill, ISSUE 17) only runs prompts to their first token and
+        # hands the chain to a decode replica — the router's candidate
+        # sets filter on this, the role never changes after spawn
+        self.role = role
         self.generation = 0            # bumps per rolling-restart rebuild
         self.retiring = False          # scale-in: remove once drained
         self.restarts_seen = 0         # supervisor restarts already counted
@@ -207,6 +213,7 @@ class Replica:
         except Exception:              # noqa: BLE001
             depth = None
         return {"accepting": self.routable(),
+                "role": self.role,
                 "broken": bool(self.sup.broken),
                 "draining": self.draining,
                 "retiring": self.retiring,

@@ -36,6 +36,19 @@ surface a single supervisor exposes:
   and the loser is cancelled through the lifecycle path so no KV blocks
   leak (greedy determinism makes the copies interchangeable).
 
+* **One cache, split compute (ISSUE 17).** A fleet-wide
+  :class:`~.directory.CacheDirectory` tracks which replica holds every
+  chained prefix key (fed by BlockManager/offload-tier callbacks the
+  router wires into each replica): a submit finds the LONGEST cached
+  chain anywhere in the fleet and either routes to its holder or PULLS
+  the blocks cross-replica (checksummed export/graft — a stale entry or
+  corrupt transfer degrades to recompute, never wrong KV). And with
+  ``RouterConfig.prefill_replicas`` set, long prompts run their chunked
+  prefill on a dedicated PREFILL-ONLY pool, then hand off to a decode
+  replica through the live-migration adopt path (``recomputed_tokens ==
+  0``) — decode TPOT stops paying for other requests' prefill bubbles.
+  Both collapse to the unified path when disabled, empty, or failing.
+
 * **Autoscale actuation + rolling restarts.** :meth:`autoscale` consumes
   the same :func:`~.supervisor.autoscale_signal` telemetry the PR-7
   supervisor emits — aggregated fleet-wide — to SPAWN a replica on
@@ -65,6 +78,8 @@ import numpy as np
 
 from ...flags import flag
 from ...health import watchdog as _watchdog
+from .directory import CacheDirectory
+from .paged_cache import prefix_block_chain
 from .replica import CircuitBreaker, Replica
 from .scheduler import (CANCELLED, FINISHED, QUEUED, TERMINAL_STATES,
                         ServingQueueFull, completes_by_tokens)
@@ -102,14 +117,32 @@ ROUTER_HEALTH_FIELDS = {
                 "blocks during a drain/roll/scale-in — the tokens never "
                 "recompute; ISSUE 16) / migration_fallbacks (exports "
                 "that no replica could adopt; they ride the resubmit/"
-                "recompute path instead) / completed / failed "
+                "recompute path instead) / directory_hits (submits "
+                "routed to the replica the fleet cache directory says "
+                "holds the longest prefix chain; ISSUE 17) / "
+                "cache_pulls + pulled_blocks (cross-replica chain "
+                "pulls that landed at least one checksummed block on "
+                "the target) / pull_fallbacks (pulls that found "
+                "nothing to move — stale entry, layout mismatch or "
+                "checksum failure; the submit recomputes) / "
+                "prefill_routed (long prompts classified onto the "
+                "disaggregated prefill pool) / prefill_handoffs "
+                "(prefill->decode adoptions, recomputed_tokens == 0) / "
+                "handoff_fallbacks (handoffs that collapsed to "
+                "decoding in place on the prefill replica) / "
+                "completed / failed "
                 "(failed MUST stay 0 across a rolling restart)",
-    "replicas": "per-replica rows: accepting / broken / draining / "
+    "directory": "fleet cache directory snapshot: entries / adds / "
+                 "drops / evicted ({'enabled': false} when "
+                 "RouterConfig.fleet_cache is off)",
+    "replicas": "per-replica rows: accepting / role (decode|prefill) / "
+                "broken / draining / "
                 "retiring / generation / restarts / depth / breaker "
                 "(state, consecutive_failures, threshold, cooldown_s, "
                 "opens, half_open_probes, reclosures)",
-    "fleet": "size / routable / open_breakers / draining / retiring — "
-             "the degraded-then-recovered story /readyz tells",
+    "fleet": "size / routable / open_breakers / draining / retiring / "
+             "prefill (disaggregated prefill-pool size) — the "
+             "degraded-then-recovered story /readyz tells",
     "roll": "rolling-restart progress: active / target / pending / "
             "restarted",
     "autoscale": "fleet-aggregated autoscale_signal() record (peeked — "
@@ -146,6 +179,15 @@ class RouterConfig:
     # requests to an adoptive replica WITH their computed blocks instead
     # of recomputing; None resolves FLAGS_serving_migrate
     migrate: Optional[bool] = None
+    # disaggregated prefill + fleet cache directory (ISSUE 17): a pool
+    # of prefill-only replicas long prompts are classified onto (0 =
+    # unified serving), the prompt length (tokens) at which a request
+    # counts as long, and the fleet-wide prefix-chain directory that
+    # replaces the first-block affinity map; None resolves the
+    # FLAGS_serving_* flags of the same names
+    prefill_replicas: Optional[int] = None
+    prefill_len_threshold: Optional[int] = None
+    fleet_cache: Optional[bool] = None
     seed: int = 0                             # P2C sampling RNG
     # successful health probes are cached this long: 0 (default) probes
     # every candidate on every submit — the spec'd behavior, and what a
@@ -173,9 +215,23 @@ class RouterConfig:
             self.ttft_slo_s = float(flag("FLAGS_serving_ttft_slo_s"))
         if self.migrate is None:
             self.migrate = bool(flag("FLAGS_serving_migrate"))
+        if self.prefill_replicas is None:
+            self.prefill_replicas = int(
+                flag("FLAGS_serving_router_prefill_replicas"))
+        if self.prefill_len_threshold is None:
+            self.prefill_len_threshold = int(
+                flag("FLAGS_serving_prefill_len_threshold"))
+        if self.fleet_cache is None:
+            self.fleet_cache = bool(flag("FLAGS_serving_fleet_cache"))
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1 (got {self.replicas})")
-        self.max_replicas = max(self.max_replicas, self.replicas)
+        if self.prefill_replicas < 0:
+            raise ValueError("prefill_replicas must be >= 0 "
+                             f"(got {self.prefill_replicas})")
+        # the ceiling governs DECODE autoscale headroom; the prefill pool
+        # is fixed-size and must not eat it
+        self.max_replicas = max(self.max_replicas, self.replicas) \
+            + self.prefill_replicas
 
     @property
     def hedge_after_s(self) -> Optional[float]:
@@ -218,6 +274,11 @@ class RouterRequest:
     state: str = QUEUED
     finish: Optional[Dict[str, Any]] = None
     failovers: int = 0
+    # disaggregated prefill (ISSUE 17): True while the request runs on a
+    # prefill-only replica; cleared on handoff to a decode replica (or
+    # on the collapse-to-unified fallbacks). Hedging skips staged
+    # requests — the handoff IS their second-replica path.
+    prefill_stage: bool = False
     hedge: Optional[Tuple[int, int]] = None   # (replica rid, srid)
     hedged: bool = False              # a hedge was ever placed
     client_cancelled: bool = False
@@ -301,10 +362,24 @@ class ServingRouter:
         self.migrations = 0            # live KV migrations completed
         self.migration_tokens = 0      # tokens that skipped recompute
         self.migration_fallbacks = 0   # exports no replica could adopt
+        self.directory_hits = 0        # routed to the fleet-cache holder
+        self.cache_pulls = 0           # cross-replica pulls that landed
+        self.pulled_blocks = 0         # blocks grafted by those pulls
+        self.pull_fallbacks = 0        # pulls that degraded to recompute
+        self.prefill_routed = 0        # long prompts onto the prefill pool
+        self.prefill_handoffs = 0      # prefill->decode adoptions (0 rcmp)
+        self.handoff_fallbacks = 0     # collapsed to decoding in place
         self.completed = 0
         self.failed = 0                # router-terminal FAILED (no replica)
+        # fleet-wide prefix-chain directory (ISSUE 17): fed by the
+        # BlockManager/offload-tier callbacks _wire_directory installs
+        # on every replica; None = legacy first-block affinity only
+        self._directory: Optional[CacheDirectory] = (
+            CacheDirectory() if self.config.fleet_cache else None)
         for _ in range(self.config.replicas):
             self.spawn_replica()
+        for _ in range(self.config.prefill_replicas):
+            self.spawn_replica(role="prefill")
 
     # ---- fleet membership --------------------------------------------------
 
@@ -317,9 +392,10 @@ class ServingRouter:
         self._programs = sup.engine.programs
         return sup
 
-    def spawn_replica(self) -> Optional[int]:
+    def spawn_replica(self, role: str = "decode") -> Optional[int]:
         """Add one replica (autoscale scale-up / construction). Returns
-        its rid, or None at the ``max_replicas`` ceiling."""
+        its rid, or None at the ``max_replicas`` ceiling.
+        ``role="prefill"`` adds to the disaggregated prefill pool."""
         with self._lock:
             if len(self._replicas) >= self.config.max_replicas:
                 return None
@@ -327,10 +403,33 @@ class ServingRouter:
             self._next_replica_rid += 1
             rep = Replica(rid, self._build_supervisor(),
                           CircuitBreaker(self.config.breaker_threshold,
-                                         self.config.breaker_cooldown_s))
+                                         self.config.breaker_cooldown_s),
+                          role=role)
             self._replicas[rid] = rep
             self._routes[rid] = {}
+            self._wire_directory(rep)
             return rid
+
+    def _wire_directory(self, rep: Replica) -> None:
+        """Point the replica's CURRENT engine at the fleet cache
+        directory: every prefix-chain key the BlockManager registers
+        appears under this rid, every removal path — device
+        unregistration without a surviving host-tier copy, tier
+        eviction/discard/verified-take — drops it. Re-run after every
+        engine rebuild (crash recovery, rolling restart): the callbacks
+        die with the old BlockManager, and the fresh pool starts
+        empty."""
+        if self._directory is None:
+            return
+        d, rid = self._directory, rep.rid
+        try:
+            cache = rep.sup.engine.cache
+        except Exception:              # noqa: BLE001 — mid-crash rebuild
+            return
+        cache.manager.notify_register = lambda key: d.add(rid, key)
+        cache.manager.notify_unregister = lambda key: d.drop(rid, key)
+        if cache.offload is not None:
+            cache.offload.on_drop = lambda key: d.drop(rid, key)
 
     def drain_replica(self, rid: int) -> None:
         """Scale-in: stop routing to the replica, migrate its in-flight
@@ -355,6 +454,9 @@ class ServingRouter:
             self._routes.pop(rid, None)
             self._affinity = {k: v for k, v in self._affinity.items()
                               if v != rid}
+            if self._directory is not None:
+                # scale-in: its cached chains left with it
+                self._directory.drop_replica(rid)
 
     @property
     def replicas(self) -> List[int]:
@@ -396,11 +498,12 @@ class ServingRouter:
         rep.breaker.record_success()   # rejoin the candidate set
 
     def _candidates(self, exclude: Set[int] = frozenset(),
-                    now: Optional[float] = None) -> List[Replica]:
+                    now: Optional[float] = None,
+                    role: str = "decode") -> List[Replica]:
         now = time.time() if now is None else now
         out = []
         for rep in self._replicas.values():
-            if rep.rid in exclude:
+            if rep.rid in exclude or rep.role != role:
                 continue
             if rep.breaker.ready_to_probe(now):
                 self._half_open_probe(rep, now)
@@ -420,16 +523,32 @@ class ServingRouter:
         """Backoff hint: the minimum retirement-interval estimate over
         replicas still serving (or about to again) — a broken,
         breaker-open or retiring replica's fresh-but-idle scheduler must
-        not promise capacity that no longer takes traffic."""
-        vals = []
+        not promise capacity that no longer takes traffic.
+
+        With a disaggregated prefill pool the DECODE minimum alone is
+        the wrong hint for a shed long prompt: an idle decode fleet
+        promises sub-second retries while every prefill replica is
+        backlogged. When the prefill pool exists and none of it is
+        routable, the pool's own estimate — already scaled by
+        ``Scheduler.prefill_queue_depth`` — is the binding one."""
+        decode_vals, prefill_vals = [], []
+        prefill_routable = False
         for rep in self._replicas.values():
             if rep.sup.broken or not rep.breaker.allow() or rep.retiring:
                 continue
             try:
-                vals.append(rep.sup.engine._sched.retry_after_s())
+                v = rep.sup.engine._sched.retry_after_s()
             except Exception:          # noqa: BLE001
-                pass
-        return min(vals) if vals else None
+                continue
+            if rep.role == "prefill":
+                prefill_vals.append(v)
+                prefill_routable = prefill_routable or rep.routable()
+            else:
+                decode_vals.append(v)
+        if prefill_vals and not prefill_routable:
+            return min(prefill_vals)   # the saturated pool binds
+        return min(decode_vals) if decode_vals else (
+            min(prefill_vals) if prefill_vals else None)
 
     def _depth(self, rep: Replica) -> int:
         try:
@@ -449,6 +568,55 @@ class ServingRouter:
         if prompt.shape[0] < bs:
             return None
         return hash((tenant, prompt[:bs].tobytes()))
+
+    def _prompt_chain(self, prompt: np.ndarray) -> List[Tuple[int, tuple]]:
+        """The prompt's full chained prefix keys — the directory lookup
+        unit (every FULL block, not just the leading one: two prompts
+        sharing three blocks route to the same holder even when their
+        first blocks are ubiquitous). Empty when the directory is off or
+        the prompt spans no full block."""
+        if self._directory is None:
+            return []
+        bs = self.decode_config.block_size
+        if prompt.shape[0] < bs:
+            return []
+        return list(prefix_block_chain(prompt, bs, prompt.shape[0]))
+
+    def _pull_chain(self, holder_rid: int, target: Replica,
+                    chain: List[Tuple[int, tuple]]) -> int:
+        """Move a cached chain's blocks cross-replica: serialize on the
+        holder (device read or host-tier peek, per-leaf CRC32 stamped),
+        graft into the target's pool (CRC re-verified, registered as
+        ordinary refcount-0 cached blocks). Any failure — stale
+        directory entry, layout mismatch, checksum mismatch, dry pool —
+        lands as ``pull_fallbacks`` and the submit recomputes: a pull
+        can cost time, never correctness. Returns blocks grafted."""
+        src = self._replicas.get(holder_rid)
+        if src is None or not chain:
+            return 0
+        try:
+            payload = src.sup.export_chain(chain)
+        except Exception:              # noqa: BLE001 — sick holder
+            payload = None
+        if payload is None:
+            # stale-missing entry: the holder evicted since the lookup
+            self.pull_fallbacks += 1
+            if self._directory is not None:
+                for k, _ in chain:
+                    self._directory.drop(holder_rid, k)
+            return 0
+        try:
+            res = target.sup.graft_chain(payload)
+        except Exception:              # noqa: BLE001 — AdoptError/drain
+            self.pull_fallbacks += 1
+            return 0
+        got = int(res.get("grafted", 0))
+        self.pulled_blocks += got
+        if got or res.get("present"):
+            self.cache_pulls += 1
+        else:
+            self.pull_fallbacks += 1
+        return got
 
     def _pick(self, cands: List[Replica],
               key: Optional[int]) -> Replica:
@@ -493,9 +661,13 @@ class ServingRouter:
                 # a single supervisor gives), not a misleading
                 # "broken/circuit-broken" 503 for plain overload
                 cands = [rep for rep in self._replicas.values()
-                         if rep.adoptable()]
+                         if rep.adoptable() and rep.role == "decode"]
             if replica is not None:
-                cands = [r for r in cands if r.rid == replica]
+                # an ops/canary pin may name a prefill replica too (the
+                # bench's island-cache baseline pins placement directly)
+                cands = [r for r in cands
+                         + self._candidates(now=now, role="prefill")
+                         if r.rid == replica]
             if not cands:
                 raise ServingUnavailable(
                     f"no routable replica ({len(self._replicas)} in the "
@@ -504,9 +676,47 @@ class ServingRouter:
                     retry_after_s=self._retry_after())
             p = np.asarray(prompt, np.int32).reshape(-1)
             key = self._affinity_key(p, tenant)
-            pick = self._pick(cands, key)
+            chain = self._prompt_chain(p)
+            holder_rid, depth = (None, 0)
+            if chain and self._directory is not None:
+                holder_rid, depth = self._directory.longest(
+                    [k for k, _ in chain])
+            pick = None
+            if holder_rid is not None and replica is None:
+                # fleet cache hit: the replica holding the longest cached
+                # chain takes the request when it has headroom — the
+                # admit() there maps depth*block_size tokens, recompute 0
+                hrep = self._replicas.get(holder_rid)
+                if hrep is not None and hrep.role == "decode" \
+                        and hrep in cands:
+                    pick = hrep
+                    self.directory_hits += 1
+                    self.sticky_hits += 1
+            prefill_cands: List[Replica] = []
+            if pick is None and replica is None \
+                    and self.config.prefill_replicas > 0 \
+                    and self.config.prefill_len_threshold > 0 \
+                    and p.shape[0] >= self.config.prefill_len_threshold:
+                # disaggregated prefill: a long prompt runs its chunked
+                # prefill on the dedicated pool, then hands the chain to
+                # a decode replica via the adopt path; an empty/draining
+                # pool falls through to the unified path below
+                prefill_cands = self._candidates(now=now, role="prefill")
+                if prefill_cands:
+                    pick = (prefill_cands[0] if len(prefill_cands) == 1
+                            else min(self._rng.sample(prefill_cands, 2),
+                                     key=lambda r: r.probe_depth))
+            if pick is None:
+                pick = self._pick(cands, key)
+            if holder_rid is not None and chain \
+                    and pick.rid != holder_rid:
+                # the chain lives elsewhere: pull its blocks into the
+                # pick's prefix cache before admitting — checksummed at
+                # both ends, and any failure just means recompute
+                self._pull_chain(holder_rid, pick, chain[:depth])
             last_exc: Optional[Exception] = None
-            for rep in [pick] + [c for c in cands if c is not pick]:
+            for rep in [pick] + [c for c in prefill_cands + cands
+                                 if c is not pick]:
                 try:
                     srid = rep.sup.submit(
                         p, max_new_tokens=max_new_tokens,
@@ -533,11 +743,14 @@ class ServingRouter:
                 top_p=rec.top_p, seed=rec.seed,
                 replica=rep.rid, srid=srid, affinity_key=key,
                 submit_t=now)
+            req.prefill_stage = (rep.role == "prefill")
+            if req.prefill_stage:
+                self.prefill_routed += 1
             self._next_frid += 1
             self._reqs[req.frid] = req
             self._active[req.frid] = req
             self._routes[rep.rid][srid] = req.frid
-            if key is not None:
+            if key is not None and rep.role == "decode":
                 self._affinity[key] = rep.rid
             self.routed += 1
             while len(self._affinity) > self.MAX_AFFINITY:
@@ -592,7 +805,14 @@ class ServingRouter:
             out: Dict[int, List[int]] = {}
             now = time.time()
             for rep in list(self._replicas.values()):
-                emitted = rep.sup.step(max_iters) if rep.sup.pending else {}
+                # a prefill replica's decode dispatch is bounded to ONE
+                # iteration: chunked prefill still advances a full chunk
+                # per step (its whole job), but a finished prompt stops
+                # right after its first sampled token instead of decoding
+                # to completion — the same-step _handoffs() below moves
+                # it to a decode replica with zero recompute
+                iters = 1 if rep.role == "prefill" else max_iters
+                emitted = rep.sup.step(iters) if rep.sup.pending else {}
                 self._observe(rep, now)
                 routes = self._routes.get(rep.rid, {})
                 for srid in sorted(emitted):
@@ -611,11 +831,66 @@ class ServingRouter:
                     got = [int(t) for t in emitted[srid]]
                     req.tokens.extend(got)
                     out.setdefault(frid, []).extend(got)
+            self._handoffs(now)
             self._sweep(now)
             self._check_hedges(now)
             self._advance_roll(now)
             self._finalize_retiring()
             return out
+
+    def _handoffs(self, now: float) -> None:
+        """Disaggregated prefill stage 2: every staged request that got
+        its FIRST token (prefill finished — the prefill replica sampled
+        it) moves to a decode replica through the live-migration adopt
+        path, KV blocks and all (``recomputed_tokens == 0``). A handoff
+        no decode replica can take right now collapses to decoding in
+        place on the prefill replica (``handoff_fallbacks``) — the
+        unified path, never a lost request."""
+        from .engine import AdoptError
+        for req in list(self._active.values()):
+            if req.terminal or not req.prefill_stage or not req.tokens:
+                continue
+            rep = self._replicas.get(req.replica)
+            if rep is None:
+                req.prefill_stage = False     # failover already moved it
+                continue
+            try:
+                payload = rep.sup.export_request(req.srid)
+            except Exception:          # noqa: BLE001 — sick origin
+                payload = None
+            if payload is None:
+                # finished inside the prefill replica (tiny max_new /
+                # EOS on the first token): the sweep mirrors it; there
+                # is nothing left to move
+                req.prefill_stage = False
+                continue
+            moved = False
+            for cand in self._candidates(exclude={rep.rid}, now=now):
+                try:
+                    new_srid = cand.sup.adopt(payload)
+                except (AdoptError, ServingUnavailable):
+                    continue           # this target can't take the blocks
+                except Exception:      # noqa: BLE001 — raced a crash
+                    continue
+                # pop the route BEFORE cancelling the origin copy so no
+                # sweep can double-handle this frid (the _migrate rule)
+                self._routes[rep.rid].pop(req.srid, None)
+                try:
+                    rep.sup.release_migrated(req.srid)
+                except Exception:      # noqa: BLE001 — drain reaps it
+                    pass
+                self._routes[cand.rid][new_srid] = req.frid
+                req.replica, req.srid = cand.rid, new_srid
+                req.prefill_stage = False
+                if req.affinity_key is not None:
+                    # shared-prefix traffic follows the blocks
+                    self._affinity[req.affinity_key] = cand.rid
+                self.prefill_handoffs += 1
+                moved = True
+                break
+            if not moved:
+                self.handoff_fallbacks += 1
+                req.prefill_stage = False
 
     def _observe(self, rep: Replica, now: float) -> None:
         """Post-step health accounting: supervisor restarts count as
@@ -628,10 +903,20 @@ class ServingRouter:
                 rep.breaker.record_failure(now)
             rep.restarts_seen = rep.sup.restarts
             rep.probe_cache = None    # pre-crash snapshot is stale
+            if self._directory is not None:
+                # the rebuilt engine's pool is EMPTY and its BlockManager
+                # is a new object: every directory entry naming this rid
+                # died with the old pool, and the callbacks must re-aim
+                # at the fresh one — a crash can never leave a
+                # stale-authoritative entry behind
+                self._directory.drop_replica(rep.rid)
+                self._wire_directory(rep)
         if rep.sup.broken and not rep.broken_seen:
             rep.broken_seen = True
             rep.breaker.trip(now)
             rep.probe_cache = None
+            if self._directory is not None:
+                self._directory.drop_replica(rep.rid)
         if not rep.breaker.allow() and self._routes.get(rep.rid):
             self._evacuate(rep, now)
 
@@ -740,7 +1025,8 @@ class ServingRouter:
             # peak saturation (the fleet-replay regime) FAILs its
             # in-flight requests even though healthy replicas remain.
             cands = [rep for rep in self._replicas.values()
-                     if rep.rid not in exclude and rep.adoptable()]
+                     if rep.rid not in exclude and rep.adoptable()
+                     and rep.role == "decode"]
         for rep in cands:
             try:
                 srid = rep.sup.resubmit(
@@ -847,6 +1133,7 @@ class ServingRouter:
             return
         for req in list(self._active.values()):
             if req.terminal or req.tokens or req.hedged \
+                    or req.prefill_stage \
                     or now - req.submit_t < thresh:
                 continue
             cands = self._candidates(exclude={req.replica}, now=now)
@@ -972,6 +1259,10 @@ class ServingRouter:
         old = rep.replace(fresh)
         self._restarts_retired += old.restarts  # lifetime totals survive
         self._routes[rid] = {}
+        if self._directory is not None:
+            # the rebuilt pool starts empty; re-aim the callbacks at it
+            self._directory.drop_replica(rid)
+            self._wire_directory(rep)
         roll["restarted"] += 1
         roll["last_report"] = report
         self.replica_restarts += 1
@@ -1048,7 +1339,8 @@ class ServingRouter:
                 # outage
                 healthy = [r for r in self._replicas.values()
                            if not r.retiring and not r.sup.broken
-                           and r.breaker.allow()]
+                           and r.breaker.allow()
+                           and r.role == "decode"]
                 if len(healthy) > 1:
                     victim = min(healthy, key=self._depth)
                     self.drain_replica(victim.rid)
@@ -1230,9 +1522,20 @@ class ServingRouter:
                     "migrations": self.migrations,
                     "migration_tokens": self.migration_tokens,
                     "migration_fallbacks": self.migration_fallbacks,
+                    "directory_hits": self.directory_hits,
+                    "cache_pulls": self.cache_pulls,
+                    "pulled_blocks": self.pulled_blocks,
+                    "pull_fallbacks": self.pull_fallbacks,
+                    "prefill_routed": self.prefill_routed,
+                    "prefill_handoffs": self.prefill_handoffs,
+                    "handoff_fallbacks": self.handoff_fallbacks,
                     "completed": self.completed,
                     "failed": self.failed,
                 },
+                "directory": ({"enabled": True,
+                               **self._directory.snapshot()}
+                              if self._directory is not None
+                              else {"enabled": False}),
                 "replicas": reps,
                 "fleet": {
                     "size": len(reps),
@@ -1242,6 +1545,8 @@ class ServingRouter:
                         for r in reps.values()),
                     "draining": sum(r["draining"] for r in reps.values()),
                     "retiring": sum(r["retiring"] for r in reps.values()),
+                    "prefill": sum(r["role"] == "prefill"
+                                   for r in reps.values()),
                 },
                 "roll": {
                     "active": roll is not None,

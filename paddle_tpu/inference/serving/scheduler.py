@@ -338,13 +338,27 @@ class Scheduler:
                 out[tkey(r.tenant)]["live"] += 1
         return out
 
+    @property
+    def prefill_queue_depth(self) -> int:
+        """Requests still ahead of their FIRST token on this replica:
+        everything queued plus live slots mid-prefill. The backlog a
+        prefill-pool replica's retry hint must account for — and the
+        saturation signal the router's prefill-pool sizing reads."""
+        return len(self.queue) + \
+            sum(1 for r in self.live if r.prefilling)
+
     def retry_after_s(self) -> float:
         """Suggested backoff when shedding: the mean interval between the
         most recent retirements (one retirement frees one slot, which is
-        what drains one queued request). Before two retirements have been
-        observed there is no interval to estimate, so the conservative
-        ``FLAGS_serving_retry_after_s`` default is returned instead of a
-        degenerate None/0 a client would turn into a hot retry loop.
+        what drains one queued request), SCALED by the prefill backlog —
+        a shed request re-arriving after one mean retirement interval
+        meets the same full queue if ``prefill_queue_depth`` requests
+        are still ahead of it, so the hint multiplies the interval by
+        the backlog (floor 1: an idle replica keeps the plain estimate).
+        Before two retirements have been observed there is no interval
+        to estimate, so the conservative ``FLAGS_serving_retry_after_s``
+        default is returned instead of a degenerate None/0 a client
+        would turn into a hot retry loop.
 
         During an ACTIVE drain the retirement-interval estimate is the
         wrong signal entirely — this replica is leaving, and a client
@@ -361,7 +375,8 @@ class Scheduler:
         span = self._finish_times[-1] - self._finish_times[0]
         if span <= 0:
             return 0.001
-        return round(span / (len(self._finish_times) - 1), 3)
+        est = span / (len(self._finish_times) - 1)
+        return round(est * max(1, self.prefill_queue_depth), 3)
 
     # ---- lifecycle --------------------------------------------------------
 

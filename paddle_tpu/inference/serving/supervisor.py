@@ -390,6 +390,29 @@ class EngineSupervisor:
             self.recovered_tokens += len(rec.tokens)
             return rec.srid
 
+    # ---- fleet-wide cache pulls (ISSUE 17) ---------------------------------
+
+    def export_chain(self, chain):
+        """Serialize a cached prefix chain (no request attached) for a
+        cross-replica cache pull — :meth:`ServingEngine.export_chain`
+        guarded for a dead/rebuilding engine. None when the engine is
+        unavailable or holds none of the chain (a stale directory entry
+        — the benign miss; the puller recomputes)."""
+        with self._lock:
+            if self.broken or self.engine is None:
+                return None
+            return self.engine.export_chain(chain)
+
+    def graft_chain(self, payload):
+        """Land an exported chain in this replica's prefix cache —
+        :meth:`ServingEngine.graft_chain` guarded for availability.
+        Raises :class:`ServingUnavailable` while draining or broken and
+        :class:`~.engine.AdoptError` on layout mismatch; both degrade
+        the pull to plain recompute at the router."""
+        with self._lock:
+            self._check_admitting()
+            return self.engine.graft_chain(payload)
+
     def release_migrated(self, srid: int) -> bool:
         """Confirm a migration: the adoptive replica owns the request
         now, so cancel the origin's copy (frees its blocks — possibly

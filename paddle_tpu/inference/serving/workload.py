@@ -347,6 +347,7 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                serving_config=None, router_config=None,
                replicas: Optional[int] = None,
                chaos: Any = "auto", chaos_events: int = 6,
+               prefill_replicas: int = 0,
                programs=None, router=None, collect_violations: bool = False,
                record_streams: bool = False, hbm_gb: float = 16.0,
                host_gb: float = 0.0,
@@ -401,9 +402,13 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
             # race), breaker cooldown 0 (an opened breaker half-open
             # probes on the next routing pass instead of after a
             # wall-clock cooldown), probe caching off
+            # prefill_replicas adds a disaggregated prefill pool (ISSUE
+            # 17) — captured in the manifest like every other RouterConfig
+            # scalar, so a replay rebuilds the same split fleet
             router_config = RouterConfig(replicas=replicas,
                                          breaker_cooldown_s=0.0,
-                                         hedge_ttft_mult=0.0)
+                                         hedge_ttft_mult=0.0,
+                                         prefill_replicas=prefill_replicas)
         router = ServingRouter(params, model_config, serving_config,
                                router_config=router_config,
                                programs=programs)
@@ -553,6 +558,22 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                     timeline.log(step, ev.name, res)
                     return
             timeline.log(step, ev.name, "skipped: tier off or empty")
+        elif ev.name == "kill_prefill_replica":
+            res = _chaos.kill_prefill_replica(router, **ev.kwargs)
+            if res["enabled"]:
+                timeline.log(step, ev.name, res)
+            else:
+                # no prefill pool in this fleet: nothing to kill
+                timeline.log(step, ev.name, "skipped: no prefill replica")
+        elif ev.name == "stale_directory":
+            res = _chaos.stale_directory(router, **ev.kwargs)
+            if res["enabled"]:
+                timeline.log(step, ev.name, res)
+            else:
+                # a poisoning that armed nothing did not exercise the
+                # pull-checksum path and must not count as fired
+                timeline.log(step, ev.name,
+                             "skipped: directory off or empty")
         elif ev.name == "disconnect_mid_stream":
             # logged when a live stream is ACTUALLY cut (or as skipped
             # at quiesce if none ever was) — an armed-but-never-fired

@@ -58,6 +58,15 @@ injector               fault it models
                        (ECC miss, bit rot): the write-time checksum must
                        degrade the entry to a cache MISS so the request
                        recomputes bit-exactly — wrong KV is never served
+``kill_prefill_replica``  a disaggregated-prefill replica dying for good
+                       mid-handoff: staged requests must land on a
+                       decode replica via fallback recompute with zero
+                       failed requests, and long prompts collapse to the
+                       unified path while the pool is empty
+``stale_directory``    a poisoned fleet-cache-directory entry: the next
+                       cross-replica chain pull through the armed holder
+                       fails checksum verification at the graft end and
+                       degrades to recompute — wrong KV is never pulled
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -83,8 +92,10 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "flood_tenant", "engine_crash", "disconnect_mid_stream",
            "slow_client", "replica_kill", "slow_replica", "flaky_probe",
            "host_pressure", "corrupt_offload_block",
+           "kill_prefill_replica", "stale_directory",
            "ChaosEvent", "ChaosTimeline", "chaos_timeline",
-           "TIMELINE_INJECTORS", "TIER_INJECTORS", "INJECTORS"]
+           "TIMELINE_INJECTORS", "TIER_INJECTORS", "DISAGG_INJECTORS",
+           "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -590,6 +601,62 @@ def corrupt_offload_block(target, rid=None, seed: int = 0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# disaggregated-prefill / fleet-cache injectors (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def kill_prefill_replica(target, rid=None,
+                         exc: Optional[BaseException] = None) -> dict:
+    """Kill a PREFILL-pool replica for good — possibly mid-handoff, with
+    prompts mid-chunked-prefill and first tokens not yet adopted by a
+    decode replica. Same mechanics as :func:`replica_kill` (restart
+    budget zeroed + armed crash, detonated immediately when idle), aimed
+    at the first replica with ``role == "prefill"`` (or ``rid``). The
+    recovery proof: every staged request lands on a decode replica
+    through the failover/recompute fallback — ZERO failed requests,
+    outputs bit-identical to a single-replica oracle — and subsequent
+    long prompts collapse to the unified path while the pool is empty.
+    Returns ``{"rid", "enabled"}``; ``enabled=False`` when the fleet has
+    no prefill replica (the fault is vacuous — nothing to kill)."""
+    victim = rid
+    if victim is None and hasattr(target, "_replicas"):
+        victim = next((r for r, rep in target._replicas.items()
+                       if getattr(rep, "role", "decode") == "prefill"),
+                      None)
+    if victim is None:
+        return {"rid": None, "enabled": False}
+    replica_kill(target, rid=victim, exc=exc)
+    return {"rid": victim, "enabled": True}
+
+
+def stale_directory(target, seed: int = 0) -> dict:
+    """Poison the fleet cache directory: arm one holder replica so its
+    NEXT chain export flips a byte AFTER stamping the per-leaf checksums
+    (``ServingEngine._corrupt_next_export``) — the moral equivalent of a
+    directory entry pointing at a replica whose cached bytes are no
+    longer what the chain key promises (torn update, host corruption in
+    flight). The next cross-replica pull through that holder must fail
+    checksum verification at the graft end and degrade to recompute
+    (``pull_fallbacks``/partial graft) — wrong KV is never served, and
+    outputs stay bit-identical. The holder is picked deterministically
+    by ``seed`` from the directory's current entries. Returns ``{"rid",
+    "enabled", "key"}``; ``enabled=False`` when the directory is off or
+    empty (the fault is vacuous)."""
+    directory = getattr(target, "_directory", None)
+    if directory is None:
+        return {"rid": None, "enabled": False, "key": None}
+    items = directory.items()
+    if not items:
+        return {"rid": None, "enabled": False, "key": None}
+    key, holders = items[int(seed) % len(items)]
+    rid = holders[int(seed) % len(holders)]
+    rep = target._replicas.get(rid)
+    if rep is None:
+        return {"rid": rid, "enabled": False, "key": key}
+    rep.sup.engine._corrupt_next_export = True
+    return {"rid": rid, "enabled": True, "key": key}
+
+
+# ---------------------------------------------------------------------------
 # chaos timeline (fleet-scale replay; ISSUE 13)
 # ---------------------------------------------------------------------------
 
@@ -667,6 +734,11 @@ TIMELINE_INJECTORS = ("replica_kill", "slow_replica", "flood_tenant",
 # TIER_INJECTORS`` (or any mix) explicitly
 TIER_INJECTORS = ("host_pressure", "corrupt_offload_block")
 
+# the disaggregated-prefill / fleet-cache faults (ISSUE 17) — same
+# out-of-default-mix rule as TIER_INJECTORS, for the same reason:
+# previously generated seeds must keep their schedules byte-identical
+DISAGG_INJECTORS = ("kill_prefill_replica", "stale_directory")
+
 
 def chaos_timeline(seed: int, horizon_steps: int,
                    kinds=TIMELINE_INJECTORS, events: int = 6,
@@ -699,6 +771,8 @@ def chaos_timeline(seed: int, horizon_steps: int,
             kw = {"blocks": rng.randrange(0, 4)}
         elif name == "corrupt_offload_block":
             kw = {"seed": rng.randrange(1000)}
+        elif name == "stale_directory":
+            kw = {"seed": rng.randrange(1000)}
         out.append(ChaosEvent(step, name, **kw))
     return ChaosTimeline(out)
 
@@ -726,4 +800,6 @@ INJECTORS = {
     "flaky_probe": flaky_probe,
     "host_pressure": host_pressure,
     "corrupt_offload_block": corrupt_offload_block,
+    "kill_prefill_replica": kill_prefill_replica,
+    "stale_directory": stale_directory,
 }

@@ -206,6 +206,33 @@ def test_bench_serve_entry_point():
     assert detail["migration_leaked_blocks"] == 0
     assert detail["migration_recompute_saved"] > 0
     assert "serving_migration_recompute_saved" in metrics
+    # fleet-cache row (ISSUE 17): prefix families re-visited from the
+    # NON-holder replica — the fleet directory pulls the chain's blocks
+    # cross-replica (CRC-checked at both ends) where island caches
+    # re-prefill. Parity / pulls / zero fallbacks / zero leaks are
+    # asserted in-section; the smoke pins the record + the metric.
+    assert detail["fleet_outputs_match"] is True
+    assert detail["fleet_cache_pulls"] >= 1
+    assert detail["fleet_pulled_blocks"] >= 3
+    assert detail["fleet_pull_fallbacks"] == 0
+    assert detail["fleet_prefix_hit_tokens"] > \
+        detail["fleet_island_hit_tokens"]
+    assert detail["fleet_leaked_blocks"] == 0
+    assert detail["fleet_hit_ttft_ratio"] > 0
+    assert "serving_fleet_cache_hit_ttft_ratio" in metrics
+    # disaggregation row (ISSUE 17): long prompts prefill on a dedicated
+    # replica and hand their finished chain to a decode replica via the
+    # adopt path — parity, handoffs >= 1, recomputed_tokens == 0, zero
+    # failed/leaks asserted in-section; the smoke pins the record + the
+    # metric.
+    assert detail["disagg_outputs_match"] is True
+    assert detail["disagg_prefill_routed"] >= 1
+    assert detail["disagg_prefill_handoffs"] >= 1
+    assert detail["disagg_recomputed_tokens"] == 0
+    assert detail["disagg_failed"] == 0
+    assert detail["disagg_leaked_blocks"] == 0
+    assert detail["disagg_tpot_ratio"] > 0
+    assert "serving_disagg_tpot_ratio" in metrics
 
 
 def test_bench_health_entry_point():

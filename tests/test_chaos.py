@@ -979,7 +979,14 @@ class TestFleetChaos:
         # + the ISSUE 16 KV-tier pair (host_pressure, corrupt_offload_block)
         for name in chaos.TIER_INJECTORS:
             assert name in chaos.INJECTORS
-        assert len(chaos.INJECTORS) == 20
+        # + the ISSUE 17 disaggregation pair (kill_prefill_replica,
+        # stale_directory) — like the tier pair, OUT of the default
+        # timeline mix so previously generated seeds keep their
+        # schedules byte-identical
+        for name in chaos.DISAGG_INJECTORS:
+            assert name in chaos.INJECTORS
+            assert name not in chaos.TIMELINE_INJECTORS
+        assert len(chaos.INJECTORS) == 22
 
     def _router(self, params, cfg, **kw):
         from paddle_tpu.inference.serving import ServingConfig, ServingRouter

@@ -1,0 +1,384 @@
+"""Fleet-wide KV cache directory + disaggregated prefill (ISSUE 17).
+
+The acceptance matrix for "one cache, split compute":
+
+* **Pull parity** — a request landing on a replica that does NOT hold its
+  prefix chain pulls the blocks cross-replica (CRC-checked at both ends,
+  grafted into the target's prefix cache) and its stream stays
+  bit-identical to a single-replica engine, across greedy + seeded
+  sampling, fp + int8 KV pools, and the kernel + gather decode paths.
+* **Handoff parity** — a long prompt prefills on a dedicated prefill
+  replica and hands its finished chain to a decode replica through the
+  adopt path with ``recomputed_tokens == 0``, same matrix.
+* **Degrade-to-recompute** — a corrupted export fails the graft-side CRC
+  and the pull collapses to plain recompute: never wrong KV, parity
+  intact.
+* **Directory coherence fuzz** — randomized evict/pull/migrate/scale-in
+  churn with the InvariantAuditor (block partition + the
+  ``directory_coherence`` check) asserted after every step.
+* **Saturated-pool retry hint** — ``Scheduler.retry_after_s()`` scales by
+  the prefill backlog and the router's ``_retry_after`` lets a saturated
+  prefill pool bind the hint.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp                                    # noqa: E402
+
+from paddle_tpu.models import generation as G              # noqa: E402
+from paddle_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+
+
+def tiny_cfg():
+    return LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=96,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=64)
+
+
+BASE = dict(block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+            queue_depth=8, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def quiesced(router):
+    """Zero in-use blocks on EVERY replica once nothing is pending."""
+    return sum(p["in_use"] for p in router.block_partitions().values())
+
+
+def drain(router, step_kw=None):
+    while router.pending:
+        router.step(**(step_kw or {}))
+
+
+# ---------------------------------------------------------------------------
+# pull + handoff bit-parity across the sampling x kv-pool x kernel matrix
+# ---------------------------------------------------------------------------
+
+# (kv_quant, paged_kernel): each pool/path pair compiles its own programs;
+# greedy and seeded requests run through the SAME routers inside each case.
+# Tier-1 runs the DIAGONAL (fp-gather, int8-kernel) — every axis value is
+# exercised at half the compile bill; the off-diagonal pair completes the
+# full cross in the slow tier.
+MATRIX = [
+    pytest.param(None, False, id="fp-gather"),
+    pytest.param(None, True, id="fp-kernel", marks=pytest.mark.slow),
+    pytest.param("int8", False, id="int8-gather", marks=pytest.mark.slow),
+    pytest.param("int8", True, id="int8-kernel"),
+]
+
+
+class TestPullHandoffParity:
+    @pytest.mark.parametrize("kvq,kern", MATRIX)
+    def test_pull_and_handoff_match_single_replica(self, setup, kvq, kern):
+        from paddle_tpu.inference.serving import (InvariantAuditor,
+                                                  RouterConfig,
+                                                  ServingConfig,
+                                                  ServingRouter)
+        cfg, params = setup
+        rng = np.random.default_rng(17)
+        sc = dict(BASE, kv_quant=kvq, paged_kernel=kern, prefill_chunk=4)
+
+        # two prefix families (3 full blocks each) + per-request tails;
+        # one long prompt (>= threshold) for the handoff half
+        prefixes = [rng.integers(0, 97, (12,)).astype(np.int32)
+                    for _ in range(2)]
+
+        def tailed(fam, n):
+            return np.concatenate([prefixes[fam],
+                                   rng.integers(0, 97, (n,))
+                                   .astype(np.int32)])
+
+        place = [tailed(0, 2), tailed(1, 3)]
+        pulls = [tailed(0, 3), tailed(1, 2)]   # greedy, seeded
+        # two DISTINCT long prompts: a repeat would hit the fleet
+        # directory (its chain got cached by the first handoff's decode)
+        # and route straight to the holder instead of the prefill pool
+        longs = [rng.integers(0, 97, (16,)).astype(np.int32)
+                 for _ in range(2)]
+        SAMP = dict(temperature=0.8, top_k=20, seed=5)
+
+        # single-replica oracle: the SAME resolved config, one engine —
+        # every fleet stream below must be bit-identical to these
+        oracle = ServingRouter(params, cfg, ServingConfig(**sc),
+                               replicas=1)
+        want = {}
+        for name, p, kw, n in (("pull0", pulls[0], {}, 4),
+                               ("pull1", pulls[1], SAMP, 4),
+                               ("long0", longs[0], {}, 6),
+                               ("long1", longs[1], SAMP, 6)):
+            f = oracle.submit(p, max_new_tokens=n, eos_token_id=None, **kw)
+            drain(oracle)
+            want[name] = oracle.result(f)
+
+        # ---- pull half: the chain lives on replica 0, the requests are
+        # pinned to replica 1 -> cross-replica pulls, then bit-parity
+        fleet = ServingRouter(params, cfg, ServingConfig(**sc),
+                              router_config=RouterConfig(replicas=2),
+                              programs=oracle._programs)
+        r0, r1 = fleet.replicas[0], fleet.replicas[1]
+        for p in place:
+            fleet.submit(p, max_new_tokens=2, eos_token_id=None,
+                         replica=r0)
+            drain(fleet)
+        f0 = fleet.submit(pulls[0], max_new_tokens=4, eos_token_id=None,
+                          replica=r1)
+        drain(fleet)
+        f1 = fleet.submit(pulls[1], max_new_tokens=4, eos_token_id=None,
+                          replica=r1, **SAMP)
+        drain(fleet)
+        snap = fleet.health_snapshot()
+        assert snap["counters"]["cache_pulls"] >= 2, snap["counters"]
+        assert snap["counters"]["pulled_blocks"] >= 6, snap["counters"]
+        assert snap["counters"]["pull_fallbacks"] == 0, snap["counters"]
+        np.testing.assert_array_equal(fleet.result(f0), want["pull0"])
+        np.testing.assert_array_equal(fleet.result(f1), want["pull1"])
+        assert quiesced(fleet) == 0
+        InvariantAuditor().check(fleet)
+
+        # ---- handoff half: long prompts prefill on the dedicated
+        # replica, decode after adoption on the decode replica —
+        # recomputed_tokens == 0, bit-parity, zero leaks
+        disagg = ServingRouter(
+            params, cfg, ServingConfig(**sc),
+            router_config=RouterConfig(replicas=1, prefill_replicas=1,
+                                       prefill_len_threshold=8),
+            programs=oracle._programs)
+        g0 = disagg.submit(longs[0], max_new_tokens=6, eos_token_id=None)
+        drain(disagg, {"max_iters": 1})
+        g1 = disagg.submit(longs[1], max_new_tokens=6, eos_token_id=None,
+                           **SAMP)
+        drain(disagg, {"max_iters": 1})
+        snap = disagg.health_snapshot()
+        assert snap["counters"]["prefill_routed"] == 2, snap["counters"]
+        assert snap["counters"]["prefill_handoffs"] == 2, snap["counters"]
+        assert snap["counters"]["failed"] == 0, snap["counters"]
+        recomputed = sum(rep.sup.engine.stats()["recomputed_tokens"]
+                         for rep in disagg._replicas.values())
+        assert recomputed == 0
+        # both streams FINISHED on the decode replica (role followed)
+        for g in (g0, g1):
+            rep = disagg._replicas[disagg.request(g).replica]
+            assert rep.role == "decode"
+        np.testing.assert_array_equal(disagg.result(g0), want["long0"])
+        np.testing.assert_array_equal(disagg.result(g1), want["long1"])
+        assert quiesced(disagg) == 0
+        InvariantAuditor().check(disagg)
+
+
+# ---------------------------------------------------------------------------
+# checksum degrade + stale-entry degrade: never wrong KV
+# ---------------------------------------------------------------------------
+
+class TestPullDegradesToRecompute:
+    def _fleet(self, setup):
+        from paddle_tpu.inference.serving import (RouterConfig,
+                                                  ServingConfig,
+                                                  ServingRouter)
+        cfg, params = setup
+        return ServingRouter(params, cfg, ServingConfig(**BASE),
+                             router_config=RouterConfig(replicas=2))
+
+    def test_corrupt_export_falls_back_bit_exact(self, setup):
+        """A flipped byte in the exported chain fails the graft-side CRC:
+        the pull degrades to plain recompute — parity intact, the
+        fallback counted, nothing leaked."""
+        cfg, params = setup
+        fleet = self._fleet(setup)
+        rng = np.random.default_rng(23)
+        prefix = rng.integers(0, 97, (12,)).astype(np.int32)
+        a = np.concatenate([prefix,
+                            rng.integers(0, 97, (2,)).astype(np.int32)])
+        b = np.concatenate([prefix,
+                            rng.integers(0, 97, (3,)).astype(np.int32)])
+        r0, r1 = fleet.replicas[0], fleet.replicas[1]
+        fleet.submit(a, max_new_tokens=2, eos_token_id=None, replica=r0)
+        drain(fleet)
+        # poison the NEXT export on the holder (the stale_directory chaos
+        # injector's hook): checksums are stamped before the flip, so the
+        # graft side must catch it
+        fleet._replicas[r0].sup.engine._corrupt_next_export = True
+        f = fleet.submit(b, max_new_tokens=4, eos_token_id=None,
+                         replica=r1)
+        drain(fleet)
+        snap = fleet.health_snapshot()
+        assert snap["counters"]["pull_fallbacks"] >= 1, snap["counters"]
+        assert snap["counters"]["pulled_blocks"] == 0, snap["counters"]
+        assert snap["counters"]["failed"] == 0
+        np.testing.assert_array_equal(
+            fleet.result(f),
+            np.asarray(G.generate(params, jnp.asarray(b[None]), cfg,
+                                  max_new_tokens=4))[0])
+        assert quiesced(fleet) == 0
+
+    def test_stale_entry_is_a_benign_miss(self, setup):
+        """A directory entry whose blocks already left the holder's pool
+        (wiped below) makes export return None: the pull degrades to
+        recompute and the stale holder is dropped from the directory."""
+        cfg, params = setup
+        fleet = self._fleet(setup)
+        rng = np.random.default_rng(29)
+        prefix = rng.integers(0, 97, (12,)).astype(np.int32)
+        a = np.concatenate([prefix,
+                            rng.integers(0, 97, (2,)).astype(np.int32)])
+        b = np.concatenate([prefix,
+                            rng.integers(0, 97, (3,)).astype(np.int32)])
+        r0, r1 = fleet.replicas[0], fleet.replicas[1]
+        fleet.submit(a, max_new_tokens=2, eos_token_id=None, replica=r0)
+        drain(fleet)
+        # make the entries stale-MISSING without telling the directory:
+        # wipe the holder's registered blocks directly (no notify path —
+        # simulating any accounting gap); the export must just miss
+        mgr = fleet._replicas[r0].sup.engine.cache.manager
+        for key in list(mgr._hash2block):
+            blk = mgr._hash2block.pop(key)
+            mgr._block2hash.pop(blk, None)
+            mgr._block_tokens.pop(blk, None)
+        f = fleet.submit(b, max_new_tokens=4, eos_token_id=None,
+                         replica=r1)
+        drain(fleet)
+        snap = fleet.health_snapshot()
+        assert snap["counters"]["pull_fallbacks"] >= 1, snap["counters"]
+        assert snap["counters"]["failed"] == 0
+        np.testing.assert_array_equal(
+            fleet.result(f),
+            np.asarray(G.generate(params, jnp.asarray(b[None]), cfg,
+                                  max_new_tokens=4))[0])
+        # the stale holder was dropped: a second identical submit cannot
+        # retry the same dead pull
+        pulls_before = snap["counters"]["cache_pulls"]
+        fb_before = snap["counters"]["pull_fallbacks"]
+        c = np.concatenate([prefix,
+                            rng.integers(0, 97, (2,)).astype(np.int32)])
+        fleet.submit(c, max_new_tokens=2, eos_token_id=None, replica=r1)
+        drain(fleet)
+        snap2 = fleet.health_snapshot()
+        assert snap2["counters"]["pull_fallbacks"] == fb_before
+        assert snap2["counters"]["cache_pulls"] == pulls_before
+
+
+# ---------------------------------------------------------------------------
+# directory coherence fuzz: churn x pulls x migration x scale-in
+# ---------------------------------------------------------------------------
+
+class TestDirectoryCoherenceFuzz:
+    def test_randomized_churn_keeps_directory_coherent(self, setup):
+        """Randomized interleaving of shared-prefix submits (pinned, so
+        pulls fire), eviction pressure (undersized pool + offload tier
+        swap-outs), live migration via scale-in drains, and replica
+        spawns — with the full InvariantAuditor (block partition + the
+        ``directory_coherence`` check) asserted after EVERY router step
+        and exhaustively at quiesce."""
+        import random
+        from paddle_tpu.inference.serving import (InvariantAuditor,
+                                                  RouterConfig,
+                                                  ServingConfig,
+                                                  ServingRouter)
+        cfg, params = setup
+        sc = ServingConfig(**dict(BASE, num_blocks=10, offload=True,
+                                  offload_blocks=16))
+        fleet = ServingRouter(
+            params, cfg, sc,
+            router_config=RouterConfig(replicas=2, max_replicas=4,
+                                       migrate=True))
+        auditor = InvariantAuditor()
+        rng = np.random.default_rng(31)
+        pyrng = random.Random(31)
+        prefixes = [rng.integers(0, 97, (8,)).astype(np.int32)
+                    for _ in range(3)]
+        live = []
+        for it in range(40):
+            op = pyrng.random()
+            rids = fleet.replicas
+            if op < 0.45:
+                fam = pyrng.randrange(len(prefixes))
+                p = np.concatenate([prefixes[fam],
+                                    rng.integers(0, 97, (3,))
+                                    .astype(np.int32)])
+                pin = pyrng.choice(rids + [None])
+                try:
+                    live.append(fleet.submit(
+                        p, max_new_tokens=2, eos_token_id=None,
+                        replica=pin))
+                except Exception:      # noqa: BLE001 — shed under churn
+                    pass
+            elif op < 0.55 and len(rids) > 2:
+                fleet.drain_replica(pyrng.choice(rids))
+            elif op < 0.65 and len(rids) < 4:
+                fleet.spawn_replica()
+            fleet.step()
+            auditor.check(fleet)
+        drain(fleet)
+        auditor.check(fleet)
+        snap = fleet.health_snapshot()
+        assert snap["counters"]["failed"] == 0, snap["counters"]
+        assert quiesced(fleet) == 0
+        # the churn actually exercised the machinery under test
+        assert snap["counters"]["cache_pulls"] + \
+            snap["counters"]["pull_fallbacks"] >= 1, snap["counters"]
+        assert snap["directory"]["entries"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: saturated prefill pool must shape the retry hint
+# ---------------------------------------------------------------------------
+
+class TestPrefillAwareRetryAfter:
+    def _sched(self, setup):
+        from paddle_tpu.inference.serving import PagedKVCache, Scheduler
+        cfg, _ = setup
+        cache = PagedKVCache(cfg, max_slots=2, max_model_len=16,
+                             block_size=4)
+        return Scheduler(cache, max_slots=2, queue_depth=8)
+
+    def test_hint_scales_with_prefill_backlog(self, setup):
+        """One mean retirement interval frees ONE slot: a shed request
+        re-arriving behind N queued prompts waits ~N intervals, so the
+        hint multiplies (floor 1 keeps the idle estimate unchanged)."""
+        import time as _t
+        from types import SimpleNamespace
+        sched = self._sched(setup)
+        t = _t.time()
+        sched._finish_times.extend([t, t + 0.1, t + 0.2])
+        assert sched.retry_after_s() == pytest.approx(0.1, abs=1e-3)
+        for _ in range(5):
+            sched.queue.append(SimpleNamespace(prefilling=False))
+        assert sched.prefill_queue_depth == 5
+        assert sched.retry_after_s() == pytest.approx(0.5, abs=1e-3)
+
+    def test_router_hint_binds_to_saturated_prefill_pool(self, setup):
+        """An idle decode fleet must not promise sub-second retries while
+        every prefill replica is backlogged: with the pool unroutable the
+        pool's own scaled estimate is the hint."""
+        import time as _t
+        from types import SimpleNamespace
+        from paddle_tpu.inference.serving import (RouterConfig,
+                                                  ServingConfig,
+                                                  ServingRouter)
+        cfg, params = setup
+        fleet = ServingRouter(
+            params, cfg, ServingConfig(**BASE),
+            router_config=RouterConfig(replicas=1, prefill_replicas=1,
+                                       prefill_len_threshold=8))
+        pre = next(r for r in fleet._replicas.values()
+                   if r.role == "prefill")
+        sched = pre.sup.engine._sched
+        t = _t.time()
+        sched._finish_times.extend([t, t + 0.05, t + 0.1])
+        for _ in range(8):
+            sched.queue.append(SimpleNamespace(prefilling=False))
+        pre.routable = lambda: False        # pool saturated
+        hint = fleet._retry_after()
+        assert hint == pytest.approx(0.4, abs=1e-3)
+        # pool routable again: the decode estimate binds as before
+        pre.routable = lambda: True
+        assert fleet._retry_after() != pytest.approx(0.4, abs=1e-3)

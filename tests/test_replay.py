@@ -577,3 +577,47 @@ class TestSurvivableKVReplay:
         # int8 host blocks are cheaper: same budget, more cached tokens
         assert cap["layouts"]["int8_tp1"]["host_blocks_per_chip"] > \
             fp1["host_blocks_per_chip"]
+
+
+# ---------------------------------------------------------------------------
+# disaggregated-fleet replay (ISSUE 17): prefill pool + directory chaos
+# ---------------------------------------------------------------------------
+
+class TestDisaggReplay:
+    def test_disagg_fleet_replay_clean_under_chaos(self, setup):
+        """A fleet with a dedicated prefill replica and the cache
+        directory on, chaos drawn from the full mix INCLUDING the disagg
+        pair: ``kill_prefill_replica`` (mid-handoff prefill death — the
+        staged requests land via failover recompute, zero failed) and
+        ``stale_directory`` (a poisoned export fails the pull-side CRC
+        and degrades to recompute, never wrong KV). The audit — carrying
+        ``directory_coherence`` — stays clean every sample, nothing
+        fails or leaks fleet-wide."""
+        from paddle_tpu.inference.serving import RouterConfig, run_replay
+        from paddle_tpu.testing.chaos import (DISAGG_INJECTORS,
+                                              TIMELINE_INJECTORS,
+                                              chaos_timeline)
+        cfg, params, programs = setup
+        # requests/horizon trimmed below small_spec defaults: 8 events
+        # over 8 kinds still fire every injector once inside [0.1, 0.75)
+        # of the horizon, and the fleet drains well before the cap
+        spec = small_spec(requests=36, horizon_steps=28)
+        timeline = chaos_timeline(
+            spec.seed + 2, spec.horizon,
+            kinds=TIMELINE_INJECTORS + DISAGG_INJECTORS, events=8)
+        rep = run_replay(
+            params, cfg, spec=spec, serving_config=serving_config(),
+            router_config=RouterConfig(replicas=3, migrate=True,
+                                       prefill_replicas=1,
+                                       prefill_len_threshold=10,
+                                       breaker_cooldown_s=0.0,
+                                       hedge_ttft_mult=0.0),
+            chaos=timeline, programs=programs)
+        assert rep["violations"] == []
+        assert rep["failed"] == 0 and rep["router_failed"] == 0
+        assert rep["gave_up"] == 0
+        assert rep["leaked_blocks"] == 0
+        assert rep["drain_report"]["leaked_blocks"] == 0
+        fired = {name for _, name, _ in rep["chaos_fired"]} \
+            if "chaos_fired" in rep else set(rep["chaos_kinds"])
+        assert fired & set(DISAGG_INJECTORS)

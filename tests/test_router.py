@@ -132,6 +132,37 @@ class TestRouting:
             np.testing.assert_array_equal(r.result(f),
                                           dense(params, cfg, p, 2))
 
+    def test_shared_chain_lands_on_directory_holder(self, setup):
+        """ISSUE 17 satellite (first-block-only fragmentation): two
+        requests sharing a 3-block prefix chain land on the SAME replica
+        even with the legacy first-block affinity map wiped — the fleet
+        directory's longest-chain lookup, not the affinity bucket, finds
+        the holder."""
+        cfg, params, _, _ = setup
+        r = mk_router(setup, replicas=2)
+        rng = np.random.default_rng(11)
+        prefix = rng.integers(0, 97, (12,)).astype(np.int32)  # 3 blocks
+        a = np.concatenate([prefix,
+                            rng.integers(0, 97, (2,)).astype(np.int32)])
+        b = np.concatenate([prefix,
+                            rng.integers(0, 97, (3,)).astype(np.int32)])
+        fa = r.submit(a, max_new_tokens=2, eos_token_id=None)
+        while r.pending:
+            r.step()
+        r._affinity.clear()           # the legacy map alone can't help
+        fb = r.submit(b, max_new_tokens=2, eos_token_id=None)
+        while r.pending:
+            r.step()
+        assert r.request(fa).replica == r.request(fb).replica
+        snap = r.health_snapshot()
+        assert snap["counters"]["directory_hits"] >= 1
+        home = r._replicas[r.request(fb).replica]
+        assert home.sup.engine.stats()["prefix_hit_tokens"] >= 12
+        for f, p in ((fa, a), (fb, b)):
+            np.testing.assert_array_equal(r.result(f),
+                                          dense(params, cfg, p, 2))
+        assert_balanced(r)
+
     def test_p2c_prefers_shallower_replica(self, setup):
         """With one replica loaded and one idle, the two-choice pick
         lands new work on the idle one."""
