@@ -1947,6 +1947,97 @@ def bench_serve(backend):
     assert dg_leaked == 0 and dg_leaked_uni == 0, \
         (dg_leaked, dg_leaked_uni)
 
+    # ---- durability row: crash-safe journal + cold-restart recovery -----
+    # (ISSUE 18) two halves. OVERHEAD: the headline mixed trace served
+    # with the request journal OFF vs ON (per-step fsync'd WAL appends),
+    # interleaved rounds sharing the headline engine's compiled programs,
+    # min-of-rounds per side — the journal must cost < 5% (asserted).
+    # RECOVERY: a journaled supervisor serving the front-line trace is
+    # KILLED without grace mid-flight (``process_kill``: the userspace
+    # WAL tail dies, only fsynced state survives — no drain, no final
+    # snapshot) and a NEW supervisor is rebuilt via
+    # ``EngineSupervisor.recover(journal_dir)`` — the timed cold start is
+    # the serving_recovery_ms metric. Every pre-kill delivered stream +
+    # its post-recovery remainder must equal the dense oracle exactly:
+    # zero lost requests, zero re-delivered tokens, both asserted here.
+    import tempfile as _tf
+    from paddle_tpu.inference.serving import RequestJournal
+    from paddle_tpu.testing.chaos import process_kill
+
+    dj_sc = ServingConfig(block_size=blk, max_slots=max_slots,
+                          max_model_len=mlen, decode_chunk=chunk,
+                          queue_depth=n_req, prefix_cache=None)
+
+    def dj_round(j):
+        eng = ServingEngine(params, cfg, dj_sc,
+                            programs=engine.programs, journal=j)
+        t0 = time.time()
+        for p, o in zip(prompts, outs):
+            eng.submit(p, max_new_tokens=int(o), eos_token_id=None)
+        while eng.pending:
+            eng.step()
+        return time.time() - t0
+
+    dj_round(None)                                      # warm
+    dj_round(RequestJournal(_tf.mkdtemp(prefix="bj-w")))
+    dj_off, dj_on = [], []
+    # 4 interleaved rounds per side: min-of-2 still reads a host-load
+    # spike as journal cost on the 1-core box (observed 5.4% on a run
+    # that measured -8% an hour earlier); min-of-4 is stable
+    for _ in range(4):
+        dj_off.append(dj_round(None))
+        dj_on.append(dj_round(RequestJournal(_tf.mkdtemp(prefix="bj-"))))
+    dj_overhead = (min(dj_on) - min(dj_off)) / min(dj_off) * 100.0
+    assert dj_overhead < 5.0, \
+        f"journal overhead {dj_overhead:.2f}% >= 5% on the mixed trace"
+
+    dj_dir = _tf.mkdtemp(prefix="bj-kill-")
+    dj_sup = EngineSupervisor(params, cfg, ServingConfig(
+        block_size=blk, max_slots=ov_slots, max_model_len=mlen,
+        decode_chunk=chunk, queue_depth=fl_n, prefix_cache=None),
+        programs=eng_ov.programs, journal=RequestJournal(dj_dir))
+    dj_ids = [dj_sup.submit(p, max_new_tokens=fl_out, eos_token_id=None)
+              for p in fl_prompts]
+    dj_pre = {s: [] for s in dj_ids}
+    for _ in range(3):                # kill mid-flight, between steps
+        for s, toks in dj_sup.step(max_iters=1).items():
+            dj_pre[s].extend(int(t) for t in toks)
+    dj_jid = {s: dj_sup._reqs[s].jid for s in dj_ids}
+    dj_kill = process_kill(dj_sup)    # the fleet object is dead now
+    del dj_sup
+    t0 = time.time()
+    dj_rec = EngineSupervisor.recover(
+        dj_dir, params, cfg, serving_config=ServingConfig(
+            block_size=blk, max_slots=ov_slots, max_model_len=mlen,
+            decode_chunk=chunk, queue_depth=fl_n, prefix_cache=None),
+        programs=eng_ov.programs)
+    dj_recovery_ms = (time.time() - t0) * 1e3
+    dj_by_jid = {rec.jid: srid for srid, rec in dj_rec._reqs.items()}
+    dj_post = {s: [] for s in dj_ids}
+    while any(not rec.terminal for rec in dj_rec._reqs.values()):
+        emitted = dj_rec.step()
+        for srid, toks in emitted.items():
+            jid = dj_rec._reqs[srid].jid
+            orig = next(s for s in dj_ids if dj_jid[s] == jid)
+            dj_post[orig].extend(int(t) for t in toks)
+    dj_lost = dj_dup = 0
+    dj_match = True
+    for i, s in enumerate(dj_ids):
+        want = [int(t) for t in fl_oracle[i]]
+        got = dj_pre[s] + dj_post[s]
+        # got == want proves both halves at once: nothing lost (every
+        # oracle token delivered exactly once across the kill) and
+        # nothing duplicated (recovery never re-emitted a pre-kill token)
+        if got != want:
+            dj_match = False
+        if dj_jid[s] not in dj_by_jid or len(got) < len(want):
+            dj_lost += 1              # request dropped or stream cut short
+        dj_dup += max(0, len(got) - len(want))
+    assert dj_match and dj_lost == 0 and dj_dup == 0, \
+        (dj_match, dj_lost, dj_dup)
+    dj_leaked = dj_rec.engine.cache.manager.blocks_in_use
+    assert dj_leaked == 0, f"{dj_leaked} blocks leaked after recovery"
+
     return {
         "serving_tok_s": round(serving_tok_s, 1),
         "static_tok_s": round(static_tok_s, 1),
@@ -2173,6 +2264,19 @@ def bench_serve(backend):
         "disagg_recomputed_tokens": int(dg_recomputed),
         "disagg_failed": dg_snap["counters"]["failed"],
         "disagg_leaked_blocks": int(dg_leaked + dg_leaked_uni),
+        # durability row (ISSUE 18): journal overhead < 5%, kill -9
+        # mid-trace + timed cold-restart recovery with zero lost
+        # requests and zero re-delivered tokens — all asserted
+        # in-section; serving_recovery_ms is the tracked metric
+        "durable_outputs_match": bool(dj_match),
+        "durable_lost_requests": int(dj_lost),
+        "durable_duplicated_tokens": int(dj_dup),
+        "durable_journal_overhead_pct": round(dj_overhead, 2),
+        "durable_recovery_ms": round(dj_recovery_ms, 2),
+        "durable_resubmitted": int(dj_rec.resubmitted),
+        "durable_recovered_records": len(dj_by_jid),
+        "durable_wal_bytes": int(dj_kill["wal_bytes"]),
+        "durable_leaked_blocks": int(dj_leaked),
     }
 
 
@@ -2306,6 +2410,16 @@ _R2_ANCHORS = {
     # recomputed_tokens == 0, zero failed/leaks) are asserted; the
     # ratio is emitted-not-asserted, like goodput.
     "serving_disagg_tpot_ratio": 0.6,  # observed CPU value
+    # durability row (ISSUE 18): timed cold-restart recovery — journal
+    # load (newest snapshot + WAL suffix) + supervisor rebuild on shared
+    # compiled programs + bit-exact resubmission of every non-terminal
+    # request. Lower is better (the emit inverts the ratio). The row's
+    # hard proofs (parity across the kill, zero lost, zero duplicated,
+    # journal overhead < 5%) are asserted, not tracked.
+    "serving_recovery_ms": 2.0,  # observed CPU value (1.3-1.6ms: journal
+    # load + supervisor rebuild are host-side and the shared compiled
+    # programs make the engine build free; the resubmitted prefill
+    # recompute lands in the post-recovery steps, not here)
 }
 
 
@@ -2771,6 +2885,20 @@ def main():
                 _emit("serving_tp_capacity_ratio", s["tp_capacity_ratio"],
                       "x", s["tp_capacity_ratio"] /
                       _R2_ANCHORS["serving_tp_capacity_ratio"])
+            # durability row (ISSUE 18): the hard proofs — bit parity
+            # across the kill, zero lost requests, zero re-delivered
+            # tokens, journal overhead < 5% — are asserted inside
+            # bench_serve; re-pin them here so the row cannot silently
+            # vanish, then emit the timed cold-restart metric (lower is
+            # better, so the ratio inverts)
+            assert s["durable_outputs_match"], \
+                "durability row streams diverged across the kill"
+            assert s["durable_lost_requests"] == 0
+            assert s["durable_duplicated_tokens"] == 0
+            assert s["durable_journal_overhead_pct"] < 5.0
+            _emit("serving_recovery_ms", s["durable_recovery_ms"], "ms",
+                  _R2_ANCHORS["serving_recovery_ms"] /
+                  max(s["durable_recovery_ms"], 1e-6))
         section("serve", _serve)
     if want("wide"):
         def _wide():

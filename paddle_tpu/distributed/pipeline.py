@@ -716,14 +716,27 @@ class PipelineParallel(Layer):
             stage_meta.append((shp, [int(np.prod(s)) for s in shp]))
         Lmax = max(1, max(sum(sz) for _, sz in stage_meta))
 
+        # the flat pack must preserve the parameter dtype — forcing fp32
+        # here made a bf16 model's compiled stages run in fp32 and diverge
+        # from eager. One rectangular [S, Lmax] array holds exactly one
+        # dtype, so a uniform dtype packs natively and MIXED stage dtypes
+        # fall back to the eager schedule rather than silently upcast.
+        dtypes = sorted({str(p._value.dtype) for sl in stage_layers
+                         for l in sl for p in l.parameters()})
+        if len(dtypes) > 1:
+            return _NO_RUN_REASON + "; " + pre + (
+                f"mixed stage parameter dtypes {dtypes} cannot flat-pack "
+                "into one rectangular array")
+        pack_dtype = (jnp.zeros((), dtypes[0]).dtype if dtypes
+                      else jnp.float32)
+
         def pack_stage(s):
             leaves = [p._value for l in stage_layers[s]
                       for p in l.parameters()]
             if leaves:
-                flat = jnp.concatenate([jnp.ravel(v.astype(jnp.float32))
-                                        for v in leaves])
+                flat = jnp.concatenate([jnp.ravel(v) for v in leaves])
             else:
-                flat = jnp.zeros((0,), jnp.float32)
+                flat = jnp.zeros((0,), pack_dtype)
             return jnp.pad(flat, (0, Lmax - flat.shape[0]))
 
         def stack_now():

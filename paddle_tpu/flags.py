@@ -479,6 +479,32 @@ define_flag("FLAGS_serving_fleet_cache", True,
             "prefix cache is an island and stickiness falls back to the "
             "first-block affinity map.", bool)
 
+# durable serving: crash-safe request journal + cold-restart recovery
+# (ISSUE 18): docs/FAULT_TOLERANCE.md "Cold restart (serving)"
+define_flag("FLAGS_serving_journal_dir", "",
+            "Directory for the crash-safe serving request journal; empty "
+            "disables durability. When set, EngineSupervisor and "
+            "ServingRouter journal every submit / delivered-token cursor "
+            "/ terminal transition there (crc32 + length framed WAL plus "
+            "periodic snapshots), and EngineSupervisor.recover() / "
+            "ServingRouter.cold_start() rebuild the fleet after a "
+            "process death — every non-terminal request resubmitted "
+            "bit-exactly from prompt + delivered-so-far, no delivered "
+            "token ever re-emitted.", str)
+define_flag("FLAGS_serving_journal_sync", "step",
+            "Journal fsync policy: 'step' batches one fsync per engine "
+            "step (the boundary at which tokens become visible to "
+            "clients, so the journal never claims delivery of a token "
+            "the caller could not have seen), 'always' fsyncs every "
+            "record, 'off' leaves residency to the page cache (survives "
+            "process death, not host death).", str)
+define_flag("FLAGS_serving_snapshot_every", 64,
+            "Engine steps (journal flushes) between serving-state "
+            "snapshots; 0 disables periodic snapshots (the journal "
+            "still snapshots once on graceful drain). Snapshots bound "
+            "cold-restart replay to the WAL suffix written since the "
+            "last good generation.", int)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

@@ -91,6 +91,12 @@ class ModelAverage:
         self.max_window = int(max_average_window)
         self._sum: Dict[int, np.ndarray] = {}
         self._num = 0
+        # the previous window's completed (sum, count) pair — the
+        # single-accumulator spelling of the reference's sum_1/2/3
+        # rotation. apply() folds it in, so the effective window right
+        # after a rotation is ~2 windows, never 1 sample (ADVICE r6)
+        self._old_sum: Dict[int, np.ndarray] = {}
+        self._old_num = 0
         self._total = 0
         self._backup: Dict[int, np.ndarray] = {}
 
@@ -104,26 +110,38 @@ class ModelAverage:
             self._sum[pid] = v.copy() if acc is None else acc + v
         # reference window semantics: the effective window is
         # rate * num_updates, clamped to [min_average_window,
-        # max_average_window]; restart the accumulator when the window
-        # overflows (the reference's sum_1/2/3 rotation collapses to a
-        # restart under a single accumulator)
+        # max_average_window]; when the accumulator overflows the window,
+        # ROTATE it — the full window just finished becomes the old pair
+        # and a fresh one starts from the current values. A hard restart
+        # here (the pre-ADVICE-r6 bug) meant an apply() shortly after the
+        # rotation averaged ~1 sample instead of >= a window's worth.
         window = int(min(self.max_window,
                          max(self.min_window,
                              self.rate * self._total)))
         if self._num > window:
-            for p in self.params:
-                self._sum[id(p)] = p.numpy().copy()
-            self._num = 1
+            self._old_sum = self._sum
+            self._old_num = self._num
+            # the fresh accumulator restarts EMPTY (the just-added sample
+            # lives in the rotated-out pair — seeding from the current
+            # value would count it twice)
+            self._sum = {}
+            self._num = 0
 
     def apply(self, executor=None, need_restore: bool = True):
-        if not self._num:
+        if not self._num and not self._old_num:
             return
         for p in self.params:
             pid = id(p)
             if need_restore:
                 self._backup[pid] = p.numpy().copy()
-            p.set_value((self._sum[pid] / self._num).astype(
-                p.numpy().dtype))
+            s, n = self._sum.get(pid), self._num
+            old = self._old_sum.get(pid)
+            if old is not None and self._old_num:
+                s = old if s is None else s + old
+                n += self._old_num
+            if s is None:
+                continue
+            p.set_value((s / n).astype(p.numpy().dtype))
 
     def restore(self, executor=None):
         for p in self.params:

@@ -37,6 +37,7 @@ report (``capacity_report`` + the ``serving_replay_goodput`` metric).
 from .audit import AUDIT_CHECKS, InvariantAuditor, InvariantViolation
 from .engine import (EnginePrograms, HEALTH_SNAPSHOT_FIELDS,
                      SUPERVISOR_SNAPSHOT_KEYS, ServingConfig, ServingEngine)
+from .journal import JournalRecord, RequestJournal
 from .paged_cache import BlockManager, PagedKVCache
 from .policies import (AdmissionPolicy, EDFPolicy, FairSharePolicy,
                        FIFOPolicy, POLICIES, PriorityPolicy, resolve_policy)
@@ -68,4 +69,5 @@ __all__ = ["ServingEngine", "ServingConfig", "PagedKVCache", "BlockManager",
            "BREAKER_CLOSED", "BREAKER_OPEN", "BREAKER_HALF_OPEN",
            "InvariantAuditor", "InvariantViolation", "AUDIT_CHECKS",
            "WorkloadSpec", "TraceRequest", "generate_trace",
-           "ReplayManifest", "run_replay", "capacity_report"]
+           "ReplayManifest", "run_replay", "capacity_report",
+           "RequestJournal", "JournalRecord"]

@@ -119,6 +119,19 @@ AUDIT_CHECKS = {
                            "would mean the invalidation callbacks "
                            "leaked) — vacuously true with the "
                            "directory off",
+    "durable_exactly_once": "crash-safe journal coherence (ISSUE 18): "
+                            "every live request owning a journal record "
+                            "maps to a record that exists, is still "
+                            "live, and mirrors the delivered token "
+                            "stream EXACTLY; no journal record is owned "
+                            "by two live requests at once (across the "
+                            "whole fleet sharing one journal); a "
+                            "terminal request's still-retained record "
+                            "is terminal — so a kill -9 right now "
+                            "recovers every stream from prompt + "
+                            "delivered, losing nothing and re-emitting "
+                            "nothing (vacuously true with the journal "
+                            "off)",
 }
 
 
@@ -329,6 +342,25 @@ class InvariantAuditor:
         with self._locked(target) as engines:
             for label, eng in engines:
                 self._check_engine(label, eng, fail)
+            if "durable_exactly_once" in self.checks:
+                # fleet scope: the journal is SHARED across replicas,
+                # so record ownership must be unique across all of them
+                # — two live owners would double-deliver after a cold
+                # restart (a vacated migration/hedge/handoff copy that
+                # was never disowned)
+                owners: Dict[int, List[str]] = {}
+                for label, eng in engines:
+                    if getattr(eng, "journal", None) is None:
+                        continue
+                    for rid, jid in eng._jlive.items():
+                        owners.setdefault(int(jid), []).append(
+                            f"{label} rid {rid}")
+                for jid, who in sorted(owners.items()):
+                    if len(who) > 1:
+                        fail("durable_exactly_once",
+                             f"journal record {jid} owned by "
+                             f"{len(who)} live requests at once: "
+                             f"{', '.join(who)}")
             if hasattr(target, "_replicas"):
                 self._check_router(target, fail)
                 if "counters_monotonic" in self.checks:
@@ -436,6 +468,8 @@ class InvariantAuditor:
         tier = getattr(eng.cache, "offload", None)
         if on("tier_partition") and tier is not None:
             self._check_tier(label, bm, tier, fail)
+        if on("durable_exactly_once"):
+            self._check_durable(label, eng, fail)
         if on("quiesce_leaks") and not sched.pending \
                 and bm.blocks_in_use != 0:
             fail("quiesce_leaks",
@@ -490,6 +524,58 @@ class InvariantAuditor:
                 fail("tier_partition",
                      f"pending host entry {key} holds {len(toks)} tokens "
                      f"(exactly block_size={tier.block_size} expected)",
+                     label)
+
+    @staticmethod
+    def _check_durable(label: str, eng, fail) -> None:
+        """The journal half of the durability story (ISSUE 18): the
+        in-memory journal mirror must be EXACTLY what cold-start
+        recovery would rebuild from — a kill -9 after this step's fsync
+        replays every live stream from prompt + delivered-so-far with
+        nothing lost and nothing re-emitted. A disowned request
+        (jid -1: hedge copy, vacated migration source) asserts nothing
+        here; its logical request owns the record elsewhere. Vacuously
+        true with the journal off."""
+        journal = getattr(eng, "journal", None)
+        if journal is None:
+            return
+        sched = eng._sched
+        for req in list(sched.queue) + sched.live:
+            if req.jid < 0:
+                continue
+            rec = journal.records.get(req.jid)
+            if rec is None:
+                fail("durable_exactly_once",
+                     f"live request {req.rid} owns journal record "
+                     f"{req.jid}, which does not exist", label)
+                continue
+            if rec.terminal:
+                fail("durable_exactly_once",
+                     f"live request {req.rid}'s journal record "
+                     f"{req.jid} already closed {rec.state!r} — a cold "
+                     f"restart would drop the stream", label)
+                continue
+            jt = [int(t) for t in rec.tokens]
+            rt = [int(t) for t in req.tokens]
+            if jt != rt:
+                verb = "re-emit" if len(jt) < len(rt) else "skip"
+                fail("durable_exactly_once",
+                     f"request {req.rid}: journal record {req.jid} "
+                     f"holds {len(jt)} token(s) (crc {_crc(jt)}) but "
+                     f"the live request delivered {len(rt)} (crc "
+                     f"{_crc(rt)}) — recovery would {verb} delivered "
+                     f"tokens", label)
+        for rid, req in sched.finished.items():
+            if req.jid < 0:
+                continue
+            rec = journal.records.get(req.jid)
+            if rec is None:
+                continue       # bounded terminal retention pruned it
+            if not rec.terminal:
+                fail("durable_exactly_once",
+                     f"terminal request {rid} ({req.state!r}) left "
+                     f"journal record {req.jid} live — a cold restart "
+                     f"would resurrect a stream the client saw end",
                      label)
 
     @staticmethod

@@ -371,11 +371,19 @@ class ServingEngine:
 
     def __init__(self, params, model_config, serving_config:
                  Optional[ServingConfig] = None, gen_config=None,
-                 programs: Optional[EnginePrograms] = None):
+                 programs: Optional[EnginePrograms] = None,
+                 journal=None):
         import jax
 
         from ...models.generation import GenerationConfig, validate_sampling
         self.config = serving_config or ServingConfig()
+        # durable serving (ISSUE 18): a RequestJournal (possibly shared
+        # fleet-wide) that this engine feeds under its own lock — submit
+        # records, per-step delivered-token cursors, terminal
+        # transitions — with ONE flush (fsync under the 'step' policy)
+        # per step. None = durability off, zero overhead.
+        self.journal = journal
+        self._jlive: Dict[int, int] = {}   # rid -> owned journal jid
         self._gen = gen_config or GenerationConfig()
         # the engine-default sampling knobs must themselves be servable
         # (per-request overrides are validated again at submit)
@@ -698,7 +706,9 @@ class ServingEngine:
                                  temperature=temperature, top_k=top_k,
                                  top_p=top_p, seed=seed)
         with self._lock:
-            return self._sched.submit(req)
+            rid = self._sched.submit(req)
+            self._journal_submit(req)
+            return rid
 
     def _make_request(self, prompt, max_new_tokens, eos_token_id, tenant,
                       priority, deadline, tokens: Sequence[int] = (),
@@ -742,7 +752,8 @@ class ServingEngine:
                  deadline: Optional[float] = None,
                  tenant: Optional[str] = None, priority: int = 0,
                  temperature: Any = "unset", top_k: Any = "unset",
-                 top_p: Any = "unset", seed: Any = "unset") -> int:
+                 top_p: Any = "unset", seed: Any = "unset",
+                 jid: Optional[int] = None) -> int:
         """Re-queue a request recovered from a torn-down engine with the
         tokens it had already emitted — the supervisor's restart path.
         Rides the preemption-recompute machinery: prefill recomputes KV
@@ -755,7 +766,14 @@ class ServingEngine:
         original request's). Bypasses the queue-depth shed — everything
         resubmitted was already accepted once, and the recovered set
         (old queue + old slots) can exceed the admission bound by up to
-        ``max_slots``."""
+        ``max_slots``.
+
+        ``jid`` re-attaches the request to an existing journal record
+        (crash recovery / cross-replica failover under a shared journal):
+        the record is resumed in place — no duplicate submit event — so
+        recovery is idempotent across repeated crashes. An unknown or
+        already-terminal jid falls back to a fresh journal record seeded
+        with the delivered tokens."""
         req = self._make_request(prompt, max_new_tokens, eos_token_id,
                                  tenant, priority, deadline, tokens=tokens,
                                  temperature=temperature, top_k=top_k,
@@ -765,7 +783,93 @@ class ServingEngine:
                 f"request is already finished ({len(req.tokens)} tokens of "
                 f"{req.max_new_tokens}); record it, don't resubmit it")
         with self._lock:
-            return self._sched.submit(req, enforce_bound=False)
+            rid = self._sched.submit(req, enforce_bound=False)
+            self._journal_submit(req, jid)
+            return rid
+
+    # ---- durable journal hooks (ISSUE 18) ---------------------------------
+
+    def _journal_submit(self, req: Request,
+                        jid: Optional[int] = None) -> None:
+        """Attach a just-admitted request to the journal: resume an
+        existing record when ``jid`` names a live one (recovery /
+        failover / adoption), else append a fresh submit event carrying
+        the RESOLVED record. Caller holds the engine lock."""
+        if self.journal is None:
+            return
+        if jid is not None and jid >= 0 \
+                and self.journal.resume(jid, req.tokens):
+            req.jid = jid
+        else:
+            req.jid = self.journal.log_submit(
+                prompt=req.prompt, max_new_tokens=req.max_new_tokens,
+                eos_token_id=req.eos_token_id,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, seed=req.seed, tenant=req.tenant,
+                priority=req.priority, deadline=req.deadline,
+                tokens=req.tokens)
+        self._jlive[req.rid] = req.jid
+
+    def _journal_end(self, req: Request) -> None:
+        """Journal a terminal transition the moment it happens (deadline
+        expiry, cancel, shed) — a disowned request (jid -1) logs
+        nothing. Caller holds the engine lock."""
+        self._jlive.pop(req.rid, None)
+        if self.journal is not None and req.jid >= 0:
+            self.journal.log_terminal(req.jid, req.state)
+
+    def _journal_step(self, emitted: Dict[int, List[int]]) -> None:
+        """The per-step journal hook, run under the engine lock right
+        after ``_step``: log every delivered-token cursor advance, log
+        terminal transitions the retire sweep made, then flush — ONE
+        fsync per step under the default policy, at exactly the boundary
+        where the emitted tokens become visible to the caller."""
+        if self.journal is None:
+            return
+        for rid, toks in emitted.items():
+            jid = self._jlive.get(rid)
+            if jid is not None and toks:
+                self.journal.log_tokens(jid, toks)
+        fin = self._sched.finished
+        for rid in [r for r in self._jlive if r in fin]:
+            req = fin[rid]
+            self._jlive.pop(rid, None)
+            if req.jid >= 0:
+                self.journal.log_terminal(req.jid, req.state)
+        self.journal.flush()
+
+    def _journal_flush(self) -> None:
+        if self.journal is not None:
+            self.journal.flush()
+
+    def journal_disown(self, rid: int) -> None:
+        """Detach a live request from its journal record WITHOUT ending
+        it — the deliberate same-fleet moves (migration release, prefill
+        handoff release, hedge copies) cancel their vacated copy, and
+        that cancel must not mark the still-live logical request
+        terminal. The new owner re-attaches via :meth:`journal_own` or
+        ``resubmit(jid=)``/``adopt``."""
+        with self._lock:
+            self._jlive.pop(rid, None)
+            req = self._sched.find(rid)
+            if req is not None:
+                req.jid = -1
+
+    def journal_own(self, rid: int, jid: int, tokens) -> bool:
+        """Attach a live request to journal record ``jid`` (hedge
+        promotion: the winning copy inherits the logical request's
+        record), rebasing the record's delivered cursor to ``tokens`` —
+        what the client actually saw. False when the record is unknown /
+        terminal or the rid is not live."""
+        with self._lock:
+            if self.journal is None:
+                return False
+            req = self._sched.find(rid)
+            if req is None or not self.journal.resume(jid, tokens):
+                return False
+            req.jid = int(jid)
+            self._jlive[rid] = req.jid
+            return True
 
     # ---- live KV migration (ISSUE 16) -------------------------------------
 
@@ -808,6 +912,7 @@ class ServingEngine:
                 "top_k": req.top_k, "top_p": req.top_p, "seed": req.seed,
                 "tenant": req.tenant, "priority": req.priority,
                 "deadline": req.deadline,
+                "jid": req.jid,
                 "kv": None,
             }
             if req.slot is None or not req.blocks:
@@ -859,7 +964,9 @@ class ServingEngine:
                                  "don't migrate it")
             kv = payload.get("kv")
             if kv is None:
-                return self._sched.submit(req, enforce_bound=False)
+                rid = self._sched.submit(req, enforce_bound=False)
+                self._journal_submit(req, payload.get("jid"))
+                return rid
             if tuple(kv["shape_key"]) != self.kv_shape_key():
                 raise AdoptError("KV layout mismatch (block size / "
                                  "kv_quant / TP shape differ); falling "
@@ -902,6 +1009,7 @@ class ServingEngine:
             req.reg_state = self.cache.register_prefix(
                 req.build_prefill_ids(), blocks, entries,
                 tenant=req.tenant)
+            self._journal_submit(req, payload.get("jid"))
             return req.rid
 
     # ---- fleet-wide cache pulls (ISSUE 17) --------------------------------
@@ -1009,6 +1117,7 @@ class ServingEngine:
             if self._retire_if_finished(req):
                 return False         # its work completed first: not an error
             self._terminate(req, CANCELLED)
+            self._journal_flush()
             return True
 
     def cancel_all(self) -> int:
@@ -1021,6 +1130,8 @@ class ServingEngine:
                     continue
                 self._terminate(req, CANCELLED)
                 n += 1
+            if n:
+                self._journal_flush()
             return n
 
     def _retire_if_finished(self, req: Request) -> bool:
@@ -1035,6 +1146,7 @@ class ServingEngine:
         m = req.slot
         self._sched.finish(req)
         self._clear_slot(m)
+        self._journal_end(req)
         return True
 
     def _clear_slot(self, m: int) -> None:
@@ -1054,6 +1166,7 @@ class ServingEngine:
         self._sched.terminate(req, state)
         if m is not None:
             self._clear_slot(m)
+        self._journal_end(req)
 
     def _expire_deadlines(self, now: float) -> None:
         """Terminal-state sweep, run once per step and only while some
@@ -1523,7 +1636,9 @@ class ServingEngine:
         named in the hang diagnosis exactly like a training section."""
         _watchdog.touch()
         with self._lock, _watchdog.section("serving.step"):
-            return self._step(max_iters)
+            emitted = self._step(max_iters)
+            self._journal_step(emitted)
+            return emitted
 
     def _step(self, max_iters: Optional[int]) -> Dict[int, List[int]]:
         import jax.numpy as jnp

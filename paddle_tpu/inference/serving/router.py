@@ -79,6 +79,7 @@ import numpy as np
 from ...flags import flag
 from ...health import watchdog as _watchdog
 from .directory import CacheDirectory
+from .journal import RequestJournal
 from .paged_cache import prefix_block_chain
 from .replica import CircuitBreaker, Replica
 from .scheduler import (CANCELLED, FINISHED, QUEUED, TERMINAL_STATES,
@@ -267,6 +268,8 @@ class RouterRequest:
     seed: int = 0
     replica: int = -1                 # current primary replica rid
     srid: int = -1                    # supervisor rid on that replica
+    jid: int = -1                     # journal record id (ISSUE 18);
+    #                                    journal-global across the fleet
     affinity_key: Optional[int] = None
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
@@ -305,7 +308,8 @@ class ServingRouter:
 
     def __init__(self, params, model_config, serving_config=None,
                  gen_config=None, router_config: Optional[RouterConfig]
-                 = None, replicas: Optional[int] = None, programs=None):
+                 = None, replicas: Optional[int] = None, programs=None,
+                 journal="unset"):
         from .engine import ServingConfig
         self.config = router_config or RouterConfig(replicas=replicas)
         if replicas is not None and router_config is not None:
@@ -371,11 +375,20 @@ class ServingRouter:
         self.handoff_fallbacks = 0     # collapsed to decoding in place
         self.completed = 0
         self.failed = 0                # router-terminal FAILED (no replica)
+        self.cold_recovered = 0        # requests resubmitted by cold_start
         # fleet-wide prefix-chain directory (ISSUE 17): fed by the
         # BlockManager/offload-tier callbacks _wire_directory installs
         # on every replica; None = legacy first-block affinity only
         self._directory: Optional[CacheDirectory] = (
             CacheDirectory() if self.config.fleet_cache else None)
+        # durable serving (ISSUE 18): the WHOLE fleet shares ONE journal
+        # (jids are journal-global), resolved here and passed explicitly
+        # to every supervisor — they must never self-resolve the flag
+        # into N competing journals on the same directory.
+        if isinstance(journal, str) and journal == "unset":
+            jdir = str(flag("FLAGS_serving_journal_dir", ""))
+            journal = RequestJournal(jdir) if jdir else None
+        self._journal = journal
         for _ in range(self.config.replicas):
             self.spawn_replica()
         for _ in range(self.config.prefill_replicas):
@@ -386,11 +399,121 @@ class ServingRouter:
     def _build_supervisor(self) -> EngineSupervisor:
         sup = EngineSupervisor(self._params, self._model_config,
                                self._serving_config, self._gen_config,
-                               programs=self._programs)
+                               programs=self._programs,
+                               journal=self._journal)
         # EVERY replica shares the first one's compiled programs: a fleet
         # costs one compile total, and the flat trace counter proves it
         self._programs = sup.engine.programs
         return sup
+
+    # ---- durable cold-restart recovery (ISSUE 18) ---------------------------
+
+    @property
+    def journal(self) -> Optional[RequestJournal]:
+        return self._journal
+
+    @classmethod
+    def cold_start(cls, journal_dir: str, params, model_config,
+                   serving_config=None, gen_config=None,
+                   router_config: Optional[RouterConfig] = None,
+                   replicas: Optional[int] = None, programs=None,
+                   journal: Optional[RequestJournal] = None
+                   ) -> "ServingRouter":
+        """Rebuild the fleet after a FULL process death from the shared
+        journal directory: spawn fresh replicas, then for every journal
+        record — terminal ones become readable router records; ones
+        whose delivered tokens already complete them close FINISHED
+        (record it, don't re-run it); every other request resubmits
+        bit-exactly from prompt + delivered-so-far under its original
+        jid onto a healthy replica. Greedy and seeded streams resume
+        bit-identical to an uninterrupted run and no delivered token is
+        ever re-emitted — the exactly-once ledger is primed from the
+        journal. Idempotent: dying again during recovery and cold-
+        starting once more replays to the same state."""
+        j = journal if journal is not None else RequestJournal(journal_dir)
+        router = cls(params, model_config, serving_config, gen_config,
+                     router_config, replicas=replicas, programs=programs,
+                     journal=j)
+        router._restore_from_journal()
+        return router
+
+    def _restore_from_journal(self) -> None:
+        """Turn the journal mirror into router records + replica
+        resubmissions, in jid (original submission) order."""
+        j = self._journal
+        if j is None:
+            return
+        with self._lock:
+            now = time.time()
+            for jid in sorted(j.records):
+                rec = j.records[jid]
+                req = RouterRequest(
+                    frid=self._next_frid, prompt=rec.prompt_array(),
+                    max_new_tokens=rec.max_new_tokens,
+                    eos_token_id=rec.eos_token_id, tenant=rec.tenant,
+                    priority=rec.priority, deadline=rec.deadline,
+                    temperature=rec.temperature, top_k=rec.top_k,
+                    top_p=rec.top_p, seed=rec.seed, jid=jid,
+                    submit_t=now)
+                req.tokens = [int(t) for t in rec.tokens]
+                self._next_frid += 1
+                self._reqs[req.frid] = req
+                if rec.terminal:
+                    req.state = rec.state
+                    req.finish = {"state": rec.state,
+                                  "tokens": len(req.tokens),
+                                  "recovered": True}
+                    continue
+                if req.finished_by_tokens:
+                    # died after its last delivered token but before the
+                    # terminal event landed: it IS complete
+                    req.state = FINISHED
+                    req.finish = {"state": FINISHED,
+                                  "tokens": len(req.tokens),
+                                  "recovered": True,
+                                  "finished_by_tokens": True}
+                    self.completed += 1
+                    j.log_terminal(jid, FINISHED)
+                    continue
+                placed = False
+                for rep in self._candidates(now=now) or \
+                        [r for r in self._replicas.values()
+                         if r.adoptable() and r.role == "decode"]:
+                    try:
+                        srid = rep.sup.resubmit(
+                            req.prompt, req.tokens,
+                            max_new_tokens=req.max_new_tokens,
+                            eos_token_id=req.eos_token_id,
+                            deadline=req.deadline, tenant=req.tenant,
+                            priority=req.priority,
+                            temperature=req.temperature,
+                            top_k=req.top_k, top_p=req.top_p,
+                            seed=req.seed, jid=jid)
+                    except Exception:  # noqa: BLE001 — raced a drain
+                        continue
+                    self._routes[rep.rid][srid] = req.frid
+                    req.replica, req.srid = rep.rid, srid
+                    self._active[req.frid] = req
+                    self.cold_recovered += 1
+                    placed = True
+                    break
+                if not placed:
+                    req.state = FAILED
+                    req.finish = {"state": FAILED,
+                                  "tokens": len(req.tokens),
+                                  "reason": "no_replica",
+                                  "recovered": True}
+                    self.failed += 1
+                    j.log_terminal(jid, FAILED)
+            j.flush()
+
+    def _journal_router_end(self, req: RouterRequest, state: str) -> None:
+        """Journal a router-level terminal no engine can log (the owning
+        replica is gone): FAILED with no replica left, or finished-by-
+        tokens resolved during failover."""
+        if self._journal is not None and req.jid >= 0:
+            self._journal.log_terminal(req.jid, state)
+            self._journal.flush()
 
     def spawn_replica(self, role: str = "decode") -> Optional[int]:
         """Add one replica (autoscale scale-up / construction). Returns
@@ -741,8 +864,8 @@ class ServingRouter:
                 priority=rec.priority, deadline=rec.deadline,
                 temperature=rec.temperature, top_k=rec.top_k,
                 top_p=rec.top_p, seed=rec.seed,
-                replica=rep.rid, srid=srid, affinity_key=key,
-                submit_t=now)
+                replica=rep.rid, srid=srid, jid=rec.jid,
+                affinity_key=key, submit_t=now)
             req.prefill_stage = (rep.role == "prefill")
             if req.prefill_stage:
                 self.prefill_routed += 1
@@ -831,6 +954,14 @@ class ServingRouter:
                     got = [int(t) for t in emitted[srid]]
                     req.tokens.extend(got)
                     out.setdefault(frid, []).extend(got)
+                    if req.jid >= 0:
+                        srec = rep.sup._reqs.get(srid)
+                        if srec is not None and srec.jid != req.jid:
+                            # a promoted hedge copy inherits the logical
+                            # request's journal record, rebased to what
+                            # the client has ACTUALLY been delivered
+                            rep.sup.journal_own(srid, req.jid,
+                                                req.tokens)
             self._handoffs(now)
             self._sweep(now)
             self._check_hedges(now)
@@ -931,6 +1062,13 @@ class ServingRouter:
                 continue
             is_primary = (req.replica, req.srid) == (rep.rid, srid)
             if not rep.sup.broken:
+                if is_primary and req.jid >= 0:
+                    # the evacuation cancel must not end the journal
+                    # record — the failover below resumes it elsewhere
+                    try:
+                        rep.sup.disown_journal(srid)
+                    except Exception:  # noqa: BLE001
+                        pass
                 try:
                     rep.sup.cancel(srid)
                 except Exception:      # noqa: BLE001
@@ -1014,6 +1152,7 @@ class ServingRouter:
                           "failovers": req.failovers,
                           "finished_by_tokens": True}
             self.completed += 1
+            self._journal_router_end(req, FINISHED)
             self._retire_record(req)
             return
         cands = self._candidates(exclude=exclude, now=now)
@@ -1035,11 +1174,18 @@ class ServingRouter:
                     eos_token_id=req.eos_token_id, deadline=req.deadline,
                     tenant=req.tenant, priority=req.priority,
                     temperature=req.temperature, top_k=req.top_k,
-                    top_p=req.top_p, seed=req.seed)
+                    top_p=req.top_p, seed=req.seed,
+                    jid=req.jid if req.jid >= 0 else None)
             except Exception:          # noqa: BLE001 — raced a drain
                 continue
             self._routes[rep.rid][srid] = req.frid
             req.replica, req.srid = rep.rid, srid
+            # when the crashed supervisor closed the old journal record
+            # FAILED, resubmit opened a fresh superseding record — adopt
+            # its jid so the ownership hook doesn't chase a dead one
+            srec = rep.sup._reqs.get(srid)
+            if srec is not None:
+                req.jid = srec.jid
             self.failover_tokens += len(req.tokens)
             if req.affinity_key is not None:
                 # shared-prefix traffic follows the work to its new home
@@ -1049,6 +1195,7 @@ class ServingRouter:
         req.finish = {"state": FAILED, "tokens": len(req.tokens),
                       "failovers": req.failovers, "reason": "no_replica"}
         self.failed += 1
+        self._journal_router_end(req, FAILED)
         self._retire_record(req)
 
     def _sweep(self, now: float) -> None:
@@ -1122,6 +1269,14 @@ class ServingRouter:
             self._cancel_hedge(req)    # primary won
             return
         loser = (req.replica, req.srid)
+        lrep = self._replicas.get(loser[0])
+        if lrep is not None and req.jid >= 0:
+            # the demoted primary must not terminate the journal record
+            # its winning copy is about to inherit
+            try:
+                lrep.sup.disown_journal(loser[1])
+            except Exception:          # noqa: BLE001 — sick loser
+                pass
         req.replica, req.srid = rid, srid
         req.hedge = loser              # demote, then cancel via the same
         self._cancel_hedge(req)        # path (mapping + engine cancel)
@@ -1151,6 +1306,10 @@ class ServingRouter:
                 continue
             req.hedge = (rep.rid, srid)
             req.hedged = True
+            # the hedge copy is NOT journaled (its emission is not client
+            # delivery — the primary's is); on promotion it inherits the
+            # primary's record via journal_own
+            rep.sup.disown_journal(srid)
             self._routes[rep.rid][srid] = req.frid
             self.hedges += 1
 

@@ -168,6 +168,11 @@ class Request:
     #                                    repeats what it had finished)
     oom_truncated: bool = False        # pool exhausted with nothing left to
     #                                    preempt: retired early, output kept
+    # durable serving (ISSUE 18): the journal record this request owns
+    # (-1 = unjournaled). Ownership moves with the request across
+    # migration / handoff / hedge resolution — the vacated copy is
+    # DISOWNED before its cancel so the record stays live.
+    jid: int = -1
 
     @property
     def prompt_len(self) -> int:

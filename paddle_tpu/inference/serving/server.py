@@ -130,6 +130,31 @@ class ServingServer:
         self.drain_report: Optional[Dict[str, Any]] = None
         self.pump_error: Optional[BaseException] = None
 
+    @classmethod
+    def cold_start(cls, journal_dir: str, params, model_config,
+                   serving_config=None, gen_config=None,
+                   replicas: Optional[int] = None, router_config=None,
+                   programs=None, **server_kw) -> "ServingServer":
+        """Build a server over a crash-recovered backend (ISSUE 18): a
+        :meth:`~.router.ServingRouter.cold_start` fleet when
+        ``replicas``/``router_config`` is given, else a single
+        :meth:`EngineSupervisor.recover` replica. Every request the dead
+        process had journaled and not finished resumes bit-exactly; its
+        SIGTERM path (``install_signal_handlers`` → drain) flushes the
+        journal and writes a final snapshot before exit, closing the
+        durability loop for the next cold start."""
+        if replicas is not None or router_config is not None:
+            from .router import ServingRouter
+            backend = ServingRouter.cold_start(
+                journal_dir, params, model_config, serving_config,
+                gen_config, router_config=router_config,
+                replicas=replicas, programs=programs)
+        else:
+            backend = EngineSupervisor.recover(
+                journal_dir, params, model_config, serving_config,
+                gen_config, programs=programs)
+        return cls(backend, **server_kw)
+
     # ---- lifecycle ---------------------------------------------------------
 
     async def start_pump(self) -> None:

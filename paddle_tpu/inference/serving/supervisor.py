@@ -56,6 +56,7 @@ import numpy as np
 from ...flags import flag
 from ...health import watchdog as _watchdog
 from .engine import ServingEngine
+from .journal import RequestJournal
 from .scheduler import (CANCELLED, FINISHED, QUEUED, TERMINAL_STATES,
                         completes_by_tokens)
 
@@ -103,6 +104,8 @@ class TrackedRequest:
     top_p: Optional[float] = None
     seed: int = 0
     erid: int = -1                     # rid in the CURRENT engine
+    jid: int = -1                      # journal record id (ISSUE 18);
+    #                                    -1 = unjournaled/disowned
     tokens: List[int] = dataclasses.field(default_factory=list)
     state: str = QUEUED
     resubmits: int = 0
@@ -193,7 +196,8 @@ class EngineSupervisor:
 
     def __init__(self, params, model_config, serving_config=None,
                  gen_config=None, max_restarts: Optional[int] = None,
-                 drain_deadline_s: Optional[float] = None, programs=None):
+                 drain_deadline_s: Optional[float] = None, programs=None,
+                 journal="unset"):
         self._params = params
         self._model_config = model_config
         self._serving_config = serving_config
@@ -223,6 +227,14 @@ class EngineSupervisor:
         self._wd_seen: Optional[object] = None
         self._last_shed = 0
         self._programs = programs
+        # durable serving (ISSUE 18): 'unset' resolves through
+        # FLAGS_serving_journal_dir (empty = off); an explicit journal
+        # instance (the router shares ONE across its replicas) or an
+        # explicit None always wins over the flag.
+        if isinstance(journal, str) and journal == "unset":
+            jdir = str(flag("FLAGS_serving_journal_dir", ""))
+            journal = RequestJournal(jdir) if jdir else None
+        self._journal = journal
         self.engine = self._build_engine()
         # terminal TrackedRequests are retained BOUNDED (insertion order,
         # oldest evicted) — the scheduler's own record bound, which is
@@ -235,7 +247,8 @@ class EngineSupervisor:
     def _build_engine(self) -> ServingEngine:
         eng = ServingEngine(self._params, self._model_config,
                             self._serving_config, self._gen_config,
-                            programs=self._programs)
+                            programs=self._programs,
+                            journal=self._journal)
         # reuse the first engine's compiled programs on every rebuild:
         # restart must never pay a recompile (EnginePrograms docstring)
         self._programs = eng.programs
@@ -306,7 +319,7 @@ class EngineSupervisor:
             eos_token_id=req.eos_token_id, tenant=req.tenant,
             priority=req.priority, deadline=req.deadline,
             temperature=req.temperature, top_k=req.top_k,
-            top_p=req.top_p, seed=req.seed, erid=erid)
+            top_p=req.top_p, seed=req.seed, erid=erid, jid=req.jid)
         rec.tokens = [int(t) for t in req.tokens]
         rec.resubmits = resubmits
         self._next_srid += 1
@@ -334,7 +347,7 @@ class EngineSupervisor:
                  deadline: Optional[float] = None,
                  tenant: Optional[str] = None, priority: int = 0,
                  temperature="unset", top_k="unset", top_p="unset",
-                 seed="unset") -> int:
+                 seed="unset", jid: Optional[int] = None) -> int:
         """ADOPT a request recovered from another replica (the router's
         cross-replica failover): queue it with the tokens the client has
         already been delivered, riding :meth:`ServingEngine.resubmit`'s
@@ -349,11 +362,118 @@ class EngineSupervisor:
                 prompt, tokens, max_new_tokens=max_new_tokens,
                 eos_token_id=eos_token_id, deadline=deadline,
                 tenant=tenant, priority=priority, temperature=temperature,
-                top_k=top_k, top_p=top_p, seed=seed)
+                top_k=top_k, top_p=top_p, seed=seed, jid=jid)
             rec = self._track(erid, resubmits=1)    # born from a failover
             self.adopted += 1
             self.recovered_tokens += len(rec.tokens)
             return rec.srid
+
+    # ---- durable cold-restart recovery (ISSUE 18) --------------------------
+
+    @property
+    def journal(self) -> Optional[RequestJournal]:
+        return self._journal
+
+    @classmethod
+    def recover(cls, journal_dir: str, params, model_config,
+                serving_config=None, gen_config=None,
+                max_restarts: Optional[int] = None,
+                drain_deadline_s: Optional[float] = None, programs=None,
+                journal: Optional[RequestJournal] = None
+                ) -> "EngineSupervisor":
+        """Rebuild a replica after a FULL process death from its journal
+        directory: open the journal (newest good snapshot + WAL suffix,
+        torn tail truncated), then for every record — terminal ones
+        become readable tracked records; ones whose delivered tokens
+        already complete them are closed FINISHED (record it, don't
+        re-run it); every other request is resubmitted bit-exactly from
+        prompt + delivered-so-far under its original jid, so the
+        exactly-once ledger is primed from the journal and no delivered
+        token is ever re-emitted. KV recomputes through the resubmit
+        path, reusing whatever the prefix cache still holds. Idempotent:
+        a second crash during recovery replays to the same state."""
+        j = journal if journal is not None else RequestJournal(journal_dir)
+        sup = cls(params, model_config, serving_config, gen_config,
+                  max_restarts=max_restarts,
+                  drain_deadline_s=drain_deadline_s, programs=programs,
+                  journal=j)
+        sup._restore_from_journal()
+        return sup
+
+    def _restore_from_journal(self) -> None:
+        """Turn the journal's mirror into tracked requests + engine
+        resubmissions (submission order — jids are allocated in it)."""
+        j = self._journal
+        if j is None:
+            return
+        with self._lock:
+            for jid in sorted(j.records):
+                rec = j.records[jid]
+                tr = TrackedRequest(
+                    srid=self._next_srid, prompt=rec.prompt_array(),
+                    max_new_tokens=rec.max_new_tokens,
+                    eos_token_id=rec.eos_token_id, tenant=rec.tenant,
+                    priority=rec.priority, deadline=rec.deadline,
+                    temperature=rec.temperature, top_k=rec.top_k,
+                    top_p=rec.top_p, seed=rec.seed, jid=jid)
+                tr.tokens = [int(t) for t in rec.tokens]
+                self._next_srid += 1
+                self._reqs[tr.srid] = tr
+                if rec.terminal:
+                    tr.state = rec.state
+                    tr.finish = {"state": rec.state,
+                                 "tokens": len(tr.tokens),
+                                 "recovered": True, "resubmits": 0}
+                    continue
+                if tr.finished_by_tokens:
+                    # died after its last delivered token but before the
+                    # terminal event landed: it IS complete
+                    tr.state = FINISHED
+                    tr.finish = {"state": FINISHED,
+                                 "tokens": len(tr.tokens),
+                                 "recovered": True, "resubmits": 0}
+                    self.completed += 1
+                    j.log_terminal(jid, FINISHED)
+                    continue
+                tr.erid = self.engine.resubmit(
+                    tr.prompt, tr.tokens,
+                    max_new_tokens=tr.max_new_tokens,
+                    eos_token_id=tr.eos_token_id, deadline=tr.deadline,
+                    tenant=tr.tenant, priority=tr.priority,
+                    temperature=tr.temperature, top_k=tr.top_k,
+                    top_p=tr.top_p, seed=tr.seed, jid=jid)
+                tr.state = QUEUED
+                tr.resubmits = 1
+                self.resubmitted += 1
+                self.recovered_tokens += len(tr.tokens)
+                self._by_erid[tr.erid] = tr
+            j.flush()
+            self._prune_records()
+
+    def disown_journal(self, srid: int) -> None:
+        """Detach a live request from its journal record (see
+        :meth:`ServingEngine.journal_disown`) — the router calls this
+        before deliberately cancelling a copy whose logical request
+        lives on elsewhere (hedges, evacuation-with-failover)."""
+        with self._lock:
+            rec = self._reqs.get(srid)
+            if rec is None or rec.terminal:
+                return
+            self.engine.journal_disown(rec.erid)
+            rec.jid = -1
+
+    def journal_own(self, srid: int, jid: int, tokens) -> bool:
+        """Attach a live request to journal record ``jid``, rebasing its
+        delivered cursor to ``tokens`` (hedge promotion — see
+        :meth:`ServingEngine.journal_own`)."""
+        with self._lock:
+            rec = self._reqs.get(srid)
+            if rec is None or rec.terminal:
+                return False
+            if not self.engine.journal_own(rec.erid, jid, tokens):
+                return False
+            rec.jid = int(jid)
+            return True
 
     # ---- live KV migration (ISSUE 16) --------------------------------------
 
@@ -424,6 +544,10 @@ class EngineSupervisor:
                 return False
             already = rec.terminal
             if not already:
+                # the adoptive replica owns the journal record now: the
+                # vacated copy must not mark the logical request terminal
+                self.engine.journal_disown(rec.erid)
+                rec.jid = -1
                 self.engine.cancel(rec.erid)
                 self._sweep()
                 self.migrated_out += 1
@@ -559,6 +683,10 @@ class EngineSupervisor:
                 rec.finish = {"state": FAILED, "tokens": len(rec.tokens),
                               "reason": reason,
                               "resubmits": rec.resubmits}
+                if self._journal is not None and rec.jid >= 0:
+                    self._journal.log_terminal(rec.jid, FAILED)
+            if self._journal is not None:
+                self._journal.flush()
             self.engine = self._build_engine()
             self.engine._sched.drain_deadline = drain_deadline
             return
@@ -574,6 +702,8 @@ class EngineSupervisor:
                               "tokens": len(rec.tokens),
                               "resubmits": rec.resubmits}
                 self.completed += 1
+                if self._journal is not None and rec.jid >= 0:
+                    self._journal.log_terminal(rec.jid, FINISHED)
                 continue
             rec.erid = self.engine.resubmit(
                 rec.prompt, rec.tokens,
@@ -581,12 +711,14 @@ class EngineSupervisor:
                 eos_token_id=rec.eos_token_id, deadline=rec.deadline,
                 tenant=rec.tenant, priority=rec.priority,
                 temperature=rec.temperature, top_k=rec.top_k,
-                top_p=rec.top_p, seed=rec.seed)
+                top_p=rec.top_p, seed=rec.seed, jid=rec.jid)
             rec.resubmits += 1
             rec.state = QUEUED
             self.resubmitted += 1
             self.recovered_tokens += len(rec.tokens)
             self._by_erid[rec.erid] = rec
+        if self._journal is not None:
+            self._journal.flush()
 
     # ---- requests ----------------------------------------------------------
 
@@ -677,6 +809,13 @@ class EngineSupervisor:
             if not self.broken and self.engine.pending:
                 cancelled = self.engine.cancel_all()
                 self._sweep()
+            if self._journal is not None:
+                # the SIGTERM/preemption grace contract: before the
+                # process exits, the journal is flushed and a final
+                # snapshot written, so a cold restart replays nothing
+                # and every terminal state reached during the drain
+                # (including the deadline cancels above) is durable
+                self._journal.snapshot()
             leaked = self.engine.cache.manager.blocks_in_use
             report = {"completed": self.completed - done_before,
                       "cancelled": cancelled,

@@ -134,6 +134,39 @@ def generate(out_path: str = "docs/OPS.md") -> str:
         "cancelled, every KV block returns to the pool (the drain "
         "report's `leaked_blocks` must read 0).",
         "",
+        "### Cold-restart runbook (durable serving)",
+        "",
+        "With `FLAGS_serving_journal_dir` set, every replica logs its "
+        "request lifecycle to ONE shared `RequestJournal` "
+        "(`inference.serving.journal`): an append-only WAL of "
+        "crc-framed submit / token-cursor / ownership-rebase / terminal "
+        "events, fsynced once per engine step "
+        "(`FLAGS_serving_journal_sync`; admissions fsync at submit so "
+        "an ACKED request is never lost), plus a serving-state snapshot "
+        "every `FLAGS_serving_snapshot_every` flushes (tmp + fsync + "
+        "rename, newest two generations kept) that bounds replay "
+        "length. KV is NEVER persisted — recovery recomputes it through "
+        "the resubmit path. After a `kill -9` (or host loss with the "
+        "journal on durable storage): "
+        "`EngineSupervisor.recover(journal_dir, params, cfg, ...)` for "
+        "one replica, `ServingRouter.cold_start(journal_dir, ...)` for "
+        "a fleet. Recovery loads the newest snapshot that verifies "
+        "(corrupt generations are skipped — `snapshot_fallbacks` "
+        "counts them), replays the WAL suffix (a torn tail is "
+        "truncated to the last whole frame — `torn_tail_bytes`), "
+        "closes records whose delivered tokens already complete them, "
+        "and resubmits everything else bit-exactly from `prompt + "
+        "delivered-so-far` under its original journal id — zero lost "
+        "requests, zero re-delivered tokens, greedy and seeded streams "
+        "bit-identical (the `durable_exactly_once` auditor check and "
+        "`bench --serve`'s `serving_recovery_ms` row hold the line; "
+        "journal overhead is asserted < 5% there). A graceful SIGTERM "
+        "drain writes a final snapshot, so the next cold start replays "
+        "nothing. Watch: `torn_tail_bytes` > 0 (the crash cut a "
+        "write), `snapshot_fallbacks` climbing (snapshot corruption — "
+        "check the disk), `resubmitted`/`recovered_tokens` (work "
+        "re-entering the fleet after recovery).",
+        "",
         "### Autoscale hook",
         "",
         "`EngineSupervisor.autoscale_signal()` turns queue-depth / "

@@ -67,6 +67,18 @@ injector               fault it models
                        cross-replica chain pull through the armed holder
                        fails checksum verification at the graft end and
                        degrades to recompute — wrong KV is never pulled
+``process_kill``       the whole serving process dying without grace
+                       (kill -9 between steps): only the journal's
+                       fsynced state survives; a cold restart must
+                       resubmit every non-terminal request bit-exactly,
+                       re-emitting no delivered token
+``torn_journal_tail``  a crash mid-append cutting the journal's last
+                       WAL record short: recovery must truncate the
+                       torn tail and come up at the last durable record
+``corrupt_snapshot``   bit rot inside the newest serving-state
+                       snapshot: recovery must reject the generation
+                       and fall back to the previous one or a full WAL
+                       replay — the last good state, never wrong output
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -93,9 +105,10 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "slow_client", "replica_kill", "slow_replica", "flaky_probe",
            "host_pressure", "corrupt_offload_block",
            "kill_prefill_replica", "stale_directory",
+           "process_kill", "torn_journal_tail", "corrupt_snapshot",
            "ChaosEvent", "ChaosTimeline", "chaos_timeline",
            "TIMELINE_INJECTORS", "TIER_INJECTORS", "DISAGG_INJECTORS",
-           "INJECTORS"]
+           "DURABLE_INJECTORS", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -657,6 +670,125 @@ def stale_directory(target, seed: int = 0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# durable-serving injectors (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _journal_of(target):
+    """Resolve the shared RequestJournal from a router / supervisor /
+    engine / bare journal (or None)."""
+    for attr in ("_journal", "journal"):
+        j = getattr(target, attr, None)
+        if j is not None:
+            return j
+    return target if hasattr(target, "records") \
+        and hasattr(target, "abandon") else None
+
+
+def _journal_dir_of(target):
+    """Resolve a journal directory from a path string or anything
+    :func:`_journal_of` understands."""
+    if isinstance(target, (str, os.PathLike)):
+        return os.fspath(target)
+    j = _journal_of(target)
+    return None if j is None else j.dir
+
+
+def process_kill(target) -> dict:
+    """Whole-process death without grace (kill -9 between engine steps:
+    preemption with the grace window gone, OOM kill, host loss): every
+    userspace buffer dies, no drain, no final snapshot — the only state
+    that survives is what the journal's per-step fsync already made
+    durable. In-process spelling: the shared journal is ABANDONED
+    (buffered WAL tail discarded, handle dropped) and the live fleet
+    must be thrown away untouched. The recovery proof is a NEW fleet
+    built via ``EngineSupervisor.recover(journal_dir)`` /
+    ``ServingRouter.cold_start(journal_dir)`` finishing every
+    non-terminal request bit-identically to an unkilled oracle, with
+    zero lost requests and no delivered token re-emitted
+    (tests/test_journal.py; the real SIGKILL-a-subprocess spelling is
+    the ``durable``-marked test). Returns ``{"enabled", "journal_dir",
+    "wal_bytes", "live"}`` — ``enabled=False`` without a journal (a
+    kill -9 then loses everything by design: the fault is vacuous for
+    durability)."""
+    j = _journal_of(target)
+    if j is None:
+        return {"enabled": False, "journal_dir": None,
+                "wal_bytes": 0, "live": 0}
+    live = len(j.live())
+    size = j.abandon()
+    return {"enabled": True, "journal_dir": j.dir,
+            "wal_bytes": size, "live": live}
+
+
+def torn_journal_tail(target, frac: float = 0.5) -> dict:
+    """A crash mid-append: the WAL's last record is cut short (power
+    loss between ``write`` and ``fsync``, a full disk). Truncates the
+    final frame to ``frac`` of its payload so the length/crc framing
+    CANNOT validate it. Recovery must truncate the torn tail in place
+    and come up at the last durable record — degrade to the last good
+    state, never parse garbage, never emit a wrong token. ``target`` is
+    a journal directory path or anything holding a journal (apply AFTER
+    :func:`process_kill` / ``abandon`` — the file must not have a live
+    writer). Returns ``{"enabled", "wal", "before", "after"}`` —
+    ``enabled=False`` with no WAL or an empty one (the fault is
+    vacuous)."""
+    d = _journal_dir_of(target)
+    from paddle_tpu.inference.serving import journal as _jm
+    wal = None if d is None else os.path.join(d, _jm.WAL_NAME)
+    if wal is None or not os.path.exists(wal):
+        return {"enabled": False, "wal": wal, "before": 0, "after": 0}
+    with open(wal, "rb") as fh:
+        raw = fh.read()
+    # walk the framing to the last complete frame's start
+    pos, last = 0, None
+    while pos + _jm._FRAME.size <= len(raw):
+        length, _ = _jm._FRAME.unpack_from(raw, pos)
+        end = pos + _jm._FRAME.size + length
+        if end > len(raw):
+            break
+        last = (pos, length)
+        pos = end
+    if last is None:
+        return {"enabled": False, "wal": wal,
+                "before": len(raw), "after": len(raw)}
+    start, length = last
+    keep = start + _jm._FRAME.size + max(0, min(length - 1,
+                                                int(length * frac)))
+    with open(wal, "r+b") as fh:
+        fh.truncate(keep)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return {"enabled": True, "wal": wal,
+            "before": len(raw), "after": keep}
+
+
+def corrupt_snapshot(target, seed: int = 0, nbits: int = 8) -> dict:
+    """Silent corruption inside the NEWEST serving-state snapshot (bit
+    rot, torn block-device write under the atomic-rename window's
+    fsync): flips ``nbits`` bits without touching its crc frame.
+    Recovery must reject the generation at load (``snapshot_fallbacks``
+    increments) and fall back to the previous snapshot or a full WAL
+    replay — the last good state, never wrong output. ``target`` as in
+    :func:`torn_journal_tail`. Returns ``{"enabled", "path"}`` —
+    ``enabled=False`` with no snapshot on disk (recovery then replays
+    the WAL from byte 0 anyway: the fault is vacuous)."""
+    d = _journal_dir_of(target)
+    if d is None:
+        return {"enabled": False, "path": None}
+    try:
+        names = sorted((n for n in os.listdir(d)
+                        if n.startswith("snapshot-")
+                        and n.endswith(".snap")), reverse=True)
+    except OSError:
+        names = []
+    if not names:
+        return {"enabled": False, "path": None}
+    path = os.path.join(d, names[0])
+    flip_bits(path, nbits=nbits, seed=seed)
+    return {"enabled": True, "path": path}
+
+
+# ---------------------------------------------------------------------------
 # chaos timeline (fleet-scale replay; ISSUE 13)
 # ---------------------------------------------------------------------------
 
@@ -739,6 +871,13 @@ TIER_INJECTORS = ("host_pressure", "corrupt_offload_block")
 # previously generated seeds must keep their schedules byte-identical
 DISAGG_INJECTORS = ("kill_prefill_replica", "stale_directory")
 
+# the durable-serving faults (ISSUE 18) — out of the default mix too;
+# process_kill additionally ends the replay's fleet object outright, so
+# a timeline scheduling it must drive recovery itself (the journal
+# kill-point fuzz in tests/test_journal.py is exactly that driver)
+DURABLE_INJECTORS = ("process_kill", "torn_journal_tail",
+                     "corrupt_snapshot")
+
 
 def chaos_timeline(seed: int, horizon_steps: int,
                    kinds=TIMELINE_INJECTORS, events: int = 6,
@@ -802,4 +941,7 @@ INJECTORS = {
     "corrupt_offload_block": corrupt_offload_block,
     "kill_prefill_replica": kill_prefill_replica,
     "stale_directory": stale_directory,
+    "process_kill": process_kill,
+    "torn_journal_tail": torn_journal_tail,
+    "corrupt_snapshot": corrupt_snapshot,
 }

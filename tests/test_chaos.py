@@ -986,7 +986,12 @@ class TestFleetChaos:
         for name in chaos.DISAGG_INJECTORS:
             assert name in chaos.INJECTORS
             assert name not in chaos.TIMELINE_INJECTORS
-        assert len(chaos.INJECTORS) == 22
+        # + the ISSUE 18 durable trio (process_kill, torn_journal_tail,
+        # corrupt_snapshot) — also OUT of the default timeline mix
+        for name in chaos.DURABLE_INJECTORS:
+            assert name in chaos.INJECTORS
+            assert name not in chaos.TIMELINE_INJECTORS
+        assert len(chaos.INJECTORS) == 25
 
     def _router(self, params, cfg, **kw):
         from paddle_tpu.inference.serving import ServingConfig, ServingRouter

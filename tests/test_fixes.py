@@ -197,6 +197,41 @@ def test_model_average_apply_restore():
     np.testing.assert_allclose(lin.weight.numpy(), before)
 
 
+def test_model_average_window_rotation_keeps_history():
+    """ADVICE r6: ModelAverage discarded ALL history when the accumulator
+    overflowed the window (sum reset to the current params, count to 1),
+    so an apply() shortly after a rotation averaged ~1 sample. The
+    finished window's (sum, count) pair must rotate into an old
+    accumulator that apply() folds in, keeping the effective window >= a
+    window's worth at all times."""
+    from paddle_tpu.incubate import ModelAverage
+    lin = nn.Linear(3, 1)
+    ma = ModelAverage(0.0, parameters=lin.parameters(),
+                      min_average_window=3, max_average_window=3)
+    for v in (1.0, 2.0, 3.0, 4.0):     # 4th step overflows the 3-window
+        lin.weight.set_value(np.full((3, 1), v, np.float32))
+        ma.step()
+    # apply() IMMEDIATELY after the rotation: the old pair must carry the
+    # whole window — the pre-fix hard restart would average just 4.0
+    ma.apply()
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.mean([1.0, 2.0, 3.0, 4.0]), rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.full((3, 1), 4.0, np.float32))
+    # and with the next window underway, apply() spans BOTH windows —
+    # every sample exactly once (no double count of the rotation step)
+    lin.weight.set_value(np.full((3, 1), 10.0, np.float32))
+    ma.step()
+    ma.apply()
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.mean([1.0, 2.0, 3.0, 4.0, 10.0]),
+                               rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.full((3, 1), 10.0, np.float32))
+
+
 def test_lookahead_anchors_lazily_after_checkpoint_load():
     """ADVICE r5: LookAhead snapshotted slow weights at CONSTRUCTION, so a
     checkpoint loaded into the parameters afterwards made the first k-step

@@ -1,0 +1,619 @@
+"""Durable serving tests (ISSUE 18): crash-safe request journal,
+serving-state snapshots, cold-restart recovery.
+
+Oracle pattern (SURVEY §4): an UNKILLED run of the same trace (same
+params, shared compiled programs) is the reference. A kill at ANY engine
+step must lose no request and re-deliver no token: the concatenation of
+pre-kill deliveries and post-recovery deliveries equals the unkilled
+stream bit for bit, greedy and seeded alike. Journal-file units (framing,
+torn tails, snapshot fallback) run host-only.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.serving import (EngineSupervisor, InvariantAuditor,
+                                          RequestJournal, ServingConfig,
+                                          ServingRouter)
+from paddle_tpu.inference.serving.router import RouterConfig
+from paddle_tpu.models.llama import LlamaConfig, init_params
+from paddle_tpu.testing.chaos import (corrupt_snapshot, process_kill,
+                                      torn_journal_tail)
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, hidden_size=64, intermediate_size=96,
+                num_hidden_layers=3, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+# 2 slots for 5 requests (queueing), decode_chunk=2 against a 12-token
+# prompt (chunked prefill spans several steps) — the kill sweep lands in
+# every lifecycle state without hand-picking step indices
+SC = dict(block_size=4, max_slots=2, max_model_len=32, decode_chunk=2,
+          queue_depth=64)
+
+
+def trace_spec():
+    """The canonical mixed trace, as plain JSON-able data so the real-
+    SIGKILL child process can rebuild it verbatim. Last request is
+    SEEDED sampling (recovery must be bit-exact beyond greedy)."""
+    rng = np.random.default_rng(3)
+
+    def p(n):
+        return [int(t) for t in rng.integers(0, 97, (n,))]
+
+    return [
+        dict(prompt=p(12), max_new_tokens=5),
+        dict(prompt=p(5), max_new_tokens=6),
+        dict(prompt=p(7), max_new_tokens=4),
+        dict(prompt=p(4), max_new_tokens=7),
+        dict(prompt=p(6), max_new_tokens=5, temperature=0.8, top_k=20,
+             seed=11),
+    ]
+
+
+def submit_trace(target, spec=None):
+    return [target.submit(np.asarray(s["prompt"], np.int32),
+                          eos_token_id=None,
+                          **{k: v for k, v in s.items() if k != "prompt"})
+            for s in (spec or trace_spec())]
+
+
+def drive(target, auditor=None, max_steps=400):
+    """Run to drain one engine iteration at a time; returns per-id token
+    streams in delivery order."""
+    out = {}
+    steps = 0
+    while target.pending:
+        for rid, toks in target.step(max_iters=1).items():
+            out.setdefault(rid, []).extend(int(t) for t in toks)
+        if auditor is not None:
+            assert auditor.check(target, collect=True) == []
+        steps += 1
+        assert steps < max_steps, "run did not drain"
+    return out, steps
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def oracle(setup):
+    """Unkilled, journal-less reference run; its compiled programs are
+    shared by every killed/recovered run (restart never recompiles)."""
+    cfg, params = setup
+    sup = EngineSupervisor(params, cfg, ServingConfig(**SC), journal=None)
+    srids = submit_trace(sup)
+    out, steps = drive(sup)
+    want = [list(out.get(s, ())) for s in srids]
+    return want, sup.engine.programs, steps
+
+
+# ---------------------------------------------------------------------------
+# journal-file units (host-only)
+# ---------------------------------------------------------------------------
+
+def jsubmit(j, prompt=(1, 2, 3), mnt=4, **kw):
+    base = dict(prompt=list(prompt), max_new_tokens=mnt, eos_token_id=None,
+                temperature=0.0, top_k=None, top_p=None, seed=0,
+                tenant="default", priority=0, deadline=None)
+    base.update(kw)
+    return j.log_submit(**base)
+
+
+class TestJournalFile:
+    def test_roundtrip_restores_mirror(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j, prompt=[5, 6], mnt=3, tenant="t0", priority=2,
+                    temperature=0.7, top_k=9, top_p=0.9, seed=4)
+        b = jsubmit(j, prompt=[7], mnt=2)
+        j.log_tokens(a, [10, 11])
+        j.log_tokens(b, [12])
+        j.log_terminal(b, "finished")
+        j.flush()
+        j.close()
+
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.recovered_records == 2
+        assert j2.torn_tail_bytes == 0
+        ra, rb = j2.records[a], j2.records[b]
+        assert ra.tokens == [10, 11] and not ra.terminal
+        assert (ra.tenant, ra.priority, ra.temperature, ra.top_k,
+                ra.top_p, ra.seed) == ("t0", 2, 0.7, 9, 0.9, 4)
+        assert rb.terminal and rb.state == "finished"
+        assert list(j2.live()) == [a]
+        # jid allocation continues past everything on disk
+        assert jsubmit(j2) == b + 1
+        j2.close()
+
+    def test_torn_tail_truncated_in_place(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j)
+        j.log_tokens(a, [1])
+        j.flush()
+        j.close()
+        wal = os.path.join(str(tmp_path), "journal.wal")
+        good = os.path.getsize(wal)
+        garbage = b"\x40\x00\x00\x00\xde\xad\xbe\xefpartial"
+        with open(wal, "ab") as fh:           # a frame cut mid-payload
+            fh.write(garbage)
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.torn_tail_bytes == len(garbage)
+        assert os.path.getsize(wal) == good   # truncated back in place
+        assert j2.records[a].tokens == [1]
+        # the next append lands on the clean boundary and survives
+        j2.log_tokens(a, [2])
+        j2.flush()
+        j2.close()
+        j3 = RequestJournal(str(tmp_path))
+        assert j3.records[a].tokens == [1, 2]
+        assert j3.torn_tail_bytes == 0
+        j3.close()
+
+    def test_resume_rebase_and_idempotence(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j)
+        j.log_tokens(a, [1, 2])
+        n = j.appended_records
+        # cursors match -> resume writes NOTHING (recovery re-runs safely)
+        assert j.resume(a, [1, 2]) is True
+        assert j.appended_records == n
+        # cursor differs -> one rebase REPLACES the record's tokens
+        assert j.resume(a, [1, 2, 3]) is True
+        assert j.records[a].tokens == [1, 2, 3]
+        # unknown / terminal records refuse (caller falls back to submit)
+        assert j.resume(a + 99, []) is False
+        j.log_terminal(a, "finished")
+        assert j.resume(a, [1, 2, 3]) is False
+        # re-ending is a no-op, state keeps the FIRST terminal
+        n = j.appended_records
+        j.log_terminal(a, "cancelled")
+        assert j.appended_records == n
+        assert j.records[a].state == "finished"
+        j.close()
+
+    def test_snapshot_fallback_newest_to_oldest_to_full_replay(
+            self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j)
+        j.log_tokens(a, [1])
+        j.snapshot()
+        j.log_tokens(a, [2])
+        j.snapshot()
+        j.log_tokens(a, [3])
+        j.flush()
+        j.close()
+
+        def reopen():
+            r = RequestJournal(str(tmp_path))
+            toks, fb = r.records[a].tokens, r.snapshot_fallbacks
+            r.close()
+            return toks, fb
+
+        # clean: newest snapshot + WAL suffix
+        assert reopen() == ([1, 2, 3], 0)
+        # newest snapshot corrupted -> older generation + LONGER suffix
+        info = corrupt_snapshot(str(tmp_path), seed=1)
+        assert info["enabled"]
+        assert reopen() == ([1, 2, 3], 1)
+        # every generation corrupted -> full WAL replay from offset 0
+        for name in os.listdir(str(tmp_path)):
+            if name.startswith("snapshot-"):
+                with open(os.path.join(str(tmp_path), name), "r+b") as fh:
+                    fh.seek(6)
+                    fh.write(b"\xff\xff\xff\xff")
+        toks, fb = reopen()
+        assert toks == [1, 2, 3] and fb == 2
+
+    def test_deep_torn_tail_snapshot_is_last_good(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j)
+        j.log_tokens(a, [1])
+        j.snapshot()
+        j.log_tokens(a, [2])
+        j.flush()
+        j.close()
+        wal = os.path.join(str(tmp_path), "journal.wal")
+        # cut BELOW the snapshot's fsynced offset: nothing newer survives
+        with open(wal, "r+b") as fh:
+            fh.truncate(5)
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.records[a].tokens == [1]
+        j2.close()
+
+    def test_abandon_loses_only_the_unflushed_tail(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        a = jsubmit(j)
+        j.log_tokens(a, [1])
+        j.flush()
+        wal = os.path.join(str(tmp_path), "journal.wal")
+        durable = os.path.getsize(wal)
+        j.log_tokens(a, [2])          # buffered, never flushed
+        assert j.abandon() == durable
+        assert os.path.getsize(wal) == durable
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.records[a].tokens == [1]
+        j2.close()
+
+    def test_snapshot_retention_and_auto_snapshot(self, tmp_path):
+        j = RequestJournal(str(tmp_path), snapshot_every=2)
+        jsubmit(j)
+        for _ in range(6):
+            j.flush()
+        assert j.snapshots_written == 3
+        snaps = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("snapshot-")]
+        assert len(snaps) == 2        # KEEP_SNAPSHOTS generations
+        j.close()
+
+    def test_unknown_sync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="sync policy"):
+            RequestJournal(str(tmp_path), sync="fsync-sometimes")
+
+
+# ---------------------------------------------------------------------------
+# kill-point fuzz: supervisor cold restart
+# ---------------------------------------------------------------------------
+
+class TestKillPointFuzz:
+    def _run_killed(self, k, jdir, setup, programs, snapshot_every=None):
+        """Journaled run killed after ``k`` steps; returns (pre-kill
+        streams by jid, original jids in submission order)."""
+        cfg, params = setup
+        j = RequestJournal(str(jdir), snapshot_every=snapshot_every)
+        sup = EngineSupervisor(params, cfg, ServingConfig(**SC),
+                               programs=programs, journal=j)
+        srids = submit_trace(sup)
+        jids = [sup._reqs[s].jid for s in srids]
+        pre = {jid: [] for jid in jids}
+        for _ in range(k):
+            for s, toks in sup.step(max_iters=1).items():
+                pre[sup._reqs[s].jid].extend(int(t) for t in toks)
+        info = process_kill(sup)
+        assert info["enabled"] and info["journal_dir"] == str(jdir)
+        return pre, jids
+
+    def _recover_and_finish(self, jdir, setup, programs):
+        """Cold restart; returns (post-recovery streams by jid, sup)."""
+        cfg, params = setup
+        rec = EngineSupervisor.recover(str(jdir), params, cfg,
+                                       ServingConfig(**SC),
+                                       programs=programs)
+        aud = InvariantAuditor()
+        by_srid = {srid: r.jid for srid, r in rec._reqs.items()}
+        post = {}
+        steps = 0
+        while rec.pending:
+            for srid, toks in rec.step(max_iters=1).items():
+                post.setdefault(by_srid[srid], []).extend(
+                    int(t) for t in toks)
+            assert aud.check(rec, collect=True) == []
+            steps += 1
+            assert steps < 400
+        return post, rec
+
+    def test_sigkill_at_any_step_is_exactly_once(self, setup, oracle,
+                                                 tmp_path):
+        """Randomized kill points across the whole run (queued, mid-
+        chunked-prefill, decoding, queued-behind-full-slots): pre-kill +
+        post-recovery deliveries must concatenate to the unkilled stream
+        — zero lost requests, zero re-delivered tokens, greedy AND
+        seeded bit-identical."""
+        want, programs, total = oracle
+        rng = np.random.default_rng(1234)
+        kills = sorted({0, 1, total - 1}
+                       | {int(x) for x in rng.integers(2, total - 1, 4)})
+        for k in kills:
+            jdir = tmp_path / f"kill{k}"
+            # snapshots every 3 flushes: later kill points also exercise
+            # the snapshot + WAL-suffix load path
+            pre, jids = self._run_killed(k, jdir, setup, programs,
+                                         snapshot_every=3)
+            post, rec = self._recover_and_finish(jdir, setup, programs)
+            for i, jid in enumerate(jids):
+                got = pre[jid] + post.get(jid, [])
+                assert got == want[i], \
+                    f"kill@{k} request {i}: {got} != {want[i]}"
+            assert rec.engine.cache.manager.blocks_in_use == 0
+
+    def test_recovery_survives_a_second_crash(self, setup, oracle,
+                                              tmp_path):
+        """Idempotence: dying again right after recovery (before any
+        step) and recovering once more replays to the same state."""
+        want, programs, total = oracle
+        k = max(2, total // 2)
+        pre, jids = self._run_killed(k, tmp_path, setup, programs)
+        cfg, params = setup
+        rec1 = EngineSupervisor.recover(str(tmp_path), params, cfg,
+                                        ServingConfig(**SC),
+                                        programs=programs)
+        process_kill(rec1)
+        post, rec = self._recover_and_finish(tmp_path, setup, programs)
+        for i, jid in enumerate(jids):
+            assert pre[jid] + post.get(jid, []) == want[i]
+
+    def test_torn_tail_and_corrupt_snapshot_degrade_to_last_good(
+            self, setup, oracle, tmp_path):
+        """Physical corruption on top of the crash: a torn WAL tail and a
+        corrupt newest snapshot. Recovery degrades to the last durable
+        cursor — the FINAL streams still complete bit-exactly (re-
+        decoding from an older cursor re-derives the same tokens)."""
+        want, programs, total = oracle
+        k = max(3, total // 2)
+        pre, jids = self._run_killed(k, tmp_path, setup, programs,
+                                     snapshot_every=2)
+        t = torn_journal_tail(str(tmp_path))
+        assert t["enabled"] and t["after"] < t["before"]
+        c = corrupt_snapshot(str(tmp_path))
+        assert c["enabled"]
+        post, rec = self._recover_and_finish(tmp_path, setup, programs)
+        st = rec._journal.stats()
+        assert st["torn_tail_bytes"] > 0
+        assert st["snapshot_fallbacks"] >= 1
+        # degraded-cursor recovery may legitimately re-emit the torn
+        # suffix; the completed records must still match the oracle
+        by_jid = {r.jid: srid for srid, r in rec._reqs.items()}
+        for i, jid in enumerate(jids):
+            got = [int(x) for x in rec.result(by_jid[jid])]
+            assert got == want[i]
+
+    @pytest.mark.parametrize("variant", ["int8", "kernel"])
+    def test_variant_engines_recover_bit_exact(self, setup, tmp_path,
+                                               variant):
+        """The journal contract is engine-path independent: the int8
+        weight-only decode path and the Pallas paged-attention kernel
+        path both recover bit-exactly against their own unkilled runs."""
+        cfg, params = setup
+        sc = dict(SC)
+        if variant == "int8":
+            sc["quantize"] = "int8"
+        else:
+            sc["paged_kernel"] = True
+        spec = trace_spec()[1:4]      # short trace: compile cost dominates
+        base = EngineSupervisor(params, cfg, ServingConfig(**sc),
+                                journal=None)
+        srids = submit_trace(base, spec)
+        out, _ = drive(base)
+        want = [list(out.get(s, ())) for s in srids]
+        programs = base.engine.programs
+
+        sup = EngineSupervisor(params, cfg, ServingConfig(**sc),
+                               programs=programs,
+                               journal=RequestJournal(str(tmp_path)))
+        srids = submit_trace(sup, spec)
+        jids = [sup._reqs[s].jid for s in srids]
+        pre = {jid: [] for jid in jids}
+        for _ in range(3):
+            for s, toks in sup.step(max_iters=1).items():
+                pre[sup._reqs[s].jid].extend(int(t) for t in toks)
+        process_kill(sup)
+        rec = EngineSupervisor.recover(str(tmp_path), params, cfg,
+                                       ServingConfig(**sc),
+                                       programs=programs)
+        by_srid = {srid: r.jid for srid, r in rec._reqs.items()}
+        post = {}
+        while rec.pending:
+            for srid, toks in rec.step(max_iters=1).items():
+                post.setdefault(by_srid[srid], []).extend(
+                    int(t) for t in toks)
+        for i, jid in enumerate(jids):
+            assert pre[jid] + post.get(jid, []) == want[i]
+
+    def test_kill_while_draining(self, setup, oracle, tmp_path):
+        """SIGKILL mid-drain: admissions were already stopped; recovery
+        resumes the in-flight work and completes it."""
+        want, programs, total = oracle
+        cfg, params = setup
+        sup = EngineSupervisor(params, cfg, ServingConfig(**SC),
+                               programs=programs,
+                               journal=RequestJournal(str(tmp_path)))
+        srids = submit_trace(sup)
+        jids = [sup._reqs[s].jid for s in srids]
+        pre = {jid: [] for jid in jids}
+        for _ in range(2):
+            for s, toks in sup.step(max_iters=1).items():
+                pre[sup._reqs[s].jid].extend(int(t) for t in toks)
+        sup.request_drain()           # drain in progress...
+        for _ in range(2):
+            for s, toks in sup.step(max_iters=1).items():
+                pre[sup._reqs[s].jid].extend(int(t) for t in toks)
+        process_kill(sup)             # ...killed before it finishes
+        cfg, params = setup
+        rec = EngineSupervisor.recover(str(tmp_path), params, cfg,
+                                       ServingConfig(**SC),
+                                       programs=programs)
+        by_srid = {srid: r.jid for srid, r in rec._reqs.items()}
+        post = {}
+        while rec.pending:
+            for srid, toks in rec.step(max_iters=1).items():
+                post.setdefault(by_srid[srid], []).extend(
+                    int(t) for t in toks)
+        for i, jid in enumerate(jids):
+            assert pre[jid] + post.get(jid, []) == want[i]
+
+
+# ---------------------------------------------------------------------------
+# fleet tier: router cold start
+# ---------------------------------------------------------------------------
+
+class TestRouterColdStart:
+    def _drive_router(self, rt, pre=None, auditor=None):
+        acc = {} if pre is None else pre
+        steps = 0
+        while rt.pending:
+            for frid, toks in rt.step(max_iters=1).items():
+                acc.setdefault(rt._reqs[frid].jid, []).extend(
+                    int(t) for t in toks)
+            if auditor is not None:
+                assert auditor.check(rt, collect=True) == []
+            steps += 1
+            assert steps < 400
+        return acc
+
+    @pytest.mark.parametrize("kill_at", [0, 2, 6])
+    def test_cold_start_resumes_the_fleet(self, setup, oracle, tmp_path,
+                                          kill_at):
+        """Kill the WHOLE 2-replica fleet (one shared journal) at several
+        points; cold_start resumes every stream bit-exactly on fresh
+        replicas."""
+        want, programs, _ = oracle
+        cfg, params = setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=0)
+        rt = ServingRouter(params, cfg, ServingConfig(**SC),
+                           router_config=rc, programs=programs,
+                           journal=RequestJournal(str(tmp_path)))
+        frids = submit_trace(rt)
+        jids = [rt._reqs[f].jid for f in frids]
+        pre = {jid: [] for jid in jids}
+        for _ in range(kill_at):
+            for frid, toks in rt.step(max_iters=1).items():
+                pre[rt._reqs[frid].jid].extend(int(t) for t in toks)
+        assert process_kill(rt)["enabled"]
+        rt2 = ServingRouter.cold_start(str(tmp_path), params, cfg,
+                                       ServingConfig(**SC),
+                                       router_config=rc,
+                                       programs=programs)
+        assert rt2.cold_recovered >= 1 or kill_at == 0
+        aud = InvariantAuditor()
+        got = self._drive_router(rt2, pre=pre, auditor=aud)
+        for i, jid in enumerate(jids):
+            assert got[jid] == want[i], f"kill@{kill_at} request {i}"
+
+    def test_cold_start_through_disagg_handoff(self, setup, oracle,
+                                               tmp_path):
+        """Disaggregated fleet (1 prefill + 2 decode replicas): kills
+        landing around the prefill->decode handoff of the long prompt
+        must still recover every stream bit-exactly."""
+        want, programs, _ = oracle
+        cfg, params = setup
+        rc = RouterConfig(replicas=2, hedge_ttft_mult=0,
+                          prefill_replicas=1, prefill_len_threshold=8)
+        for kill_at in (1, 2, 3, 4):
+            jdir = tmp_path / f"k{kill_at}"
+            rt = ServingRouter(params, cfg, ServingConfig(**SC),
+                               router_config=rc, programs=programs,
+                               journal=RequestJournal(str(jdir)))
+            frids = submit_trace(rt)
+            jids = [rt._reqs[f].jid for f in frids]
+            pre = {jid: [] for jid in jids}
+            for _ in range(kill_at):
+                for frid, toks in rt.step(max_iters=1).items():
+                    pre[rt._reqs[frid].jid].extend(int(t) for t in toks)
+            process_kill(rt)
+            rt2 = ServingRouter.cold_start(str(jdir), params, cfg,
+                                           ServingConfig(**SC),
+                                           router_config=rc,
+                                           programs=programs)
+            got = self._drive_router(rt2, pre=pre,
+                                     auditor=InvariantAuditor())
+            for i, jid in enumerate(jids):
+                assert got[jid] == want[i], \
+                    f"kill@{kill_at} request {i}"
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL of a live process
+# ---------------------------------------------------------------------------
+
+@pytest.mark.durable
+@pytest.mark.slow
+class TestRealSigkill:
+    def test_subprocess_sigkill_recovery(self, setup, oracle, tmp_path):
+        """An actual ``kill -9`` of a serving process (no atexit, no
+        flush): the parent recovers from the journal directory the dead
+        process left behind and finishes every stream bit-exactly."""
+        want, programs, _ = oracle
+        cfg, params = setup
+        child = textwrap.dedent("""
+            import json, os, signal, sys
+            import numpy as np
+            import jax
+            from paddle_tpu.models.llama import LlamaConfig, init_params
+            from paddle_tpu.inference.serving import (EngineSupervisor,
+                                                      RequestJournal,
+                                                      ServingConfig)
+            jdir, sc, spec, kill_at = json.loads(sys.argv[1])
+            cfg = LlamaConfig(vocab_size=97, hidden_size=64,
+                              intermediate_size=96, num_hidden_layers=3,
+                              num_attention_heads=4, num_key_value_heads=2,
+                              max_position_embeddings=64)
+            params = init_params(cfg, jax.random.PRNGKey(0))
+            sup = EngineSupervisor(params, cfg, ServingConfig(**sc),
+                                   journal=RequestJournal(jdir))
+            srids = [sup.submit(np.asarray(s["prompt"], np.int32),
+                                eos_token_id=None,
+                                **{k: v for k, v in s.items()
+                                   if k != "prompt"})
+                     for s in spec]
+            pre = {}
+            for _ in range(kill_at):
+                for s, toks in sup.step(max_iters=1).items():
+                    pre.setdefault(str(sup._reqs[s].jid), []).extend(
+                        int(t) for t in toks)
+            print(json.dumps(pre), flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        spec = trace_spec()
+        kill_at = 5
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", child,
+             json.dumps([str(tmp_path), SC, spec, kill_at])],
+            capture_output=True, text=True, timeout=540, env=env)
+        assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        pre = {int(k): v for k, v in
+               json.loads(proc.stdout.strip().splitlines()[-1]).items()}
+        rec = EngineSupervisor.recover(str(tmp_path), params, cfg,
+                                       ServingConfig(**SC),
+                                       programs=programs)
+        by_srid = {srid: r.jid for srid, r in rec._reqs.items()}
+        post = {}
+        aud = InvariantAuditor()
+        while rec.pending:
+            for srid, toks in rec.step(max_iters=1).items():
+                post.setdefault(by_srid[srid], []).extend(
+                    int(t) for t in toks)
+            assert aud.check(rec, collect=True) == []
+        for i in range(len(spec)):
+            got = pre.get(i, []) + post.get(i, [])
+            assert got == want[i], f"request {i}: {got} != {want[i]}"
+        assert rec.engine.cache.manager.blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# audit integration: tampering trips durable_exactly_once
+# ---------------------------------------------------------------------------
+
+class TestDurableAudit:
+    def test_cursor_divergence_fails_the_check(self, setup, oracle,
+                                               tmp_path):
+        cfg, params = setup
+        _, programs, _ = oracle
+        sup = EngineSupervisor(params, cfg, ServingConfig(**SC),
+                               programs=programs,
+                               journal=RequestJournal(str(tmp_path)))
+        submit_trace(sup)
+        for _ in range(2):
+            sup.step(max_iters=1)
+        aud = InvariantAuditor(checks=("durable_exactly_once",))
+        assert aud.check(sup, collect=True) == []
+        live = list(sup._journal.live().values())
+        assert live, "need a live record to tamper with"
+        live[0].tokens.append(42)     # journal thinks MORE was delivered
+        msgs = aud.check(sup, collect=True)
+        assert msgs and any("durable_exactly_once" in str(m)
+                            for m in msgs)

@@ -542,6 +542,91 @@ class TestHeteroCompiledPipeline:
         for pp_, ps in zip(pp_params, ser_params):
             np.testing.assert_allclose(pp_.numpy(), ps.numpy(), atol=1e-5)
 
+    def test_bf16_model_compiles_in_bf16_and_matches_eager(self, pp_mesh):
+        """ADVICE r7: ``pack_stage`` raveled every stage parameter through
+        ``.astype(float32)``, so a bf16 model's compiled stages silently
+        ran in fp32 and diverged from the eager schedule. A uniform
+        parameter dtype must survive the flat pack end to end."""
+        def make(seed):
+            paddle.seed(seed)
+            return [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh),
+                    LayerDesc(nn.Linear, 8, 3), LayerDesc(nn.Linear, 3, 8),
+                    LayerDesc(nn.GELU),
+                    LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+                    LayerDesc(nn.Linear, 8, 8)]
+
+        st = fleet.DistributedStrategy()
+        st.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        pl = PipelineLayer(layers=make(21), num_stages=4,
+                           loss_fn=nn.MSELoss())
+        pl.bfloat16()
+        model = PipelineParallel(pl, pp_mesh, st)
+
+        serial_layers = [d.build_layer() for d in make(21)]
+        for l in serial_layers:
+            l.bfloat16()
+        ser_params = [p for l in serial_layers for p in l.parameters()]
+        for ps, pp_ in zip(ser_params, pl.parameters()):
+            ps.set_value(pp_.numpy())
+
+        from paddle_tpu.optimizer import SGD
+        opt_pp = SGD(learning_rate=0.1, parameters=model.parameters())
+        opt_s = SGD(learning_rate=0.1, parameters=ser_params)
+        mse = nn.MSELoss()
+        rng = np.random.RandomState(9)
+        for _ in range(2):
+            xb = rng.randn(8, 8).astype("float32")
+            yb = rng.randn(8, 8).astype("float32")
+            loss_pp = model.train_batch(
+                (paddle.to_tensor(xb), paddle.to_tensor(yb)), opt_pp)
+            assert model.last_path == "compiled-hetero", model.last_path
+            total = 0.0
+            for m in range(4):
+                h = paddle.to_tensor(xb[m * 2:(m + 1) * 2])
+                for l in serial_layers:
+                    h = l(h)
+                loss = mse(h, paddle.to_tensor(yb[m * 2:(m + 1) * 2]))
+                (loss / 4).backward()
+                total += float(loss)
+            opt_s.step()
+            opt_s.clear_grad()
+            np.testing.assert_allclose(float(loss_pp), total / 4,
+                                       rtol=3e-2, atol=3e-2)
+        # the packed [S, Lmax] array itself must be bf16 — an fp32 pack
+        # would round-trip every weight through fp32 each step
+        assert model._compiled_step["stack"]().dtype == jnp.bfloat16
+        for pp_, ps in zip(pl.parameters(), ser_params):
+            assert pp_.numpy().dtype == ps.numpy().dtype
+            np.testing.assert_allclose(
+                pp_.numpy().astype("float32"),
+                ps.numpy().astype("float32"), rtol=3e-2, atol=3e-2)
+
+    def test_mixed_dtype_stages_fall_back_with_reason(self, pp_mesh):
+        """Stages holding DIFFERENT parameter dtypes cannot share one
+        rectangular flat-pack; the hetero tier must decline with a
+        diagnosable reason instead of silently upcasting everything."""
+        paddle.seed(23)
+        descs = [LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.Tanh),
+                 LayerDesc(nn.Linear, 8, 3), LayerDesc(nn.Linear, 3, 8),
+                 LayerDesc(nn.GELU),
+                 LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+                 LayerDesc(nn.Linear, 8, 8)]
+        st = fleet.DistributedStrategy()
+        st.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2,
+                               "allow_eager_fallback": True}
+        pl = PipelineLayer(layers=descs, num_stages=4, loss_fn=nn.MSELoss())
+        pl._layers_list[5].bfloat16()   # one interior layer off-dtype
+        model = PipelineParallel(pl, pp_mesh, st)
+        from paddle_tpu.optimizer import SGD
+        opt = SGD(learning_rate=0.05, parameters=model.parameters())
+        xb = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        yb = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        with pytest.warns(UserWarning,
+                          match="mixed stage parameter dtypes"):
+            loss = model.train_batch((xb, yb), opt)
+        assert model.last_path == "eager"
+        assert np.isfinite(float(loss))
+
     def test_prologue_epilogue_split_off_shape_changes(self, pp_mesh):
         """Embedding-style input (width change at the front) and a head
         (width change at the back) land in prologue/epilogue; the stable
