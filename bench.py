@@ -2038,6 +2038,56 @@ def bench_serve(backend):
     dj_leaked = dj_rec.engine.cache.manager.blocks_in_use
     assert dj_leaked == 0, f"{dj_leaked} blocks leaked after recovery"
 
+    # ---- multi-adapter LoRA row (ISSUE 19) ------------------------------
+    # the headline mixed trace served round-robin across 8 LoRA adapters
+    # from ONE paged pool vs the base-only engine — same interleaved
+    # min-of-rounds methodology as the durability row. The pool's cost is
+    # the gathered batched adapter matmul riding the shared decode
+    # program, so the bound is < 10% (asserted). Three proofs ride along:
+    # zero-adapter traffic through the pool is bit-identical to the dense
+    # oracle, the 8-adapter mix adds ZERO decode executables (per-slot
+    # adapter ids are a device operand, not a trace key), and the pool
+    # leaks no KV blocks.
+    from paddle_tpu.models.lora import lora_init_params
+
+    lr_rank, lr_adapters = 4, 8
+    lr_eng = ServingEngine(params, cfg, ServingConfig(
+        block_size=blk, max_slots=max_slots, max_model_len=mlen,
+        decode_chunk=chunk, queue_depth=n_req, prefix_cache=None,
+        lora_rank=lr_rank, lora_slots=lr_adapters, lora_pool=lr_adapters))
+    for i in range(lr_adapters):
+        lr_eng.register_adapter(
+            f"lora{i}", lora_init_params(cfg, lr_rank, seed=i, scale=0.5))
+    lr_ids = [f"lora{i % lr_adapters}" for i in range(n_req)]
+
+    def lr_round(eng, ids):
+        t0 = time.time()
+        rids = [eng.submit(p, max_new_tokens=int(o), eos_token_id=None,
+                           adapter_id=a)
+                for p, o, a in zip(prompts, outs, ids)]
+        while eng.pending:
+            eng.step()
+        outs_ = [np.asarray(eng.request(r).output()) for r in rids]
+        return outs_, time.time() - t0
+
+    lr_base_out, _ = lr_round(lr_eng, [None] * n_req)     # warm + parity
+    lr_match = all((a == np.asarray(s)).all()
+                   for a, s in zip(lr_base_out, static_out))
+    lr_round(lr_eng, lr_ids)                              # adapters resident
+    lr_traces0 = lr_eng.stats()["decode_traces"]
+    lr_off, lr_on = [], []
+    for _ in range(4):
+        lr_off.append(lr_round(engine, [None] * n_req)[1])
+        lr_on.append(lr_round(lr_eng, lr_ids)[1])
+    lr_overhead = (min(lr_on) - min(lr_off)) / min(lr_off) * 100.0
+    assert lr_overhead < 10.0, \
+        f"adapter overhead {lr_overhead:.2f}% >= 10% on the mixed trace"
+    lr_st = lr_eng.stats()
+    assert lr_st["decode_traces"] == lr_traces0, \
+        "adapter round-robin recompiled the decode program"
+    lr_leaked = lr_eng.cache.manager.blocks_in_use
+    assert lr_leaked == 0, f"{lr_leaked} blocks leaked by the LoRA row"
+
     return {
         "serving_tok_s": round(serving_tok_s, 1),
         "static_tok_s": round(static_tok_s, 1),
@@ -2277,6 +2327,15 @@ def bench_serve(backend):
         "durable_recovered_records": len(dj_by_jid),
         "durable_wal_bytes": int(dj_kill["wal_bytes"]),
         "durable_leaked_blocks": int(dj_leaked),
+        # multi-adapter LoRA row (ISSUE 19): 8 adapters round-robin vs
+        # base-only — overhead < 10%, zero-adapter bit parity, zero new
+        # executables, zero leaked blocks, all asserted in-section
+        "lora_outputs_match": bool(lr_match),
+        "lora_adapter_overhead_pct": round(lr_overhead, 2),
+        "lora_adapters": int(lr_adapters),
+        "lora_decode_traces": int(lr_st["decode_traces"]),
+        "lora_adapter_loads": int(lr_st["lora"]["adapter_loads"]),
+        "lora_leaked_blocks": int(lr_leaked),
     }
 
 
@@ -2420,6 +2479,14 @@ _R2_ANCHORS = {
     # load + supervisor rebuild are host-side and the shared compiled
     # programs make the engine build free; the resubmitted prefill
     # recompute lands in the post-recovery steps, not here)
+    # multi-adapter LoRA row (ISSUE 19): the anchor is the 10% acceptance
+    # bound on the gathered-adapter-matmul overhead (lower is better, the
+    # emit inverts), plus the adapter population one pool serves. The
+    # row's hard proofs (zero-adapter bit parity, decode_traces flat
+    # across the 8-adapter round-robin, zero leaked blocks) are asserted,
+    # not tracked.
+    "serving_lora_adapter_overhead_pct": 10.0,
+    "serving_lora_adapters_per_replica": 8,
 }
 
 
@@ -2899,6 +2966,23 @@ def main():
             _emit("serving_recovery_ms", s["durable_recovery_ms"], "ms",
                   _R2_ANCHORS["serving_recovery_ms"] /
                   max(s["durable_recovery_ms"], 1e-6))
+            # multi-adapter LoRA row (ISSUE 19): zero-adapter parity,
+            # compile-once across the 8-adapter round-robin, overhead
+            # < 10%, zero leaks — asserted in bench_serve; re-pin them
+            # here so the row cannot silently vanish, then emit the
+            # overhead (lower is better, ratio inverts) and the adapter
+            # population one pool serves
+            assert s["lora_outputs_match"], \
+                "LoRA row zero-adapter traffic diverged from the oracle"
+            assert s["lora_adapter_overhead_pct"] < 10.0
+            assert s["lora_leaked_blocks"] == 0
+            _emit("serving_lora_adapter_overhead_pct",
+                  s["lora_adapter_overhead_pct"], "%",
+                  _R2_ANCHORS["serving_lora_adapter_overhead_pct"] /
+                  max(s["lora_adapter_overhead_pct"], 1.0))
+            _emit("serving_lora_adapters_per_replica", s["lora_adapters"],
+                  "adapters", s["lora_adapters"] /
+                  _R2_ANCHORS["serving_lora_adapters_per_replica"])
         section("serve", _serve)
     if want("wide"):
         def _wide():

@@ -505,6 +505,31 @@ define_flag("FLAGS_serving_snapshot_every", 64,
             "cold-restart replay to the WAL suffix written since the "
             "last good generation.", int)
 
+# multi-adapter LoRA serving (ISSUE 19): docs/SERVING.md "Multi-adapter
+# LoRA & embeddings"
+define_flag("FLAGS_serving_lora_rank", 8,
+            "LoRA rank r of the device-resident adapter pool: every "
+            "registered adapter's per-projection A/B factors are stored "
+            "at this fixed rank so one stacked [L, slots, ...] pool (and "
+            "ONE compiled program gathering from it) serves every "
+            "adapter. Registering an adapter with a different rank is a "
+            "structured error naming this flag.", int)
+define_flag("FLAGS_serving_lora_slots", 0,
+            "Device-resident adapter slots of the paged adapter pool "
+            "(slot 0 is the reserved zeroed BASE adapter and is not "
+            "counted). 0 disables multi-adapter serving entirely — the "
+            "engine compiles exactly the base programs and base traffic "
+            "is bit-identical to a LoRA-less build. With N slots, up to "
+            "N distinct adapters decode concurrently; colder adapters "
+            "LRU-evict to the host registry and reload on demand "
+            "(counted as adapter_loads).", int)
+define_flag("FLAGS_serving_lora_pool", 16,
+            "Host-side adapter registry capacity — the most adapters "
+            "register() accepts (resident + evicted; the zeroed base "
+            "adapter is free). Registration past the bound is a "
+            "structured error naming this flag. Must be >= "
+            "FLAGS_serving_lora_slots.", int)
+
 define_flag("FLAGS_profile_annotations", False,
             "Emit jax.profiler.TraceAnnotation spans ('data', 'h2d', 'step', "
             "'ckpt') around the input pipeline, the fused train step, and "

@@ -132,6 +132,18 @@ AUDIT_CHECKS = {
                             "delivered, losing nothing and re-emitting "
                             "nothing (vacuously true with the journal "
                             "off)",
+    "adapter_pool_partition": "multi-adapter LoRA pool conservation "
+                              "(ISSUE 19): every registered adapter is "
+                              "device-resident XOR evicted, no two "
+                              "resident adapters share a slot (slot 0 — "
+                              "the zeroed base adapter — is never "
+                              "assigned), every pinned adapter is "
+                              "resident, and every RUNNING request "
+                              "carrying an adapter_id runs on an adapter "
+                              "that is resident at exactly the slot the "
+                              "request carries and pinned against "
+                              "eviction (vacuously true with "
+                              "multi-adapter serving off)",
 }
 
 
@@ -468,6 +480,9 @@ class InvariantAuditor:
         tier = getattr(eng.cache, "offload", None)
         if on("tier_partition") and tier is not None:
             self._check_tier(label, bm, tier, fail)
+        if on("adapter_pool_partition") \
+                and getattr(eng, "_lora", None) is not None:
+            self._check_adapters(label, eng, fail)
         if on("durable_exactly_once"):
             self._check_durable(label, eng, fail)
         if on("quiesce_leaks") and not sched.pending \
@@ -491,6 +506,10 @@ class InvariantAuditor:
                     label, tier,
                     ("swap_outs", "swap_ins", "tier_hits", "tier_misses",
                      "corrupt_drops", "tier_evictions"), fail)
+            pool = getattr(eng, "_lora", None)
+            if pool is not None:
+                self._counter_floor(label, pool,
+                                    ("loads", "evictions"), fail)
 
     @staticmethod
     def _check_tier(label: str, bm, tier, fail) -> None:
@@ -525,6 +544,59 @@ class InvariantAuditor:
                      f"pending host entry {key} holds {len(toks)} tokens "
                      f"(exactly block_size={tier.block_size} expected)",
                      label)
+
+    @staticmethod
+    def _check_adapters(label: str, eng, fail) -> None:
+        """The adapter-pool half of the multi-adapter story (ISSUE 19):
+        residency is a partition of the registry, slots are exclusive,
+        and a running request's adapter can never be evicted out from
+        under its in-flight dispatches (the pin lifecycle's whole job).
+        Vacuously true with multi-adapter serving off."""
+        part = eng.adapter_partition()
+        if part is None:
+            return
+        registered = set(part["registered"])
+        resident = dict(part["resident"])
+        evicted = set(part["evicted"])
+        pinned = dict(part["pinned"])
+        both = set(resident) & evicted
+        if both:
+            fail("adapter_pool_partition",
+                 f"adapter(s) {sorted(both)} resident AND evicted "
+                 f"(residency must be XOR)", label)
+        neither = registered - set(resident) - evicted
+        if neither:
+            fail("adapter_pool_partition",
+                 f"registered adapter(s) {sorted(neither)} neither "
+                 f"resident nor evicted", label)
+        stray = (set(resident) | evicted | set(pinned)) - registered
+        if stray:
+            fail("adapter_pool_partition",
+                 f"unregistered adapter(s) {sorted(stray)} tracked by "
+                 f"the pool", label)
+        slots = list(resident.values())
+        if 0 in slots:
+            fail("adapter_pool_partition",
+                 "an adapter occupies slot 0 (reserved for the zeroed "
+                 "base adapter)", label)
+        if len(set(slots)) != len(slots):
+            fail("adapter_pool_partition",
+                 f"two resident adapters share a slot: {resident}", label)
+        for name in pinned:
+            if name not in resident:
+                fail("adapter_pool_partition",
+                     f"pinned adapter {name!r} is not resident", label)
+        for rid, (aid, slot) in sorted(part["running"].items()):
+            if resident.get(aid) != slot:
+                fail("adapter_pool_partition",
+                     f"running request {rid} carries adapter {aid!r} at "
+                     f"slot {slot} but the pool has it at "
+                     f"{resident.get(aid)}", label)
+            if pinned.get(aid, 0) < 1:
+                fail("adapter_pool_partition",
+                     f"running request {rid}'s adapter {aid!r} holds no "
+                     f"pin — an eviction could swap its weights "
+                     f"mid-stream", label)
 
     @staticmethod
     def _check_durable(label: str, eng, fail) -> None:

@@ -167,6 +167,14 @@ HEALTH_SNAPSHOT_FIELDS = {
                "tier_misses / corrupt_drops (checksum or token-mismatch "
                "entries dropped — degraded to a MISS, never attended) / "
                "tier_evictions; all zeros with the tier off",
+    "lora": "multi-adapter LoRA serving (ISSUE 19): enabled + rank / "
+            "slots (device adapter-pool rows past the reserved zeroed "
+            "base slot 0) / resident (adapter names loaded on device) / "
+            "adapters_registered / adapters_resident / adapter_loads "
+            "(H2D uploads — cold acquires) / adapter_evictions (LRU "
+            "slot reclaims) / adapter_pins (running-request pins; a "
+            "pinned adapter is never evicted mid-stream); zeros with "
+            "multi-adapter serving off",
     "watchdog": "global hang-watchdog state: installed / fired / "
                 "timeout_s",
     "tenants": "per-tenant breakdown: queued / live / submitted / "
@@ -218,8 +226,11 @@ class EnginePrograms:
     prefill_buckets: set
     key: tuple          # shape signature (incl. the sampling/spec-decode
     #                     surface: spec_decode widths change the verify
-    #                     program's shapes); reuse under a different one
-    #                     raises
+    #                     program's shapes, and the LoRA pool geometry /
+    #                     embed-model config change operand shapes); reuse
+    #                     under a different one raises
+    embed: Any = None   # prefill-only embeddings encoder (ISSUE 19);
+    #                     None when no embed model is attached
 
 
 @dataclasses.dataclass
@@ -287,6 +298,19 @@ class ServingConfig:
     #                                  dying; unset -> FLAGS_serving_offload
     offload_blocks: Any = _UNSET     # host-tier capacity bound in blocks;
     #                                  unset -> FLAGS_serving_offload_blocks
+    # multi-adapter LoRA serving (ISSUE 19)
+    lora_rank: Optional[int] = None  # adapter rank r (fixed pool-wide);
+    #                                  None -> FLAGS_serving_lora_rank
+    lora_slots: Optional[int] = None  # device adapter-pool slots (on top
+    #                                   of the reserved zeroed base slot
+    #                                   0); 0 disables multi-adapter
+    #                                   serving entirely — the compiled
+    #                                   programs are then byte-identical
+    #                                   to the LoRA-less engine; None ->
+    #                                   FLAGS_serving_lora_slots
+    lora_pool: Optional[int] = None  # host-registry capacity (adapters
+    #                                  registered in total, >= lora_slots);
+    #                                  None -> FLAGS_serving_lora_pool
 
     def __post_init__(self):
         for f, name in (("block_size", "FLAGS_serving_block_size"),
@@ -294,9 +318,24 @@ class ServingConfig:
                         ("max_model_len", "FLAGS_serving_max_model_len"),
                         ("queue_depth", "FLAGS_serving_queue_depth"),
                         ("decode_chunk", "FLAGS_serving_decode_chunk"),
-                        ("tp", "FLAGS_serving_tp")):
+                        ("tp", "FLAGS_serving_tp"),
+                        ("lora_rank", "FLAGS_serving_lora_rank"),
+                        ("lora_slots", "FLAGS_serving_lora_slots"),
+                        ("lora_pool", "FLAGS_serving_lora_pool")):
             if getattr(self, f) is None:
                 setattr(self, f, int(flag(name)))
+        self.lora_rank = int(self.lora_rank)
+        self.lora_slots = int(self.lora_slots)
+        self.lora_pool = int(self.lora_pool)
+        if self.lora_slots < 0:
+            raise ValueError(f"lora_slots must be >= 0 (0 = multi-adapter "
+                             f"serving off), got {self.lora_slots}")
+        if self.lora_slots and self.lora_pool < self.lora_slots:
+            raise ValueError(
+                f"lora_pool ({self.lora_pool}) must be >= lora_slots "
+                f"({self.lora_slots}): the host registry backs every "
+                f"device-resident adapter (FLAGS_serving_lora_pool / "
+                f"FLAGS_serving_lora_slots)")
         self.tp = int(self.tp)
         if self.tp < 1:
             raise ValueError(f"tensor-parallel degree must be >= 1 (1 = "
@@ -372,7 +411,7 @@ class ServingEngine:
     def __init__(self, params, model_config, serving_config:
                  Optional[ServingConfig] = None, gen_config=None,
                  programs: Optional[EnginePrograms] = None,
-                 journal=None):
+                 journal=None, embed_model=None):
         import jax
 
         from ...models.generation import GenerationConfig, validate_sampling
@@ -442,6 +481,29 @@ class ServingEngine:
         self._topp = np.ones((M,), np.float32)    # 1.0 = disabled
         self._keys = np.zeros((M, 2), np.uint32)
         self._sample_idx = np.zeros((M,), np.int32)
+        # multi-adapter LoRA (ISSUE 19): the device adapter pool plus the
+        # per-slot adapter-row operand of every dispatch (0 = the zeroed
+        # base adapter) and the rid -> adapter pin map the admission gate
+        # maintains (pins persist across preemption; released only at a
+        # terminal state, so an in-flight stream's weights never swap out)
+        if self.config.lora_slots:
+            from ...models.lora import AdapterPool
+            self._lora = AdapterPool(model_config, self.config.lora_rank,
+                                     self.config.lora_slots,
+                                     self.config.lora_pool,
+                                     mesh=self._mesh)
+        else:
+            self._lora = None
+        self._adapters = np.zeros((M,), np.int32)
+        self._lora_pinned: Dict[int, str] = {}
+        # embeddings endpoint (ISSUE 19): an optional (BertConfig, params)
+        # encoder serving prefill-only requests (kind "embed") — proof the
+        # engine is model-agnostic beyond llama. Replicated even under TP
+        # (a BERT-base forward is tiny next to the LM's KV traffic).
+        if embed_model is not None:
+            self._embed_cfg, self._embed_params = embed_model
+        else:
+            self._embed_cfg = self._embed_params = None
         # speculative decoding (ISSUE 11)
         self._spec_k = int(self.config.spec_decode)
         self._spec_n = int(self.config.spec_ngram)
@@ -465,7 +527,13 @@ class ServingEngine:
                self.config.max_model_len, self.config.quantize,
                str(self.config.cache_dtype), self.config.kv_quant,
                self.config.paged_kernel, self.config.spec_decode,
-               self.config.tp)
+               self.config.tp,
+               # LoRA pool geometry changes the gathered-matmul operand
+               # shapes (rank normalized to 0 when disabled so base
+               # engines share programs regardless of the rank flag);
+               # the embed config keys the encoder program's shapes
+               self.config.lora_rank if self.config.lora_slots else 0,
+               self.config.lora_slots, self._embed_cfg)
         if programs is not None:
             if programs.key != key:
                 raise ValueError(
@@ -478,18 +546,23 @@ class ServingEngine:
             self._jprefill, self._jchunk, self._jdecode = (
                 programs.prefill, programs.chunk, programs.decode)
             self._jspec, self._jsample = programs.spec, programs.sample
+            self._jembed = programs.embed
             self.programs = programs
         else:
             self._stats = {"decode_traces": 0, "prefill_traces": 0,
                            "chunk_prefill_traces": 0, "chunks": 0,
                            "steps": 0, "spec_traces": 0,
-                           "sample_traces": 0, "spec_steps": 0}
+                           "sample_traces": 0, "spec_steps": 0,
+                           "embed_traces": 0, "embeds": 0}
             self._prefill_buckets = set()
             (self._jprefill, self._jchunk, self._jdecode, self._jspec,
              self._jsample) = self._build(jax)
+            self._jembed = (self._build_embed(jax)
+                            if self._embed_params is not None else None)
             self.programs = EnginePrograms(
                 self._jprefill, self._jchunk, self._jdecode, self._jspec,
-                self._jsample, self._stats, self._prefill_buckets, key)
+                self._jsample, self._stats, self._prefill_buckets, key,
+                embed=self._jembed)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -507,15 +580,22 @@ class ServingEngine:
             # axis the attention-output merge all_gathers over
             cfg = dataclasses.replace(cfg, tp_axis="tp")
 
-        def prefill_fn(params, ids, prompt_lens, block_tables, pool, active):
+        # every program takes the LoRA operand LAST ({"ids": per-row
+        # adapter slots, "layers": the stacked pool} — a device operand
+        # like the sampling knobs, so adapter churn never retraces); with
+        # multi-adapter serving off it is bound to None below and the
+        # traced computation is byte-identical to the LoRA-less engine
+        def prefill_fn(params, ids, prompt_lens, block_tables, pool, active,
+                       lora):
             stats["prefill_traces"] += 1           # trace-time only
             return G.paged_prefill(params, cfg, ids, prompt_lens,
-                                   block_tables, pool, active)
+                                   block_tables, pool, active, lora=lora)
 
-        def chunk_fn(params, ids, start, chunk_len, block_tables, pool):
+        def chunk_fn(params, ids, start, chunk_len, block_tables, pool,
+                     lora):
             stats["chunk_prefill_traces"] += 1     # trace-time only
             return G.paged_prefill_chunk(params, cfg, ids, start, chunk_len,
-                                         block_tables, pool)
+                                         block_tables, pool, lora=lora)
 
         use_kernel = self.config.paged_kernel
 
@@ -534,7 +614,7 @@ class ServingEngine:
 
         def decode_fn(params, pool, tokens, seq_lens, steps_left, done,
                       block_tables, eos_ids, limit, keys, sample_idx,
-                      temp, topk, topp):
+                      temp, topk, topp, lora):
             stats["decode_traces"] += 1            # trace-time only
             M = tokens.shape[0]
 
@@ -550,7 +630,7 @@ class ServingEngine:
                 active = (~done) & (steps_left > 0)
                 logits, pool, _drops = G.paged_decode_step(
                     params, cfg, tokens, seq_lens, block_tables, pool,
-                    active, use_kernel=use_kernel)
+                    active, use_kernel=use_kernel, lora=lora)
                 nxt = _next_tokens(logits, keys, sample_idx, temp, topk,
                                    topp)
                 nxt = jnp.where(active, nxt, tokens)
@@ -574,7 +654,8 @@ class ServingEngine:
             return pool, tokens, seq_lens, steps_left, done, out
 
         def spec_fn(params, pool, tokens, seq_lens, draft_lens, steps_left,
-                    done, block_tables, keys, sample_idx, temp, topk, topp):
+                    done, block_tables, keys, sample_idx, temp, topk, topp,
+                    lora):
             """One speculative VERIFY dispatch: multi-query decode over
             ``tokens [M, Q]`` (last token + drafts), then sample each
             position with its own per-index key and count the accepted
@@ -585,7 +666,7 @@ class ServingEngine:
             active = (~done) & (steps_left > 0)
             logits, pool, _drops = G.paged_spec_step(
                 params, cfg, tokens, seq_lens, draft_lens, block_tables,
-                pool, active, use_kernel=use_kernel)
+                pool, active, use_kernel=use_kernel, lora=lora)
             V = logits.shape[-1]
             idx = sample_idx[:, None] + jnp.arange(Q)[None, :]   # [M, Q]
             kt = jax.vmap(jax.vmap(jax.random.fold_in,
@@ -616,6 +697,14 @@ class ServingEngine:
             kt = jax.vmap(jax.random.fold_in)(keys, idx)
             return G.sample_tokens(logits, kt, temp, topk, topp)
 
+        if self._lora is None:
+            # bind the LoRA operand away: the jitted surface (and under
+            # TP the shard_map arity) is exactly the LoRA-less engine's
+            import functools
+            prefill_fn = functools.partial(prefill_fn, lora=None)
+            chunk_fn = functools.partial(chunk_fn, lora=None)
+            decode_fn = functools.partial(decode_fn, lora=None)
+            spec_fn = functools.partial(spec_fn, lora=None)
         if self._mesh is not None:
             # tensor parallelism: every pool-touching program runs under
             # shard_map on the replica's "tp" mesh — params enter at the
@@ -632,18 +721,29 @@ class ServingEngine:
             ps = serving_param_specs(self._params, self._mesh)
             zs = G.paged_pool_specs(self.cache.pool, self._mesh)
             R = PartitionSpec()
+            if self._lora is not None:
+                # the adapter pool shards like the projections it feeds
+                # (qB/kB/vB on their output-feature axis, the rest
+                # replicated); the per-row slot ids replicate like every
+                # other scheduler operand
+                from ...models.lora import lora_pool_specs
+                ls = ({"ids": R,
+                       "layers": lora_pool_specs(self._lora.layers,
+                                                 self._mesh)},)
+            else:
+                ls = ()
             prefill_fn = shard_map(prefill_fn, mesh=self._mesh,
-                                   in_specs=(ps, R, R, R, zs, R),
+                                   in_specs=(ps, R, R, R, zs, R) + ls,
                                    out_specs=(R, zs, R), check_vma=False)
             chunk_fn = shard_map(chunk_fn, mesh=self._mesh,
-                                 in_specs=(ps, R, R, R, R, zs),
+                                 in_specs=(ps, R, R, R, R, zs) + ls,
                                  out_specs=(R, zs, R), check_vma=False)
             decode_fn = shard_map(decode_fn, mesh=self._mesh,
-                                  in_specs=(ps, zs) + (R,) * 12,
+                                  in_specs=(ps, zs) + (R,) * 12 + ls,
                                   out_specs=(zs, R, R, R, R, R),
                                   check_vma=False)
             spec_fn = shard_map(spec_fn, mesh=self._mesh,
-                                in_specs=(ps, zs) + (R,) * 11,
+                                in_specs=(ps, zs) + (R,) * 11 + ls,
                                 out_specs=(zs, R, R), check_vma=False)
         donate = donation_supported()
         jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
@@ -652,6 +752,31 @@ class ServingEngine:
         jspec = jax.jit(spec_fn, donate_argnums=(1,) if donate else ())
         jsamp = jax.jit(sample_fn)
         return jpre, jchk, jdec, jspec, jsamp
+
+    def _build_embed(self, jax):
+        """The prefill-only embeddings program (ISSUE 19): one jitted
+        ``bert_encode`` forward, compiled per ``(batch, length)`` bucket
+        exactly like the batched prefill. Plain jit even under TP — the
+        encoder runs replicated (params and activations are tiny next to
+        the LM's sharded KV traffic)."""
+        from ...models.bert import bert_encode
+        ecfg, stats = self._embed_cfg, self._stats
+
+        def embed_fn(params, ids, lengths):
+            stats["embed_traces"] += 1             # trace-time only
+            return bert_encode(params, ecfg, ids, lengths)
+
+        return jax.jit(embed_fn)
+
+    def _lora_operand(self, ids) -> tuple:
+        """The trailing LoRA dispatch operand: per-row adapter pool slots
+        + the stacked pool leaves, or () with multi-adapter serving off
+        (the programs were then partial-bound to ``lora=None``)."""
+        if self._lora is None:
+            return ()
+        import jax.numpy as jnp
+        return ({"ids": jnp.asarray(np.asarray(ids, np.int32)),
+                 "layers": self._lora.layers},)
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -668,7 +793,8 @@ class ServingEngine:
                deadline_s: Optional[float] = None,
                tenant: Optional[str] = None, priority: int = 0,
                temperature: Any = "unset", top_k: Any = "unset",
-               top_p: Any = "unset", seed: Any = "unset") -> int:
+               top_p: Any = "unset", seed: Any = "unset",
+               adapter_id: Optional[str] = None) -> int:
         """Queue one prompt; returns the request id. ``eos_token_id``
         defaults to the engine's GenerationConfig (pass ``None`` explicitly
         to disable EOS for this request).
@@ -693,6 +819,13 @@ class ServingEngine:
         and prefix-cache quotas; ``priority`` orders the priority policy
         (higher first).
 
+        ``adapter_id`` (ISSUE 19) selects a registered LoRA adapter for
+        this request (None = base traffic — the zeroed slot-0 adapter,
+        bit-identical to the LoRA-less engine). The adapter must already
+        be :meth:`register_adapter`-ed; admission pins it device-resident
+        for the request's whole lifetime (preemption included), so its
+        weights can never be evicted mid-stream.
+
         Raises :class:`ServingQueueFull` — carrying ``queue_depth`` /
         ``live_slots`` / ``retry_after_s`` for the caller's backoff — when
         the bounded admission queue is full: the submit is SHED, not
@@ -704,7 +837,8 @@ class ServingEngine:
         req = self._make_request(prompt, max_new_tokens, eos_token_id,
                                  tenant, priority, deadline,
                                  temperature=temperature, top_k=top_k,
-                                 top_p=top_p, seed=seed)
+                                 top_p=top_p, seed=seed,
+                                 adapter_id=adapter_id)
         with self._lock:
             rid = self._sched.submit(req)
             self._journal_submit(req)
@@ -713,7 +847,8 @@ class ServingEngine:
     def _make_request(self, prompt, max_new_tokens, eos_token_id, tenant,
                       priority, deadline, tokens: Sequence[int] = (),
                       temperature: Any = "unset", top_k: Any = "unset",
-                      top_p: Any = "unset", seed: Any = "unset") -> Request:
+                      top_p: Any = "unset", seed: Any = "unset",
+                      adapter_id: Optional[str] = None) -> Request:
         """One Request from user-facing arguments — the single place
         submit() and resubmit() resolve GenerationConfig defaults (the
         sampling knobs included), the "unset" sentinels and the tenant
@@ -744,6 +879,18 @@ class ServingEngine:
             raise ValueError("max_new_tokens must be >= 1")
         if req.prompt_len < 1:
             raise ValueError("prompt must contain at least one token")
+        if adapter_id is not None:
+            if self._lora is None:
+                raise ValueError(
+                    "adapter_id requires multi-adapter serving: set "
+                    "ServingConfig.lora_slots / FLAGS_serving_lora_slots "
+                    "> 0")
+            if not self._lora.is_registered(adapter_id):
+                raise ValueError(
+                    f"adapter {adapter_id!r} is not registered on this "
+                    f"engine (register_adapter() first; registered: "
+                    f"{self._lora.registered()})")
+            req.adapter_id = str(adapter_id)
         return req
 
     def resubmit(self, prompt, tokens: Sequence[int] = (),
@@ -753,7 +900,8 @@ class ServingEngine:
                  tenant: Optional[str] = None, priority: int = 0,
                  temperature: Any = "unset", top_k: Any = "unset",
                  top_p: Any = "unset", seed: Any = "unset",
-                 jid: Optional[int] = None) -> int:
+                 jid: Optional[int] = None,
+                 adapter_id: Optional[str] = None) -> int:
         """Re-queue a request recovered from a torn-down engine with the
         tokens it had already emitted — the supervisor's restart path.
         Rides the preemption-recompute machinery: prefill recomputes KV
@@ -777,7 +925,8 @@ class ServingEngine:
         req = self._make_request(prompt, max_new_tokens, eos_token_id,
                                  tenant, priority, deadline, tokens=tokens,
                                  temperature=temperature, top_k=top_k,
-                                 top_p=top_p, seed=seed)
+                                 top_p=top_p, seed=seed,
+                                 adapter_id=adapter_id)
         if req.finished:
             raise ValueError(
                 f"request is already finished ({len(req.tokens)} tokens of "
@@ -807,7 +956,7 @@ class ServingEngine:
                 temperature=req.temperature, top_k=req.top_k,
                 top_p=req.top_p, seed=req.seed, tenant=req.tenant,
                 priority=req.priority, deadline=req.deadline,
-                tokens=req.tokens)
+                tokens=req.tokens, adapter_id=req.adapter_id)
         self._jlive[req.rid] = req.jid
 
     def _journal_end(self, req: Request) -> None:
@@ -871,6 +1020,95 @@ class ServingEngine:
             self._jlive[rid] = req.jid
             return True
 
+    # ---- multi-adapter LoRA + embeddings endpoint (ISSUE 19) --------------
+
+    def register_adapter(self, name: str, adapter_params) -> None:
+        """Accept one LoRA adapter (host-side checksummed copy; rank must
+        match ``lora_rank``) so requests may select it via
+        ``submit(adapter_id=name)``. Re-registering an unpinned adapter
+        replaces its weights; a pinned one (running requests) refuses."""
+        with self._lock:
+            if self._lora is None:
+                raise ValueError(
+                    "multi-adapter serving is off: set ServingConfig."
+                    "lora_slots / FLAGS_serving_lora_slots > 0")
+            self._lora.register(name, adapter_params)
+
+    def adapter_registered(self, name: str) -> bool:
+        with self._lock:
+            return self._lora is not None and \
+                self._lora.is_registered(name)
+
+    def adapter_resident(self, name: str) -> bool:
+        """Whether ``name`` is loaded in the device pool right now — the
+        router's adapter-affinity signal (land a request where its
+        adapter is already resident and skip the H2D load)."""
+        with self._lock:
+            return self._lora is not None and \
+                self._lora.slot_of(name) is not None
+
+    def adapter_partition(self) -> Optional[Dict[str, Any]]:
+        """A consistent view of the adapter pool under the engine lock —
+        what the InvariantAuditor's ``adapter_pool_partition`` check
+        reads: every registered adapter is resident XOR evicted, every
+        live request's adapter is resident at the slot the request
+        carries, and every such request holds a pin. None with
+        multi-adapter serving off."""
+        with self._lock:
+            if self._lora is None:
+                return None
+            running = {r.rid: (r.adapter_id, int(r.adapter_slot))
+                       for r in self._sched.live
+                       if r.adapter_id is not None}
+            return {"registered": self._lora.registered(),
+                    "resident": self._lora.resident(),
+                    "evicted": self._lora.evicted(),
+                    "pinned": self._lora.pinned(),
+                    "running": running}
+
+    def submit_embedding(self, prompt, timeout_s: Optional[float] = None,
+                         deadline_s: Optional[float] = None,
+                         tenant: Optional[str] = None,
+                         priority: int = 0) -> int:
+        """Queue one prefill-only EMBEDDING request (ISSUE 19): it rides
+        the admission queue (bounded — sheds with ServingQueueFull like
+        generate traffic), runs through the attached encoder in the next
+        step's batched bucketed dispatch, and retires at prefill
+        completion with the pooled hidden states readable via
+        :meth:`embedding`. Embeds hold no decode slot and no KV blocks
+        and are NOT journaled — they carry no generation state, so a
+        crash loses nothing a stateless client retry cannot recompute."""
+        if self._embed_params is None:
+            raise ValueError(
+                "no embedding model attached: construct the engine with "
+                "embed_model=(BertConfig, params) to serve embeddings")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("prompt must contain at least one token")
+        if prompt.shape[0] > self._embed_cfg.max_position_embeddings:
+            raise ValueError(
+                f"embedding prompt has {prompt.shape[0]} tokens > the "
+                f"encoder's max_position_embeddings "
+                f"{self._embed_cfg.max_position_embeddings}")
+        deadline = deadline_s
+        if timeout_s is not None:
+            t = time.time() + float(timeout_s)
+            deadline = t if deadline is None else min(deadline, t)
+        req = Request(
+            rid=-1, prompt=prompt, max_new_tokens=1,
+            tenant=str(tenant) if tenant is not None else DEFAULT_TENANT,
+            priority=int(priority),
+            deadline=float(deadline) if deadline is not None else None,
+            kind="embed")
+        with self._lock:
+            return self._sched.submit(req)
+
+    def embedding(self, rid: int) -> np.ndarray:
+        """The pooled ``[hidden_size]`` fp32 embedding of a finished
+        embed request (KeyError while still queued/in-flight)."""
+        with self._lock:
+            return self._sched.finished[rid].embedding
+
     # ---- live KV migration (ISSUE 16) -------------------------------------
 
     def kv_shape_key(self) -> tuple:
@@ -913,6 +1151,7 @@ class ServingEngine:
                 "tenant": req.tenant, "priority": req.priority,
                 "deadline": req.deadline,
                 "jid": req.jid,
+                "adapter_id": req.adapter_id,
                 "kv": None,
             }
             if req.slot is None or not req.blocks:
@@ -951,6 +1190,12 @@ class ServingEngine:
         payload (queued/preempted origin) is queued via the resubmit
         path directly."""
         with self._lock:
+            aid = payload.get("adapter_id")
+            if aid is not None and (self._lora is None
+                                    or not self._lora.is_registered(aid)):
+                raise AdoptError(
+                    f"adapter {aid!r} is not registered on this replica; "
+                    f"falling back to resubmit")
             req = self._make_request(
                 payload["prompt"], payload["max_new_tokens"],
                 payload["eos_token_id"], payload["tenant"],
@@ -958,7 +1203,7 @@ class ServingEngine:
                 tokens=payload["tokens"],
                 temperature=payload["temperature"],
                 top_k=payload["top_k"], top_p=payload["top_p"],
-                seed=payload["seed"])
+                seed=payload["seed"], adapter_id=aid)
             if req.finished:
                 raise AdoptError("request already finished; record it, "
                                  "don't migrate it")
@@ -990,9 +1235,22 @@ class ServingEngine:
             except Exception as e:
                 self.cache.manager.free(blocks)
                 raise AdoptError(f"KV restore failed: {e}")
+            if req.adapter_id is not None:
+                # pin the adapter resident BEFORE seating: a fully pinned
+                # pool refuses the migration (recompute elsewhere beats
+                # evicting someone's in-flight weights)
+                aslot = self._lora.acquire(req.adapter_id)
+                if aslot is None:
+                    self.cache.manager.free(blocks)
+                    raise AdoptError(
+                        f"adapter pool fully pinned; cannot seat adapter "
+                        f"{req.adapter_id!r} — falling back to resubmit")
+                req.adapter_slot = aslot
             slot = free[0]
             self._clear_slot(slot)
             self._sched.adopt_running(req, slot, blocks)
+            if req.adapter_id is not None:
+                self._lora_pinned[req.rid] = req.adapter_id
             self.cache.assign(slot, blocks)
             entries = int(kv["entries"])
             if kv["prefilling"]:
@@ -1008,7 +1266,7 @@ class ServingEngine:
             # adopted blocks register under exactly the origin's keys)
             req.reg_state = self.cache.register_prefix(
                 req.build_prefill_ids(), blocks, entries,
-                tenant=req.tenant)
+                tenant=req.tenant, namespace=req.adapter_id)
             self._journal_submit(req, payload.get("jid"))
             return req.rid
 
@@ -1134,6 +1392,47 @@ class ServingEngine:
                 self._journal_flush()
             return n
 
+    # ---- adapter pin lifecycle (ISSUE 19) ---------------------------------
+
+    def _lora_gate(self, req: Request) -> bool:
+        """The scheduler's admission gate: pin the pick's adapter
+        device-resident (loading it over the LRU unpinned victim when
+        cold) and stamp its pool slot on the request. False — skip this
+        pick, no head-of-line blocking — when every pool slot is pinned
+        by other running requests. Idempotent per request: a pick that
+        pinned but then waited for KV blocks (or was preempted) keeps
+        its pin and slot."""
+        if req.adapter_id is None:
+            req.adapter_slot = 0
+            return True
+        if req.rid in self._lora_pinned:
+            return True
+        slot = self._lora.acquire(req.adapter_id)
+        if slot is None:
+            return False
+        self._lora_pinned[req.rid] = req.adapter_id
+        req.adapter_slot = slot
+        return True
+
+    def _lora_release(self, req: Request) -> None:
+        """Drop a terminal request's adapter pin (the adapter stays
+        resident-warm until the LRU needs its slot)."""
+        if self._lora is None:
+            return
+        name = self._lora_pinned.pop(req.rid, None)
+        if name is not None:
+            self._lora.release(name)
+
+    def _lora_sweep(self) -> None:
+        """Release pins whose requests the retire sweep finished — the
+        step-boundary companion to the explicit terminal-path releases,
+        mirroring how ``_journal_step`` collects finished jids."""
+        if self._lora is None or not self._lora_pinned:
+            return
+        fin = self._sched.finished
+        for rid in [r for r in self._lora_pinned if r in fin]:
+            self._lora.release(self._lora_pinned.pop(rid))
+
     def _retire_if_finished(self, req: Request) -> bool:
         """A request can sit FINISHED in its slot until the next step's
         retire sweep (e.g. oom-truncated with no decode dispatch after
@@ -1146,6 +1445,7 @@ class ServingEngine:
         m = req.slot
         self._sched.finish(req)
         self._clear_slot(m)
+        self._lora_release(req)
         self._journal_end(req)
         return True
 
@@ -1160,12 +1460,14 @@ class ServingEngine:
         self._topp[m] = 1.0
         self._keys[m] = 0
         self._sample_idx[m] = 0
+        self._adapters[m] = 0
 
     def _terminate(self, req: Request, state: str) -> None:
         m = req.slot
         self._sched.terminate(req, state)
         if m is not None:
             self._clear_slot(m)
+        self._lora_release(req)
         self._journal_end(req)
 
     def _expire_deadlines(self, now: float) -> None:
@@ -1224,6 +1526,7 @@ class ServingEngine:
         self._topp[m] = req.top_p if req.top_p is not None else 1.0
         self._keys[m] = seed_key(req.seed)
         self._sample_idx[m] = len(req.tokens)
+        self._adapters[m] = req.adapter_slot
 
     def _emit_first(self, req: Request, tok0: int, now: float,
                     emitted: Dict[int, List[int]]) -> None:
@@ -1239,8 +1542,10 @@ class ServingEngine:
 
     def _admit(self, emitted: Dict[int, List[int]]) -> None:
         import jax.numpy as jnp
+        self._admit_embeds()
+        gate = self._lora_gate if self._lora is not None else None
         admitted: List[Request] = []
-        while (req := self._sched.next_admission()) is not None:
+        while (req := self._sched.next_admission(gate=gate)) is not None:
             admitted.append(req)
         if not admitted:
             return
@@ -1267,24 +1572,65 @@ class ServingEngine:
             plens = np.ones((Bb,), np.int32)      # pad rows: harmless len 1
             tables = np.zeros((Bb, self.cache.blocks_per_seq), np.int32)
             act = np.zeros((Bb,), bool)
+            aids = np.zeros((Bb,), np.int32)      # pad rows: base adapter
             for r, req in enumerate(group):
                 ids[r, :req.prompt_len] = req.prompt
                 plens[r] = req.prompt_len
                 tables[r] = self.cache.tables[req.slot]
                 act[r] = True
+                aids[r] = req.adapter_slot
             with _watchdog.section("serving.prefill"):
                 logits, self.cache.pool, _ = self._jprefill(
                     self._params, jnp.asarray(ids), jnp.asarray(plens),
-                    jnp.asarray(tables), self.cache.pool, jnp.asarray(act))
+                    jnp.asarray(tables), self.cache.pool, jnp.asarray(act),
+                    *self._lora_operand(aids))
                 first = self._first_tokens(logits, group, Bb)
             now = time.time()
             for r, req in enumerate(group):
                 req.num_computed = req.prompt_len
                 req.reg_state = self.cache.register_prefix(
                     req.prompt, req.blocks, req.prompt_len, req.reg_state,
-                    tenant=req.tenant)
+                    tenant=req.tenant, namespace=req.adapter_id)
                 self._emit_first(req, int(first[r]), now, emitted)
         # chunked/offset admissions advance via _advance_prefills
+
+    def _admit_embeds(self) -> None:
+        """Drain every queued embedding request (ISSUE 19) through the
+        batched encoder: one jitted ``bert_encode`` dispatch per
+        power-of-2 ``(batch, length)`` bucket, exactly the batched-
+        bucketed-prefill shape discipline. The whole batch admits,
+        encodes and FINISHES inside this locked step — embeds hold no
+        decode slot and no KV blocks, so no observer ever sees one
+        mid-flight."""
+        if self._embed_params is None:
+            return
+        import jax.numpy as jnp
+        group = self._sched.admit_embeds()
+        if not group:
+            return
+        by_bucket: Dict[int, List[Request]] = {}
+        for req in group:
+            by_bucket.setdefault(self._bucket(req.prompt_len),
+                                 []).append(req)
+        for Sb, grp in sorted(by_bucket.items()):
+            Bb = 1
+            while Bb < len(grp):
+                Bb *= 2
+            ids = np.zeros((Bb, Sb), np.int32)
+            lens = np.zeros((Bb,), np.int32)      # pad rows: length 0
+            for r, req in enumerate(grp):
+                ids[r, :req.prompt_len] = req.prompt
+                lens[r] = req.prompt_len
+            with _watchdog.section("serving.prefill"):
+                pooled = np.asarray(self._jembed(
+                    self._embed_params, jnp.asarray(ids),
+                    jnp.asarray(lens)))
+            now = time.time()
+            for r, req in enumerate(grp):
+                req.embedding = pooled[r]
+                req.first_token_t = now
+                self._stats["embeds"] += 1
+                self._sched.finish(req)
 
     def _advance_prefills(self, emitted: Dict[int, List[int]]) -> None:
         """One prefill chunk per mid-prefill slot (offset path, B=1):
@@ -1309,11 +1655,13 @@ class ServingEngine:
                     jnp.asarray(req.num_computed, jnp.int32),
                     jnp.asarray(n, jnp.int32),
                     jnp.asarray(self.cache.tables[req.slot][None]),
-                    self.cache.pool)
+                    self.cache.pool,
+                    *self._lora_operand([req.adapter_slot]))
             req.num_computed += n
             req.reg_state = self.cache.register_prefix(
                 req.prefill_ids, req.blocks, req.num_computed,
-                req.reg_state, tenant=req.tenant)
+                req.reg_state, tenant=req.tenant,
+                namespace=req.adapter_id)
             if req.prefilling:
                 continue                          # more chunks to go
             if req.tokens:                        # readmission: resume
@@ -1587,7 +1935,8 @@ class ServingEngine:
                 jnp.asarray(self._steps_left), jnp.asarray(self._done),
                 jnp.asarray(self.cache.tables), jnp.asarray(self._keys),
                 jnp.asarray(self._sample_idx), jnp.asarray(self._temp),
-                jnp.asarray(self._topk), jnp.asarray(self._topp))
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                *self._lora_operand(self._adapters))
             cand = np.asarray(cand)
             acc = np.asarray(acc)
         for req in decoding:
@@ -1617,7 +1966,8 @@ class ServingEngine:
                     sl // self.config.block_size > req.reg_state[0]:
                 req.reg_state = self.cache.register_prefix(
                     self._chain_ids(req, base, sl), req.blocks, sl,
-                    req.reg_state, base=base, tenant=req.tenant)
+                    req.reg_state, base=base, tenant=req.tenant,
+                    namespace=req.adapter_id)
             if not req.finished:
                 self._rollback_blocks(req)
         self._stats["chunks"] += 1
@@ -1637,6 +1987,7 @@ class ServingEngine:
         _watchdog.touch()
         with self._lock, _watchdog.section("serving.step"):
             emitted = self._step(max_iters)
+            self._lora_sweep()
             self._journal_step(emitted)
             return emitted
 
@@ -1689,7 +2040,8 @@ class ServingEngine:
                     jnp.asarray(self._eos), jnp.asarray(k, jnp.int32),
                     jnp.asarray(self._keys), jnp.asarray(self._sample_idx),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp))
+                    jnp.asarray(self._topp),
+                    *self._lora_operand(self._adapters))
                 toks = np.asarray(toks)
             # np.array (copy): zero-copy views of jax outputs are read-only,
             # and admission writes these slots in place next step
@@ -1717,7 +2069,8 @@ class ServingEngine:
                         sl // self.config.block_size > req.reg_state[0]:
                     req.reg_state = self.cache.register_prefix(
                         self._chain_ids(req, base, sl), req.blocks, sl,
-                        req.reg_state, base=base, tenant=req.tenant)
+                        req.reg_state, base=base, tenant=req.tenant,
+                        namespace=req.adapter_id)
             self._stats["chunks"] += 1
             self._sched.retire_finished()
         self._stats["steps"] += 1
@@ -1838,7 +2191,9 @@ class ServingEngine:
                 "kv_pool_shard_bytes": self.cache.kv_bytes(per_shard=True),
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2),
                 "offload": (self.cache.offload.stats()
-                            if self.cache.offload is not None else None)}
+                            if self.cache.offload is not None else None),
+                "lora": (self._lora.stats()
+                         if self._lora is not None else None)}
 
     def health_snapshot(self) -> Dict[str, Any]:
         """One JSON-serializable health/ops record (docs/OPS.md): overall
@@ -1935,6 +2290,14 @@ class ServingEngine:
                    {"capacity": 0, "blocks": 0, "swap_outs": 0,
                     "swap_ins": 0, "tier_hits": 0, "tier_misses": 0,
                     "corrupt_drops": 0, "tier_evictions": 0}),
+            },
+            "lora": {
+                "enabled": self._lora is not None,
+                **(self._lora.snapshot() if self._lora is not None else
+                   {"rank": 0, "slots": 0, "resident": [],
+                    "adapters_registered": 0, "adapters_resident": 0,
+                    "adapter_loads": 0, "adapter_evictions": 0,
+                    "adapter_pins": 0}),
             },
             "watchdog": {
                 "installed": wd is not None,

@@ -13,7 +13,7 @@ emergency saves), specialized to the serving lifecycle:
   mid-write, ``torn_journal_tail`` chaos) truncates cleanly at the last
   good frame instead of poisoning recovery. Three event kinds mirror the
   request lifecycle: ``submit`` (the FULL resolved record — prompt,
-  budget, sampling knobs, tenant/priority/deadline — exactly what
+  budget, sampling knobs, tenant/priority/deadline/adapter — exactly what
   ``resubmit()`` needs), ``tok`` (the delivered-token cursor: the newly
   emitted token ids, logged under the engine lock at the step boundary
   that delivers them), and ``end`` (terminal transition: finished /
@@ -97,6 +97,7 @@ class JournalRecord:
     tenant: str = "default"
     priority: int = 0
     deadline: Optional[float] = None
+    adapter_id: Optional[str] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     state: str = LIVE
 
@@ -311,6 +312,7 @@ class RequestJournal:
                    top_k: Optional[int], top_p: Optional[float],
                    seed: int, tenant: str, priority: int,
                    deadline: Optional[float],
+                   adapter_id: Optional[str] = None,
                    tokens: Iterable[int] = ()) -> int:
         """Journal a newly admitted request's RESOLVED record; returns its
         journal-global jid. ``tokens`` seeds the delivered cursor for a
@@ -330,6 +332,8 @@ class RequestJournal:
                 "seed": int(seed), "tenant": str(tenant),
                 "priority": int(priority),
                 "deadline": None if deadline is None else float(deadline),
+                "adapter_id": (None if adapter_id is None
+                               else str(adapter_id)),
                 "tokens": [int(t) for t in tokens],
             })
             # admission is a durability point of its own: submit() acks

@@ -55,7 +55,7 @@ __all__ = ["BlockManager", "PagedKVCache", "prefix_block_chain"]
 
 def prefix_block_chain(ids: Sequence[int], block_size: int, upto: int,
                        start: int = 0, prev_key: Optional[int] = None,
-                       base: int = 0):
+                       base: int = 0, namespace: Optional[str] = None):
     """Yield ``(key, tokens)`` for the FULL blocks ``start .. upto //
     block_size`` of a sequence — the ONE definition of the chained content
     key (lookup, registration and incremental resumption all walk this,
@@ -70,8 +70,18 @@ def prefix_block_chain(ids: Sequence[int], block_size: int, upto: int,
     cost. ``ids`` is indexed relative to ``base`` (``ids[i * block_size -
     base]`` is block ``i``'s first token), letting callers pass only the
     not-yet-registered tail instead of rebuilding the whole chain.
+
+    ``namespace`` seeds the chain root (ISSUE 19): KV written under a
+    LoRA adapter differs from base KV for the same tokens (the k/v
+    projections carry the adapter delta), so each adapter hashes in its
+    own disjoint key space — a base-cached block can never prefix-hit an
+    adapter request or vice versa. ``None`` (base traffic) leaves the
+    seed untouched, so every pre-LoRA key — including fleet directory
+    entries and host-tier registrations — is bit-identical to before.
     """
     h = prev_key
+    if h is None and namespace is not None:
+        h = hash(("adapter-ns", namespace))
     for i in range(start, int(upto) // block_size):
         lo = i * block_size - base
         toks = tuple(int(t) for t in ids[lo:lo + block_size])
@@ -397,7 +407,8 @@ class PagedKVCache:
     # ---- admission ---------------------------------------------------------
 
     def admit(self, ids: np.ndarray,
-              reserve_kv: Optional[int] = None
+              reserve_kv: Optional[int] = None,
+              namespace: Optional[str] = None
               ) -> Optional[Tuple[List[int], int, Tuple[int, Optional[int]]]]:
         """Map + allocate blocks for a sequence entering prefill.
 
@@ -409,7 +420,10 @@ class PagedKVCache:
         prefill — the next-token logits have to come from somewhere); only
         the remainder is allocated. ``reserve_kv`` switches to the legacy
         worst-case reservation (allocate the full ``prompt + max_new - 1``
-        footprint now — the ``preempt=False`` mode). Returns ``(blocks,
+        footprint now — the ``preempt=False`` mode). ``namespace``
+        (ISSUE 19) is the request's adapter id — it seeds the content
+        chain so adapter KV and base KV never cross-hit (see
+        :func:`prefix_block_chain`). Returns ``(blocks,
         hit_tokens, reg_state)`` — ``reg_state`` seeds
         :meth:`register_prefix` at the hit boundary so later registration
         never re-hashes the hit chain — or None when the pool can't cover
@@ -430,7 +444,8 @@ class PagedKVCache:
             # a host-tier restore's alloc (which may itself LRU-evict) can
             # never evict a block we are about to map
             for key, toks in prefix_block_chain(ids, self.block_size,
-                                                len(ids) - 1):
+                                                len(ids) - 1,
+                                                namespace=namespace):
                 b = self.manager.lookup(key, toks)
                 if b is not None:
                     self.manager.share(b)
@@ -479,7 +494,8 @@ class PagedKVCache:
 
     def register_prefix(self, ids, blocks: List[int], upto: int,
                         state: Tuple[int, Optional[int]] = (0, None),
-                        base: int = 0, tenant: Optional[str] = None
+                        base: int = 0, tenant: Optional[str] = None,
+                        namespace: Optional[str] = None
                         ) -> Tuple[int, Optional[int]]:
         """Register the full blocks covering KV entries ``[..upto)`` (those
         the device has finished writing) in the prefix cache,
@@ -496,7 +512,8 @@ class PagedKVCache:
             return state
         n, h = state
         for key, toks in prefix_block_chain(ids, self.block_size, upto,
-                                            start=n, prev_key=h, base=base):
+                                            start=n, prev_key=h, base=base,
+                                            namespace=namespace):
             self.manager.register(key, blocks[n], toks, tenant=tenant)
             n, h = n + 1, key
         return (n, h)

@@ -131,6 +131,11 @@ ROUTER_HEALTH_FIELDS = {
                 "(prefill->decode adoptions, recomputed_tokens == 0) / "
                 "handoff_fallbacks (handoffs that collapsed to "
                 "decoding in place on the prefill replica) / "
+                "adapter_affinity_hits (adapter submits routed to a "
+                "replica already holding the adapter device-resident; "
+                "ISSUE 19) / adapter_loads (adapter submits that had "
+                "to fault the adapter in somewhere — a thrashing "
+                "signal when it grows with steady traffic) / "
                 "completed / failed "
                 "(failed MUST stay 0 across a rolling restart)",
     "directory": "fleet cache directory snapshot: entries / adds / "
@@ -266,6 +271,9 @@ class RouterRequest:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     seed: int = 0
+    adapter_id: Optional[str] = None  # LoRA adapter (ISSUE 19): failover/
+    #                                   hedge copies re-select it, so the
+    #                                   copies stay interchangeable
     replica: int = -1                 # current primary replica rid
     srid: int = -1                    # supervisor rid on that replica
     jid: int = -1                     # journal record id (ISSUE 18);
@@ -309,7 +317,7 @@ class ServingRouter:
     def __init__(self, params, model_config, serving_config=None,
                  gen_config=None, router_config: Optional[RouterConfig]
                  = None, replicas: Optional[int] = None, programs=None,
-                 journal="unset"):
+                 journal="unset", embed_model=None):
         from .engine import ServingConfig
         self.config = router_config or RouterConfig(replicas=replicas)
         if replicas is not None and router_config is not None:
@@ -318,6 +326,12 @@ class ServingRouter:
         self._model_config = model_config
         self._serving_config = serving_config or ServingConfig()
         self._gen_config = gen_config
+        self._embed_model = embed_model
+        # multi-adapter LoRA (ISSUE 19): the fleet-wide adapter registry
+        # — register_adapter fans out to every replica, and every spawn/
+        # rebuild re-registers from here so the whole fleet always serves
+        # the same adapter set
+        self._adapter_registry: Dict[str, Any] = {}
         self._programs = programs
         self._lock = threading.RLock()
         self._rng = random.Random(self.config.seed)
@@ -373,6 +387,10 @@ class ServingRouter:
         self.prefill_routed = 0        # long prompts onto the prefill pool
         self.prefill_handoffs = 0      # prefill->decode adoptions (0 rcmp)
         self.handoff_fallbacks = 0     # collapsed to decoding in place
+        self.adapter_affinity_hits = 0  # routed to a replica already
+        #                                 holding the adapter resident
+        self.adapter_loads = 0         # routed where the adapter was NOT
+        #                                resident (the pick faults it in)
         self.completed = 0
         self.failed = 0                # router-terminal FAILED (no replica)
         self.cold_recovered = 0        # requests resubmitted by cold_start
@@ -400,11 +418,70 @@ class ServingRouter:
         sup = EngineSupervisor(self._params, self._model_config,
                                self._serving_config, self._gen_config,
                                programs=self._programs,
-                               journal=self._journal)
+                               journal=self._journal,
+                               embed_model=self._embed_model)
         # EVERY replica shares the first one's compiled programs: a fleet
         # costs one compile total, and the flat trace counter proves it
         self._programs = sup.engine.programs
+        for name, aparams in self._adapter_registry.items():
+            sup.register_adapter(name, aparams)
         return sup
+
+    # ---- multi-adapter LoRA + embeddings (ISSUE 19) --------------------------
+
+    def register_adapter(self, name: str, adapter_params) -> None:
+        """Register one LoRA adapter FLEET-WIDE: every current replica
+        (decode and prefill pools alike) registers it now, and every
+        future spawn/rebuild re-registers it from the router's registry
+        — a request carrying ``adapter_id`` can then land anywhere a
+        failover or hedge takes it."""
+        with self._lock:
+            for rep in self._replicas.values():
+                rep.sup.register_adapter(name, adapter_params)
+            self._adapter_registry[str(name)] = adapter_params
+
+    def adapter_registered(self, name: str) -> bool:
+        with self._lock:
+            return str(name) in self._adapter_registry
+
+    def embed(self, prompts: Sequence, tenant: Optional[str] = None,
+              priority: int = 0) -> np.ndarray:
+        """Pooled sentence embeddings for ``prompts`` — the prefill-only
+        request kind, routed to one healthy replica and pumped to
+        completion (embedding batches retire inside the admitting step,
+        so this returns after at most a few fleet steps). Returns
+        ``[len(prompts), hidden]`` fp32 rows in submission order.
+        Embeddings are stateless and unjournaled: a crash mid-batch
+        raises and the client simply retries."""
+        with self._lock:
+            if self._drain_requested or self.draining or self.closed:
+                raise ServingUnavailable(
+                    "router draining: admissions stopped fleet-wide",
+                    reason="draining", retry_after_s=self._retry_after())
+            cands = self._candidates()
+            if not cands:
+                raise ServingUnavailable(
+                    "no routable replica for embeddings",
+                    reason="no_replica",
+                    retry_after_s=self._retry_after())
+            rep = (cands[0] if len(cands) == 1
+                   else min(self._rng.sample(cands, 2),
+                            key=lambda r: r.probe_depth))
+            erids = [rep.sup.submit_embedding(p, tenant=tenant,
+                                              priority=priority)
+                     for p in prompts]
+            self.routed += len(erids)
+        for _ in range(64):
+            with self._lock:
+                if all(rep.sup.embedding(e) is not None for e in erids):
+                    break
+                rep.sup.step()
+        with self._lock:
+            rows = [rep.sup.embedding(e) for e in erids]
+        if any(r is None for r in rows):
+            raise RuntimeError("embedding batch did not complete "
+                               "(replica crashed mid-batch; retry)")
+        return np.stack(rows)
 
     # ---- durable cold-restart recovery (ISSUE 18) ---------------------------
 
@@ -417,7 +494,9 @@ class ServingRouter:
                    serving_config=None, gen_config=None,
                    router_config: Optional[RouterConfig] = None,
                    replicas: Optional[int] = None, programs=None,
-                   journal: Optional[RequestJournal] = None
+                   journal: Optional[RequestJournal] = None,
+                   embed_model=None,
+                   adapters: Optional[Dict[str, Any]] = None
                    ) -> "ServingRouter":
         """Rebuild the fleet after a FULL process death from the shared
         journal directory: spawn fresh replicas, then for every journal
@@ -433,7 +512,9 @@ class ServingRouter:
         j = journal if journal is not None else RequestJournal(journal_dir)
         router = cls(params, model_config, serving_config, gen_config,
                      router_config, replicas=replicas, programs=programs,
-                     journal=j)
+                     journal=j, embed_model=embed_model)
+        for name, aparams in (adapters or {}).items():
+            router.register_adapter(name, aparams)
         router._restore_from_journal()
         return router
 
@@ -453,7 +534,8 @@ class ServingRouter:
                     eos_token_id=rec.eos_token_id, tenant=rec.tenant,
                     priority=rec.priority, deadline=rec.deadline,
                     temperature=rec.temperature, top_k=rec.top_k,
-                    top_p=rec.top_p, seed=rec.seed, jid=jid,
+                    top_p=rec.top_p, seed=rec.seed,
+                    adapter_id=rec.adapter_id, jid=jid,
                     submit_t=now)
                 req.tokens = [int(t) for t in rec.tokens]
                 self._next_frid += 1
@@ -488,7 +570,8 @@ class ServingRouter:
                             priority=req.priority,
                             temperature=req.temperature,
                             top_k=req.top_k, top_p=req.top_p,
-                            seed=req.seed, jid=jid)
+                            seed=req.seed, jid=jid,
+                            adapter_id=req.adapter_id)
                     except Exception:  # noqa: BLE001 — raced a drain
                         continue
                     self._routes[rep.rid][srid] = req.frid
@@ -692,18 +775,25 @@ class ServingRouter:
             return None
         return hash((tenant, prompt[:bs].tobytes()))
 
-    def _prompt_chain(self, prompt: np.ndarray) -> List[Tuple[int, tuple]]:
+    def _prompt_chain(self, prompt: np.ndarray,
+                      adapter_id: Optional[str] = None
+                      ) -> List[Tuple[int, tuple]]:
         """The prompt's full chained prefix keys — the directory lookup
         unit (every FULL block, not just the leading one: two prompts
         sharing three blocks route to the same holder even when their
-        first blocks are ubiquitous). Empty when the directory is off or
-        the prompt spans no full block."""
+        first blocks are ubiquitous). ``adapter_id`` seeds the chain
+        exactly like the engine's admit does (ISSUE 19) — adapter KV
+        lives in its own key space, so directory hits for adapter
+        traffic resolve to blocks the target admit can actually map.
+        Empty when the directory is off or the prompt spans no full
+        block."""
         if self._directory is None:
             return []
         bs = self.decode_config.block_size
         if prompt.shape[0] < bs:
             return []
-        return list(prefix_block_chain(prompt, bs, prompt.shape[0]))
+        return list(prefix_block_chain(prompt, bs, prompt.shape[0],
+                                       namespace=adapter_id))
 
     def _pull_chain(self, holder_rid: int, target: Replica,
                     chain: List[Tuple[int, tuple]]) -> int:
@@ -763,7 +853,8 @@ class ServingRouter:
                deadline_s: Optional[float] = None,
                tenant: Optional[str] = None, priority: int = 0,
                temperature="unset", top_k="unset", top_p="unset",
-               seed="unset", replica: Optional[int] = None) -> int:
+               seed="unset", replica: Optional[int] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Route one prompt to a healthy replica; returns the ROUTER
         request id. ``replica`` pins the pick (an ops/canary hook — the
         pinned replica must still be routable). Raises
@@ -775,6 +866,12 @@ class ServingRouter:
                 raise ServingUnavailable(
                     "router draining: admissions stopped fleet-wide",
                     reason="draining", retry_after_s=self._retry_after())
+            if adapter_id is not None \
+                    and str(adapter_id) not in self._adapter_registry:
+                raise ValueError(
+                    f"adapter {adapter_id!r} is not registered with this "
+                    f"router (register_adapter first; registered: "
+                    f"{sorted(self._adapter_registry)})")
             now = time.time()
             cands = self._candidates(now=now)
             if not cands:
@@ -799,7 +896,8 @@ class ServingRouter:
                     retry_after_s=self._retry_after())
             p = np.asarray(prompt, np.int32).reshape(-1)
             key = self._affinity_key(p, tenant)
-            chain = self._prompt_chain(p)
+            chain = self._prompt_chain(
+                p, None if adapter_id is None else str(adapter_id))
             holder_rid, depth = (None, 0)
             if chain and self._directory is not None:
                 holder_rid, depth = self._directory.longest(
@@ -829,6 +927,20 @@ class ServingRouter:
                     pick = (prefill_cands[0] if len(prefill_cands) == 1
                             else min(self._rng.sample(prefill_cands, 2),
                                      key=lambda r: r.probe_depth))
+            if pick is None and adapter_id is not None and cands:
+                # adapter affinity: a replica already holding the adapter
+                # RESIDENT serves it without an H2D load; with none, the
+                # P2C pick below faults it in (counted — the ops signal
+                # for an adapter set that thrashes the pools)
+                resident = [r for r in cands
+                            if r.sup.adapter_resident(adapter_id)]
+                if resident:
+                    pick = (resident[0] if len(resident) == 1
+                            else min(self._rng.sample(resident, 2),
+                                     key=lambda r: r.probe_depth))
+                    self.adapter_affinity_hits += 1
+                else:
+                    self.adapter_loads += 1
             if pick is None:
                 pick = self._pick(cands, key)
             if holder_rid is not None and chain \
@@ -846,7 +958,8 @@ class ServingRouter:
                         eos_token_id=eos_token_id, timeout_s=timeout_s,
                         deadline_s=deadline_s, tenant=tenant,
                         priority=priority, temperature=temperature,
-                        top_k=top_k, top_p=top_p, seed=seed)
+                        top_k=top_k, top_p=top_p, seed=seed,
+                        adapter_id=adapter_id)
                     rep.breaker.record_success()
                     break
                 except ServingQueueFull as e:   # full: try the next pick
@@ -864,6 +977,7 @@ class ServingRouter:
                 priority=rec.priority, deadline=rec.deadline,
                 temperature=rec.temperature, top_k=rec.top_k,
                 top_p=rec.top_p, seed=rec.seed,
+                adapter_id=rec.adapter_id,
                 replica=rep.rid, srid=srid, jid=rec.jid,
                 affinity_key=key, submit_t=now)
             req.prefill_stage = (rep.role == "prefill")
@@ -1175,7 +1289,8 @@ class ServingRouter:
                     tenant=req.tenant, priority=req.priority,
                     temperature=req.temperature, top_k=req.top_k,
                     top_p=req.top_p, seed=req.seed,
-                    jid=req.jid if req.jid >= 0 else None)
+                    jid=req.jid if req.jid >= 0 else None,
+                    adapter_id=req.adapter_id)
             except Exception:          # noqa: BLE001 — raced a drain
                 continue
             self._routes[rep.rid][srid] = req.frid
@@ -1301,7 +1416,8 @@ class ServingRouter:
                     eos_token_id=req.eos_token_id,
                     deadline_s=req.deadline, tenant=req.tenant,
                     priority=req.priority, temperature=req.temperature,
-                    top_k=req.top_k, top_p=req.top_p, seed=req.seed)
+                    top_k=req.top_k, top_p=req.top_p, seed=req.seed,
+                    adapter_id=req.adapter_id)
             except Exception:          # noqa: BLE001 — shed: retry later
                 continue
             req.hedge = (rep.rid, srid)
@@ -1688,6 +1804,8 @@ class ServingRouter:
                     "prefill_routed": self.prefill_routed,
                     "prefill_handoffs": self.prefill_handoffs,
                     "handoff_fallbacks": self.handoff_fallbacks,
+                    "adapter_affinity_hits": self.adapter_affinity_hits,
+                    "adapter_loads": self.adapter_loads,
                     "completed": self.completed,
                     "failed": self.failed,
                 },

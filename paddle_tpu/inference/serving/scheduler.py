@@ -173,6 +173,20 @@ class Request:
     # migration / handoff / hedge resolution — the vacated copy is
     # DISOWNED before its cancel so the record stays live.
     jid: int = -1
+    # multi-adapter LoRA (ISSUE 19): the adapter this request decodes
+    # under (None = base traffic) and the device pool slot the engine's
+    # admission gate pinned for it (0 = the zeroed base adapter). The
+    # pin — and with it the slot — survives preemption: a readmission
+    # must find the SAME weights resident, so the adapter releases only
+    # at a terminal state.
+    adapter_id: Optional[str] = None
+    adapter_slot: int = 0
+    # embeddings endpoint (ISSUE 19): kind "embed" requests are
+    # prefill-only — they retire at prefill completion with the pooled
+    # hidden states in ``embedding`` and never occupy a decode slot or
+    # KV blocks (see Scheduler.admit_embeds)
+    kind: str = "generate"
+    embedding: Optional[np.ndarray] = None
 
     @property
     def prompt_len(self) -> int:
@@ -190,6 +204,8 @@ class Request:
 
     @property
     def finished(self) -> bool:
+        if self.kind == "embed":
+            return self.embedding is not None
         return self.eos_seen or self.remaining <= 0 or self.oom_truncated
 
     @property
@@ -407,29 +423,33 @@ class Scheduler:
                 retry_after_s=ra)
         # fail fast on requests the pool can NEVER hold (vs transiently
         # full); the bound is KV entries, not blocks — block granularity
-        # would admit up to block_size-1 entries past max_model_len
-        if req.kv_tokens > self.cache.max_model_len:
-            raise ValueError(
-                f"request needs {req.kv_tokens} KV entries "
-                f"(prompt {req.prompt_len} + {req.max_new_tokens} new) > "
-                f"max_model_len {self.cache.max_model_len}")
-        usable = self.cache.manager.num_blocks - 1      # block 0 is null
-        if self.preempt_enabled:
-            # on-demand: only the PROMPT must fit the pool (a max_new worst
-            # case is a budget, not a charge — EOS usually lands first, and
-            # a genuinely over-budget sole survivor is truncated, not hung)
-            n = self.cache.manager.blocks_for(req.prompt_len)
-            what = f"prompt ({req.prompt_len} tokens)"
-        else:
-            # reservation mode admits only full worst-case footprints
-            n = self.cache.manager.blocks_for(req.kv_tokens)
-            what = f"worst case ({req.kv_tokens} KV entries)"
-        if n > usable:
-            raise ValueError(
-                f"request {what} needs {n} KV blocks but the pool only has "
-                f"{usable} usable blocks (num_blocks="
-                f"{self.cache.manager.num_blocks} incl. the null block); "
-                f"admitting it would wait forever")
+        # would admit up to block_size-1 entries past max_model_len.
+        # Embedding requests (ISSUE 19) bypass both: they run through the
+        # encoder without KV blocks, so pool geometry cannot reject them.
+        if req.kind != "embed":
+            if req.kv_tokens > self.cache.max_model_len:
+                raise ValueError(
+                    f"request needs {req.kv_tokens} KV entries "
+                    f"(prompt {req.prompt_len} + {req.max_new_tokens} new) "
+                    f"> max_model_len {self.cache.max_model_len}")
+            usable = self.cache.manager.num_blocks - 1  # block 0 is null
+            if self.preempt_enabled:
+                # on-demand: only the PROMPT must fit the pool (a max_new
+                # worst case is a budget, not a charge — EOS usually lands
+                # first, and a genuinely over-budget sole survivor is
+                # truncated, not hung)
+                n = self.cache.manager.blocks_for(req.prompt_len)
+                what = f"prompt ({req.prompt_len} tokens)"
+            else:
+                # reservation mode admits only full worst-case footprints
+                n = self.cache.manager.blocks_for(req.kv_tokens)
+                what = f"worst case ({req.kv_tokens} KV entries)"
+            if n > usable:
+                raise ValueError(
+                    f"request {what} needs {n} KV blocks but the pool only "
+                    f"has {usable} usable blocks (num_blocks="
+                    f"{self.cache.manager.num_blocks} incl. the null "
+                    f"block); admitting it would wait forever")
         req.rid = self._next_rid
         self._next_rid += 1
         req.submit_t = time.time()
@@ -440,7 +460,7 @@ class Scheduler:
         self.queue.append(req)
         return req.rid
 
-    def next_admission(self) -> Optional[Request]:
+    def next_admission(self, gate=None) -> Optional[Request]:
         """Pop the policy's pick into a free slot if its blocks fit; None
         when nothing can be admitted this iteration. On-demand mode maps
         prefix-cache hits and allocates only the remaining prompt blocks;
@@ -448,22 +468,39 @@ class Scheduler:
         preempts running work — it waits for retirement to free blocks,
         and is head-of-line PER THE POLICY'S ORDER: when the pick's
         blocks don't fit, admission waits rather than skipping to a
-        smaller request (skipping would starve large requests)."""
-        if not self.queue:
+        smaller request (skipping would starve large requests).
+
+        ``gate`` (ISSUE 19) is the engine's adapter-pool admission hook:
+        called with the pick BEFORE any blocks are allocated, returning
+        False when the pick cannot be seated right now (its adapter has
+        no free pool slot — every slot pinned by running requests). A
+        gated-out pick is SKIPPED for this iteration only — the policy
+        re-selects among the remaining candidates, so one starved
+        adapter never head-of-line blocks base traffic or other
+        adapters — and stays queued for the next step, when a
+        retirement may have unpinned a slot."""
+        candidates = [r for r in self.queue if r.kind != "embed"]
+        while candidates:
+            if not [m for m, r in enumerate(self.slots) if r is None]:
+                return None
+            # a preempted request re-queued at the FRONT outranks any
+            # policy pick: its generated tokens are already paid for, and
+            # the no-livelock argument assumes it readmits at the next
+            # retirement
+            if candidates[0] is self.queue[0] and self.queue[0].preemptions:
+                req = candidates[0]
+            else:
+                req = self.policy.select(candidates, self, time.time())
+            if gate is None or gate(req):
+                break
+            candidates.remove(req)
+        else:
             return None
         free = [m for m, r in enumerate(self.slots) if r is None]
-        if not free:
-            return None
-        # a preempted request re-queued at the FRONT outranks any policy
-        # pick: its generated tokens are already paid for, and the
-        # no-livelock argument assumes it readmits at the next retirement
-        if self.queue[0].preemptions:
-            req = self.queue[0]
-        else:
-            req = self.policy.select(self.queue, self, time.time())
         ids = req.build_prefill_ids()
         res = self.cache.admit(
-            ids, reserve_kv=None if self.preempt_enabled else req.kv_tokens)
+            ids, reserve_kv=None if self.preempt_enabled else req.kv_tokens,
+            namespace=req.adapter_id)
         if res is None:
             return None                       # the pick waits for blocks
         blocks, hit, reg_state = res
@@ -521,6 +558,28 @@ class Scheduler:
         t["admitted"] += 1
         t["service_tokens"] += req.prompt_len
         return req.rid
+
+    def admit_embeds(self) -> List[Request]:
+        """Pop EVERY queued embedding request (``kind == "embed"``) for
+        the engine's batched encoder dispatch (ISSUE 19). Embeds need no
+        decode slot and no KV blocks, so admission is unconditional and
+        slot-free; the engine completes the whole batch — encoder
+        forward, pooled output, :meth:`finish` — inside the same locked
+        step, so no observer ever sees a RUNNING request without a slot.
+        Stamps the full admit bookkeeping so the auditor's accounting
+        closure (admitted >= retired, tenant rows) holds exactly as for
+        generate traffic."""
+        out = [r for r in self.queue if r.kind == "embed"]
+        for req in out:
+            self.queue.remove(req)
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            req.state = RUNNING
+            self.admitted += 1
+            t = self.tenant(req.tenant)
+            t["admitted"] += 1
+            t["service_tokens"] += req.prompt_len
+        return out
 
     def preempt(self, req: Request) -> None:
         """Free a RUNNING request's blocks and re-queue it at the FRONT for

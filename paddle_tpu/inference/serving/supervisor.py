@@ -103,6 +103,10 @@ class TrackedRequest:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     seed: int = 0
+    adapter_id: Optional[str] = None   # LoRA adapter (ISSUE 19); the
+    #                                    resubmission re-selects it so the
+    #                                    recovered stream runs the same
+    #                                    adapted weights
     erid: int = -1                     # rid in the CURRENT engine
     jid: int = -1                      # journal record id (ISSUE 18);
     #                                    -1 = unjournaled/disowned
@@ -197,8 +201,14 @@ class EngineSupervisor:
     def __init__(self, params, model_config, serving_config=None,
                  gen_config=None, max_restarts: Optional[int] = None,
                  drain_deadline_s: Optional[float] = None, programs=None,
-                 journal="unset"):
+                 journal="unset", embed_model=None):
         self._params = params
+        self._embed_model = embed_model
+        # LoRA adapters registered through THIS supervisor (ISSUE 19):
+        # host copies survive engine teardown, so every rebuild
+        # re-registers them and crash recovery can resubmit adapter
+        # traffic onto the fresh engine's pool
+        self._adapter_registry: Dict[str, Any] = {}
         self._model_config = model_config
         self._serving_config = serving_config
         self._gen_config = gen_config
@@ -248,10 +258,13 @@ class EngineSupervisor:
         eng = ServingEngine(self._params, self._model_config,
                             self._serving_config, self._gen_config,
                             programs=self._programs,
-                            journal=self._journal)
+                            journal=self._journal,
+                            embed_model=self._embed_model)
         # reuse the first engine's compiled programs on every rebuild:
         # restart must never pay a recompile (EnginePrograms docstring)
         self._programs = eng.programs
+        for name, aparams in self._adapter_registry.items():
+            eng.register_adapter(name, aparams)
         return eng
 
     # ---- admission ---------------------------------------------------------
@@ -287,7 +300,7 @@ class EngineSupervisor:
                deadline_s: Optional[float] = None,
                tenant: Optional[str] = None, priority: int = 0,
                temperature="unset", top_k="unset", top_p="unset",
-               seed="unset") -> int:
+               seed="unset", adapter_id: Optional[str] = None) -> int:
         """Queue one prompt; returns the SUPERVISOR request id (stable
         across engine restarts). Sampling knobs pass through to
         :meth:`ServingEngine.submit` (resolved once there — the tracked
@@ -303,7 +316,7 @@ class EngineSupervisor:
                 eos_token_id=eos_token_id, timeout_s=timeout_s,
                 deadline_s=deadline_s, tenant=tenant, priority=priority,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed)
+                seed=seed, adapter_id=adapter_id)
             return self._track(erid).srid
 
     def _track(self, erid: int, resubmits: int = 0) -> TrackedRequest:
@@ -319,7 +332,8 @@ class EngineSupervisor:
             eos_token_id=req.eos_token_id, tenant=req.tenant,
             priority=req.priority, deadline=req.deadline,
             temperature=req.temperature, top_k=req.top_k,
-            top_p=req.top_p, seed=req.seed, erid=erid, jid=req.jid)
+            top_p=req.top_p, seed=req.seed,
+            adapter_id=req.adapter_id, erid=erid, jid=req.jid)
         rec.tokens = [int(t) for t in req.tokens]
         rec.resubmits = resubmits
         self._next_srid += 1
@@ -347,7 +361,8 @@ class EngineSupervisor:
                  deadline: Optional[float] = None,
                  tenant: Optional[str] = None, priority: int = 0,
                  temperature="unset", top_k="unset", top_p="unset",
-                 seed="unset", jid: Optional[int] = None) -> int:
+                 seed="unset", jid: Optional[int] = None,
+                 adapter_id: Optional[str] = None) -> int:
         """ADOPT a request recovered from another replica (the router's
         cross-replica failover): queue it with the tokens the client has
         already been delivered, riding :meth:`ServingEngine.resubmit`'s
@@ -362,7 +377,8 @@ class EngineSupervisor:
                 prompt, tokens, max_new_tokens=max_new_tokens,
                 eos_token_id=eos_token_id, deadline=deadline,
                 tenant=tenant, priority=priority, temperature=temperature,
-                top_k=top_k, top_p=top_p, seed=seed, jid=jid)
+                top_k=top_k, top_p=top_p, seed=seed, jid=jid,
+                adapter_id=adapter_id)
             rec = self._track(erid, resubmits=1)    # born from a failover
             self.adopted += 1
             self.recovered_tokens += len(rec.tokens)
@@ -379,7 +395,8 @@ class EngineSupervisor:
                 serving_config=None, gen_config=None,
                 max_restarts: Optional[int] = None,
                 drain_deadline_s: Optional[float] = None, programs=None,
-                journal: Optional[RequestJournal] = None
+                journal: Optional[RequestJournal] = None,
+                embed_model=None, adapters: Optional[Dict[str, Any]] = None
                 ) -> "EngineSupervisor":
         """Rebuild a replica after a FULL process death from its journal
         directory: open the journal (newest good snapshot + WAL suffix,
@@ -396,7 +413,9 @@ class EngineSupervisor:
         sup = cls(params, model_config, serving_config, gen_config,
                   max_restarts=max_restarts,
                   drain_deadline_s=drain_deadline_s, programs=programs,
-                  journal=j)
+                  journal=j, embed_model=embed_model)
+        for name, aparams in (adapters or {}).items():
+            sup.register_adapter(name, aparams)
         sup._restore_from_journal()
         return sup
 
@@ -415,7 +434,8 @@ class EngineSupervisor:
                     eos_token_id=rec.eos_token_id, tenant=rec.tenant,
                     priority=rec.priority, deadline=rec.deadline,
                     temperature=rec.temperature, top_k=rec.top_k,
-                    top_p=rec.top_p, seed=rec.seed, jid=jid)
+                    top_p=rec.top_p, seed=rec.seed,
+                    adapter_id=rec.adapter_id, jid=jid)
                 tr.tokens = [int(t) for t in rec.tokens]
                 self._next_srid += 1
                 self._reqs[tr.srid] = tr
@@ -435,13 +455,28 @@ class EngineSupervisor:
                     self.completed += 1
                     j.log_terminal(jid, FINISHED)
                     continue
+                if (tr.adapter_id is not None
+                        and not self.engine.adapter_registered(
+                            tr.adapter_id)):
+                    # the journal outlived the adapter registry (weights
+                    # live OUTSIDE the journal by design): fail the
+                    # record readably instead of poisoning recovery
+                    tr.state = FAILED
+                    tr.finish = {"state": FAILED,
+                                 "tokens": len(tr.tokens),
+                                 "reason": (f"adapter {tr.adapter_id!r} "
+                                            f"not registered at recovery"),
+                                 "recovered": True, "resubmits": 0}
+                    j.log_terminal(jid, FAILED)
+                    continue
                 tr.erid = self.engine.resubmit(
                     tr.prompt, tr.tokens,
                     max_new_tokens=tr.max_new_tokens,
                     eos_token_id=tr.eos_token_id, deadline=tr.deadline,
                     tenant=tr.tenant, priority=tr.priority,
                     temperature=tr.temperature, top_k=tr.top_k,
-                    top_p=tr.top_p, seed=tr.seed, jid=jid)
+                    top_p=tr.top_p, seed=tr.seed, jid=jid,
+                    adapter_id=tr.adapter_id)
                 tr.state = QUEUED
                 tr.resubmits = 1
                 self.resubmitted += 1
@@ -554,6 +589,59 @@ class EngineSupervisor:
             if rec.finish is not None:
                 rec.finish["migrated"] = True
             return not already
+
+    # ---- multi-adapter LoRA + embeddings (ISSUE 19) ------------------------
+
+    def register_adapter(self, name: str, adapter_params) -> None:
+        """Register a LoRA adapter on the live engine AND in the
+        supervisor's host registry, so every crash rebuild re-registers
+        it (weights survive the engine; residency/pins do not — a
+        recovered request re-faults its adapter in through the pool's
+        normal load path)."""
+        with self._lock:
+            self.engine.register_adapter(name, adapter_params)
+            self._adapter_registry[str(name)] = adapter_params
+
+    def adapter_registered(self, name: str) -> bool:
+        with self._lock:
+            return self.engine.adapter_registered(name)
+
+    def adapter_resident(self, name: str) -> bool:
+        """Device residency of one adapter — the router's affinity
+        signal (False on a broken replica: nothing is resident)."""
+        with self._lock:
+            if self.broken:
+                return False
+            return self.engine.adapter_resident(name)
+
+    def adapter_partition(self):
+        with self._lock:
+            return self.engine.adapter_partition()
+
+    def submit_embedding(self, prompt, timeout_s: Optional[float] = None,
+                         deadline_s: Optional[float] = None,
+                         tenant: Optional[str] = None,
+                         priority: int = 0) -> int:
+        """Queue a prefill-only embedding request; returns the ENGINE
+        rid (embeddings are stateless and unjournaled — they retire
+        within the admitting step, so the supervisor does not track
+        them; a crash mid-batch simply drops them and the client
+        retries)."""
+        with self._lock:
+            self._check_admitting()
+            return self.engine.submit_embedding(
+                prompt, timeout_s=timeout_s, deadline_s=deadline_s,
+                tenant=tenant, priority=priority)
+
+    def embedding(self, erid: int):
+        """Pooled embedding row, or ``None`` while the request is still
+        queued/in-flight (the engine raises KeyError until it retires —
+        the router polls this against ``is not None``)."""
+        with self._lock:
+            try:
+                return self.engine.embedding(erid)
+            except KeyError:
+                return None
 
     def depth(self) -> int:
         """Queued + live requests on this replica — the router's
@@ -711,7 +799,8 @@ class EngineSupervisor:
                 eos_token_id=rec.eos_token_id, deadline=rec.deadline,
                 tenant=rec.tenant, priority=rec.priority,
                 temperature=rec.temperature, top_k=rec.top_k,
-                top_p=rec.top_p, seed=rec.seed, jid=rec.jid)
+                top_p=rec.top_p, seed=rec.seed, jid=rec.jid,
+                adapter_id=rec.adapter_id)
             rec.resubmits += 1
             rec.state = QUEUED
             self.resubmitted += 1

@@ -9,8 +9,10 @@ closes that gap with three composable pieces:
 * **Deterministic workload generator.** :class:`WorkloadSpec` +
   :func:`generate_trace` emit a reproducible request stream keyed to
   engine-STEP indices (never wall-clock): diurnal/bursty arrival curves,
-  Zipf-skewed tenants, shared-prefix prompt families (exercising the
-  prefix cache and the router's prefix affinity), mixed greedy/sampled
+  Zipf-skewed tenants, Zipf-skewed multi-adapter LoRA mixes (a few hot
+  adapters + a cold tail, exercising the paged adapter pool and the
+  router's adapter affinity), shared-prefix prompt families (exercising
+  the prefix cache and the router's prefix affinity), mixed greedy/sampled
   knobs, priorities and client-side deadlines, and client misbehavior —
   cancels, disconnect-mid-stream, abandoned streams, and duplicate
   retries after a 429/503 that BACK OFF by the returned
@@ -92,6 +94,16 @@ class WorkloadSpec:
     deadline_steps: Tuple[int, ...] = (60, 120, 240)
     # ---- client misbehavior ----
     misbehavior_frac: float = 0.08    # cancel / disconnect / abandon
+    # ---- multi-adapter LoRA mix (ISSUE 19) ----
+    # adapters=0 keeps the trace base-only AND rng-draw free: every
+    # previously generated seed keeps its byte-identical trace. With
+    # adapters>0 a Zipf-skewed adapter population rides the stream —
+    # a few hot adapters dominating (the S-LoRA locality the router's
+    # adapter affinity exploits) with a long cold tail (the churn the
+    # device pool's LRU absorbs).
+    adapters: int = 0                 # distinct adapters ("lora0"..)
+    adapter_frac: float = 0.75        # requests carrying an adapter_id
+    adapter_zipf_alpha: float = 1.2   # hot-adapter skew
     # ---- 429/503 retry policy ----
     # "fixed": back off retry_backoff_steps engine steps per attempt —
     # deterministic, the replay-determinism contract's setting. "hint":
@@ -121,6 +133,8 @@ class WorkloadSpec:
         if self.retry_policy not in ("fixed", "hint", "storm"):
             raise ValueError(f"unknown retry_policy {self.retry_policy!r}"
                              " (fixed | hint | storm)")
+        if int(self.adapters) < 0:
+            raise ValueError("adapters must be >= 0 (0 = base-only)")
         if int(self.retry_backoff_steps) < 1:
             raise ValueError(
                 "retry_backoff_steps must be >= 1 (0 would re-bucket a "
@@ -157,6 +171,7 @@ class TraceRequest:
     deadline_steps: Optional[int] = None
     behavior: str = "normal"          # normal | cancel | disconnect | abandon
     behavior_at: int = 0              # delivered tokens before it fires
+    adapter_id: Optional[str] = None  # None = base-model traffic
 
 
 def _arrival_weights(spec: WorkloadSpec, rng) -> np.ndarray:
@@ -195,6 +210,11 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
                 for _ in range(max(1, spec.families))]
     fam_w = 1.0 / np.power(np.arange(1, len(prefixes) + 1), spec.zipf_alpha)
     fam_w /= fam_w.sum()
+    ad_w = None
+    if spec.adapters > 0:
+        ad_w = 1.0 / np.power(np.arange(1, spec.adapters + 1),
+                              spec.adapter_zipf_alpha)
+        ad_w /= ad_w.sum()
     out: List[TraceRequest] = []
     for tid in range(spec.requests):
         tenant = f"t{int(rng.choice(spec.tenants, p=zipf))}"
@@ -226,6 +246,11 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
             tr.behavior = str(rng.choice(["cancel", "disconnect",
                                           "abandon"]))
             tr.behavior_at = int(rng.integers(1, 4))
+        # gated LAST so adapters=0 specs draw nothing here and every
+        # previously generated seed keeps its byte-identical trace
+        if spec.adapters > 0 and rng.random() < spec.adapter_frac:
+            tr.adapter_id = \
+                f"lora{int(rng.choice(spec.adapters, p=ad_w))}"
         out.append(tr)
     return out
 
@@ -396,7 +421,14 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
 
     own_router = router is None
     if own_router:
-        serving_config = serving_config or ServingConfig()
+        if serving_config is None:
+            # a LoRA-mixed trace needs an adapter pool; size the device
+            # slots BELOW the adapter population so the replay exercises
+            # LRU eviction + reload under traffic, not just residency
+            serving_config = ServingConfig(
+                lora_slots=max(2, (spec.adapters + 1) // 2),
+                lora_pool=max(16, spec.adapters)) \
+                if spec.adapters > 0 else ServingConfig()
         if router_config is None:
             # deterministic fleet defaults: hedging off (wall-clock
             # race), breaker cooldown 0 (an opened breaker half-open
@@ -413,6 +445,19 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                                router_config=router_config,
                                programs=programs)
     tp = int(router.decode_config.tp)
+    if spec.adapters > 0:
+        # the trace's adapter population, seeded off the spec so a
+        # replay regenerates identical adapter weights; scale well above
+        # init-noise so adapter outputs genuinely diverge from base
+        from ...models.lora import lora_init_params
+        rank = int(router.decode_config.lora_rank)
+        for i in range(int(spec.adapters)):
+            name = f"lora{i}"
+            if not router.adapter_registered(name):
+                router.register_adapter(
+                    name, lora_init_params(model_config, rank,
+                                           seed=int(spec.seed) * 1000 + i,
+                                           scale=0.5))
     if fresh_manifest:
         # capture AFTER the router exists: the manifest records the
         # resolved configs + starting fleet size actually in force
@@ -457,7 +502,8 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                 tr.prompt, max_new_tokens=tr.max_new_tokens,
                 eos_token_id=tr.eos_token_id, tenant=tr.tenant,
                 priority=tr.priority, temperature=tr.temperature,
-                top_k=tr.top_k, top_p=tr.top_p, seed=tr.seed)
+                top_k=tr.top_k, top_p=tr.top_p, seed=tr.seed,
+                adapter_id=tr.adapter_id)
         except (ServingQueueFull, ServingUnavailable) as e:
             shed_submits += 1
             if cl.attempts >= spec.max_attempts:
@@ -574,6 +620,18 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
                 # pull-checksum path and must not count as fired
                 timeline.log(step, ev.name,
                              "skipped: directory off or empty")
+        elif ev.name == "adapter_churn":
+            if not adoptable:
+                timeline.log(step, ev.name, "skipped: none healthy")
+                return
+            rid = min(adoptable)
+            res = _chaos.adapter_churn(router, rid=rid, **ev.kwargs)
+            if res["enabled"]:
+                timeline.log(step, ev.name, res)
+            else:
+                # no pool / nothing registered: nothing to churn
+                timeline.log(step, ev.name,
+                             "skipped: multi-adapter serving off")
         elif ev.name == "disconnect_mid_stream":
             # logged when a live stream is ACTUALLY cut (or as skipped
             # at quiesce if none ever was) — an armed-but-never-fired
@@ -826,6 +884,10 @@ def run_replay(params, model_config, spec: Optional[WorkloadSpec] = None,
         "audit": auditor.digest(),
         "audit_trail": list(auditor.trail),
         "router_failed": int(router.failed),
+        "adapter_requests": sum(1 for c in clients
+                                if c.tr.adapter_id is not None),
+        "adapter_affinity_hits": int(router.adapter_affinity_hits),
+        "adapter_loads": int(router.adapter_loads),
         "leaked_blocks": sum(p["in_use"] for p in
                              router.block_partitions().values()),
         "prompt_len_mean": round(float(np.mean(prompt_lens)), 2),

@@ -19,7 +19,7 @@ from ..nn import (Dropout, Embedding, LayerNorm, Linear, Tanh,
 from ..nn.layer import Layer
 
 __all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
-           "BertForPretraining"]
+           "BertForPretraining", "bert_init_params", "bert_encode"]
 
 
 @dataclasses.dataclass
@@ -123,6 +123,107 @@ class BertForSequenceClassification(Layer):
             from ..nn import functional as F
             return F.cross_entropy(logits, labels), logits
         return logits
+
+
+# ---------------------------------------------------------------------------
+# functional JAX encoder — the serving engine's EMBEDDINGS model (ISSUE 19)
+# ---------------------------------------------------------------------------
+#
+# The eager Layer classes above are the fine-tune benchmark surface; the
+# serving engine's prefill-only embeddings endpoint needs the same shape in
+# the engine's idiom instead: a pure (params, ids, lengths) -> pooled [B, E]
+# function over STACKED per-layer params (lax.scan over [L, ...] leaves,
+# exactly like the llama paged path), jitted per length bucket by
+# ``ServingEngine``. Post-norm BERT blocks, bidirectional length-masked
+# attention, first-token tanh pooler — ``BertModel``'s semantics, minus
+# dropout (inference) and token-type embeddings (single-segment requests).
+
+def bert_init_params(cfg: BertConfig, seed: int = 0):
+    """Random stacked encoder params (fp32 jnp pytree): embeddings
+    (word + position + LayerNorm), ``num_hidden_layers`` stacked
+    transformer blocks, and the pooler dense."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(seed)
+    E, I = cfg.hidden_size, cfg.intermediate_size
+    L = cfg.num_hidden_layers
+
+    def w(*shape, scale=0.02):
+        return jnp.asarray(rng.standard_normal(shape) * scale, jnp.float32)
+
+    def ones(*shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, jnp.float32)
+
+    return {
+        "embed": w(cfg.vocab_size, E),
+        "pos_embed": w(cfg.max_position_embeddings, E),
+        "ln_embed_w": ones(E), "ln_embed_b": zeros(E),
+        "layers": {
+            "wq": w(L, E, E), "bq": zeros(L, E),
+            "wk": w(L, E, E), "bk": zeros(L, E),
+            "wv": w(L, E, E), "bv": zeros(L, E),
+            "wo": w(L, E, E), "bo": zeros(L, E),
+            "ln_attn_w": ones(L, E), "ln_attn_b": zeros(L, E),
+            "w_in": w(L, E, I), "b_in": zeros(L, I),
+            "w_out": w(L, I, E), "b_out": zeros(L, E),
+            "ln_mlp_w": ones(L, E), "ln_mlp_b": zeros(L, E),
+        },
+        "pool_w": w(E, E), "pool_b": zeros(E),
+    }
+
+
+def _bert_ln(x, w, b, eps):
+    import jax.numpy as jnp
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+def bert_encode(params, cfg: BertConfig, ids, lengths):
+    """Pooled sentence embeddings for a right-padded batch: ``ids [B, S]``
+    int32, ``lengths [B]`` real token counts -> ``[B, E]`` fp32 (the
+    first-token tanh pooler, ``BertPooler``'s contract). Pure and
+    jit-friendly — the serving engine compiles one program per
+    ``(B, S)`` bucket and batches queued embedding requests into it; pad
+    rows (``lengths == 0``) attend only themselves and their pooled rows
+    are garbage the engine never reads."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    B, S = ids.shape
+    H = cfg.num_attention_heads
+    E = cfg.hidden_size
+    D = E // H
+    eps = cfg.layer_norm_eps
+    x = (jnp.take(params["embed"], ids, axis=0)
+         + params["pos_embed"][None, :S])
+    x = _bert_ln(x, params["ln_embed_w"], params["ln_embed_b"], eps)
+    j = jnp.arange(S)
+    # bidirectional length mask (keys beyond a row's length are invisible);
+    # pad rows get their own position 0 so softmax stays finite
+    visible = j[None, :] < jnp.maximum(lengths, 1)[:, None]     # [B, S]
+    bias = jnp.where(visible, 0.0, -1e9)[:, None, None, :]      # [B,1,1,S]
+
+    def body(h, lp):
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, S, H, D)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, S, H, D)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, S, H, D)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
+            jnp.float32(D))
+        p = jax.nn.softmax(scores + bias, axis=-1)
+        o = jnp.einsum("bhst,bthd->bshd", p, v).reshape(B, S, E)
+        h = _bert_ln(h + (o @ lp["wo"] + lp["bo"]),
+                     lp["ln_attn_w"], lp["ln_attn_b"], eps)
+        f = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] \
+            + lp["b_out"]
+        h = _bert_ln(h + f, lp["ln_mlp_w"], lp["ln_mlp_b"], eps)
+        return h, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    pooled = jnp.tanh(x[:, 0] @ params["pool_w"] + params["pool_b"])
+    return pooled.astype(jnp.float32)
 
 
 class BertForPretraining(Layer):

@@ -57,6 +57,7 @@ from jax import lax
 
 from .llama import (LlamaConfig, _masked_sdpa, _mm, _moe_ffn, _rms_norm,
                     _rope)
+from .lora import lora_delta
 
 __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
@@ -772,8 +773,28 @@ def _kv_gather(p: Dict, block_tables, B: int, C: int, Hk: int, D: int):
     return kk, vv
 
 
+def _lora_xs(params: Dict, pool: Dict, lora: Optional[Dict]):
+    """Scan xs for one paged forward pass: the stacked layer weights and
+    the pool, plus — when multi-adapter LoRA serving is on — the stacked
+    adapter-pool leaves (``lora["layers"]``, sliced per layer alongside
+    the weights; see ``models.lora``). ``lora`` is ``None`` on LoRA-less
+    builds, which keeps the traced computation BYTE-IDENTICAL to the
+    pre-LoRA engine — the zero-cost-for-base-traffic contract."""
+    if lora is None:
+        return (params["layers"], pool)
+    return (params["layers"], pool, lora["layers"])
+
+
+def _lora_unpack(xs):
+    """(layer params, pool layer, adapter layer or None) from scan xs."""
+    if len(xs) == 2:
+        lp, pz = xs
+        return lp, pz, None
+    return xs
+
+
 def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
-                  block_tables, pool: Dict, active):
+                  block_tables, pool: Dict, active, lora=None):
     """Prefill a BATCH of admitted sequences into the paged pool.
 
     ``ids [B, Sb]`` right-padded to the (power-of-2 bucketed) length
@@ -789,9 +810,12 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
     pools the attention reads the QUANTIZED round-trip of this chunk's
     K/V (``_kv_store``'s attend view), so prefill attends exactly the
     values decode/chunk dispatches will later gather — cold and
-    prefix-hit requests see one consistent quantized history. Returns
-    (next-token logits ``[B, V]`` read at each row's ``prompt_len - 1``,
-    pool, dropped_tokens).
+    prefix-hit requests see one consistent quantized history. ``lora``
+    (optional) is the multi-adapter operand ``{"ids": [B] int32 slot
+    ids, "layers": stacked adapter pool}`` — a device operand like the
+    sampling knobs, so adapter churn never retraces (``models.lora``).
+    Returns (next-token logits ``[B, V]`` read at each row's
+    ``prompt_len - 1``, pool, dropped_tokens).
     """
     from ..kernels.rope import rope_cos_sin
     B, Sb = ids.shape
@@ -811,27 +835,39 @@ def paged_prefill(params: Dict, cfg: LlamaConfig, ids, prompt_lens,
     x = jnp.take(params["embed"], ids, axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pz = xs
+        lp, pz, ll = _lora_unpack(xs)
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-        q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
-        k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
-        v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
+        q = _mm(hh, lp, "wq", dt)
+        k = _mm(hh, lp, "wk", dt)
+        v = _mm(hh, lp, "wv", dt)
+        if ll is not None:
+            lids = lora["ids"]
+            q = q + lora_delta(hh, ll["qA"], ll["qB"], lids, dt)
+            k = k + lora_delta(hh, ll["kA"], ll["kB"], lids, dt)
+            v = v + lora_delta(hh, ll["vA"], ll["vB"], lids, dt)
+        q = q.reshape(B, Sb, H, D)
+        k = k.reshape(B, Sb, Hk, D)
+        v = v.reshape(B, Sb, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
         pz, ka, va = _kv_store(pz, phys, off, k, v)
         o = _masked_sdpa(q, ka, va, kv_mask)
-        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
+        m = _merge_heads(o, cfg).astype(dt)
+        d = _mm(m, lp, "wo", dt)
+        if ll is not None:
+            d = d + lora_delta(m, ll["oA"], ll["oB"], lora["ids"], dt)
+        h = h + d
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
-    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    x, (pool, drops) = lax.scan(body, x, _lora_xs(params, pool, lora))
     idx = jnp.maximum(prompt_lens - 1, 0)[:, None, None]
     last = jnp.take_along_axis(x, idx, axis=1)          # [B, 1, E]
     return _lm_head(params, cfg, last), pool, drops.sum()
 
 
 def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
-                        chunk_len, block_tables, pool: Dict):
+                        chunk_len, block_tables, pool: Dict, lora=None):
     """Prefill-from-offset: one sequence's token chunk against the pool.
 
     The entry point behind CHUNKED PREFILL and PREFIX-CACHE HITS
@@ -877,21 +913,33 @@ def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
     x = jnp.take(params["embed"], ids, axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pz = xs
+        lp, pz, ll = _lora_unpack(xs)
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-        q = _mm(hh, lp, "wq", dt).reshape(B, Sb, H, D)
-        k = _mm(hh, lp, "wk", dt).reshape(B, Sb, Hk, D)
-        v = _mm(hh, lp, "wv", dt).reshape(B, Sb, Hk, D)
+        q = _mm(hh, lp, "wq", dt)
+        k = _mm(hh, lp, "wk", dt)
+        v = _mm(hh, lp, "wv", dt)
+        if ll is not None:
+            lids = lora["ids"]
+            q = q + lora_delta(hh, ll["qA"], ll["qB"], lids, dt)
+            k = k + lora_delta(hh, ll["kA"], ll["kB"], lids, dt)
+            v = v + lora_delta(hh, ll["vA"], ll["vB"], lids, dt)
+        q = q.reshape(B, Sb, H, D)
+        k = k.reshape(B, Sb, Hk, D)
+        v = v.reshape(B, Sb, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
         pz, _, _ = _kv_store(pz, phys, off, k, v)
         kk, vv = _kv_gather(pz, block_tables, B, C, Hk, D)
         o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
+        m = _merge_heads(o, cfg).astype(dt)
+        d = _mm(m, lp, "wo", dt)
+        if ll is not None:
+            d = d + lora_delta(m, ll["oA"], ll["oB"], lora["ids"], dt)
+        h = h + d
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
-    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    x, (pool, drops) = lax.scan(body, x, _lora_xs(params, pool, lora))
     idx = jnp.full((B, 1, 1), jnp.maximum(chunk_len - 1, 0))
     last = jnp.take_along_axis(x, idx, axis=1)           # [1, 1, E]
     return _lm_head(params, cfg, last), pool, drops.sum()
@@ -899,7 +947,7 @@ def paged_prefill_chunk(params: Dict, cfg: LlamaConfig, ids, start,
 
 def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
                       block_tables, pool: Dict, active,
-                      use_kernel: bool = False):
+                      use_kernel: bool = False, lora=None):
     """One decode iteration over ``M`` serving slots against the block pool.
 
     ``tokens [M]`` the last sampled token per slot; ``seq_lens [M]`` the KV
@@ -942,11 +990,19 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pz = xs
+        lp, pz, ll = _lora_unpack(xs)
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-        q = _mm(hh, lp, "wq", dt).reshape(M, 1, H, D)
-        k = _mm(hh, lp, "wk", dt).reshape(M, 1, Hk, D)
-        v = _mm(hh, lp, "wv", dt).reshape(M, 1, Hk, D)
+        q = _mm(hh, lp, "wq", dt)
+        k = _mm(hh, lp, "wk", dt)
+        v = _mm(hh, lp, "wv", dt)
+        if ll is not None:
+            lids = lora["ids"]
+            q = q + lora_delta(hh, ll["qA"], ll["qB"], lids, dt)
+            k = k + lora_delta(hh, ll["kA"], ll["kB"], lids, dt)
+            v = v + lora_delta(hh, ll["vA"], ll["vB"], lids, dt)
+        q = q.reshape(M, 1, H, D)
+        k = k.reshape(M, 1, Hk, D)
+        v = v.reshape(M, 1, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
         pz, _, _ = _kv_store(pz, phys, off, k[:, 0], v[:, 0])
@@ -958,11 +1014,15 @@ def paged_decode_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
         else:
             kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
             o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
+        m = _merge_heads(o, cfg).astype(dt)
+        d = _mm(m, lp, "wo", dt)
+        if ll is not None:
+            d = d + lora_delta(m, ll["oA"], ll["oB"], lora["ids"], dt)
+        h = h + d
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
-    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    x, (pool, drops) = lax.scan(body, x, _lora_xs(params, pool, lora))
     return _lm_head(params, cfg, x), pool, drops.sum()
 
 
@@ -980,7 +1040,7 @@ def _lm_head_all(params: Dict, cfg: LlamaConfig, x):
 
 def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
                     draft_lens, block_tables, pool: Dict, active,
-                    use_kernel: bool = False):
+                    use_kernel: bool = False, lora=None):
     """Speculative VERIFY over ``M`` serving slots: one multi-query decode
     iteration per slot against the block pool.
 
@@ -1041,11 +1101,19 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     x = jnp.take(params["embed"], tokens, axis=0).astype(dt)
 
     def body(h, xs):
-        lp, pz = xs
+        lp, pz, ll = _lora_unpack(xs)
         hh = _rms_norm(h, lp["ln_attn"], cfg.rms_norm_eps, cfg.use_fused_norm)
-        q = _mm(hh, lp, "wq", dt).reshape(M, Q, H, D)
-        k = _mm(hh, lp, "wk", dt).reshape(M, Q, Hk, D)
-        v = _mm(hh, lp, "wv", dt).reshape(M, Q, Hk, D)
+        q = _mm(hh, lp, "wq", dt)
+        k = _mm(hh, lp, "wk", dt)
+        v = _mm(hh, lp, "wv", dt)
+        if ll is not None:
+            lids = lora["ids"]
+            q = q + lora_delta(hh, ll["qA"], ll["qB"], lids, dt)
+            k = k + lora_delta(hh, ll["kA"], ll["kB"], lids, dt)
+            v = v + lora_delta(hh, ll["vA"], ll["vB"], lids, dt)
+        q = q.reshape(M, Q, H, D)
+        k = k.reshape(M, Q, Hk, D)
+        v = v.reshape(M, Q, Hk, D)
         q = _rope(q, cos, sin, False)
         k = _rope(k, cos, sin, False)
         pz, _, _ = _kv_store(pz, phys, off, k, v)
@@ -1058,9 +1126,13 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
         else:
             kk, vv = _kv_gather(pz, block_tables, M, C, Hk, D)
             o = _masked_sdpa(q, kk, vv, kv_mask)
-        h = h + _mm(_merge_heads(o, cfg).astype(dt), lp, "wo", dt)
+        m = _merge_heads(o, cfg).astype(dt)
+        d = _mm(m, lp, "wo", dt)
+        if ll is not None:
+            d = d + lora_delta(m, ll["oA"], ll["oB"], lora["ids"], dt)
+        h = h + d
         h, drops = _ffn_tail(lp, h, cfg)
         return h, (pz, drops)
 
-    x, (pool, drops) = lax.scan(body, x, (params["layers"], pool))
+    x, (pool, drops) = lax.scan(body, x, _lora_xs(params, pool, lora))
     return _lm_head_all(params, cfg, x), pool, drops.sum()

@@ -1,0 +1,352 @@
+"""Multi-adapter LoRA serving: registry + device-resident paged adapter pool.
+
+The S-LoRA / Punica shape adapted to this repo's compile-once paged
+engine (ISSUE 19): N per-customer LoRA fine-tunes share ONE base model
+and ONE set of compiled programs, so a fine-tune costs adapter weights
+(two rank-r factors per attention projection per layer), not a replica.
+
+* **One stacked pool, one program.** Every registered adapter's A/B
+  factors live at a FIXED rank ``r`` in a stacked device pool
+  ``[L, slots, ...]`` (:class:`AdapterPool`). Each serving dispatch
+  carries a per-row ``adapter slot id`` array — a DEVICE OPERAND of the
+  one compiled program, exactly like the PR 11 sampling-knob arrays — and
+  the layer body applies the gathered batched adapter matmul
+  ``y += (x @ A[ids]) @ B[ids]`` fused into the q/k/v/o projections
+  (:func:`lora_delta`). Adapter churn (register / evict / reload) only
+  rewrites pool rows and the id operand: the trace-counter tests prove
+  zero recompiles across any adapter mix.
+* **Slot 0 is the zeroed BASE adapter.** Requests without an adapter
+  gather all-zero factors, and the delta they add is an exact ``+0.0`` —
+  floating-point addition of a zero product can only normalize ``-0.0``
+  to ``+0.0``, which no argmax or categorical draw can observe, so base
+  traffic through a LoRA-enabled engine emits token streams BIT-IDENTICAL
+  to the LoRA-less build (pinned across fp32/int8 x kernel/gather x
+  greedy/seeded x TP degrees by tests/test_lora.py).
+* **Host LRU tier.** Cold adapters live in a host-side registry
+  (checksummed numpy copies, the PR 16 offload-tier discipline: crc32 at
+  registration, verified again at every H2D load so a corrupted host
+  copy becomes a structured error, never silently-wrong weights). The
+  pool LRU-evicts the coldest UNPINNED resident adapter to make room;
+  running requests pin theirs, so an in-flight stream's weights can
+  never be swapped out from under it. Evict + reload round-trips are
+  bit-exact: the same bytes reload into whatever slot is free.
+* **Tensor parallelism.** Under the serving TP mesh the ``qB``/``kB``/
+  ``vB`` pool leaves shard their output-feature axis exactly like the
+  column-sharded ``wq``/``wk``/``wv`` they feed (each shard's delta is
+  its local head slice); ``oA``/``oB`` replicate (the wo projection runs
+  replicated on the all-gathered merged heads). :func:`lora_pool_specs`
+  is the one spec map both the pool's ``device_put`` and the engine's
+  ``shard_map`` in_specs read.
+
+The merged-dense oracle (:func:`merge_lora`) folds ``W + A @ B`` into a
+plain parameter tree so the dense ``generate()`` tier reproduces each
+adapter's greedy token stream — the engine's factored spelling
+``x @ W + (x @ A) @ B`` and the merged ``x @ (W + A B)`` differ in fp
+rounding, but not by enough to move any greedy argmax in the pinned
+configs, so the oracle check is token-exact.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+__all__ = ["AdapterPool", "lora_param_shapes", "lora_init_params",
+           "lora_delta", "lora_pool_specs", "merge_lora"]
+
+
+# the four attention projections LoRA targets: (weight leaf, A leaf, B leaf)
+_TARGETS = (("wq", "qA", "qB"), ("wk", "kA", "kB"),
+            ("wv", "vA", "vB"), ("wo", "oA", "oB"))
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def lora_param_shapes(cfg: LlamaConfig, rank: int) -> Dict[str, tuple]:
+    """Per-adapter factor shapes (leading L = stacked layers): ``A`` maps
+    the projection input to rank ``r``, ``B`` maps rank ``r`` to the
+    projection output — matching the stacked llama weights ``wq [L, E,
+    H*D]`` / ``wk``/``wv [L, E, Hk*D]`` / ``wo [L, H*D, E]``."""
+    L, E = cfg.num_hidden_layers, cfg.hidden_size
+    H, Hk, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    r = int(rank)
+    return {"qA": (L, E, r), "qB": (L, r, H * D),
+            "kA": (L, E, r), "kB": (L, r, Hk * D),
+            "vA": (L, E, r), "vB": (L, r, Hk * D),
+            "oA": (L, H * D, r), "oB": (L, r, E)}
+
+
+def lora_init_params(cfg: LlamaConfig, rank: int, seed: int = 0,
+                     scale: float = 0.05) -> Dict[str, np.ndarray]:
+    """A random host-side adapter (both factors nonzero — a zero ``B``
+    would be indistinguishable from the base adapter and prove nothing
+    in any parity test). fp32, numpy: adapters register from the host."""
+    rng = np.random.default_rng(seed)
+    return {n: (rng.standard_normal(s) * scale).astype(np.float32)
+            for n, s in lora_param_shapes(cfg, rank).items()}
+
+
+def lora_delta(x, la, lb, ids, dt):
+    """The gathered batched adapter matmul for one layer's projection:
+    ``(x @ A[ids]) @ B[ids]`` with ``x [B, T, in]``, per-layer pool
+    slices ``la [slots, in, r]`` / ``lb [slots, r, out]`` and ``ids [B]``
+    int32 adapter slots (a device operand — churn never retraces).
+    Returns the ``[B, T, out]`` delta in compute dtype ``dt``; slot 0's
+    zeroed factors make it an exact ``+0.0`` for base rows."""
+    a = jnp.take(la, ids, axis=0).astype(dt)         # [B, in, r]
+    b = jnp.take(lb, ids, axis=0).astype(dt)         # [B, r, out]
+    t = jnp.einsum("bti,bir->btr", x.astype(dt), a)
+    return jnp.einsum("btr,bro->bto", t, b)
+
+
+def lora_pool_specs(layers: Dict, mesh, axis: str = "tp") -> Dict:
+    """PartitionSpecs for the stacked pool leaves under serving TP:
+    ``qB``/``kB``/``vB`` shard their output-feature axis (dim -1) exactly
+    like the column-sharded projections they add into; everything else
+    replicates (``oA``/``oB`` feed the replicated wo on merged heads).
+    Indivisible shapes raise the structured ``shard_dim_spec`` error
+    naming the leaf."""
+    from jax.sharding import PartitionSpec
+
+    from ..distributed.sharding import shard_dim_spec
+    out = {}
+    for name, leaf in layers.items():
+        if name in ("qB", "kB", "vB"):
+            out[name] = shard_dim_spec(leaf.shape, mesh, axis, dim=-1,
+                                       name=f"lora_pool.{name}")
+        else:
+            out[name] = PartitionSpec()
+    return out
+
+
+def merge_lora(params: Dict, lora_params: Dict[str, np.ndarray]) -> Dict:
+    """The DENSE ORACLE: fold one adapter into a copy of the stacked
+    llama params (``W += A @ B`` per projection per layer) so the plain
+    dense ``generate()`` path reproduces the adapter's greedy stream.
+    fp params only — the int8 engine path quantizes the BASE weights and
+    adds the fp delta outside the quantized matmul, which a merged int8
+    weight could not represent."""
+    layers = dict(params["layers"])
+    for wname, aname, bname in _TARGETS:
+        a = jnp.asarray(lora_params[aname], jnp.float32)
+        b = jnp.asarray(lora_params[bname], jnp.float32)
+        w = layers[wname]
+        layers[wname] = (w.astype(jnp.float32)
+                        + jnp.einsum("lir,lro->lio", a, b)).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+class AdapterPool:
+    """Device-resident paged adapter pool + host LRU registry.
+
+    ``slots`` device rows hold loaded adapters (slot 0 is the reserved
+    zeroed base adapter on top of that); up to ``capacity`` adapters may
+    be registered host-side in total. ``acquire`` pins an adapter
+    resident (loading it over the LRU unpinned victim if cold) and
+    ``release`` unpins it; a fully pinned pool makes ``acquire`` return
+    None — the scheduler's admission gate SKIPS that request (no
+    head-of-line blocking) and retries at the next step.
+    """
+
+    def __init__(self, cfg: LlamaConfig, rank: int, slots: int,
+                 capacity: int, mesh=None, tp_axis: str = "tp"):
+        rank, slots, capacity = int(rank), int(slots), int(capacity)
+        if rank < 1:
+            raise ValueError(
+                f"FLAGS_serving_lora_rank must be >= 1, got {rank}")
+        if slots < 1:
+            raise ValueError(
+                f"AdapterPool needs FLAGS_serving_lora_slots >= 1 device "
+                f"slots, got {slots} (0 disables multi-adapter serving "
+                f"at the engine, not here)")
+        if capacity < slots:
+            raise ValueError(
+                f"FLAGS_serving_lora_pool ({capacity}) must be >= "
+                f"FLAGS_serving_lora_slots ({slots}): the host registry "
+                f"backs every resident adapter")
+        self.cfg, self.rank = cfg, rank
+        self.num_slots = slots          # loadable slots (1..slots)
+        self.capacity = capacity
+        self._shapes = lora_param_shapes(cfg, rank)
+        # stacked [L, slots+1, ...] pool; row 0 = the zeroed base adapter
+        self.layers = {
+            n: jnp.zeros((s[0], slots + 1) + s[1:], jnp.float32)
+            for n, s in self._shapes.items()}
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            specs = lora_pool_specs(self.layers, mesh, tp_axis)
+            import jax
+            self.layers = {n: jax.device_put(a, NamedSharding(mesh,
+                                                              specs[n]))
+                           for n, a in self.layers.items()}
+        # host registry: name -> {"data": {leaf: np}, "crc": {leaf: int}}
+        self._host: "OrderedDict[str, Dict]" = OrderedDict()
+        self._resident: Dict[str, int] = {}       # name -> slot (1-based)
+        self._slot_name: List[Optional[str]] = [None] * (slots + 1)
+        self._pins: Dict[str, int] = {}           # name -> pin count
+        self._lru: "OrderedDict[str, None]" = OrderedDict()  # resident LRU
+        self.loads = 0                 # H2D adapter uploads (cold acquires)
+        self.evictions = 0
+
+    # ---- registry ---------------------------------------------------------
+
+    def register(self, name: str, params: Dict[str, np.ndarray]) -> None:
+        """Accept one adapter into the host registry (checksummed copy).
+        Shape/rank mismatches and a full registry are structured errors —
+        wrong factors must fail at registration, not deep inside a
+        gathered einsum."""
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"adapter name must be a non-empty string, "
+                             f"got {name!r}")
+        if name not in self._host and len(self._host) >= self.capacity:
+            raise ValueError(
+                f"adapter registry full ({self.capacity} adapters): "
+                f"cannot register {name!r}; raise FLAGS_serving_lora_pool "
+                f"or deregister a cold adapter")
+        missing = set(self._shapes) - set(params)
+        if missing:
+            raise ValueError(f"adapter {name!r} is missing factor leaves "
+                             f"{sorted(missing)}; expected "
+                             f"{sorted(self._shapes)}")
+        data = {}
+        for leaf, shape in self._shapes.items():
+            arr = np.asarray(params[leaf], np.float32)
+            if arr.shape != shape:
+                raise ValueError(
+                    f"adapter {name!r} leaf {leaf!r} has shape "
+                    f"{arr.shape}, expected {shape} (rank "
+                    f"FLAGS_serving_lora_rank={self.rank} over "
+                    f"{self._shapes['qA'][0]} layers)")
+            # a real copy, not a view: the registry must own its bytes,
+            # or a caller mutating (or freeing) the factors after
+            # registration silently invalidates the checksummed copy
+            data[leaf] = np.array(arr, np.float32, order="C", copy=True)
+        if name in self._resident:
+            # re-registration of a RESIDENT adapter replaces its bytes:
+            # drop residency so the next acquire uploads the new factors
+            # (pinned adapters cannot be silently swapped mid-stream)
+            if self._pins.get(name, 0):
+                raise ValueError(
+                    f"adapter {name!r} is pinned by running requests; "
+                    f"cannot replace its weights mid-stream")
+            self._evict(name)
+        self._host[name] = {"data": data,
+                            "crc": {n: _crc(a) for n, a in data.items()}}
+
+    def is_registered(self, name: str) -> bool:
+        return name in self._host
+
+    def registered(self) -> List[str]:
+        return list(self._host)
+
+    # ---- residency --------------------------------------------------------
+
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin ``name`` resident and return its slot; None when every
+        slot is pinned by other adapters (the caller skips and retries).
+        Cold acquires verify the host copy's checksums and upload it
+        into the freed slot (one ``adapter_loads`` tick)."""
+        if name not in self._host:
+            raise KeyError(f"adapter {name!r} is not registered")
+        slot = self._resident.get(name)
+        if slot is None:
+            slot = self._free_slot()
+            if slot is None:
+                return None
+            entry = self._host[name]
+            for leaf, arr in entry["data"].items():
+                if _crc(arr) != entry["crc"][leaf]:
+                    raise RuntimeError(
+                        f"adapter {name!r} leaf {leaf!r} failed its "
+                        f"load-time checksum: host copy corrupted; "
+                        f"refusing to serve wrong weights")
+            for leaf, arr in entry["data"].items():
+                self.layers[leaf] = \
+                    self.layers[leaf].at[:, slot].set(jnp.asarray(arr))
+            self._resident[name] = slot
+            self._slot_name[slot] = name
+            self.loads += 1
+        self._pins[name] = self._pins.get(name, 0) + 1
+        self._lru.pop(name, None)
+        self._lru[name] = None                      # most recently used
+        return slot
+
+    def release(self, name: str) -> None:
+        """Drop one pin; the adapter STAYS resident (warm) until the LRU
+        needs its slot."""
+        n = self._pins.get(name, 0)
+        if n <= 1:
+            self._pins.pop(name, None)
+        else:
+            self._pins[name] = n - 1
+
+    def _free_slot(self) -> Optional[int]:
+        for s in range(1, self.num_slots + 1):
+            if self._slot_name[s] is None:
+                return s
+        for victim in self._lru:                    # oldest first
+            if not self._pins.get(victim, 0):
+                slot = self._resident[victim]
+                self._evict(victim)
+                self.evictions += 1
+                return slot
+        return None
+
+    def _evict(self, name: str) -> None:
+        slot = self._resident.pop(name)
+        self._slot_name[slot] = None
+        self._lru.pop(name, None)
+        self._pins.pop(name, None)
+
+    def resident(self) -> Dict[str, int]:
+        return dict(self._resident)
+
+    def evicted(self) -> List[str]:
+        return [n for n in self._host if n not in self._resident]
+
+    def pinned(self) -> Dict[str, int]:
+        return dict(self._pins)
+
+    def slot_of(self, name: str) -> Optional[int]:
+        return self._resident.get(name)
+
+    # ---- observability + chaos --------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        return {"adapters_registered": len(self._host),
+                "adapters_resident": len(self._resident),
+                "adapter_loads": self.loads,
+                "adapter_evictions": self.evictions,
+                "adapter_pins": sum(self._pins.values())}
+
+    def snapshot(self) -> Dict:
+        out = self.stats()
+        out["rank"] = self.rank
+        out["slots"] = self.num_slots
+        out["resident"] = sorted(self._resident)
+        return out
+
+    def corrupt_one(self) -> Optional[str]:
+        """Chaos hook (the offload tier's discipline): flip one byte of
+        one COLD adapter's host copy. The next acquire of that adapter
+        fails its load-time checksum with a structured error instead of
+        serving wrong weights. Returns the adapter corrupted, or None
+        when every registered adapter is resident."""
+        for name in self._host:
+            if name in self._resident:
+                continue
+            leaf = next(iter(self._shapes))
+            buf = self._host[name]["data"][leaf]
+            buf.view(np.uint8).reshape(-1)[0] ^= 0xFF
+            return name
+        return None

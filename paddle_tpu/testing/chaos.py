@@ -79,6 +79,11 @@ injector               fault it models
                        snapshot: recovery must reject the generation
                        and fall back to the previous one or a full WAL
                        replay — the last good state, never wrong output
+``adapter_churn``      hostile LoRA-adapter locality: seeded rounds of
+                       cold-adapter acquires force the device pool's
+                       LRU to evict warm adapters mid-traffic — pinned
+                       (running) adapters must survive in place and
+                       reloads must stay bit-exact
 =====================  ====================================================
 
 File injectors are plain functions; process/region injectors are context
@@ -106,9 +111,10 @@ __all__ = ["truncate_file", "flip_bits", "fail_nth", "async_writer_fault",
            "host_pressure", "corrupt_offload_block",
            "kill_prefill_replica", "stale_directory",
            "process_kill", "torn_journal_tail", "corrupt_snapshot",
+           "adapter_churn",
            "ChaosEvent", "ChaosTimeline", "chaos_timeline",
            "TIMELINE_INJECTORS", "TIER_INJECTORS", "DISAGG_INJECTORS",
-           "DURABLE_INJECTORS", "INJECTORS"]
+           "DURABLE_INJECTORS", "LORA_INJECTORS", "INJECTORS"]
 
 
 def truncate_file(path: str, frac: float = 0.5,
@@ -789,6 +795,47 @@ def corrupt_snapshot(target, seed: int = 0, nbits: int = 8) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# multi-adapter LoRA injectors (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def adapter_churn(target, rid=None, rounds: int = 4, seed: int = 0) -> dict:
+    """Hostile adapter locality: ``rounds`` seeded acquire/release cycles
+    aimed at COLD (registered-but-evicted) adapters, forcing the device
+    pool's LRU to evict warm ones and fault the cold ones back in while
+    traffic is live — the worst-case adapter mix a multi-tenant LoRA
+    fleet sees. Pinned (running) adapters must survive in place
+    (``_free_slot`` never evicts a pinned slot), reloads must be
+    bit-exact, and the ``adapter_pool_partition`` invariant must hold
+    throughout. When every registered adapter is already resident the
+    cycles only reshuffle LRU order — the fault is then mild, not
+    vacuous: eviction order for the NEXT overflow still changes.
+    ``target`` is a router (``rid`` picks the replica), replica, or bare
+    supervisor/engine. Returns ``{"rid", "enabled", "touched", "loads",
+    "evictions"}`` (deltas) — ``enabled=False`` with multi-adapter
+    serving off or nothing registered (the fault is vacuous)."""
+    sup, rid = _fleet_sup(target, rid)
+    eng = getattr(sup, "engine", sup)
+    pool = getattr(eng, "_lora", None)
+    if pool is None or not pool.registered():
+        return {"rid": rid, "enabled": False, "touched": [],
+                "loads": 0, "evictions": 0}
+    rng = random.Random(int(seed))
+    loads0, evictions0 = pool.loads, pool.evictions
+    touched = []
+    for _ in range(max(1, int(rounds))):
+        cold = sorted(pool.evicted())
+        name = rng.choice(cold) if cold \
+            else rng.choice(sorted(pool.registered()))
+        slot = pool.acquire(name)
+        if slot is not None:       # every slot pinned -> skip, like admit
+            pool.release(name)
+            touched.append(name)
+    return {"rid": rid, "enabled": True, "touched": touched,
+            "loads": pool.loads - loads0,
+            "evictions": pool.evictions - evictions0}
+
+
+# ---------------------------------------------------------------------------
 # chaos timeline (fleet-scale replay; ISSUE 13)
 # ---------------------------------------------------------------------------
 
@@ -878,6 +925,11 @@ DISAGG_INJECTORS = ("kill_prefill_replica", "stale_directory")
 DURABLE_INJECTORS = ("process_kill", "torn_journal_tail",
                      "corrupt_snapshot")
 
+# the multi-adapter LoRA fault (ISSUE 19) — out of the default mix for
+# the same seed-stability reason; adapter-exercising replays pass
+# ``kinds=TIMELINE_INJECTORS + LORA_INJECTORS`` explicitly
+LORA_INJECTORS = ("adapter_churn",)
+
 
 def chaos_timeline(seed: int, horizon_steps: int,
                    kinds=TIMELINE_INJECTORS, events: int = 6,
@@ -912,6 +964,9 @@ def chaos_timeline(seed: int, horizon_steps: int,
             kw = {"seed": rng.randrange(1000)}
         elif name == "stale_directory":
             kw = {"seed": rng.randrange(1000)}
+        elif name == "adapter_churn":
+            kw = {"rounds": rng.randrange(2, 6),
+                  "seed": rng.randrange(1000)}
         out.append(ChaosEvent(step, name, **kw))
     return ChaosTimeline(out)
 
@@ -944,4 +999,5 @@ INJECTORS = {
     "process_kill": process_kill,
     "torn_journal_tail": torn_journal_tail,
     "corrupt_snapshot": corrupt_snapshot,
+    "adapter_churn": adapter_churn,
 }

@@ -249,6 +249,18 @@ def test_bench_serve_entry_point():
     assert detail["durable_wal_bytes"] > 0
     assert detail["durable_leaked_blocks"] == 0
     assert "serving_recovery_ms" in metrics
+    # multi-adapter LoRA row (ISSUE 19): 8 adapters served round-robin
+    # from ONE paged pool vs the base-only engine — zero-adapter traffic
+    # bit-identical, the mix adds zero decode executables, overhead
+    # < 10%, zero leaked blocks; the smoke pins the detail record + both
+    # metrics so the row cannot silently vanish.
+    assert detail["lora_outputs_match"] is True
+    assert detail["lora_adapter_overhead_pct"] < 10.0
+    assert detail["lora_adapters"] == 8
+    assert detail["lora_adapter_loads"] >= 8
+    assert detail["lora_leaked_blocks"] == 0
+    assert "serving_lora_adapter_overhead_pct" in metrics
+    assert "serving_lora_adapters_per_replica" in metrics
 
 
 def test_bench_health_entry_point():

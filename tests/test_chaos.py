@@ -991,7 +991,12 @@ class TestFleetChaos:
         for name in chaos.DURABLE_INJECTORS:
             assert name in chaos.INJECTORS
             assert name not in chaos.TIMELINE_INJECTORS
-        assert len(chaos.INJECTORS) == 25
+        # + the ISSUE 19 LoRA injector (adapter_churn) — also OUT of the
+        # default timeline mix
+        for name in chaos.LORA_INJECTORS:
+            assert name in chaos.INJECTORS
+            assert name not in chaos.TIMELINE_INJECTORS
+        assert len(chaos.INJECTORS) == 26
 
     def _router(self, params, cfg, **kw):
         from paddle_tpu.inference.serving import ServingConfig, ServingRouter

@@ -1,0 +1,594 @@
+"""Multi-adapter LoRA serving + embeddings endpoint (ISSUE 19): a paged
+device adapter pool behind ONE compiled program, gathered batched adapter
+matmul fused into the q/k/v/o projections, adapter identity threaded
+through the whole durability/fleet stack, and a prefill-only embeddings
+request kind over the BERT encoder.
+
+Two oracle disciplines anchor everything:
+
+* **Zero-adapter parity.** Slot 0 of the pool is the zeroed base adapter,
+  so an engine WITH the pool serving base traffic must be bit-identical
+  to the LoRA-less engine across {fp32, int8} x {kernel, gather} x
+  {greedy, seeded} x {TP1, TP2} — the pool's cost for base traffic is a
+  zero-delta matmul, never a numerics fork.
+
+* **Merged-dense oracle.** A request selecting adapter ``a`` must produce
+  the same greedy token stream as a plain engine whose dense weights are
+  ``W + A @ B`` (:func:`~paddle_tpu.models.lora.merge_lora`) — the
+  adapter math is real, not just plumbing.
+
+Compile-once is the perf tentpole's contract: the per-slot adapter ids
+ride the decode/prefill programs as a DEVICE OPERAND, so adapter churn
+(register / evict / reload) adds ZERO executables — ``decode_traces``
+stays flat through every mix this file throws at the pool.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import generation as G
+from paddle_tpu.models import llama
+from paddle_tpu.models.bert import BertConfig, bert_encode, bert_init_params
+from paddle_tpu.models.lora import (AdapterPool, lora_init_params,
+                                    merge_lora)
+from paddle_tpu.inference.serving import (AUDIT_CHECKS, EngineSupervisor,
+                                          HEALTH_SNAPSHOT_FIELDS,
+                                          InvariantAuditor, RequestJournal,
+                                          ServingConfig, ServingEngine,
+                                          ServingRouter)
+from paddle_tpu.testing import chaos
+
+CFG = llama.LlamaConfig(vocab_size=128, hidden_size=64,
+                        intermediate_size=96, num_hidden_layers=2,
+                        num_attention_heads=8, num_key_value_heads=4,
+                        max_position_embeddings=128)
+
+BCFG = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=4, intermediate_size=64,
+                  max_position_embeddings=64)
+
+RANK = 4
+
+# base engine shape shared by every engine here (program sharing needs
+# identical shape keys); LORA adds the pool on top
+BASE = dict(block_size=8, max_slots=4, max_model_len=96, queue_depth=16,
+            decode_chunk=4)
+LORA = dict(lora_rank=RANK, lora_slots=2, lora_pool=8)
+
+
+def mk(params, lora=True, tp=1, programs=None, adapters=None,
+       embed=None, **kw):
+    sc = {**BASE, **(LORA if lora else {}), **kw, "tp": tp}
+    eng = ServingEngine(params, CFG, ServingConfig(**sc),
+                        programs=programs, embed_model=embed)
+    for name, ap in (adapters or {}).items():
+        eng.register_adapter(name, ap)
+    return eng
+
+
+def run_wave(eng, prompts, adapter_ids=None, n=10, **kw):
+    """Submit one wave (optionally per-request adapter ids) and drain."""
+    ids = adapter_ids or [None] * len(prompts)
+    rids = [eng.submit(p, max_new_tokens=n, eos_token_id=None,
+                       adapter_id=a, **kw)
+            for p, a in zip(prompts, ids)]
+    while eng.pending:
+        eng.step()
+    return [np.asarray(eng.request(r).output()) for r in rids]
+
+
+def _parity(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(a, b))
+
+
+def embed_drain(eng, erids, max_steps=50):
+    """Step until every embed rid is readable (engine.embedding raises
+    KeyError while the request is still queued/in-flight)."""
+    out = {}
+    for _ in range(max_steps):
+        for e in erids:
+            if e not in out:
+                try:
+                    out[e] = np.asarray(eng.embedding(e))
+                except KeyError:
+                    pass
+        if len(out) == len(erids):
+            return [out[e] for e in erids]
+        eng.step()
+    raise AssertionError("embeddings did not drain")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def adapters():
+    """Five adapters over a 2-slot pool (eviction is the common case,
+    not the edge case). scale=0.5 — far above init noise, so adapter
+    outputs genuinely diverge from base on this tiny model."""
+    return {f"a{i}": lora_init_params(CFG, RANK, seed=i, scale=0.5)
+            for i in range(1, 6)}
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    # one power-of-2 prefill bucket (8) and one wave bucket: each engine
+    # compiles exactly one prefill executable
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, CFG.vocab_size, (int(s),)).astype(np.int32)
+            for s in (5, 8, 6, 7)]
+
+
+@pytest.fixture(scope="module")
+def bert():
+    """The shared encoder. EnginePrograms keys on the embed config, so
+    everything sharing lora1's compiled programs attaches this too."""
+    return (BCFG, bert_init_params(BCFG, seed=3))
+
+
+@pytest.fixture(scope="module")
+def lora1(params, adapters, bert):
+    """The module's workhorse: TP=1 LoRA engine (fp pool, gather path)
+    with every adapter registered and the BERT encoder attached."""
+    return mk(params, adapters=adapters, embed=bert)
+
+
+@pytest.fixture(scope="module")
+def base1(params):
+    """The LoRA-less oracle engine at the same shape."""
+    return mk(params, lora=False)
+
+
+@pytest.fixture(scope="module")
+def oracle(base1, prompts):
+    return [np.asarray(o) for o in
+            base1.run(prompts, max_new_tokens=10, eos_token_id=None)]
+
+
+# ---------------------------------------------------------------------------
+# zero-adapter bit parity: {fp32,int8} x {kernel,gather} x {greedy,seeded}
+# x {TP1,TP2}
+# ---------------------------------------------------------------------------
+
+class TestZeroAdapterParity:
+    def test_base_traffic_fp_gather(self, lora1, oracle, prompts):
+        """The workhorse engine itself: base traffic through the pool is
+        bit-identical to the LoRA-less engine, from ONE compiled decode
+        program."""
+        outs = run_wave(lora1, prompts)
+        assert _parity(outs, oracle)
+        assert lora1.stats()["decode_traces"] == 1
+
+    @pytest.mark.parametrize("kv", [None, "int8"], ids=["fp32", "int8"])
+    @pytest.mark.parametrize("kernel", ["off", "on"],
+                             ids=["gather", "kernel"])
+    def test_matrix_tp1(self, params, adapters, prompts, kv, kernel):
+        """Every pool-dtype x attention-path combination: greedy AND
+        seeded-sampled streams through the zero adapter match the
+        LoRA-less engine bitwise."""
+        base = mk(params, lora=False, kv_quant=kv, paged_kernel=kernel)
+        lora = mk(params, kv_quant=kv, paged_kernel=kernel,
+                  adapters=adapters)
+        assert _parity(run_wave(lora, prompts), run_wave(base, prompts))
+        kw = dict(temperature=0.9, top_k=17, top_p=0.9, seed=42)
+        assert _parity(run_wave(lora, prompts, **kw),
+                       run_wave(base, prompts, **kw))
+        assert lora.stats()["decode_traces"] == 1
+
+    @pytest.mark.tp
+    @pytest.mark.parametrize("kv", [None, "int8"], ids=["fp32", "int8"])
+    @pytest.mark.parametrize("kernel", ["off", "on"],
+                             ids=["gather", "kernel"])
+    def test_matrix_tp2(self, tp_platform, params, adapters, prompts,
+                        kv, kernel):
+        """Same matrix at TP=2: the adapter pool's A/B stacks shard on
+        their head/hidden axes with the projections they feed, and base
+        traffic stays bit-identical to the LoRA-less TP=2 engine."""
+        base = mk(params, lora=False, tp=2, kv_quant=kv,
+                  paged_kernel=kernel)
+        lora = mk(params, tp=2, kv_quant=kv, paged_kernel=kernel,
+                  adapters=adapters)
+        assert _parity(run_wave(lora, prompts), run_wave(base, prompts))
+        kw = dict(temperature=0.9, top_k=17, top_p=0.9, seed=42)
+        assert _parity(run_wave(lora, prompts, **kw),
+                       run_wave(base, prompts, **kw))
+        assert lora.stats()["decode_traces"] == 1
+        assert lora.stats()["tp_degree"] == 2
+
+
+# ---------------------------------------------------------------------------
+# adapter correctness: the merged-dense oracle
+# ---------------------------------------------------------------------------
+
+class TestMergedDenseOracle:
+    def test_single_adapter_matches_merged_dense(self, params, adapters,
+                                                 lora1, prompts):
+        """submit(adapter_id='a1') greedy streams equal a plain engine
+        running on W + A@B dense weights, token for token."""
+        merged = mk(merge_lora(params, adapters["a1"]), lora=False)
+        want = run_wave(merged, prompts)
+        got = run_wave(lora1, prompts, adapter_ids=["a1"] * len(prompts))
+        assert _parity(got, want)
+
+    def test_adapters_actually_diverge(self, lora1, oracle, prompts):
+        """scale=0.5 adapters move greedy argmax on this model — the
+        parity above is a real claim, not a vacuous one."""
+        got = run_wave(lora1, prompts, adapter_ids=["a1"] * len(prompts))
+        assert any(not np.array_equal(g, o) for g, o in zip(got, oracle))
+
+    def test_mixed_wave_each_matches_own_oracle(self, params, adapters,
+                                                lora1, prompts):
+        """One batched wave mixing base + two adapters: the gathered
+        batched matmul routes each ROW through its own slot — every
+        request matches ITS oracle (base or merged) bitwise."""
+        m1 = mk(merge_lora(params, adapters["a1"]), lora=False)
+        m2 = mk(merge_lora(params, adapters["a2"]), lora=False)
+        base = mk(params, lora=False)
+        ids = [None, "a1", "a2", "a1"]
+        got = run_wave(lora1, prompts, adapter_ids=ids)
+        oracles = {None: base, "a1": m1, "a2": m2}
+        for g, p, a in zip(got, prompts, ids):
+            want = run_wave(oracles[a], [p])[0]
+            np.testing.assert_array_equal(g, want), a
+
+    def test_chain_key_namespace_unit(self):
+        """The namespaced chain-key formula itself (host-only): adapter
+        namespaces hash into disjoint key spaces over identical tokens,
+        ``None`` reproduces the un-namespaced chain exactly, and
+        incremental resumption from a prior key is namespace-oblivious
+        (the seed only matters at the chain root)."""
+        from paddle_tpu.inference.serving.paged_cache import (
+            prefix_block_chain)
+        ids = list(range(16))
+        base = list(prefix_block_chain(ids, 8, 16))
+        a = list(prefix_block_chain(ids, 8, 16, namespace="a1"))
+        b = list(prefix_block_chain(ids, 8, 16, namespace="a2"))
+        assert base == list(prefix_block_chain(ids, 8, 16, namespace=None))
+        assert [t for _, t in base] == [t for _, t in a]
+        assert {k for k, _ in base}.isdisjoint(k for k, _ in a)
+        assert {k for k, _ in a}.isdisjoint(k for k, _ in b)
+        tail = list(prefix_block_chain(ids[8:], 8, 16, start=1,
+                                       prev_key=a[0][0], base=8,
+                                       namespace="a1"))
+        assert tail == a[1:]
+
+    def test_prefix_cache_is_adapter_namespaced(self, params, adapters,
+                                                lora1, bert):
+        """Adapter KV differs from base KV for EQUAL tokens (the k/v
+        projections carry the delta), so the prefix-cache chain key is
+        seeded by the adapter id: a base wave's cached blocks must never
+        prefix-hit a same-prompt adapter request (regression — an
+        unnamespaced key served base KV to the adapter stream), while
+        the adapter's own resubmission hits its own chain and stays
+        parity-exact."""
+        eng = mk(params, adapters=adapters, programs=lora1.programs,
+                 embed=bert)
+        rng = np.random.default_rng(11)    # spans a full block over p[:-1]
+        p = rng.integers(0, CFG.vocab_size, (12,)).astype(np.int32)
+        run_wave(eng, [p])                             # seed the base chain
+        hit0 = eng.stats()["prefix_hit_tokens"]
+        got = run_wave(eng, [p], adapter_ids=["a1"])
+        assert eng.stats()["prefix_hit_tokens"] == hit0   # no cross-hit
+        want = np.asarray(G.generate(
+            merge_lora(params, adapters["a1"]), jnp.asarray(p[None]), CFG,
+            max_new_tokens=10))[0]
+        np.testing.assert_array_equal(got[0], want)
+        got2 = run_wave(eng, [p], adapter_ids=["a1"])  # own chain DOES hit
+        assert eng.stats()["prefix_hit_tokens"] > hit0
+        np.testing.assert_array_equal(got2[0], got[0])
+
+
+# ---------------------------------------------------------------------------
+# compile-once across churn + LRU evict/reload
+# ---------------------------------------------------------------------------
+
+class TestPoolChurn:
+    def test_churn_never_recompiles(self, lora1, prompts):
+        """Five adapters through two slots: every wave evicts and
+        reloads, yet the trace counters stay flat — adapter ids are a
+        device operand, not a program constant."""
+        run_wave(lora1, prompts[:2], adapter_ids=["a1", "a2"], n=4)
+        before = {k: v for k, v in lora1.stats().items()
+                  if k.endswith("_traces")}
+        loads0 = lora1.stats()["lora"]["adapter_loads"]
+        for name in ("a3", "a4", "a5", "a1", "a2"):
+            run_wave(lora1, prompts[:2], adapter_ids=[name, None], n=4)
+        after = lora1.stats()
+        for k, v in before.items():
+            assert after[k] == v, k
+        assert after["lora"]["adapter_loads"] > loads0
+        assert after["lora"]["adapter_evictions"] > 0
+
+    def test_evict_reload_bit_exact(self, params, adapters, lora1,
+                                    prompts):
+        """An adapter evicted by churn and faulted back in serves the
+        identical stream — the H2D reload (checksummed host copy) is
+        bit-exact."""
+        first = run_wave(lora1, prompts[:1], adapter_ids=["a1"])
+        # churn a1 out through the 2-slot pool
+        for name in ("a3", "a4", "a5"):
+            run_wave(lora1, prompts[:1], adapter_ids=[name], n=2)
+        part = lora1.adapter_partition()
+        assert "a1" in part["evicted"]
+        again = run_wave(lora1, prompts[:1], adapter_ids=["a1"])
+        assert _parity(first, again)
+
+    def test_running_adapter_pinned_against_eviction(self, lora1,
+                                                     prompts):
+        """More distinct adapters in flight than slots: admission gates
+        the overflow instead of evicting a RUNNING adapter; everyone
+        finishes, pins drain to zero, and the auditor's partition check
+        holds mid-flight."""
+        auditor = InvariantAuditor()
+        ids = ["a1", "a2", "a3", "a4"]          # 4 adapters, 2 slots
+        rids = [lora1.submit(p, max_new_tokens=6, eos_token_id=None,
+                             adapter_id=a)
+                for p, a in zip(prompts, ids)]
+        steps = 0
+        while lora1.pending:
+            lora1.step()
+            auditor.check(lora1)
+            part = lora1.adapter_partition()
+            assert len(part["resident"]) <= LORA["lora_slots"]
+            steps += 1
+            assert steps < 200
+        for r in rids:
+            assert lora1.request(r).state == "finished"
+        part = lora1.adapter_partition()
+        assert part["pinned"] == {}
+        assert part["running"] == {}
+
+    def test_corrupt_host_copy_refused(self, params, adapters):
+        """A bit-flipped COLD host copy fails its load-time checksum
+        with a structured error instead of serving wrong weights."""
+        pool = AdapterPool(CFG, RANK, 1, 4)
+        pool.register("x", adapters["a1"])
+        pool.register("y", adapters["a2"])
+        pool.acquire("x")                       # y stays cold
+        pool.release("x")                       # unpinned -> evictable
+        victim = pool.corrupt_one()
+        assert victim == "y"
+        with pytest.raises(RuntimeError, match="checksum"):
+            pool.acquire("y")
+
+
+# ---------------------------------------------------------------------------
+# durability + fleet: adapter identity survives crash and failover
+# ---------------------------------------------------------------------------
+
+class TestDurabilityAndFleet:
+    def test_journal_recovery_preserves_adapter(self, params, adapters,
+                                                lora1, bert, prompts,
+                                                tmp_path):
+        """Kill -9 mid-stream (journal abandoned), recover with the
+        adapter registry re-supplied: the adapter request completes
+        bit-identically to the unkilled run, through the same shared
+        programs (no recompile)."""
+        want = run_wave(lora1, prompts[:2], adapter_ids=["a1", None])
+        j = RequestJournal(str(tmp_path))
+        sup = EngineSupervisor(params, CFG,
+                               ServingConfig(**BASE, **LORA),
+                               programs=lora1.programs, journal=j,
+                               embed_model=bert)
+        for name, ap in adapters.items():
+            sup.register_adapter(name, ap)
+        r1 = sup.submit(prompts[0], max_new_tokens=10, eos_token_id=None,
+                        adapter_id="a1")
+        r2 = sup.submit(prompts[1], max_new_tokens=10, eos_token_id=None)
+        sup.step(max_iters=1)
+        chaos.process_kill(sup)
+        rec = EngineSupervisor.recover(str(tmp_path), params, CFG,
+                                       ServingConfig(**BASE, **LORA),
+                                       programs=lora1.programs,
+                                       embed_model=bert,
+                                       adapters=adapters)
+        while rec.pending:
+            rec.step()
+        rec_by_jid = {tr.jid: srid for srid, tr in rec._reqs.items()}
+        for i, r in enumerate((r1, r2)):
+            srid = rec_by_jid[sup.request(r).jid]
+            np.testing.assert_array_equal(rec.result(srid), want[i])
+        a1_srid = rec_by_jid[sup.request(r1).jid]
+        assert rec._reqs[a1_srid].adapter_id == "a1"
+
+    def test_recovery_without_adapter_fails_structured(self, params,
+                                                       adapters, lora1,
+                                                       bert, prompts,
+                                                       tmp_path):
+        """Recovering a journal whose records carry an adapter_id that
+        is NOT re-registered fails those requests with a reason naming
+        the adapter — never silently serves base weights."""
+        j = RequestJournal(str(tmp_path))
+        sup = EngineSupervisor(params, CFG,
+                               ServingConfig(**BASE, **LORA),
+                               programs=lora1.programs, journal=j,
+                               embed_model=bert)
+        sup.register_adapter("a1", adapters["a1"])
+        rid = sup.submit(prompts[0], max_new_tokens=10,
+                         eos_token_id=None, adapter_id="a1")
+        sup.step(max_iters=1)
+        jid = sup.request(rid).jid
+        chaos.process_kill(sup)
+        rec = EngineSupervisor.recover(str(tmp_path), params, CFG,
+                                       ServingConfig(**BASE, **LORA),
+                                       programs=lora1.programs,
+                                       embed_model=bert)
+        tr = next(t for t in rec._reqs.values() if t.jid == jid)
+        assert tr.state == "failed"
+        assert "a1" in tr.finish["reason"]
+        assert "not registered" in tr.finish["reason"]
+
+    def test_failover_preserves_adapter(self, params, adapters, lora1,
+                                        bert, prompts):
+        """A replica dying mid-stream fails its adapter request over to
+        the healthy replica, which re-pins the SAME adapter: delivered
+        tokens concatenate to the single-engine LoRA oracle exactly."""
+        want = run_wave(lora1, prompts[:2], adapter_ids=["a1", "a2"])
+        r = ServingRouter(params, CFG, ServingConfig(**BASE, **LORA),
+                          replicas=2, programs=lora1.programs,
+                          embed_model=bert)
+        for name, ap in adapters.items():
+            r.register_adapter(name, ap)
+        frids = [r.submit(p, max_new_tokens=10, eos_token_id=None,
+                          adapter_id=a)
+                 for p, a in zip(prompts[:2], ["a1", "a2"])]
+        delivered = {f: [] for f in frids}
+        for f, toks in r.step(1).items():
+            delivered[f].extend(toks)
+        chaos.replica_kill(r, rid=r.replicas[0])
+        steps = 0
+        while r.pending and steps < 300:
+            for f, toks in r.step(2).items():
+                delivered[f].extend(toks)
+            steps += 1
+        snap = r.health_snapshot()
+        assert snap["counters"]["failed"] == 0
+        for f, w in zip(frids, want):
+            np.testing.assert_array_equal(
+                np.asarray(delivered[f], np.int32), w)
+        for part in r.block_partitions().values():
+            assert part["in_use"] == 0
+
+    def test_router_rejects_unregistered_adapter(self, params, lora1,
+                                                 bert, prompts):
+        r = ServingRouter(params, CFG, ServingConfig(**BASE, **LORA),
+                          replicas=1, programs=lora1.programs,
+                          embed_model=bert)
+        with pytest.raises(ValueError, match="not registered"):
+            r.submit(prompts[0], max_new_tokens=2, adapter_id="nope")
+
+    def test_adapter_affinity_routing(self, params, adapters, lora1,
+                                      bert, prompts):
+        """Repeat traffic for one adapter lands on the replica already
+        holding it resident (affinity hits), instead of faulting the
+        adapter into every replica."""
+        r = ServingRouter(params, CFG, ServingConfig(**BASE, **LORA),
+                          replicas=2, programs=lora1.programs,
+                          embed_model=bert)
+        for name, ap in adapters.items():
+            r.register_adapter(name, ap)
+        for _ in range(4):
+            frid = r.submit(prompts[0], max_new_tokens=2,
+                            eos_token_id=None, adapter_id="a1")
+            while r.pending:
+                r.step()
+            assert r.request(frid).state == "finished"
+        snap = r.health_snapshot()
+        assert snap["counters"]["adapter_affinity_hits"] >= 3
+        assert snap["counters"]["adapter_loads"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# embeddings endpoint (prefill-only request kind)
+# ---------------------------------------------------------------------------
+
+class TestEmbeddings:
+    def test_matches_direct_encode_and_pad_invariant(self, lora1):
+        """Engine-served embeddings equal bert_encode run directly, and
+        a row's embedding is invariant to WHO it was batched with (the
+        bucketed pad rows never leak into real rows)."""
+        rng = np.random.default_rng(5)
+        ps = [rng.integers(0, BCFG.vocab_size, (int(s),)).astype(np.int32)
+              for s in (4, 9, 6)]
+        erids = [lora1.submit_embedding(p) for p in ps]
+        got = embed_drain(lora1, erids)
+        bparams = bert_init_params(BCFG, seed=3)
+        for g, p in zip(got, ps):
+            ids = np.zeros((1, len(p)), np.int32)
+            ids[0, :len(p)] = p
+            want = np.asarray(bert_encode(bparams, BCFG, jnp.asarray(ids),
+                                          jnp.asarray([len(p)])))[0]
+            np.testing.assert_array_equal(np.asarray(g), want)
+        # solo resubmission of the middle prompt: identical row
+        [solo_row] = embed_drain(lora1, [lora1.submit_embedding(ps[1])])
+        np.testing.assert_array_equal(solo_row, np.asarray(got[1]))
+        assert lora1.stats()["embeds"] >= 4
+
+    def test_embeds_hold_no_kv(self, lora1):
+        """An embedding request retires at prefill completion without
+        ever touching the paged KV pool or a decode slot."""
+        in_use0 = lora1.cache.manager.blocks_in_use
+        embed_drain(lora1, [lora1.submit_embedding(
+            np.arange(1, 7, dtype=np.int32))])
+        assert lora1.cache.manager.blocks_in_use == in_use0
+
+    def test_no_encoder_structured_error(self, base1):
+        with pytest.raises(ValueError, match="embed_model"):
+            base1.submit_embedding(np.arange(1, 5, dtype=np.int32))
+
+    def test_router_embed_batch(self, params, lora1, bert):
+        """The router's synchronous embed() fans a batch to one replica
+        and returns stacked rows equal to the engine-level result."""
+        r = ServingRouter(params, CFG, ServingConfig(**BASE, **LORA),
+                          replicas=2, programs=lora1.programs,
+                          embed_model=bert)
+        rng = np.random.default_rng(9)
+        ps = [rng.integers(0, BCFG.vocab_size, (int(s),)).astype(np.int32)
+              for s in (5, 8)]
+        rows = r.embed(ps)
+        assert rows.shape == (2, BCFG.hidden_size)
+        want = embed_drain(lora1, [lora1.submit_embedding(p) for p in ps])
+        for row, w in zip(rows, want):
+            np.testing.assert_array_equal(row, w)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle fuzz under the auditor + observability surface
+# ---------------------------------------------------------------------------
+
+class TestLifecycleAndObservability:
+    def test_replay_fuzz_with_churn_under_auditor(self, params):
+        """A Zipf adapter mix driven through the fleet with the
+        adapter_churn injector firing mid-traffic; the auditor's
+        adapter_pool_partition check runs throughout (violations raise)
+        and the fleet drains with zero leaked blocks."""
+        from paddle_tpu.inference.serving.workload import (WorkloadSpec,
+                                                           run_replay)
+        from paddle_tpu.testing.chaos import ChaosEvent, ChaosTimeline
+        spec = WorkloadSpec(requests=16, seed=3, adapters=4,
+                            audit_every=4, autoscale_every=0,
+                            misbehavior_frac=0.0)
+        tl = ChaosTimeline([ChaosEvent(3, "adapter_churn", rounds=3,
+                                       seed=7),
+                            ChaosEvent(6, "adapter_churn", rounds=2,
+                                       seed=11)])
+        rep = run_replay(params, CFG, spec=spec, replicas=2, chaos=tl)
+        assert rep["chaos_kinds"] == ["adapter_churn"]
+        assert rep["violations"] == []
+        assert rep["leaked_blocks"] == 0
+        assert rep["adapter_requests"] > 0
+        assert rep["failed"] == 0
+
+    def test_stats_snapshot_partition_fields(self, lora1, base1):
+        st = lora1.stats()["lora"]
+        for k in ("adapters_registered", "adapters_resident",
+                  "adapter_loads", "adapter_evictions", "adapter_pins"):
+            assert k in st, k
+        assert st["adapters_registered"] == 5
+        snap = lora1.health_snapshot()
+        assert "lora" in snap and "lora" in HEALTH_SNAPSHOT_FIELDS
+        assert snap["lora"]["slots"] == LORA["lora_slots"]
+        assert snap["lora"]["rank"] == RANK
+        import json
+        json.dumps(snap)
+        # the new auditor check is registered and vacuous-off on a
+        # LoRA-less engine
+        assert "adapter_pool_partition" in AUDIT_CHECKS
+        assert base1.adapter_partition() is None
+        InvariantAuditor().check(base1)
+
+    def test_adapter_churn_injector_registered(self):
+        assert "adapter_churn" in chaos.INJECTORS
+        assert chaos.LORA_INJECTORS == ("adapter_churn",)
+
+    def test_submit_validation(self, base1, lora1, prompts):
+        with pytest.raises(ValueError, match="lora_slots"):
+            base1.submit(prompts[0], max_new_tokens=2, adapter_id="a1")
+        with pytest.raises(ValueError, match="not registered"):
+            lora1.submit(prompts[0], max_new_tokens=2, adapter_id="zz")
