@@ -273,6 +273,18 @@ define_flag("FLAGS_serving_prefill_chunk", 256,
             "long admission no longer freezes in-flight streams. 0 "
             "disables (whole prompt in one dispatch); ServingConfig("
             "prefill_chunk=None) disables per engine.", int)
+define_flag("FLAGS_serving_mixed_batch", True,
+            "Stall-free mixed batching (ServingConfig.mixed_batch): "
+            "mid-flight prefill chunks ride the decode dispatch as "
+            "extra query rows of ONE mixed multi-query step — per-row "
+            "start/q_len are device operands, so role churn never "
+            "retraces — instead of each prompt running its own B=1 "
+            "chunk dispatch before a separate (decode_chunk-clamped) "
+            "decode dispatch. Decode rows advance every step a prompt "
+            "prefills, and the chunk that completes a prompt samples "
+            "its first token in the same dispatch. Token streams are "
+            "bit-identical either way; False restores the two-phase "
+            "path (the parity oracle).", bool)
 define_flag("FLAGS_serving_preempt", True,
             "On-demand KV paging: a sequence holds only the blocks it has "
             "filled, and when the pool runs dry the newest-admitted "

@@ -86,6 +86,7 @@ API::
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
@@ -161,6 +162,13 @@ HEALTH_SNAPSHOT_FIELDS = {
     "counters": "lifetime totals: admitted / retired / cancelled / "
                 "timed_out / shed / preemptions / oom_truncated / "
                 "prefix_hit_tokens / evictions",
+    "dispatch_latency": "per-kind device-dispatch wall time (ISSUE 20): "
+                        "for each of prefill / decode / mixed / spec, the "
+                        "lifetime dispatch count plus p50_ms / p99_ms over "
+                        "a recent window (null until that kind has "
+                        "dispatched) — the prefill-stall this splits out "
+                        "is exactly what mixed batching removes, so "
+                        "operators can watch it",
     "offload": "host-RAM KV offload tier (FLAGS_serving_offload; ISSUE "
                "16): enabled + the tier's capacity / blocks (host-"
                "resident now) / swap_outs / swap_ins / tier_hits / "
@@ -231,6 +239,13 @@ class EnginePrograms:
     #                     under a different one raises
     embed: Any = None   # prefill-only embeddings encoder (ISSUE 19);
     #                     None when no embed model is attached
+    mixed: Any = None   # mixed prefill+decode step (ISSUE 20): per-row
+    #                     start/q_len device operands, so one executable
+    #                     per Q bucket serves every role mix. Built with
+    #                     the others regardless of ServingConfig.
+    #                     mixed_batch (the flag gates DISPATCH, not
+    #                     shapes), so engines on either side of the flag
+    #                     share one program set
 
 
 @dataclasses.dataclass
@@ -278,6 +293,14 @@ class ServingConfig:
     prefix_cache: Any = _UNSET       # bool; None/False = off
     prefill_chunk: Any = _UNSET      # tokens/chunk; None/0 = whole prompt
     preempt: Any = _UNSET            # bool; None/False = legacy reservation
+    mixed_batch: Any = _UNSET        # bool (ISSUE 20): mid-flight prefill
+    #                                  chunks ride the decode dispatch as
+    #                                  extra query rows of ONE mixed step;
+    #                                  None/False = the two-phase path
+    #                                  (chunk dispatches before a clamped
+    #                                  decode dispatch — the parity
+    #                                  oracle); unset ->
+    #                                  FLAGS_serving_mixed_batch
     # speculative decoding (ISSUE 11)
     spec_decode: Any = _UNSET        # draft tokens per verify dispatch
     #                                  (n-gram prompt lookup); None/0 =
@@ -348,6 +371,10 @@ class ServingConfig:
             self.preempt = bool(flag("FLAGS_serving_preempt"))
         else:
             self.preempt = bool(self.preempt)
+        if self.mixed_batch == _UNSET:
+            self.mixed_batch = bool(flag("FLAGS_serving_mixed_batch"))
+        else:
+            self.mixed_batch = bool(self.mixed_batch)
         if self.prefill_chunk == _UNSET:
             self.prefill_chunk = int(flag("FLAGS_serving_prefill_chunk"))
         self.prefill_chunk = (int(self.prefill_chunk)
@@ -547,22 +574,34 @@ class ServingEngine:
                 programs.prefill, programs.chunk, programs.decode)
             self._jspec, self._jsample = programs.spec, programs.sample
             self._jembed = programs.embed
+            self._jmixed = programs.mixed
             self.programs = programs
         else:
             self._stats = {"decode_traces": 0, "prefill_traces": 0,
                            "chunk_prefill_traces": 0, "chunks": 0,
                            "steps": 0, "spec_traces": 0,
                            "sample_traces": 0, "spec_steps": 0,
-                           "embed_traces": 0, "embeds": 0}
+                           "embed_traces": 0, "embeds": 0,
+                           "mixed_traces": 0, "prefill_dispatches": 0,
+                           "decode_dispatches": 0, "mixed_dispatches": 0,
+                           "spec_dispatches": 0}
             self._prefill_buckets = set()
             (self._jprefill, self._jchunk, self._jdecode, self._jspec,
-             self._jsample) = self._build(jax)
+             self._jsample, self._jmixed) = self._build(jax)
             self._jembed = (self._build_embed(jax)
                             if self._embed_params is not None else None)
             self.programs = EnginePrograms(
                 self._jprefill, self._jchunk, self._jdecode, self._jspec,
                 self._jsample, self._stats, self._prefill_buckets, key,
-                embed=self._jembed)
+                embed=self._jembed, mixed=self._jmixed)
+        # per-dispatch wall-time observability (ISSUE 20): bounded recent
+        # windows per dispatch KIND, feeding the p50/p99 rows stats() and
+        # health_snapshot() expose. Per-engine (not shared with the
+        # programs): latency is a property of THIS replica's host+device,
+        # not of the executables
+        self._dispatch_ms = {k: collections.deque(maxlen=512)
+                             for k in ("prefill", "decode", "mixed",
+                                       "spec")}
 
     # ---- compiled programs ------------------------------------------------
 
@@ -690,6 +729,24 @@ class ServingEngine:
             acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
             return pool, cand, acc
 
+        def mixed_fn(params, pool, tokens, starts, q_lens, active,
+                     block_tables, keys, sample_idx, temp, topk, topp,
+                     lora):
+            """ONE mixed prefill+decode dispatch (ISSUE 20): per-row
+            ``starts``/``q_lens`` DEVICE operands carry each slot's role
+            — a decode slot is a ``q_len == 1`` row sampling its next
+            token, a mid-prefill prompt a ``q_len == n`` row scattering
+            its chunk's KV from ``starts`` (= ``num_computed``); the
+            sampled token is that prompt's FIRST token when the chunk
+            completes it, discarded otherwise. Role churn never
+            retraces: one executable per Q bucket serves every mix."""
+            stats["mixed_traces"] += 1             # trace-time only
+            logits, pool, _drops = G.paged_mixed_step(
+                params, cfg, tokens, starts, q_lens, block_tables, pool,
+                active, use_kernel=use_kernel, lora=lora)
+            return pool, _next_tokens(logits, keys, sample_idx, temp,
+                                      topk, topp)
+
         def sample_fn(logits, keys, idx, temp, topk, topp):
             """First-token sampler over a prefill wave's logits (one
             executable per wave-batch bucket, like prefill itself)."""
@@ -705,6 +762,7 @@ class ServingEngine:
             chunk_fn = functools.partial(chunk_fn, lora=None)
             decode_fn = functools.partial(decode_fn, lora=None)
             spec_fn = functools.partial(spec_fn, lora=None)
+            mixed_fn = functools.partial(mixed_fn, lora=None)
         if self._mesh is not None:
             # tensor parallelism: every pool-touching program runs under
             # shard_map on the replica's "tp" mesh — params enter at the
@@ -745,13 +803,17 @@ class ServingEngine:
             spec_fn = shard_map(spec_fn, mesh=self._mesh,
                                 in_specs=(ps, zs) + (R,) * 11 + ls,
                                 out_specs=(zs, R, R), check_vma=False)
+            mixed_fn = shard_map(mixed_fn, mesh=self._mesh,
+                                 in_specs=(ps, zs) + (R,) * 10 + ls,
+                                 out_specs=(zs, R), check_vma=False)
         donate = donation_supported()
         jpre = jax.jit(prefill_fn, donate_argnums=(4,) if donate else ())
         jchk = jax.jit(chunk_fn, donate_argnums=(5,) if donate else ())
         jdec = jax.jit(decode_fn, donate_argnums=(1,) if donate else ())
         jspec = jax.jit(spec_fn, donate_argnums=(1,) if donate else ())
+        jmix = jax.jit(mixed_fn, donate_argnums=(1,) if donate else ())
         jsamp = jax.jit(sample_fn)
-        return jpre, jchk, jdec, jspec, jsamp
+        return jpre, jchk, jdec, jspec, jsamp, jmix
 
     def _build_embed(self, jax):
         """The prefill-only embeddings program (ISSUE 19): one jitted
@@ -784,6 +846,35 @@ class ServingEngine:
         while b < n:
             b *= 2
         return b
+
+    def _record_dispatch(self, kind: str, t0: float) -> None:
+        """Count + time ONE device dispatch by kind (ISSUE 20). Every
+        dispatch — batched prefill, prefill chunk, embed encode, decode
+        loop, mixed step, spec verify — lands here, so ``chunks`` is the
+        true all-kinds dispatch total (it previously only counted
+        decode/verify dispatches: a prefill-only step reported zero
+        dispatch work), the per-kind ``*_dispatches`` counters split it,
+        and the wall time feeds the bounded window behind the p50/p99
+        dispatch-latency rows in stats()/health_snapshot()."""
+        self._stats["chunks"] += 1
+        self._stats[kind + "_dispatches"] += 1
+        self._dispatch_ms[kind].append((time.time() - t0) * 1e3)
+
+    def _dispatch_latency(self) -> Dict[str, Dict[str, float]]:
+        """p50/p99 dispatch wall time per kind over the recent window —
+        the stall mixed batching removes, as a number operators watch."""
+        out: Dict[str, Dict[str, float]] = {}
+        for kind, window in self._dispatch_ms.items():
+            n = int(self._stats.get(kind + "_dispatches", 0))
+            if window:
+                xs = np.asarray(window, np.float64)
+                out[kind] = {
+                    "count": n,
+                    "p50_ms": round(float(np.percentile(xs, 50)), 3),
+                    "p99_ms": round(float(np.percentile(xs, 99)), 3)}
+            else:
+                out[kind] = {"count": n, "p50_ms": None, "p99_ms": None}
+        return out
 
     # ---- request lifecycle ------------------------------------------------
 
@@ -1579,12 +1670,14 @@ class ServingEngine:
                 tables[r] = self.cache.tables[req.slot]
                 act[r] = True
                 aids[r] = req.adapter_slot
+            t0 = time.time()
             with _watchdog.section("serving.prefill"):
                 logits, self.cache.pool, _ = self._jprefill(
                     self._params, jnp.asarray(ids), jnp.asarray(plens),
                     jnp.asarray(tables), self.cache.pool, jnp.asarray(act),
                     *self._lora_operand(aids))
                 first = self._first_tokens(logits, group, Bb)
+            self._record_dispatch("prefill", t0)
             now = time.time()
             for r, req in enumerate(group):
                 req.num_computed = req.prompt_len
@@ -1621,10 +1714,12 @@ class ServingEngine:
             for r, req in enumerate(grp):
                 ids[r, :req.prompt_len] = req.prompt
                 lens[r] = req.prompt_len
+            t0 = time.time()
             with _watchdog.section("serving.prefill"):
                 pooled = np.asarray(self._jembed(
                     self._embed_params, jnp.asarray(ids),
                     jnp.asarray(lens)))
+            self._record_dispatch("prefill", t0)
             now = time.time()
             for r, req in enumerate(grp):
                 req.embedding = pooled[r]
@@ -1649,6 +1744,7 @@ class ServingEngine:
             ids = np.zeros((1, Sb), np.int32)
             ids[0, :n] = req.prefill_ids[req.num_computed:
                                          req.num_computed + n]
+            t0 = time.time()
             with _watchdog.section("serving.prefill"):
                 logits, self.cache.pool, _ = self._jchunk(
                     self._params, jnp.asarray(ids),
@@ -1657,6 +1753,7 @@ class ServingEngine:
                     jnp.asarray(self.cache.tables[req.slot][None]),
                     self.cache.pool,
                     *self._lora_operand([req.adapter_slot]))
+            self._record_dispatch("prefill", t0)
             req.num_computed += n
             req.reg_state = self.cache.register_prefix(
                 req.prefill_ids, req.blocks, req.num_computed,
@@ -1928,6 +2025,7 @@ class ServingEngine:
             toks[m, 1:1 + len(d)] = d
             toks[m, 1 + len(d):] = self._tokens[m]   # pad: a real token
             dl[m] = len(d)
+        t0 = time.time()
         with _watchdog.section("serving.decode"):
             self.cache.pool, cand, acc = self._jspec(
                 self._params, self.cache.pool, jnp.asarray(toks),
@@ -1939,6 +2037,7 @@ class ServingEngine:
                 *self._lora_operand(self._adapters))
             cand = np.asarray(cand)
             acc = np.asarray(acc)
+        self._record_dispatch("spec", t0)
         for req in decoding:
             m = req.slot
             if self._done[m] or self._steps_left[m] <= 0:
@@ -1970,8 +2069,126 @@ class ServingEngine:
                     namespace=req.adapter_id)
             if not req.finished:
                 self._rollback_blocks(req)
-        self._stats["chunks"] += 1
         self._stats["spec_steps"] += 1
+
+    # ---- mixed batching (ISSUE 20) ----------------------------------------
+
+    def _mixed_dispatch(self, prefills: List[Request],
+                        include_decode: bool,
+                        emitted: Dict[int, List[int]]) -> None:
+        """ONE mixed prefill+decode dispatch: every mid-prefill slot
+        contributes its next chunk as a ``q_len > 1`` row (KV scattered
+        from its per-row ``num_computed`` start), every decoding slot a
+        ``q_len == 1`` row that samples its next token — per-row
+        ``start``/``q_len`` are DEVICE operands of one executable per Q
+        bucket, so role churn never retraces. A chunk that COMPLETES its
+        prompt samples the first token in this same dispatch (TTFT no
+        longer waits for the next step's decode); incomplete chunks and
+        readmission recomputes discard their sampled lane. Block
+        planning, preemption, prefix-cache registration, LoRA operands
+        and journal cursors are exactly the two-phase path's — token
+        streams are bit-identical either way."""
+        import jax.numpy as jnp
+
+        from ...models.generation import seed_key
+        chunk = self.config.prefill_chunk
+        M = self.config.max_slots
+        bs = self.config.block_size
+        decode_rows = [r for r in self._sched.decoding
+                       if include_decode and not self._done[r.slot]
+                       and self._steps_left[r.slot] > 0]
+        plan: List[Tuple[Request, int]] = []
+        qmax = 1
+        for req in prefills:
+            n = len(req.prefill_ids) - req.num_computed
+            if chunk is not None:
+                n = min(n, chunk)
+            plan.append((req, n))
+            qmax = max(qmax, n)
+        Q = self._bucket(qmax)
+        toks = np.zeros((M, Q), np.int32)
+        starts = np.zeros((M,), np.int32)
+        qlens = np.ones((M,), np.int32)           # pad rows: harmless q=1
+        active = np.zeros((M,), bool)
+        keys = np.zeros((M, 2), np.uint32)
+        sidx = np.zeros((M,), np.int32)
+        temp = np.zeros((M,), np.float32)
+        topk = np.zeros((M,), np.int32)
+        topp = np.ones((M,), np.float32)
+        adapters = np.array(self._adapters)
+        for r in decode_rows:
+            m = r.slot
+            toks[m, :] = self._tokens[m]          # pad lanes: a real token
+            starts[m] = self._seq_lens[m]
+            active[m] = True
+            keys[m] = self._keys[m]
+            sidx[m] = self._sample_idx[m]
+            temp[m] = self._temp[m]
+            topk[m] = self._topk[m]
+            topp[m] = self._topp[m]
+        for req, n in plan:
+            m = req.slot
+            ids = req.prefill_ids[req.num_computed:req.num_computed + n]
+            toks[m, :n] = ids
+            toks[m, n:] = ids[-1]                 # pad lanes: a real token
+            starts[m] = req.num_computed
+            qlens[m] = n
+            active[m] = True
+            # the completing chunk's sampled lane IS the prompt's first
+            # token: the same (seed, index 0) key _first_tokens uses
+            keys[m] = seed_key(req.seed)
+            sidx[m] = 0
+            temp[m] = req.temperature
+            topk[m] = req.top_k if req.top_k is not None else 0
+            topp[m] = req.top_p if req.top_p is not None else 1.0
+            adapters[m] = req.adapter_slot
+        t0 = time.time()
+        with _watchdog.section("serving.decode"):
+            self.cache.pool, nxt = self._jmixed(
+                self._params, self.cache.pool, jnp.asarray(toks),
+                jnp.asarray(starts), jnp.asarray(qlens),
+                jnp.asarray(active), jnp.asarray(self.cache.tables),
+                jnp.asarray(keys), jnp.asarray(sidx), jnp.asarray(temp),
+                jnp.asarray(topk), jnp.asarray(topp),
+                *self._lora_operand(adapters))
+            nxt = np.asarray(nxt)
+        self._record_dispatch("mixed", t0)
+        now = time.time()
+        # prefill rows first (the two-phase path's bookkeeping order:
+        # _advance_prefills before the decode dispatch's commits)
+        for req, n in plan:
+            m = req.slot
+            req.num_computed += n
+            req.reg_state = self.cache.register_prefix(
+                req.prefill_ids, req.blocks, req.num_computed,
+                req.reg_state, tenant=req.tenant,
+                namespace=req.adapter_id)
+            if req.prefilling:
+                continue                          # more chunks to go
+            if req.tokens:                        # readmission: resume
+                self._start_decode(req)
+            else:
+                self._emit_first(req, int(nxt[m]), now, emitted)
+        # decode rows: exactly one iteration of the decode loop's commit
+        for req in decode_rows:
+            m = req.slot
+            t = int(nxt[m])
+            req.tokens.append(t)
+            emitted.setdefault(req.rid, []).append(t)
+            self._tokens[m] = t
+            self._seq_lens[m] += 1
+            self._steps_left[m] -= 1
+            self._sample_idx[m] = len(req.tokens)
+            if req.eos_token_id is not None and t == req.eos_token_id:
+                self._done[m] = True
+                req.eos_seen = True
+            sl = int(self._seq_lens[m])
+            base = req.reg_state[0] * bs
+            if self.config.prefix_cache and sl // bs > req.reg_state[0]:
+                req.reg_state = self.cache.register_prefix(
+                    self._chain_ids(req, base, sl), req.blocks, sl,
+                    req.reg_state, base=base, tenant=req.tenant,
+                    namespace=req.adapter_id)
 
     # ---- the scheduler iteration ------------------------------------------
 
@@ -1997,7 +2214,13 @@ class ServingEngine:
         self._expire_deadlines(time.time())
         self._sched.retire_finished()
         self._admit(emitted)
-        self._advance_prefills(emitted)
+        if not self.config.mixed_batch:
+            # two-phase path (the parity oracle): one B=1 chunk dispatch
+            # per mid-prefill slot BEFORE the decode dispatch, which
+            # _limit then clamps at decode_chunk while any prompt is
+            # mid-prefill. In mixed mode the chunks ride the mixed
+            # dispatch below instead, so the clamp never engages.
+            self._advance_prefills(emitted)
         k = 0
         decoding = self._sched.decoding
         if decoding and self._spec_k:
@@ -2018,6 +2241,25 @@ class ServingEngine:
                     self._stats["steps"] += 1
                     return emitted
             decoding = self._sched.decoding
+        if self.config.mixed_batch and \
+                any(r.prefilling for r in self._sched.live):
+            # mixed batching (ISSUE 20): every mid-prefill slot's chunk
+            # rides the decode dispatch as a q_len > 1 row of ONE mixed
+            # step — no per-prompt B=1 chunk dispatches, no decode_chunk
+            # clamp, and decoding slots advance in the SAME step a new
+            # prompt prefills. Precedence: a step with spec drafts
+            # dispatched verify above and never reaches here. Block
+            # planning is the decode planner's (_ensure_blocks for the
+            # decode rows' one iteration; a preemption inside it may
+            # shrink either role set, so both are re-read after).
+            kd = self._ensure_blocks(1) if decoding else 0
+            prefills = [r for r in self._sched.live if r.prefilling]
+            if prefills:
+                self._mixed_dispatch(prefills, kd >= 1, emitted)
+                self._sched.retire_finished()
+                self._stats["steps"] += 1
+                return emitted
+            decoding = self._sched.decoding
         if decoding:
             want = self._limit(decoding, max_iters)
             k = self._ensure_blocks(want)
@@ -2030,6 +2272,7 @@ class ServingEngine:
                 k = min(k, self._limit(decoding, max_iters))
         if decoding and k >= 1:
             before = self._steps_left.copy()
+            t0 = time.time()
             with _watchdog.section("serving.decode"):
                 (self.cache.pool, tokens, seq_lens, steps_left, done,
                  toks) = self._jdecode(
@@ -2043,6 +2286,7 @@ class ServingEngine:
                     jnp.asarray(self._topp),
                     *self._lora_operand(self._adapters))
                 toks = np.asarray(toks)
+            self._record_dispatch("decode", t0)
             # np.array (copy): zero-copy views of jax outputs are read-only,
             # and admission writes these slots in place next step
             self._tokens = np.array(tokens)
@@ -2071,7 +2315,6 @@ class ServingEngine:
                         self._chain_ids(req, base, sl), req.blocks, sl,
                         req.reg_state, base=base, tenant=req.tenant,
                         namespace=req.adapter_id)
-            self._stats["chunks"] += 1
             self._sched.retire_finished()
         self._stats["steps"] += 1
         return emitted
@@ -2190,6 +2433,7 @@ class ServingEngine:
                 "kv_pool_bytes": self.cache.kv_bytes(),
                 "kv_pool_shard_bytes": self.cache.kv_bytes(per_shard=True),
                 "kv_pool_mb": round(self.cache.kv_bytes() / 2**20, 2),
+                "dispatch_latency": self._dispatch_latency(),
                 "offload": (self.cache.offload.stats()
                             if self.cache.offload is not None else None),
                 "lora": (self._lora.stats()
@@ -2283,6 +2527,7 @@ class ServingEngine:
                 "prefix_hit_tokens": sched.prefix_hit_tokens,
                 "evictions": self.cache.manager.evictions,
             },
+            "dispatch_latency": self._dispatch_latency(),
             "offload": {
                 "enabled": self.cache.offload is not None,
                 **(self.cache.offload.stats()

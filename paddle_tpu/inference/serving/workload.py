@@ -104,6 +104,16 @@ class WorkloadSpec:
     adapters: int = 0                 # distinct adapters ("lora0"..)
     adapter_frac: float = 0.75        # requests carrying an adapter_id
     adapter_zipf_alpha: float = 1.2   # hot-adapter skew
+    # ---- long-prompt mix (ISSUE 20) ----
+    # long_prompt_frac=0 keeps the trace rng-draw free (byte-identical
+    # old seeds). >0 extends that fraction of prompts with fresh tokens
+    # up to ~long_prompt_len — prompts that must CHUNK through
+    # prefill_chunk-sized pieces, the mid-flight-prefill pressure mixed
+    # batching (FLAGS_serving_mixed_batch) absorbs into the decode
+    # dispatch. Extension is appended at the prompt END so family
+    # prefixes (and router affinity keys) stay intact.
+    long_prompt_frac: float = 0.0     # requests stretched to ~long len
+    long_prompt_len: int = 48         # target total prompt length
     # ---- 429/503 retry policy ----
     # "fixed": back off retry_backoff_steps engine steps per attempt —
     # deterministic, the replay-determinism contract's setting. "hint":
@@ -251,6 +261,16 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
         if spec.adapters > 0 and rng.random() < spec.adapter_frac:
             tr.adapter_id = \
                 f"lora{int(rng.choice(spec.adapters, p=ad_w))}"
+        # also gated LAST (after the adapter draw) for the same reason:
+        # long_prompt_frac=0 draws nothing, old seeds stay byte-identical
+        if spec.long_prompt_frac > 0 and \
+                rng.random() < spec.long_prompt_frac:
+            ext = int(spec.long_prompt_len) - len(tr.prompt)
+            if ext > 0:
+                tr.prompt = np.concatenate(
+                    [tr.prompt,
+                     rng.integers(0, spec.vocab_size,
+                                  (ext,)).astype(np.int32)])
         out.append(tr)
     return out
 

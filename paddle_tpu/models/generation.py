@@ -63,7 +63,8 @@ __all__ = ["GenerationConfig", "init_cache", "prefill", "decode_step",
            "make_generate_fn", "generate", "DecodeSession",
            "init_paged_pool", "paged_pool_block_bytes", "paged_pool_specs",
            "paged_prefill", "paged_prefill_chunk", "paged_decode_step",
-           "paged_spec_step", "sample_tokens", "seed_key",
+           "paged_spec_step", "paged_mixed_step", "sample_tokens",
+           "seed_key",
            "validate_sampling", "validate_tp"]
 
 
@@ -1076,6 +1077,55 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
     with ``draft_lens``) — block tables consumed in-kernel, one K/V block
     DMA per kv head scored against all ``Q`` query rows. Returns
     (logits ``[M, Q, V]``, pool, dropped_tokens)."""
+    x, pool, drops = _paged_multiquery_forward(
+        params, cfg, tokens, seq_lens, draft_lens, block_tables, pool,
+        active, use_kernel, lora)
+    return _lm_head_all(params, cfg, x), pool, drops
+
+
+def paged_mixed_step(params: Dict, cfg: LlamaConfig, tokens, starts,
+                     q_lens, block_tables, pool: Dict, active,
+                     use_kernel: bool = False, lora=None):
+    """ONE mixed prefill+decode iteration over ``M`` serving slots: each
+    row carries a per-row ROLE through two device operands, so role churn
+    (which slots are mid-prefill vs decoding this step) never retraces.
+
+    ``tokens [M, Q]`` — row ``m`` holds ``q_lens[m] <= Q`` real tokens
+    (pad lanes repeat a real token; their K/V scatter is masked to the
+    null block); ``starts [M]`` — KV entries already committed for the
+    row (``num_computed`` for a mid-prefill prompt, ``seq_len`` for a
+    decoding slot). A decode slot is the ``q_lens == 1`` degenerate case
+    — exactly :func:`paged_decode_step`'s computation; a prefill chunk is
+    a ``q_lens == n`` row writing K/V for positions ``[starts, starts +
+    n)`` with query ``q`` attending ``j <= starts + q`` — exactly
+    :func:`paged_prefill_chunk`'s causal window. Both are the
+    ``draft_lens = q_lens - 1`` specialization of the speculative-verify
+    forward (:func:`paged_spec_step`), which is what this shares, so the
+    kernel's multi-query entry and the gather oracle serve all three
+    unchanged.
+
+    Returns ``(logits [M, V], pool, dropped_tokens)`` where ``logits[m]``
+    is the next-token distribution after the row's LAST real token — a
+    decode slot's next sample, or a prompt-completing chunk's FIRST
+    token, sampled in the same dispatch that finished its prefill."""
+    draft_lens = jnp.maximum(q_lens - 1, 0)
+    x, pool, drops = _paged_multiquery_forward(
+        params, cfg, tokens, starts, draft_lens, block_tables, pool,
+        active, use_kernel, lora)
+    last = jnp.take_along_axis(x, draft_lens[:, None, None], axis=1)
+    return _lm_head(params, cfg, last), pool, drops
+
+
+def _paged_multiquery_forward(params: Dict, cfg: LlamaConfig, tokens,
+                              seq_lens, draft_lens, block_tables,
+                              pool: Dict, active, use_kernel: bool,
+                              lora):
+    """The multi-query decode iteration both :func:`paged_spec_step` and
+    :func:`paged_mixed_step` are views of: embed ``tokens [M, Q]``, write
+    K/V for every valid query position ``seq_lens + q`` (``q <=
+    draft_lens``), attend ``j <= seq_lens + min(q, draft_lens)``, and
+    return the hidden states ``[M, Q, E]`` (plus pool and MoE drops) —
+    the callers differ only in which positions they project to logits."""
     M, Q = tokens.shape
     H, Hk = _local_heads(cfg, pool)    # the shard's head slice under TP
     D = cfg.head_dim
@@ -1135,4 +1185,4 @@ def paged_spec_step(params: Dict, cfg: LlamaConfig, tokens, seq_lens,
         return h, (pz, drops)
 
     x, (pool, drops) = lax.scan(body, x, _lora_xs(params, pool, lora))
-    return _lm_head_all(params, cfg, x), pool, drops.sum()
+    return x, pool, drops.sum()

@@ -261,6 +261,22 @@ def test_bench_serve_entry_point():
     assert detail["lora_leaked_blocks"] == 0
     assert "serving_lora_adapter_overhead_pct" in metrics
     assert "serving_lora_adapters_per_replica" in metrics
+    # mixed-batching row (ISSUE 20): chunked prefill fused into the
+    # decode dispatch — mixed streams bit-equal to the two-phase AND
+    # dense oracles, chat TPOT p99 under long-prompt admission strictly
+    # better than two-phase, fewer dispatches per step, ONE mixed
+    # executable across role churn, zero leaked blocks; the asserts also
+    # live in-section, the smoke pins the record + both metrics so the
+    # row cannot silently vanish.
+    assert detail["mixed_outputs_match"] is True
+    assert detail["mixed_tpot_p99_ratio"] > 1.0
+    assert detail["mixed_dispatches_per_step"] < \
+        detail["unmixed_dispatches_per_step"]
+    assert detail["mixed_traces"] == 1
+    assert detail["mixed_recompiles_constant"] is True
+    assert detail["mixed_leaked_blocks"] == 0
+    assert "serving_mixed_tpot_p99_ratio" in metrics
+    assert "serving_mixed_dispatches_per_step" in metrics
 
 
 def test_bench_health_entry_point():

@@ -621,3 +621,61 @@ class TestDisaggReplay:
         fired = {name for _, name, _ in rep["chaos_fired"]} \
             if "chaos_fired" in rep else set(rep["chaos_kinds"])
         assert fired & set(DISAGG_INJECTORS)
+
+
+# ---------------------------------------------------------------------------
+# mixed batching under chaos (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestMixedBatchReplay:
+    def test_long_prompt_knob_gated_last(self):
+        """long_prompt_frac=0 draws nothing: every previously generated
+        seed keeps its byte-identical trace; >0 stretches that fraction
+        of prompts toward long_prompt_len at the END (family prefixes —
+        and the affinity keys hashed from them — stay intact)."""
+        from paddle_tpu.inference.serving import generate_trace
+        base = generate_trace(small_spec())
+        again = generate_trace(small_spec(long_prompt_frac=0.0))
+        for x, y in zip(base, again):
+            np.testing.assert_array_equal(x.prompt, y.prompt)
+        long = generate_trace(small_spec(long_prompt_frac=0.5,
+                                         long_prompt_len=24))
+        stretched = [y for y in long if len(y.prompt) == 24]
+        assert len(stretched) >= len(base) // 4
+        # arrivals are drawn before the per-request loop, so the knob
+        # never reshapes the arrival curve
+        for x, y in zip(base, long):
+            assert x.arrival_step == y.arrival_step
+        # extension lands at the END: stretched family rows still OPEN
+        # with their family's shared prefix (the prefix-cache unit)
+        by_fam = {}
+        for y in stretched:
+            if y.family is not None:
+                by_fam.setdefault(y.family, []).append(y.prompt[:8])
+        assert any(len(v) >= 2 for v in by_fam.values())
+        for rows in by_fam.values():
+            for p in rows[1:]:
+                np.testing.assert_array_equal(rows[0], p)
+
+    def test_mixed_fleet_replay_clean_under_chaos(self, setup):
+        """The chaos timeline over a MIXED fleet: chunked long prompts
+        riding the decode dispatch (prefill_chunk=4, mixed_batch on),
+        every chaos kind armed, full audit — zero violations, zero
+        leaks, failed == 0. The two-phase path's invariants hold
+        verbatim because block planning / preemption / registration /
+        journal cursors are shared between the paths."""
+        from paddle_tpu.inference.serving import run_replay
+        cfg, params, programs = setup
+        spec = small_spec(requests=40, horizon_steps=30,
+                          long_prompt_frac=0.4, long_prompt_len=24,
+                          output_lens=(3, 4, 6))
+        rep = run_replay(params, cfg, spec=spec,
+                         serving_config=serving_config(prefill_chunk=4,
+                                                       mixed_batch=True),
+                         replicas=2, chaos_events=6, programs=programs)
+        assert rep["violations"] == []
+        assert rep["failed"] == 0 and rep["router_failed"] == 0
+        assert rep["gave_up"] == 0
+        assert rep["leaked_blocks"] == 0
+        assert rep["drain_report"]["leaked_blocks"] == 0
+        assert rep["completed"] >= rep["requests"] * 0.7

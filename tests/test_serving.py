@@ -338,13 +338,40 @@ class TestRecompileBounds:
         want = dense_rows(params, cfg, prompts[:2], [8, 4])
         for g, w in zip(got, want):
             np.testing.assert_array_equal(np.asarray(g), w)
-        assert eng.stats()["chunks"] == 1
+        assert eng.stats()["decode_dispatches"] == 1
         # queued trace: 4 one-slot waves of budget 4 (3 steps after the
         # prefill token) -> retirement-aligned dispatches, not ceil(3/2)
         # chunks per wave
         eng2 = make_engine(params, cfg, max_slots=1)
         eng2.run(prompts[:4], max_new_tokens=4, eos_token_id=None)
-        assert eng2.stats()["chunks"] == 4
+        assert eng2.stats()["decode_dispatches"] == 4
+
+    def test_every_dispatch_kind_counts(self, setup):
+        """ISSUE 20 satellite: ``chunks`` counts EVERY device dispatch
+        (it used to increment only on decode/spec dispatches, so a
+        prefill-only step reported zero dispatch work) and the per-kind
+        split sums to it."""
+        cfg, params, prompts, outs = setup
+        eng = make_engine(params, cfg)
+        for p in prompts[:3]:
+            eng.submit(p, max_new_tokens=4, eos_token_id=None)
+        eng.step()                       # admission: prefill dispatches
+        st = eng.stats()
+        # the old counter ignored prefill dispatches entirely
+        assert st["prefill_dispatches"] > 0
+        assert st["chunks"] >= st["prefill_dispatches"]
+        while eng.stats()["live_slots"] or eng.stats()["queued"]:
+            eng.step()
+        st = eng.stats()
+        kinds = (st["prefill_dispatches"] + st["decode_dispatches"] +
+                 st["mixed_dispatches"] + st["spec_dispatches"])
+        assert st["chunks"] == kinds > 0
+        lat = st["dispatch_latency"]
+        assert set(lat) == {"prefill", "decode", "mixed", "spec"}
+        for kind in ("prefill", "decode"):
+            assert lat[kind]["count"] == st[kind + "_dispatches"] > 0
+            assert lat[kind]["p50_ms"] is not None
+            assert lat[kind]["p99_ms"] >= lat[kind]["p50_ms"] > 0
 
 
 class TestUnifiedGenerationConfig:
@@ -713,7 +740,10 @@ class TestChunkedPrefill:
     def test_chunked_parity(self, setup):
         """Long prompts prefilled in fixed-size chunks: greedy outputs are
         bit-identical to the dense path, and the decode executable still
-        compiles exactly once."""
+        compiles exactly once. With mixed batching (the default) the
+        chunks ride the fused mixed dispatch instead of the dedicated
+        chunk program — that two-phase program's own parity is pinned by
+        the mixed_batch=False oracles in test_serving_mixed.py."""
         cfg, params, prompts, outs = setup
         eng = make_engine(params, cfg, prefill_chunk=4)
         got = eng.run(prompts, max_new_tokens=outs, eos_token_id=None)
@@ -721,7 +751,8 @@ class TestChunkedPrefill:
         for g, w in zip(got, want):
             np.testing.assert_array_equal(np.asarray(g), w)
         st = eng.stats()
-        assert st["chunk_prefill_traces"] >= 1   # long prompts chunked
+        assert st["mixed_dispatches"] >= 1       # long prompts chunked
+        assert st["mixed_traces"] == 1           # through the fused step
         assert st["decode_traces"] == 1
 
     def test_decode_interleaves_with_long_admission(self, setup):
